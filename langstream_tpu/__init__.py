@@ -1,0 +1,29 @@
+"""langstream-tpu: a TPU-native streaming-LLM application framework.
+
+A ground-up rebuild of the capabilities of LangStream (reference:
+``/root/reference``, github.com/Gagravarr/langstream): declarative YAML
+applications composed of agent pipelines connected by topics, compiled into an
+execution plan and run by a per-agent runner with exactly-once-ish offset
+semantics — but with model inference as a first-class in-process JAX/XLA
+backend (the ``jax-local`` service provider) instead of outbound HTTP calls,
+record batches coalesced into bucketed-padding XLA calls, and agent
+parallelism mapped onto the TPU ICI/DCN mesh (data / tensor / sequence
+parallelism).
+
+Layer map (mirrors SURVEY.md §1, re-architected for TPU):
+
+- ``langstream_tpu.api``       — the SPI: records, agents, topics, services.
+- ``langstream_tpu.model``     — the application model (parsed YAML).
+- ``langstream_tpu.compiler``  — parser + planner → ExecutionPlan.
+- ``langstream_tpu.topics``    — broker implementations (in-memory, ...).
+- ``langstream_tpu.runtime``   — the per-agent runner hot loop + batching.
+- ``langstream_tpu.agents``    — the built-in agent library ("ops").
+- ``langstream_tpu.providers`` — AI service providers, incl. ``jax_local``.
+- ``langstream_tpu.ops``       — JAX/Pallas kernels (attention, norms, ...).
+- ``langstream_tpu.parallel``  — mesh / sharding / collectives helpers.
+- ``langstream_tpu.gateway``   — WebSocket/HTTP gateway.
+- ``langstream_tpu.training``  — fine-tuning (sharded train step).
+- ``langstream_tpu.cli``       — command-line interface.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,65 @@
+"""The framework SPI: records, agent contracts, topic contracts, services.
+
+Re-designed equivalent of the reference's ``langstream-api`` module
+(``langstream-api/src/main/java/ai/langstream/api``): the contracts every
+agent ("op"), broker runtime, and AI service provider implements.
+"""
+
+from langstream_tpu.api.records import Record, SimpleRecord, record_from_value
+from langstream_tpu.api.agent import (
+    Agent,
+    AgentContext,
+    AgentProcessor,
+    AgentService,
+    AgentSink,
+    AgentSource,
+    ComponentType,
+    RecordSink,
+    SingleRecordProcessor,
+    SourceRecordAndResult,
+)
+from langstream_tpu.api.errors import ErrorsSpec, FailureAction
+from langstream_tpu.api.topics import (
+    OffsetPosition,
+    TopicAdmin,
+    TopicConsumer,
+    TopicProducer,
+    TopicReader,
+    TopicConnectionsRuntime,
+)
+from langstream_tpu.api.service import (
+    ChatChunk,
+    ChatMessage,
+    CompletionsService,
+    EmbeddingsService,
+    ServiceProvider,
+)
+
+__all__ = [
+    "Agent",
+    "AgentContext",
+    "AgentProcessor",
+    "AgentService",
+    "AgentSink",
+    "AgentSource",
+    "ChatChunk",
+    "ChatMessage",
+    "CompletionsService",
+    "ComponentType",
+    "EmbeddingsService",
+    "ErrorsSpec",
+    "FailureAction",
+    "OffsetPosition",
+    "Record",
+    "RecordSink",
+    "ServiceProvider",
+    "SimpleRecord",
+    "SingleRecordProcessor",
+    "SourceRecordAndResult",
+    "TopicAdmin",
+    "TopicConsumer",
+    "TopicProducer",
+    "TopicReader",
+    "TopicConnectionsRuntime",
+    "record_from_value",
+]

@@ -1,0 +1,62 @@
+"""Minimal metrics SPI.
+
+Equivalent of the reference's counter-only reporter
+(``langstream-api/src/main/java/ai/langstream/api/runner/code/MetricsReporter.java:18``)
+with a Prometheus-backed implementation provided by the runtime
+(reference impl: ``langstream-runtime-impl/.../metrics/PrometheusMetricsReporter.java``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+
+class Counter:
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def count(self, amount: int = 1) -> None:
+        with self._lock:
+            self._value += amount
+
+    def value(self) -> int:
+        return self._value
+
+
+class MetricsReporter:
+    """Namespaced counter registry; ``with_prefix`` mirrors the reference's
+    ``MetricsReporter.withPodName/withAgentName`` chaining."""
+
+    def __init__(self, prefix: str = "") -> None:
+        self.prefix = prefix
+        self._counters: Dict[str, Counter] = {}
+        self._lock = threading.Lock()
+
+    def with_prefix(self, prefix: str) -> "MetricsReporter":
+        child = MetricsReporter(
+            f"{self.prefix}_{prefix}" if self.prefix else prefix
+        )
+        child._counters = self._counters  # shared registry
+        child._lock = self._lock
+        return child
+
+    def counter(self, name: str) -> Counter:
+        full = f"{self.prefix}_{name}" if self.prefix else name
+        with self._lock:
+            counter = self._counters.get(full)
+            if counter is None:
+                counter = Counter(full)
+                self._counters[full] = counter
+            return counter
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return {name: c.value() for name, c in self._counters.items()}
+
+
+DISABLED = MetricsReporter()

@@ -1,0 +1,132 @@
+"""AI service provider SPI — the north-star extension point.
+
+Equivalent of the reference's ``ServiceProvider`` SPI
+(``langstream-agents/langstream-ai-agents/src/main/java/com/datastax/oss/streaming/ai/services/ServiceProvider.java:24``,
+``completions/CompletionsService.java:22-35``, ``embeddings/EmbeddingsService.java:24``):
+a provider resolves a completions service and an embeddings service from a
+``resources:`` config block. The reference's providers call OpenAI / VertexAI /
+Bedrock / HuggingFace over HTTPS; this framework's flagship provider is
+``jax_local`` — the model runs *in-process* on the TPU attached to the agent.
+
+Streaming contract: ``get_chat_completions`` takes an optional
+``StreamingChunksConsumer``; chunks are delivered as they decode, with the
+reference's exponential chunk batching (1, 2, 4, ... up to
+``min-chunks-per-message``; ``OpenAICompletionService.java:126,290-300``)
+applied by the *caller* side (the chat-completions step), so services emit
+raw deltas.
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class ChatMessage:
+    """One chat turn (role + content)."""
+
+    role: str
+    content: str
+
+    @classmethod
+    def from_config(cls, config: Dict[str, Any]) -> "ChatMessage":
+        return cls(role=config.get("role", "user"), content=config.get("content", ""))
+
+
+@dataclasses.dataclass
+class ChatChunk:
+    """One streamed delta of a completion."""
+
+    content: str
+    index: int = 0
+    is_last: bool = False
+
+
+@dataclasses.dataclass
+class ChatCompletionResult:
+    """Final result of a (possibly streamed) completion."""
+
+    content: str
+    role: str = "assistant"
+    finish_reason: str = "stop"
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+
+
+class StreamingChunksConsumer(abc.ABC):
+    """Receives streamed chunks (``CompletionsService.StreamingChunksConsumer``,
+    ``CompletionsService.java:29-35``)."""
+
+    @abc.abstractmethod
+    def consume_chunk(self, answer_id: str, index: int, chunk: ChatChunk, last: bool) -> None:
+        ...
+
+
+class CompletionsService(abc.ABC):
+    """Chat + text completions (``CompletionsService.java:22``)."""
+
+    @abc.abstractmethod
+    async def get_chat_completions(
+        self,
+        messages: List[ChatMessage],
+        options: Dict[str, Any],
+        stream_consumer: Optional[StreamingChunksConsumer] = None,
+    ) -> ChatCompletionResult:
+        ...
+
+    async def get_text_completions(
+        self,
+        prompt: List[str],
+        options: Dict[str, Any],
+        stream_consumer: Optional[StreamingChunksConsumer] = None,
+    ) -> ChatCompletionResult:
+        """Default: treat the prompt as a single user message."""
+        messages = [ChatMessage("user", p) for p in prompt]
+        return await self.get_chat_completions(messages, options, stream_consumer)
+
+    async def close(self) -> None:
+        ...
+
+
+class EmbeddingsService(abc.ABC):
+    """Batch text → vectors (``EmbeddingsService.java:24``).
+
+    Batched by contract: the runtime's ordered async batch executor
+    coalesces records into one call so the TPU sees one padded matmul batch.
+    """
+
+    @abc.abstractmethod
+    async def compute_embeddings(self, texts: List[str]) -> List[List[float]]:
+        ...
+
+    async def close(self) -> None:
+        ...
+
+
+class ServiceProvider(abc.ABC):
+    """Resolves services from a resource config (``ServiceProvider.java:24``)."""
+
+    name: str = ""
+
+    @abc.abstractmethod
+    def supports(self, resource_config: Dict[str, Any]) -> bool:
+        """True when this provider owns the given ``resources:`` entry
+        (the reference keys on which config section is present, e.g.
+        ``open-ai:`` vs ``vertex-ai:`` — ours keys on ``jax-local:`` etc.)."""
+
+    @abc.abstractmethod
+    def get_completions_service(
+        self, resource_config: Dict[str, Any]
+    ) -> CompletionsService:
+        ...
+
+    @abc.abstractmethod
+    def get_embeddings_service(
+        self, resource_config: Dict[str, Any], model: Optional[str] = None
+    ) -> EmbeddingsService:
+        ...
+
+    async def close(self) -> None:
+        ...

@@ -1,0 +1,7 @@
+"""The data-plane runtime: the agent runner hot loop, batching, composition.
+
+Equivalent of the reference's ``langstream-runtime`` module — see
+``langstream-runtime/langstream-runtime-impl/src/main/java/ai/langstream/runtime/agent/AgentRunner.java``
+for the loop being re-architected here, asyncio-first with XLA-aware
+batch coalescing.
+"""

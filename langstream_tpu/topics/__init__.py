@@ -1,0 +1,51 @@
+"""Topic (broker) runtime implementations.
+
+The reference ships three broker data planes — Kafka, Pulsar, Pravega
+(``langstream-kafka-runtime/``, ``langstream-pulsar-runtime/``,
+``langstream-pravega-runtime/``). This framework ships:
+
+- ``memory``  — an in-process broker with Kafka-like semantics (partitions,
+  consumer groups, contiguous-watermark commit). The default for local runs
+  and tests, and the transport of the single-process runner
+  (the reference's analogue is the noop/in-process pattern under
+  ``langstream-core/.../impl/noop/`` + the runtime-tester).
+- ``stream``  — a durable log-backed broker (file-backed segments) for
+  multi-process deployments on one host.
+
+Registry: look up a runtime by the ``streamingCluster.type`` value of
+``instance.yaml`` (reference SPI:
+``langstream-api/.../runner/topics/TopicConnectionsRuntimeRegistry.java``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict
+
+from langstream_tpu.api.topics import TopicConnectionsRuntime
+
+_FACTORIES: Dict[str, Callable[[], TopicConnectionsRuntime]] = {}
+
+
+def register_topic_runtime(name: str, factory: Callable[[], TopicConnectionsRuntime]) -> None:
+    _FACTORIES[name] = factory
+
+
+def create_topic_runtime(streaming_cluster: Dict[str, Any]) -> TopicConnectionsRuntime:
+    kind = (streaming_cluster or {}).get("type", "memory")
+    if kind in ("noop", "none"):
+        kind = "memory"
+    factory = _FACTORIES.get(kind)
+    if factory is None:
+        raise ValueError(
+            f"unknown streaming cluster type {kind!r}; known: {sorted(_FACTORIES)}"
+        )
+    return factory()
+
+
+def _register_builtin() -> None:
+    from langstream_tpu.topics.memory import MemoryTopicConnectionsRuntime
+
+    register_topic_runtime("memory", MemoryTopicConnectionsRuntime)
+
+
+_register_builtin()

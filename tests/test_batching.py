@@ -1,0 +1,126 @@
+import asyncio
+
+from langstream_tpu.runtime.batching import BatchExecutor, OrderedAsyncBatchExecutor
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_batch_executor_flush_on_size():
+    async def main():
+        batches = []
+
+        async def proc(batch):
+            batches.append(list(batch))
+
+        ex = BatchExecutor(3, proc)
+        for i in range(7):
+            await ex.add(i)
+        assert batches == [[0, 1, 2], [3, 4, 5]]
+        await ex.close()
+        assert batches == [[0, 1, 2], [3, 4, 5], [6]]
+
+    run(main())
+
+
+def test_batch_executor_flush_on_timer():
+    async def main():
+        batches = []
+
+        async def proc(batch):
+            batches.append(list(batch))
+
+        ex = BatchExecutor(100, proc, flush_interval=0.05)
+        await ex.add("a")
+        await asyncio.sleep(0.15)
+        assert batches == [["a"]]
+
+    run(main())
+
+
+def test_batch_executor_flush_on_bytes():
+    async def main():
+        batches = []
+
+        async def proc(batch):
+            batches.append(list(batch))
+
+        ex = BatchExecutor(
+            100, proc, max_bytes=10, size_of=len
+        )
+        await ex.add("aaaa")
+        await ex.add("bbbbbbb")  # 11 bytes total -> flush
+        assert batches == [["aaaa", "bbbbbbb"]]
+
+    run(main())
+
+
+def test_ordered_executor_preserves_per_key_order():
+    async def main():
+        processed = []
+
+        async def proc(batch):
+            # simulate variable async latency: later batches finish "faster"
+            await asyncio.sleep(0.01)
+            processed.extend(batch)
+
+        ex = OrderedAsyncBatchExecutor(
+            2,
+            proc,
+            buckets=4,
+            hash_fn=lambda item: hash(item[0]),
+        )
+        items = [("k1", i) for i in range(6)] + [("k2", i) for i in range(6)]
+        for item in items:
+            await ex.add(item)
+        await ex.close()
+
+        k1 = [v for k, v in processed if k == "k1"]
+        k2 = [v for k, v in processed if k == "k2"]
+        assert k1 == list(range(6))
+        assert k2 == list(range(6))
+
+    run(main())
+
+
+def test_ordered_executor_single_inflight_per_bucket():
+    async def main():
+        inflight = {"now": 0, "max": 0}
+
+        async def proc(batch):
+            inflight["now"] += 1
+            inflight["max"] = max(inflight["max"], inflight["now"])
+            await asyncio.sleep(0.02)
+            inflight["now"] -= 1
+
+        ex = OrderedAsyncBatchExecutor(
+            1, proc, buckets=1, hash_fn=lambda item: 0
+        )
+        for i in range(5):
+            await ex.add(i)
+        await ex.close()
+        assert inflight["max"] == 1  # order within bucket => serialized
+
+    run(main())
+
+
+def test_ordered_executor_parallel_across_buckets():
+    async def main():
+        inflight = {"now": 0, "max": 0}
+
+        async def proc(batch):
+            inflight["now"] += 1
+            inflight["max"] = max(inflight["max"], inflight["now"])
+            await asyncio.sleep(0.05)
+            inflight["now"] -= 1
+
+        ex = OrderedAsyncBatchExecutor(
+            1, proc, buckets=4, hash_fn=hash
+        )
+        for i in range(4):
+            await ex.add(f"key-{i}")
+        await ex.close()
+        assert inflight["max"] > 1  # different buckets overlap
+
+    run(main())

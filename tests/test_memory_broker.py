@@ -1,0 +1,156 @@
+import asyncio
+
+import pytest
+
+from langstream_tpu.api import OffsetPosition, Record
+from langstream_tpu.api.topics import TopicSpec
+from langstream_tpu.topics.memory import MemoryBroker, MemoryTopicConnectionsRuntime
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_produce_consume_roundtrip():
+    async def main():
+        rt = MemoryTopicConnectionsRuntime()
+        producer = rt.create_producer("a", {"topic": "t"})
+        consumer = rt.create_consumer("a", {"topic": "t", "group": "g"})
+        await producer.write(Record(value="one", key="k"))
+        await producer.write(Record(value="two", key="k"))
+        batch = await consumer.read()
+        assert [r.value for r in batch] == ["one", "two"]
+        assert all(r.origin == "t" for r in batch)
+        await consumer.commit(batch)
+        assert consumer.committed_offsets() == [2]
+
+    run(main())
+
+
+def test_keyed_partition_routing_is_sticky():
+    async def main():
+        broker = MemoryBroker()
+        broker.ensure_topic("t", partitions=4)
+        rt = MemoryTopicConnectionsRuntime(broker)
+        producer = rt.create_producer("a", {"topic": "t"})
+        for i in range(20):
+            await producer.write(Record(value=i, key="same-key"))
+        topic = broker.topics["t"]
+        non_empty = [p for p in topic.partitions if p.records]
+        assert len(non_empty) == 1  # all records on one partition
+        assert [r.value for r in non_empty[0].records] == list(range(20))
+
+    run(main())
+
+
+def test_out_of_order_commit_watermark():
+    async def main():
+        rt = MemoryTopicConnectionsRuntime()
+        producer = rt.create_producer("a", {"topic": "t"})
+        consumer = rt.create_consumer("a", {"topic": "t", "group": "g"})
+        for i in range(5):
+            await producer.write(Record(value=i))
+        batch = await consumer.read()
+        assert len(batch) == 5
+        # ack offsets 2,3,4 first: watermark must NOT advance past 0
+        await consumer.commit(batch[2:])
+        assert consumer.committed_offsets() == [0]
+        await consumer.commit([batch[1]])
+        assert consumer.committed_offsets() == [0]
+        await consumer.commit([batch[0]])
+        assert consumer.committed_offsets() == [5]
+
+    run(main())
+
+
+def test_uncommitted_records_redelivered_to_new_consumer():
+    async def main():
+        broker = MemoryBroker()
+        rt = MemoryTopicConnectionsRuntime(broker)
+        producer = rt.create_producer("a", {"topic": "t"})
+        consumer = rt.create_consumer("a", {"topic": "t", "group": "g"})
+        for i in range(3):
+            await producer.write(Record(value=i))
+        batch = await consumer.read()
+        await consumer.commit(batch[:1])  # only offset 0 committed
+        await consumer.close()
+        consumer2 = rt.create_consumer("a", {"topic": "t", "group": "g"})
+        redelivered = await consumer2.read()
+        assert [r.value for r in redelivered] == [1, 2]
+
+    run(main())
+
+
+def test_group_partition_sharding():
+    async def main():
+        broker = MemoryBroker()
+        broker.ensure_topic("t", partitions=2)
+        rt = MemoryTopicConnectionsRuntime(broker)
+        c1 = rt.create_consumer("a", {"topic": "t", "group": "g"})
+        c2 = rt.create_consumer("a", {"topic": "t", "group": "g"})
+        await c1.start()
+        await c2.start()
+        producer = rt.create_producer("a", {"topic": "t"})
+        for i in range(10):
+            await producer.write(Record(value=i))  # round-robin over 2 parts
+        got1 = await c1.read()
+        got2 = await c2.read()
+        assert len(got1) == 5 and len(got2) == 5
+        assert {r.value for r in got1} | {r.value for r in got2} == set(range(10))
+
+    run(main())
+
+
+def test_reader_latest_and_earliest():
+    async def main():
+        rt = MemoryTopicConnectionsRuntime()
+        producer = rt.create_producer("a", {"topic": "t"})
+        await producer.write(Record(value="old"))
+        latest = rt.create_reader({"topic": "t"}, OffsetPosition.LATEST)
+        earliest = rt.create_reader({"topic": "t"}, OffsetPosition.EARLIEST)
+        await latest.start()
+        await earliest.start()
+        await producer.write(Record(value="new"))
+        got_latest = await latest.read()
+        got_earliest = await earliest.read()
+        assert [r.value for r in got_latest] == ["new"]
+        assert [r.value for r in got_earliest] == ["old", "new"]
+
+    run(main())
+
+
+def test_blocking_read_wakes_on_publish():
+    async def main():
+        rt = MemoryTopicConnectionsRuntime()
+        consumer = rt.create_consumer("a", {"topic": "t", "group": "g"})
+        await consumer.start()
+
+        async def delayed_publish():
+            await asyncio.sleep(0.05)
+            producer = rt.create_producer("a", {"topic": "t"})
+            await producer.write(Record(value="x"))
+
+        task = asyncio.ensure_future(delayed_publish())
+        batch = await consumer.read(timeout=2.0)
+        await task
+        assert [r.value for r in batch] == ["x"]
+
+    run(main())
+
+
+def test_admin_create_delete():
+    async def main():
+        rt = MemoryTopicConnectionsRuntime()
+        admin = rt.create_admin()
+        await admin.create_topic(TopicSpec(name="t", partitions=3))
+        assert len(rt.broker.topics["t"].partitions) == 3
+        await admin.delete_topic("t")
+        assert "t" not in rt.broker.topics
+
+    run(main())
+
+
+def test_deadletter_producer_name():
+    rt = MemoryTopicConnectionsRuntime()
+    dl = rt.create_deadletter_producer("a", {"topic": "t"})
+    assert dl.topic == "t-deadletter"

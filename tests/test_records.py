@@ -1,0 +1,46 @@
+from langstream_tpu.api import Record, record_from_value
+
+
+def test_record_headers():
+    r = Record(value="v", key="k", headers=(("a", 1), ("b", 2)))
+    assert r.header("a") == 1
+    assert r.header("missing", "d") == "d"
+    r2 = r.with_header("a", 9)
+    assert r2.header("a") == 9
+    assert r.header("a") == 1  # immutability
+    assert r2.without_header("a").header("a") is None
+    assert r2.headers_as_dict() == {"a": 9, "b": 2}
+
+
+def test_record_builders():
+    r = Record(value=1)
+    assert r.with_value(2).value == 2
+    assert r.with_key("k").key == "k"
+    assert r.with_origin("t").origin == "t"
+    assert r.value == 1
+
+
+def test_value_as_text():
+    assert Record(value={"a": 1}).value_as_text() == '{"a": 1}'
+    assert Record(value=b"bytes").value_as_text() == "bytes"
+    assert Record(value=None).value_as_text() == ""
+    assert Record(value=3.5).value_as_text() == "3.5"
+
+
+def test_record_from_value_coercions():
+    r = record_from_value("hello", origin="t")
+    assert r.value == "hello" and r.origin == "t"
+    r = record_from_value(("k", "v"))
+    assert r.key == "k" and r.value == "v"
+    r = record_from_value({"key": "k", "value": "v", "headers": {"h": 1}})
+    assert r.key == "k" and r.value == "v" and r.header("h") == 1
+    # a dict that is NOT record-shaped stays a plain value
+    r = record_from_value({"name": "x"})
+    assert r.value == {"name": "x"}
+    existing = Record(value="x")
+    assert record_from_value(existing) is existing
+
+
+def test_estimated_size():
+    assert Record(value="abcd").estimated_size() >= 4
+    assert Record(value=b"abcd", key="k").estimated_size() >= 5
