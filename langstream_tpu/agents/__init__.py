@@ -1,0 +1,64 @@
+"""Built-in agent library — the "ops" of the framework.
+
+Equivalent of the reference's ``langstream-agents/*`` modules. Importing
+this package registers every built-in agent type with the runtime registry
+(the reference uses ServiceLoader NAR scanning;
+``langstream-api/.../runner/code/AgentCodeRegistry.java:32``). Registration
+is lazy — the implementing module loads on first instantiation, keeping
+import of the core cheap.
+"""
+
+from __future__ import annotations
+
+import importlib
+
+from langstream_tpu.runtime.registry import register_agent
+
+
+def _lazy(module_name: str, class_name: str):
+    def factory():
+        module = importlib.import_module(module_name)
+        return getattr(module, class_name)()
+
+    return factory
+
+
+# type → implementation, mirroring the reference's agent-type tables
+# (flow map: flow/FlowControlAgentsCodeProvider.java:26-34; GenAI step types:
+# GenAIToolKitFunctionAgentProvider.java:53-74; text agents: §2.4 SURVEY.md)
+_BUILTIN = {
+    "identity": ("langstream_tpu.runtime.runner", "IdentityProcessor"),
+    "composite-agent": ("langstream_tpu.runtime.composite", "CompositeAgentProcessor"),
+    # in-process python agents (the reference runs these over localhost gRPC:
+    # langstream-agent-grpc/.../PythonGrpcServer.java:31)
+    "python-processor": ("langstream_tpu.agents.python_agents", "PythonProcessorAgent"),
+    "python-source": ("langstream_tpu.agents.python_agents", "PythonSourceAgent"),
+    "python-sink": ("langstream_tpu.agents.python_agents", "PythonSinkAgent"),
+    "python-service": ("langstream_tpu.agents.python_agents", "PythonServiceAgent"),
+    # the GenAI toolkit executor (all declarative steps run through it)
+    "ai-tools": ("langstream_tpu.agents.genai", "GenAIToolKitAgent"),
+    # text processing
+    "text-splitter": ("langstream_tpu.agents.text", "TextSplitterAgent"),
+    "document-to-json": ("langstream_tpu.agents.text", "DocumentToJsonAgent"),
+    "text-normaliser": ("langstream_tpu.agents.text", "TextNormaliserAgent"),
+    "language-detector": ("langstream_tpu.agents.text", "LanguageDetectorAgent"),
+    "text-extractor": ("langstream_tpu.agents.text", "TextExtractorAgent"),
+    # flow control
+    "dispatch": ("langstream_tpu.agents.flow", "DispatchAgent"),
+    "timer-source": ("langstream_tpu.agents.flow", "TimerSourceAgent"),
+    "trigger-event": ("langstream_tpu.agents.flow", "TriggerEventAgent"),
+    "log-event": ("langstream_tpu.agents.flow", "LogEventAgent"),
+    # vector / RAG
+    "vector-db-sink": ("langstream_tpu.agents.vector", "VectorDBSinkAgent"),
+    "query-vector-db": ("langstream_tpu.agents.vector", "QueryVectorDBAgent"),
+    "re-rank": ("langstream_tpu.agents.rerank", "ReRankAgent"),
+    # sources / connectors
+    "webcrawler-source": ("langstream_tpu.agents.webcrawler", "WebCrawlerSource"),
+    "s3-source": ("langstream_tpu.agents.storage", "S3Source"),
+    "azure-blob-storage-source": ("langstream_tpu.agents.storage", "AzureBlobStorageSource"),
+    "http-request": ("langstream_tpu.agents.http_request", "HttpRequestAgent"),
+}
+
+
+for _type, (_module, _cls) in _BUILTIN.items():
+    register_agent(_type, _lazy(_module, _cls))

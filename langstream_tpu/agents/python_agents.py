@@ -1,0 +1,133 @@
+"""User-custom Python agents, run in-process.
+
+The reference runs user Python code in a subprocess bridged over localhost
+gRPC (``langstream-agent-grpc/src/main/proto/langstream_grpc/proto/agent.proto:24-111``,
+``PythonGrpcServer.java:31``) because its runtime is a JVM. This framework's
+runtime *is* Python, so user agents load in-process: the ``className``
+config names a ``module.Class`` importable from the application's
+``python/`` directory (added to ``sys.path`` by the planner, mirroring the
+reference's PYTHONPATH contract, ``PythonGrpcServer.java:54-91``).
+
+User classes follow the same duck-typed shape as the reference Python SDK
+(``langstream-runtime/langstream-runtime-impl/src/main/python/langstream_grpc/api.py:34-195``):
+
+- processor: ``process(record) -> list`` (async or sync) — each result is
+  coerced via :func:`~langstream_tpu.api.records.record_from_value`.
+- source: ``read() -> list``; optional ``commit(records)``.
+- sink: ``write(record)``.
+- service: ``main()`` / ``join()``.
+- all kinds: optional ``init(config)``, ``start()``, ``close()``,
+  ``set_context(context)``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import sys
+from typing import Any, Dict, List, Optional
+
+from langstream_tpu.api.agent import (
+    AgentContext,
+    AgentService,
+    AgentSink,
+    AgentSource,
+    SingleRecordProcessor,
+)
+from langstream_tpu.api.records import Record, record_from_value
+from langstream_tpu.runtime.registry import load_class
+
+
+async def _maybe_await(value):
+    if inspect.isawaitable(value):
+        return await value
+    return value
+
+
+class _PythonAgentMixin:
+    user_agent: Any = None
+
+    async def init(self, configuration: Dict[str, Any]) -> None:
+        self.configuration = configuration
+        class_name = configuration.get("className")
+        if not class_name:
+            raise ValueError("python agent requires 'className' configuration")
+        extra_path = configuration.get("pythonPath") or []
+        for path in extra_path:
+            if path not in sys.path:
+                sys.path.insert(0, path)
+        cls = load_class(class_name)
+        self.user_agent = cls()
+        if hasattr(self.user_agent, "init"):
+            await _maybe_await(self.user_agent.init(configuration))
+
+    async def set_context(self, context: AgentContext) -> None:
+        self.context = context
+        if self.user_agent is not None and hasattr(self.user_agent, "set_context"):
+            await _maybe_await(self.user_agent.set_context(context))
+
+    async def start(self) -> None:
+        if self.user_agent is not None and hasattr(self.user_agent, "start"):
+            await _maybe_await(self.user_agent.start())
+
+    async def close(self) -> None:
+        if self.user_agent is not None and hasattr(self.user_agent, "close"):
+            await _maybe_await(self.user_agent.close())
+
+    def agent_info(self) -> Dict[str, Any]:
+        info = super().agent_info()  # type: ignore[misc]
+        info["className"] = getattr(self, "configuration", {}).get("className")
+        if self.user_agent is not None and hasattr(self.user_agent, "agent_info"):
+            info["user"] = self.user_agent.agent_info()
+        return info
+
+
+class PythonProcessorAgent(_PythonAgentMixin, SingleRecordProcessor):
+    agent_type = "python-processor"
+
+    async def process_record(self, record: Record) -> List[Record]:
+        results = await _maybe_await(self.user_agent.process(record))
+        if results is None:
+            return []
+        return [record_from_value(r, origin=record.origin) for r in results]
+
+
+class PythonSourceAgent(_PythonAgentMixin, AgentSource):
+    agent_type = "python-source"
+
+    async def read(self, max_records: int = 100) -> List[Record]:
+        results = await _maybe_await(self.user_agent.read())
+        if not results:
+            # politeness: avoid a hot spin when the user source is empty
+            await asyncio.sleep(0.05)
+            return []
+        return [record_from_value(r) for r in results]
+
+    async def commit(self, records: List[Record]) -> None:
+        if hasattr(self.user_agent, "commit"):
+            await _maybe_await(self.user_agent.commit(records))
+
+    async def permanent_failure(self, record: Record, error: BaseException) -> None:
+        if hasattr(self.user_agent, "permanent_failure"):
+            await _maybe_await(self.user_agent.permanent_failure(record, error))
+        else:
+            raise error
+
+
+class PythonSinkAgent(_PythonAgentMixin, AgentSink):
+    agent_type = "python-sink"
+
+    async def write(self, record: Record) -> None:
+        await _maybe_await(self.user_agent.write(record))
+
+
+class PythonServiceAgent(_PythonAgentMixin, AgentService):
+    agent_type = "python-service"
+
+    async def join(self) -> None:
+        if hasattr(self.user_agent, "join"):
+            await _maybe_await(self.user_agent.join())
+        elif hasattr(self.user_agent, "main"):
+            await _maybe_await(self.user_agent.main())
+        else:
+            await asyncio.Event().wait()

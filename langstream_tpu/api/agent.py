@@ -118,9 +118,11 @@ class AgentSource(Agent):
         return ComponentType.SOURCE
 
     @abc.abstractmethod
-    async def read(self) -> List[Record]:
+    async def read(self, max_records: int = 100) -> List[Record]:
         """Return the next batch of records (may be empty; must not block
-        the loop forever — poll with a timeout)."""
+        the loop forever — poll with a timeout). ``max_records`` is the
+        runner's remaining pending-record budget; honoring it is what makes
+        backpressure exact (custom sources may treat it as advisory)."""
 
     async def commit(self, records: List[Record]) -> None:
         """All downstream writes for ``records`` are durable; advance offsets."""

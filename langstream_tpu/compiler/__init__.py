@@ -1,0 +1,16 @@
+"""The compiler: YAML application directory → Application → ExecutionPlan.
+
+Equivalent of the reference's parser/planner pair
+(``langstream-core/src/main/java/ai/langstream/impl/parser/ModelBuilder.java:74``
+and ``impl/common/BasicClusterRuntime.java:45``).
+"""
+
+from langstream_tpu.compiler.parser import build_application, parse_application_directory
+from langstream_tpu.compiler.planner import ExecutionPlan, build_execution_plan
+
+__all__ = [
+    "ExecutionPlan",
+    "build_application",
+    "build_execution_plan",
+    "parse_application_directory",
+]

@@ -1,0 +1,28 @@
+"""The application model — parsed YAML, pre-planning.
+
+Equivalent of ``langstream-api/src/main/java/ai/langstream/api/model/``.
+"""
+
+from langstream_tpu.model.application import (
+    AgentConfiguration,
+    Application,
+    Gateway,
+    Instance,
+    Module,
+    Pipeline,
+    ResourcesSpec,
+    Secrets,
+    TopicDefinition,
+)
+
+__all__ = [
+    "AgentConfiguration",
+    "Application",
+    "Gateway",
+    "Instance",
+    "Module",
+    "Pipeline",
+    "ResourcesSpec",
+    "Secrets",
+    "TopicDefinition",
+]

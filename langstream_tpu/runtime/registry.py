@@ -1,0 +1,75 @@
+"""Agent code registry: agent-type → factory.
+
+Equivalent of the reference's ServiceLoader-based registry
+(``langstream-api/src/main/java/ai/langstream/api/runner/code/AgentCodeRegistry.java:32``):
+the runner resolves the implementation of each execution-plan node by its
+``agentType``. Python has no ServiceLoader; built-in agents register at
+import time and applications can register custom agents programmatically or
+via ``python`` agents (module:Class references resolved at load).
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Callable, Dict, Optional
+
+from langstream_tpu.api.agent import Agent
+
+AgentFactory = Callable[[], Agent]
+
+_AGENTS: Dict[str, AgentFactory] = {}
+
+
+def register_agent(agent_type: str, factory: AgentFactory) -> None:
+    _AGENTS[agent_type] = factory
+
+
+def agent_types() -> list:
+    return sorted(_AGENTS)
+
+
+def create_agent(agent_type: str) -> Agent:
+    """Instantiate the agent for ``agent_type``.
+
+    ``python-processor`` / ``python-source`` / ``python-sink`` /
+    ``python-service`` are resolved lazily at ``init`` time from their
+    ``className`` config (reference analogue: the gRPC Python bridge,
+    ``langstream-agent-grpc/.../PythonGrpcServer.java:31`` — here Python
+    agents run in-process, no bridge needed).
+    """
+    _ensure_builtin_loaded()
+    factory = _AGENTS.get(agent_type)
+    if factory is None:
+        raise ValueError(
+            f"unknown agent type {agent_type!r}; known: {agent_types()}"
+        )
+    try:
+        agent = factory()
+    except (ImportError, AttributeError) as error:
+        raise ValueError(
+            f"agent type {agent_type!r} is registered but its implementation "
+            f"failed to load: {error}"
+        ) from error
+    agent.agent_type = agent_type
+    return agent
+
+
+def load_class(class_name: str) -> type:
+    """Load ``module.path.ClassName`` (used by custom python agents)."""
+    module_name, _, cls_name = class_name.rpartition(".")
+    if not module_name:
+        raise ValueError(f"className must be 'module.Class', got {class_name!r}")
+    module = importlib.import_module(module_name)
+    return getattr(module, cls_name)
+
+
+_builtin_loaded = False
+
+
+def _ensure_builtin_loaded() -> None:
+    global _builtin_loaded
+    if _builtin_loaded:
+        return
+    _builtin_loaded = True
+    # import for registration side effects
+    from langstream_tpu import agents as _agents  # noqa: F401

@@ -1,0 +1,341 @@
+import os
+import textwrap
+
+import pytest
+
+from langstream_tpu.compiler import build_application, build_execution_plan
+from langstream_tpu.compiler.placeholders import PlaceholderError
+
+
+def write_app(tmp_path, files):
+    app_dir = tmp_path / "app"
+    app_dir.mkdir(exist_ok=True)
+    for name, content in files.items():
+        (app_dir / name).write_text(textwrap.dedent(content))
+    return str(app_dir)
+
+
+BASIC_PIPELINE = """
+topics:
+  - name: "input-topic"
+    creation-mode: create-if-not-exists
+  - name: "output-topic"
+    creation-mode: create-if-not-exists
+pipeline:
+  - name: "convert-to-json"
+    type: "document-to-json"
+    input: "input-topic"
+    configuration:
+      text-field: "question"
+  - name: "chat"
+    type: "ai-chat-completions"
+    output: "output-topic"
+    configuration:
+      model: "${secrets.open-ai.model}"
+      completion-field: "value.answer"
+"""
+
+
+def test_parse_and_resolve_placeholders(tmp_path):
+    app_dir = write_app(
+        tmp_path,
+        {
+            "pipeline.yaml": BASIC_PIPELINE,
+            "configuration.yaml": """
+                configuration:
+                  resources:
+                    - type: "jax-local"
+                      name: "jax"
+                      configuration:
+                        model: "${globals.model-name}"
+            """,
+            "instance.yaml": """
+                instance:
+                  streamingCluster:
+                    type: memory
+                  globals:
+                    model-name: "llama-3-8b"
+            """,
+            "secrets.yaml": """
+                secrets:
+                  - id: open-ai
+                    data:
+                      model: "gpt-x"
+            """,
+        },
+    )
+    app = build_application(app_dir)
+    assert app.resources["jax"]["configuration"]["model"] == "llama-3-8b"
+    pipeline = app.modules["default"].pipelines["pipeline"]
+    assert pipeline.agents[1].configuration["model"] == "gpt-x"
+    assert app.instance.streaming_cluster == {"type": "memory"}
+
+
+def test_missing_placeholder_raises(tmp_path):
+    app_dir = write_app(
+        tmp_path,
+        {"pipeline.yaml": BASIC_PIPELINE},
+    )
+    with pytest.raises(PlaceholderError):
+        build_application(app_dir)
+
+
+def test_env_expansion_in_secrets(tmp_path, monkeypatch):
+    monkeypatch.setenv("MY_MODEL", "from-env")
+    app_dir = write_app(
+        tmp_path,
+        {
+            "pipeline.yaml": BASIC_PIPELINE,
+            "secrets.yaml": """
+                secrets:
+                  - id: open-ai
+                    data:
+                      model: "${MY_MODEL:-fallback}"
+                      other: "${UNSET_VAR_XYZ:-fallback}"
+            """,
+        },
+    )
+    app = build_application(app_dir)
+    assert app.secrets.secrets["open-ai"]["model"] == "from-env"
+    assert app.secrets.secrets["open-ai"]["other"] == "fallback"
+
+
+def test_plan_fuses_consecutive_genai_steps(tmp_path):
+    app_dir = write_app(
+        tmp_path,
+        {
+            "pipeline.yaml": BASIC_PIPELINE,
+            "secrets.yaml": """
+                secrets:
+                  - id: open-ai
+                    data: {model: "m"}
+            """,
+        },
+    )
+    app = build_application(app_dir)
+    plan = build_execution_plan(app)
+    # document-to-json (plain processor) + ai-chat-completions (genai step)
+    # fuse into ONE node reading input-topic, writing output-topic
+    assert len(plan.agents) == 1
+    node = plan.agents[0]
+    assert node.input_topic == "input-topic"
+    assert node.output_topic == "output-topic"
+    assert [p.agent_type for p in node.processors] == [
+        "document-to-json",
+        "ai-tools",
+    ]
+    assert node.processors[1].configuration["steps"][0]["type"] == "ai-chat-completions"
+
+
+def test_plan_merges_genai_steps_into_one_executor(tmp_path):
+    app_dir = write_app(
+        tmp_path,
+        {
+            "pipeline.yaml": """
+                topics:
+                  - name: "in"
+                    creation-mode: create-if-not-exists
+                  - name: "out"
+                    creation-mode: create-if-not-exists
+                pipeline:
+                  - type: "drop-fields"
+                    input: "in"
+                    configuration: {fields: ["a"]}
+                  - type: "compute"
+                    configuration: {fields: [{name: "value.x", expression: "1"}]}
+                  - type: "cast"
+                    output: "out"
+                    configuration: {schema-type: "string"}
+            """,
+        },
+    )
+    app = build_application(app_dir)
+    plan = build_execution_plan(app)
+    assert len(plan.agents) == 1
+    node = plan.agents[0]
+    assert len(node.processors) == 1
+    steps = node.processors[0].configuration["steps"]
+    assert [s["type"] for s in steps] == ["drop-fields", "compute", "cast"]
+
+
+def test_plan_explicit_topic_breaks_fusion(tmp_path):
+    app_dir = write_app(
+        tmp_path,
+        {
+            "pipeline.yaml": """
+                topics:
+                  - name: "in"
+                    creation-mode: create-if-not-exists
+                  - name: "mid"
+                    creation-mode: create-if-not-exists
+                  - name: "out"
+                    creation-mode: create-if-not-exists
+                pipeline:
+                  - id: "first"
+                    type: "identity"
+                    input: "in"
+                    output: "mid"
+                  - id: "second"
+                    type: "identity"
+                    output: "out"
+            """,
+        },
+    )
+    plan = build_execution_plan(build_application(app_dir))
+    assert len(plan.agents) == 2
+    assert plan.agents[0].output_topic == "mid"
+    assert plan.agents[1].input_topic == "mid"
+    assert plan.agents[1].output_topic == "out"
+
+
+def test_plan_different_parallelism_breaks_fusion_with_implicit_topic(tmp_path):
+    app_dir = write_app(
+        tmp_path,
+        {
+            "pipeline.yaml": """
+                topics:
+                  - name: "in"
+                    creation-mode: create-if-not-exists
+                pipeline:
+                  - id: "first"
+                    type: "identity"
+                    input: "in"
+                  - id: "second"
+                    type: "identity"
+                    resources:
+                      parallelism: 4
+            """,
+        },
+    )
+    plan = build_execution_plan(build_application(app_dir))
+    assert len(plan.agents) == 2
+    implicit = plan.agents[1].input_topic
+    assert implicit == plan.agents[0].output_topic
+    assert plan.topics[implicit].implicit
+    assert plan.agents[1].resources.parallelism == 4
+
+
+def test_undeclared_topic_errors(tmp_path):
+    app_dir = write_app(
+        tmp_path,
+        {
+            "pipeline.yaml": """
+                pipeline:
+                  - type: "identity"
+                    input: "nope"
+            """,
+        },
+    )
+    with pytest.raises(ValueError, match="undeclared topic"):
+        build_execution_plan(build_application(app_dir))
+
+
+def test_pipeline_error_defaults_inherited(tmp_path):
+    app_dir = write_app(
+        tmp_path,
+        {
+            "pipeline.yaml": """
+                topics:
+                  - name: "in"
+                    creation-mode: create-if-not-exists
+                errors:
+                  on-failure: skip
+                  retries: 7
+                pipeline:
+                  - type: "identity"
+                    input: "in"
+                  - type: "identity"
+                    errors:
+                      retries: 1
+            """,
+        },
+    )
+    app = build_application(app_dir)
+    agents = app.modules["default"].pipelines["pipeline"].agents
+    assert agents[0].errors.retries == 7
+    assert agents[0].errors.on_failure == "skip"
+    assert agents[1].errors.retries == 1
+    assert agents[1].errors.on_failure == "skip"
+
+
+def test_gateway_parsing(tmp_path):
+    app_dir = write_app(
+        tmp_path,
+        {
+            "pipeline.yaml": """
+                topics:
+                  - name: "q"
+                    creation-mode: create-if-not-exists
+                  - name: "a"
+                    creation-mode: create-if-not-exists
+                pipeline:
+                  - type: identity
+                    input: q
+                    output: a
+            """,
+            "gateways.yaml": """
+                gateways:
+                  - id: user-input
+                    type: produce
+                    topic: q
+                    parameters: [sessionId]
+                    produce-options:
+                      headers:
+                        - key: langstream-client-session-id
+                          value-from-parameters: sessionId
+                  - id: chat
+                    type: chat
+                    chat-options:
+                      questions-topic: q
+                      answers-topic: a
+            """,
+        },
+    )
+    app = build_application(app_dir)
+    plan = build_execution_plan(app)
+    assert [g.id for g in app.gateways] == ["user-input", "chat"]
+    assert app.gateways[0].parameters == ["sessionId"]
+    assert app.gateways[1].chat_options["questions-topic"] == "q"
+
+
+def test_gateway_unknown_topic_errors(tmp_path):
+    app_dir = write_app(
+        tmp_path,
+        {
+            "pipeline.yaml": """
+                topics:
+                  - name: "q"
+                    creation-mode: create-if-not-exists
+                pipeline:
+                  - type: identity
+                    input: q
+            """,
+            "gateways.yaml": """
+                gateways:
+                  - id: g
+                    type: consume
+                    topic: missing
+            """,
+        },
+    )
+    with pytest.raises(ValueError, match="unknown topic"):
+        build_execution_plan(build_application(app_dir))
+
+
+def test_service_agent_standalone_node(tmp_path):
+    app_dir = write_app(
+        tmp_path,
+        {
+            "pipeline.yaml": """
+                pipeline:
+                  - id: "svc"
+                    type: "python-service"
+                    configuration:
+                      className: "my.Service"
+            """,
+        },
+    )
+    plan = build_execution_plan(build_application(app_dir))
+    assert len(plan.agents) == 1
+    assert plan.agents[0].service is not None
+    assert plan.agents[0].input_topic is None

@@ -1,0 +1,325 @@
+import asyncio
+
+import pytest
+
+from langstream_tpu.api import (
+    ErrorsSpec,
+    Record,
+    RecordSink,
+    SingleRecordProcessor,
+)
+from langstream_tpu.api.agent import AgentProcessor
+from langstream_tpu.runtime.composite import CompositeAgentProcessor
+from langstream_tpu.runtime.runner import (
+    AgentRunner,
+    IdentityProcessor,
+    TopicConsumerSource,
+    TopicProducerSink,
+)
+from langstream_tpu.topics.memory import MemoryBroker, MemoryTopicConnectionsRuntime
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_pipeline(broker, processor, errors=ErrorsSpec(), with_deadletter=False):
+    rt = MemoryTopicConnectionsRuntime(broker)
+    consumer = rt.create_consumer("a", {"topic": "in", "group": "g"})
+    deadletter = rt.create_deadletter_producer("a", {"topic": "in"}) if with_deadletter else None
+    producer = rt.create_producer("a", {"topic": "out"})
+    return AgentRunner(
+        agent_id="a",
+        source=TopicConsumerSource(consumer, deadletter),
+        processor=processor,
+        sink=TopicProducerSink(producer),
+        errors=errors,
+        drain_timeout=2.0,
+    )
+
+
+async def run_until(runner, predicate, timeout=5.0):
+    task = asyncio.ensure_future(runner.run())
+    try:
+        deadline = asyncio.get_event_loop().time() + timeout
+        while not predicate():
+            if task.done():
+                task.result()  # propagate failure
+                break
+            if asyncio.get_event_loop().time() > deadline:
+                raise TimeoutError("predicate not reached")
+            await asyncio.sleep(0.01)
+    finally:
+        runner.stop()
+        if not task.done():
+            await task
+        else:
+            task.result()
+
+
+class Upper(SingleRecordProcessor):
+    agent_id = "upper"
+
+    async def process_record(self, record):
+        return [record.with_value(record.value.upper())]
+
+
+class Explode(SingleRecordProcessor):
+    """1 → N fan-out."""
+
+    async def process_record(self, record):
+        return [record.with_value(c) for c in record.value]
+
+
+class FailNTimes(SingleRecordProcessor):
+    def __init__(self, n):
+        self.n = n
+        self.calls = 0
+
+    async def process_record(self, record):
+        self.calls += 1
+        if self.calls <= self.n:
+            raise RuntimeError("boom")
+        return [record]
+
+
+class AlwaysFail(SingleRecordProcessor):
+    async def process_record(self, record):
+        raise RuntimeError("permanent boom")
+
+
+def test_end_to_end_process_and_commit():
+    async def main():
+        broker = MemoryBroker()
+        rt = MemoryTopicConnectionsRuntime(broker)
+        producer = rt.create_producer("p", {"topic": "in"})
+        for text in ["a", "b", "c"]:
+            await producer.write(Record(value=text))
+        runner = make_pipeline(broker, Upper())
+        await run_until(runner, lambda: runner.stats.records_out >= 3)
+
+        reader = rt.create_reader({"topic": "out"})
+        from langstream_tpu.api import OffsetPosition
+
+        reader = rt.create_reader({"topic": "out"}, OffsetPosition.EARLIEST)
+        out = await reader.read()
+        assert sorted(r.value for r in out) == ["A", "B", "C"]
+        # source offsets committed
+        group = broker.group("in", "g")
+        assert sum(group.committed) == 3
+
+    run(main())
+
+
+def test_fan_out_records():
+    async def main():
+        broker = MemoryBroker()
+        rt = MemoryTopicConnectionsRuntime(broker)
+        producer = rt.create_producer("p", {"topic": "in"})
+        await producer.write(Record(value="xyz"))
+        runner = make_pipeline(broker, Explode())
+        await run_until(runner, lambda: runner.stats.records_out >= 3)
+        from langstream_tpu.api import OffsetPosition
+
+        reader = rt.create_reader({"topic": "out"}, OffsetPosition.EARLIEST)
+        out = await reader.read()
+        assert [r.value for r in out] == ["x", "y", "z"]
+
+    run(main())
+
+
+def test_retry_then_success():
+    async def main():
+        broker = MemoryBroker()
+        rt = MemoryTopicConnectionsRuntime(broker)
+        producer = rt.create_producer("p", {"topic": "in"})
+        await producer.write(Record(value="v"))
+        processor = FailNTimes(2)
+        runner = make_pipeline(broker, processor, ErrorsSpec(retries=3))
+        await run_until(runner, lambda: runner.stats.records_out >= 1)
+        assert processor.calls == 3
+        assert runner.stats.errors == 2
+
+    run(main())
+
+
+def test_skip_policy_commits_without_output():
+    async def main():
+        broker = MemoryBroker()
+        rt = MemoryTopicConnectionsRuntime(broker)
+        producer = rt.create_producer("p", {"topic": "in"})
+        await producer.write(Record(value="bad"))
+        await producer.write(Record(value="good"))
+
+        class FailBad(SingleRecordProcessor):
+            async def process_record(self, record):
+                if record.value == "bad":
+                    raise RuntimeError("nope")
+                return [record]
+
+        runner = make_pipeline(
+            broker, FailBad(), ErrorsSpec(retries=0, on_failure="skip")
+        )
+        await run_until(runner, lambda: runner.stats.skipped >= 1 and runner.stats.records_out >= 1)
+        group = broker.group("in", "g")
+        assert sum(group.committed) == 2  # both committed
+
+    run(main())
+
+
+def test_fail_policy_stops_runner():
+    async def main():
+        broker = MemoryBroker()
+        rt = MemoryTopicConnectionsRuntime(broker)
+        producer = rt.create_producer("p", {"topic": "in"})
+        await producer.write(Record(value="v"))
+        runner = make_pipeline(broker, AlwaysFail(), ErrorsSpec(retries=0))
+        with pytest.raises(RuntimeError, match="permanent boom"):
+            await runner.run()
+
+    run(main())
+
+
+def test_deadletter_policy():
+    async def main():
+        broker = MemoryBroker()
+        rt = MemoryTopicConnectionsRuntime(broker)
+        producer = rt.create_producer("p", {"topic": "in"})
+        await producer.write(Record(value="bad"))
+        runner = make_pipeline(
+            broker,
+            AlwaysFail(),
+            ErrorsSpec(retries=1, on_failure="dead-letter"),
+            with_deadletter=True,
+        )
+        await run_until(runner, lambda: runner.stats.dead_lettered >= 1)
+        from langstream_tpu.api import OffsetPosition
+
+        reader = rt.create_reader({"topic": "in-deadletter"}, OffsetPosition.EARLIEST)
+        dlq = await reader.read()
+        assert len(dlq) == 1
+        assert dlq[0].value == "bad"
+        assert "permanent boom" in dlq[0].header("langstream-error")
+        group = broker.group("in", "g")
+        assert sum(group.committed) == 1
+
+    run(main())
+
+
+def test_out_of_order_completion_still_commits_in_order():
+    """Records that finish out of order must not commit past in-flight ones."""
+
+    async def main():
+        broker = MemoryBroker()
+        rt = MemoryTopicConnectionsRuntime(broker)
+        producer = rt.create_producer("p", {"topic": "in"})
+        for i in range(4):
+            await producer.write(Record(value=i))
+
+        class SlowFirst(SingleRecordProcessor):
+            async def process_record(self, record):
+                if record.value == 0:
+                    await asyncio.sleep(0.2)
+                return [record]
+
+        runner = make_pipeline(broker, SlowFirst())
+        task = asyncio.ensure_future(runner.run())
+        # wait until records 1-3 are done but 0 still in flight
+        while runner.stats.records_out < 3:
+            await asyncio.sleep(0.01)
+        group = broker.group("in", "g")
+        assert group.committed == [0]  # watermark held by record 0
+        while runner.stats.records_out < 4:
+            await asyncio.sleep(0.01)
+        await asyncio.sleep(0.05)
+        assert group.committed == [4]
+        runner.stop()
+        await task
+
+    run(main())
+
+
+def test_composite_chain():
+    async def main():
+        broker = MemoryBroker()
+        rt = MemoryTopicConnectionsRuntime(broker)
+        producer = rt.create_producer("p", {"topic": "in"})
+        await producer.write(Record(value="ab"))
+        composite = CompositeAgentProcessor([Explode(), Upper()])
+        runner = make_pipeline(broker, composite)
+        await run_until(runner, lambda: runner.stats.records_out >= 2)
+        from langstream_tpu.api import OffsetPosition
+
+        reader = rt.create_reader({"topic": "out"}, OffsetPosition.EARLIEST)
+        out = await reader.read()
+        assert [r.value for r in out] == ["A", "B"]
+
+    run(main())
+
+
+def test_composite_from_config():
+    async def main():
+        composite = CompositeAgentProcessor()
+        await composite.init(
+            {
+                "processors": [
+                    {"agentType": "identity", "agentId": "id1"},
+                ]
+            }
+        )
+        assert len(composite.processors) == 1
+        from langstream_tpu.runtime.runner import process_and_collect
+
+        results = await process_and_collect(composite, [Record(value="x")])
+        assert results[0].result_records[0].value == "x"
+
+    run(main())
+
+
+def test_python_agent_in_process(tmp_path):
+    async def main():
+        agent_dir = tmp_path / "python"
+        agent_dir.mkdir()
+        (agent_dir / "my_agent.py").write_text(
+            "class Doubler:\n"
+            "    def process(self, record):\n"
+            "        return [record.value * 2]\n"
+        )
+        from langstream_tpu.runtime.registry import create_agent
+
+        agent = create_agent("python-processor")
+        await agent.init(
+            {"className": "my_agent.Doubler", "pythonPath": [str(agent_dir)]}
+        )
+        from langstream_tpu.runtime.runner import process_and_collect
+
+        results = await process_and_collect(agent, [Record(value="ab")])
+        assert results[0].result_records[0].value == "abab"
+
+    run(main())
+
+
+def test_backpressure_caps_pending():
+    async def main():
+        broker = MemoryBroker()
+        rt = MemoryTopicConnectionsRuntime(broker)
+        producer = rt.create_producer("p", {"topic": "in"})
+        for i in range(50):
+            await producer.write(Record(value=i))
+
+        inflight = {"now": 0, "max": 0}
+
+        class Slow(SingleRecordProcessor):
+            async def process_record(self, record):
+                inflight["now"] += 1
+                inflight["max"] = max(inflight["max"], inflight["now"])
+                await asyncio.sleep(0.01)
+                inflight["now"] -= 1
+                return [record]
+
+        runner = make_pipeline(broker, Slow())
+        runner.max_pending_records = 8
+        await run_until(runner, lambda: runner.stats.records_out >= 50, timeout=10)
+        assert inflight["max"] <= 8
+
+    run(main())
