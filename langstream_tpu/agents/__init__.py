@@ -55,6 +55,7 @@ _BUILTIN = {
     # sources / connectors
     "webcrawler-source": ("langstream_tpu.agents.webcrawler", "WebCrawlerSource"),
     "s3-source": ("langstream_tpu.agents.storage", "S3Source"),
+    "file-source": ("langstream_tpu.agents.storage", "FileSource"),
     "azure-blob-storage-source": ("langstream_tpu.agents.storage", "AzureBlobStorageSource"),
     "http-request": ("langstream_tpu.agents.http_request", "HttpRequestAgent"),
 }

@@ -1,0 +1,176 @@
+"""Datasource SPI + built-in implementations.
+
+Parity with the reference's datasource layer
+(``langstream-agents/langstream-ai-agents/.../datasource/impl/{AstraDataSource,JdbcDataSourceProvider}.java``):
+``resources:`` entries of type ``datasource`` resolve to a queryable
+service used by the ``query`` step and the vector agents.
+
+Built-ins:
+
+- ``service: sqlite``  — stdlib sqlite3 (the JDBC-equivalent relational
+  path; supports query + execute with ``?`` params).
+- ``service: memory``  — in-process table of dict rows with a tiny filter
+  syntax, for tests and docs.
+- ``service: vector``  — the TPU-native vector store
+  (``langstream_tpu.agents.vectorstore``), queried with JSON specs.
+
+External engines from the reference (Cassandra/Astra, Milvus, Pinecone,
+OpenSearch, Solr) are declared-but-gated: their client libraries are not in
+this image, so their configs validate and fail at `start` with an explicit
+message rather than at plan time.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import re
+from typing import Any, Dict, List, Optional
+
+_GATED_SERVICES = {
+    "astra", "cassandra", "milvus", "pinecone", "opensearch", "solr", "jdbc",
+}
+
+
+class DataSource:
+    async def query(self, query: str, params: List[Any]) -> List[Dict[str, Any]]:
+        raise NotImplementedError
+
+    async def execute(self, statement: str, params: List[Any]) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    async def close(self) -> None:
+        ...
+
+
+class SqliteDataSource(DataSource):
+    """Relational datasource over stdlib sqlite3 (reference analogue:
+    ``JdbcDataSourceProvider``)."""
+
+    def __init__(self, config: Dict[str, Any]) -> None:
+        import sqlite3
+
+        path = config.get("path") or config.get("url", ":memory:")
+        if path.startswith("sqlite:"):
+            path = path[len("sqlite:"):] or ":memory:"
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._conn.row_factory = sqlite3.Row
+        self._lock = asyncio.Lock()
+
+    async def query(self, query: str, params: List[Any]) -> List[Dict[str, Any]]:
+        async with self._lock:
+            cursor = self._conn.execute(query, params)
+            return [dict(row) for row in cursor.fetchall()]
+
+    async def execute(self, statement: str, params: List[Any]) -> Dict[str, Any]:
+        async with self._lock:
+            cursor = self._conn.execute(statement, params)
+            self._conn.commit()
+            return {"rowcount": cursor.rowcount, "lastrowid": cursor.lastrowid}
+
+    async def close(self) -> None:
+        self._conn.close()
+
+
+class MemoryDataSource(DataSource):
+    """Dict-row tables; query syntax: JSON ``{"table": ..., "where":
+    {field: value}, "limit": n}`` with ``?`` params substituting values."""
+
+    def __init__(self, config: Dict[str, Any]) -> None:
+        self.tables: Dict[str, List[Dict[str, Any]]] = {
+            name: list(rows) for name, rows in (config.get("tables") or {}).items()
+        }
+
+    async def query(self, query: str, params: List[Any]) -> List[Dict[str, Any]]:
+        spec = json.loads(_substitute(query, params))
+        rows = self.tables.get(spec.get("table", ""), [])
+        where = spec.get("where", {})
+        out = [
+            row
+            for row in rows
+            if all(row.get(field) == expected for field, expected in where.items())
+        ]
+        limit = spec.get("limit")
+        return out[:limit] if limit else out
+
+    async def execute(self, statement: str, params: List[Any]) -> Dict[str, Any]:
+        spec = json.loads(_substitute(statement, params))
+        table = self.tables.setdefault(spec.get("table", "default"), [])
+        if "insert" in spec:
+            table.append(spec["insert"])
+            return {"rowcount": 1}
+        if "delete-where" in spec:
+            before = len(table)
+            table[:] = [
+                row
+                for row in table
+                if not all(row.get(f) == v for f, v in spec["delete-where"].items())
+            ]
+            return {"rowcount": before - len(table)}
+        raise ValueError(f"unsupported memory statement: {spec}")
+
+
+def _substitute(query: str, params: List[Any]) -> str:
+    """Replace ``?`` placeholders with JSON-encoded params. A quoted
+    ``"?"`` (as produced by building the query spec with json.dumps) is
+    treated as a bare placeholder, so params keep their JSON types.
+    With no params the query passes through untouched, so literal ``?``
+    characters in zero-param specs are safe."""
+    if not params:
+        return query
+    query = query.replace('"?"', "?")
+    parts = query.split("?")
+    if len(parts) - 1 != len(params):
+        if len(parts) == 1:
+            return query
+        raise ValueError(
+            f"query has {len(parts) - 1} placeholders but {len(params)} params"
+        )
+    out = [parts[0]]
+    for param, tail in zip(params, parts[1:]):
+        out.append(json.dumps(param, default=str))
+        out.append(tail)
+    return "".join(out)
+
+
+class DataSourceRegistry:
+    """Resolve datasource resources to live connections (cached)."""
+
+    def __init__(self, resources: Optional[Dict[str, Dict[str, Any]]] = None):
+        self.resources = resources or {}
+        self._cache: Dict[str, DataSource] = {}
+
+    def resolve(self, resource_name: str) -> DataSource:
+        if resource_name in self._cache:
+            return self._cache[resource_name]
+        resource = self.resources.get(resource_name)
+        if resource is None:
+            raise ValueError(
+                f"unknown datasource {resource_name!r}; declared: "
+                f"{sorted(self.resources)}"
+            )
+        config = resource.get("configuration", resource)
+        service = config.get("service", "sqlite")
+        if service in ("sqlite", "jdbc-sqlite"):
+            source: DataSource = SqliteDataSource(config)
+        elif service in ("memory", "in-memory"):
+            source = MemoryDataSource(config)
+        elif service == "vector":
+            from langstream_tpu.agents.vectorstore import VectorStoreDataSource
+
+            source = VectorStoreDataSource(config)
+        elif service in _GATED_SERVICES:
+            raise ValueError(
+                f"datasource service {service!r} requires a client library "
+                "not bundled in this build; use 'sqlite', 'memory', or "
+                "'vector', or run against an external gateway"
+            )
+        else:
+            raise ValueError(f"unknown datasource service {service!r}")
+        self._cache[resource_name] = source
+        return source
+
+    async def close(self) -> None:
+        for source in self._cache.values():
+            await source.close()
+        self._cache.clear()

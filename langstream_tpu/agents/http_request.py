@@ -1,0 +1,75 @@
+"""HTTP request agent: call an HTTP service per record.
+
+Equivalent of the reference's ``http-request`` processor
+(``langstream-agents/langstream-agent-http-request/.../HttpRequestAgent.java:51``):
+url/method/headers/query templates evaluated against the record, response
+body lands in ``output-field`` (JSON-parsed when the response is JSON).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+from langstream_tpu.api.agent import SingleRecordProcessor
+from langstream_tpu.api.records import Record
+from langstream_tpu.agents.el import render_template
+from langstream_tpu.agents.transform import TransformContext
+
+
+class HttpRequestAgent(SingleRecordProcessor):
+    agent_type = "http-request"
+
+    async def init(self, configuration: Dict[str, Any]) -> None:
+        self.url = configuration["url"]
+        self.method = configuration.get("method", "GET").upper()
+        self.output_field = configuration.get("output-field", "value")
+        self.headers = configuration.get("headers", {}) or {}
+        self.query_string = configuration.get("query-string", {}) or {}
+        self.body = configuration.get("body")
+        self.allow_redirects = bool(configuration.get("allow-redirects", True))
+        self.handle_cookies = bool(configuration.get("handle-cookies", True))
+        self._session = None
+
+    async def start(self) -> None:
+        import aiohttp
+
+        self._session = aiohttp.ClientSession(
+            cookie_jar=aiohttp.CookieJar()
+            if self.handle_cookies
+            else aiohttp.DummyCookieJar()
+        )
+
+    async def close(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+
+    async def process_record(self, record: Record) -> List[Record]:
+        ctx = TransformContext(record)
+        el_ctx = ctx.el_context()
+        url = render_template(self.url, el_ctx)
+        params = {
+            key: render_template(str(value), el_ctx)
+            for key, value in self.query_string.items()
+        }
+        headers = {
+            key: render_template(str(value), el_ctx)
+            for key, value in self.headers.items()
+        }
+        body = render_template(self.body, el_ctx) if self.body else None
+        async with self._session.request(
+            self.method,
+            url,
+            params=params,
+            headers=headers,
+            data=body,
+            allow_redirects=self.allow_redirects,
+        ) as response:
+            response.raise_for_status()
+            content_type = response.headers.get("Content-Type", "")
+            if "json" in content_type:
+                payload: Any = await response.json()
+            else:
+                payload = await response.text()
+        ctx.set_field(self.output_field, payload)
+        return [ctx.to_record()]

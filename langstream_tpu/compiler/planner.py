@@ -73,6 +73,7 @@ _KIND: Dict[str, ComponentType] = {
     "timer-source": ComponentType.SOURCE,
     "webcrawler-source": ComponentType.SOURCE,
     "s3-source": ComponentType.SOURCE,
+    "file-source": ComponentType.SOURCE,
     "azure-blob-storage-source": ComponentType.SOURCE,
     "python-sink": ComponentType.SINK,
     "vector-db-sink": ComponentType.SINK,

@@ -1,0 +1,17 @@
+"""AI service providers.
+
+Equivalent of the reference's provider set under
+``langstream-agents/langstream-ai-agents`` (OpenAI / HuggingFace / VertexAI /
+Bedrock, resolved through ``ServiceProviderRegistry``). Here the flagship is
+``jax_local`` — in-process JAX/XLA inference on the TPU attached to the
+runner — plus an OpenAI-compatible REST client (for remote fallback parity)
+and a deterministic mock for tests.
+"""
+
+from langstream_tpu.providers.registry import (
+    ServiceProviderRegistry,
+    default_registry,
+    register_provider,
+)
+
+__all__ = ["ServiceProviderRegistry", "default_registry", "register_provider"]

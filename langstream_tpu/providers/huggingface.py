@@ -1,0 +1,129 @@
+"""HuggingFace provider: local torch/transformers embeddings or the HF
+inference REST API.
+
+Parity with the reference's ``HuggingFaceProvider``
+(``langstream-agents/langstream-ai-agents/.../HuggingFaceProvider.java:47``):
+``provider: local`` loads a sentence-transformer-style model in-process
+(the reference uses DJL/PyTorch JNI; here plain transformers on CPU —
+the TPU-native embedding path lives in ``jax_local``), ``provider: api``
+calls the hosted inference API.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from langstream_tpu.api.service import (
+    ChatCompletionResult,
+    ChatMessage,
+    CompletionsService,
+    EmbeddingsService,
+    ServiceProvider,
+    StreamingChunksConsumer,
+)
+
+
+class LocalTransformersEmbeddingsService(EmbeddingsService):
+    """CPU embeddings via transformers/torch (mean-pooled, normalized) —
+    the BASELINE config #1 path (all-MiniLM-L6-v2 on CPU)."""
+
+    def __init__(self, config: Dict[str, Any], model: Optional[str]) -> None:
+        self.model_name = model or config.get(
+            "model", "sentence-transformers/all-MiniLM-L6-v2"
+        )
+        self._model = None
+        self._tokenizer = None
+
+    def _load(self):
+        if self._model is None:
+            import torch  # noqa: F401
+            from transformers import AutoModel, AutoTokenizer
+
+            self._tokenizer = AutoTokenizer.from_pretrained(self.model_name)
+            self._model = AutoModel.from_pretrained(self.model_name)
+            self._model.eval()
+        return self._model, self._tokenizer
+
+    async def compute_embeddings(self, texts: List[str]) -> List[List[float]]:
+        import asyncio
+
+        return await asyncio.get_running_loop().run_in_executor(
+            None, self._compute_sync, texts
+        )
+
+    def _compute_sync(self, texts: List[str]) -> List[List[float]]:
+        import torch
+
+        model, tokenizer = self._load()
+        encoded = tokenizer(
+            texts, padding=True, truncation=True, max_length=512, return_tensors="pt"
+        )
+        with torch.no_grad():
+            output = model(**encoded)
+        hidden = output.last_hidden_state
+        mask = encoded["attention_mask"].unsqueeze(-1).to(hidden.dtype)
+        pooled = (hidden * mask).sum(1) / mask.sum(1).clamp(min=1e-9)
+        normalized = torch.nn.functional.normalize(pooled, p=2, dim=1)
+        return normalized.tolist()
+
+
+class HFAPIEmbeddingsService(EmbeddingsService):
+    def __init__(self, config: Dict[str, Any], model: Optional[str]) -> None:
+        self.model = model or config.get("model", "sentence-transformers/all-MiniLM-L6-v2")
+        self.url = config.get(
+            "api-url", "https://api-inference.huggingface.co/pipeline/feature-extraction"
+        ).rstrip("/")
+        self.access_key = config.get("access-key", "")
+        self._session = None
+
+    async def compute_embeddings(self, texts: List[str]) -> List[List[float]]:
+        if self._session is None:
+            import aiohttp
+
+            self._session = aiohttp.ClientSession(
+                headers={"Authorization": f"Bearer {self.access_key}"}
+            )
+        async with self._session.post(
+            f"{self.url}/{self.model}", json={"inputs": texts}
+        ) as response:
+            response.raise_for_status()
+            return await response.json()
+
+    async def close(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+
+class _UnsupportedCompletions(CompletionsService):
+    async def get_chat_completions(
+        self,
+        messages: List[ChatMessage],
+        options: Dict[str, Any],
+        stream_consumer: Optional[StreamingChunksConsumer] = None,
+    ) -> ChatCompletionResult:
+        raise NotImplementedError(
+            "hugging-face resources provide embeddings only (as in the "
+            "reference); use jax-local or open-ai for completions"
+        )
+
+
+class HuggingFaceServiceProvider(ServiceProvider):
+    name = "hugging-face"
+
+    def supports(self, resource_config: Dict[str, Any]) -> bool:
+        return (
+            resource_config.get("type")
+            in ("hugging-face", "hugging-face-configuration")
+            or "hugging-face" in resource_config
+        )
+
+    def get_completions_service(self, resource_config: Dict[str, Any]) -> CompletionsService:
+        return _UnsupportedCompletions()
+
+    def get_embeddings_service(
+        self, resource_config: Dict[str, Any], model: Optional[str] = None
+    ) -> EmbeddingsService:
+        if resource_config.get("provider", "local") == "api":
+            return HFAPIEmbeddingsService(resource_config, model)
+        return LocalTransformersEmbeddingsService(resource_config, model)

@@ -1,0 +1,103 @@
+"""Deterministic mock AI provider for tests and pipeline dry-runs.
+
+Owns ``resources:`` entries of type ``mock-ai`` (or with a ``mock-ai:`` key).
+Completions echo a configurable template; embeddings are deterministic
+hash-seeded unit vectors — so integration tests of the full pipeline
+(the reference mocks provider HTTP with WireMock in ``ChatCompletionsIT``;
+here the mock sits behind the same ServiceProvider SPI instead).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import math
+import uuid
+from typing import Any, Dict, List, Optional
+
+from langstream_tpu.api.service import (
+    ChatChunk,
+    ChatCompletionResult,
+    ChatMessage,
+    CompletionsService,
+    EmbeddingsService,
+    ServiceProvider,
+    StreamingChunksConsumer,
+)
+
+
+class MockCompletionsService(CompletionsService):
+    def __init__(self, config: Dict[str, Any]) -> None:
+        # template may use {prompt} (last user message) and {model}
+        self.template = config.get("response-template", "echo: {prompt}")
+        self.chunk_words = int(config.get("chunk-words", 1))
+        self.delay = float(config.get("chunk-delay", 0.0))
+
+    async def get_chat_completions(
+        self,
+        messages: List[ChatMessage],
+        options: Dict[str, Any],
+        stream_consumer: Optional[StreamingChunksConsumer] = None,
+    ) -> ChatCompletionResult:
+        prompt = messages[-1].content if messages else ""
+        text = self.template.format(prompt=prompt, model=options.get("model", ""))
+        if stream_consumer is not None:
+            answer_id = uuid.uuid4().hex
+            words = text.split(" ")
+            chunks = [
+                " ".join(words[i : i + self.chunk_words])
+                for i in range(0, len(words), self.chunk_words)
+            ]
+            for index, chunk in enumerate(chunks):
+                if self.delay:
+                    await asyncio.sleep(self.delay)
+                content = chunk if index == 0 else " " + chunk
+                stream_consumer.consume_chunk(
+                    answer_id,
+                    index,
+                    ChatChunk(content=content, index=index),
+                    last=index == len(chunks) - 1,
+                )
+        return ChatCompletionResult(
+            content=text,
+            prompt_tokens=sum(len(m.content.split()) for m in messages),
+            completion_tokens=len(text.split()),
+        )
+
+
+class MockEmbeddingsService(EmbeddingsService):
+    def __init__(self, config: Dict[str, Any], model: Optional[str]) -> None:
+        self.dimensions = int(config.get("dimensions", 8))
+        self.model = model
+        self.calls: List[List[str]] = []  # visible to tests: batch shapes
+
+    async def compute_embeddings(self, texts: List[str]) -> List[List[float]]:
+        self.calls.append(list(texts))
+        out = []
+        for text in texts:
+            digest = hashlib.sha256(text.encode("utf-8")).digest()
+            vector = [
+                (digest[i % len(digest)] - 127.5) / 127.5
+                for i in range(self.dimensions)
+            ]
+            norm = math.sqrt(sum(v * v for v in vector)) or 1.0
+            out.append([v / norm for v in vector])
+        return out
+
+
+class MockServiceProvider(ServiceProvider):
+    name = "mock-ai"
+
+    def supports(self, resource_config: Dict[str, Any]) -> bool:
+        return (
+            resource_config.get("type") in ("mock-ai", "mock")
+            or "mock-ai" in resource_config
+        )
+
+    def get_completions_service(self, resource_config: Dict[str, Any]) -> CompletionsService:
+        return MockCompletionsService(resource_config)
+
+    def get_embeddings_service(
+        self, resource_config: Dict[str, Any], model: Optional[str] = None
+    ) -> EmbeddingsService:
+        return MockEmbeddingsService(resource_config, model)

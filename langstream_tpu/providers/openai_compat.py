@@ -1,0 +1,163 @@
+"""OpenAI-compatible REST provider (remote fallback path).
+
+Parity with the reference's ``OpenAIServiceProvider``
+(``langstream-agents/langstream-ai-agents/.../OpenAIServiceProvider.java:26``,
+``OpenAICompletionService.java:52``): resources of type
+``open-ai-configuration`` (or with an ``open-ai`` key) talk to any
+OpenAI-compatible endpoint (OpenAI, Azure, vLLM, llama.cpp server, ...) over
+HTTPS with SSE streaming. In the TPU build this is the *fallback* — the
+flagship path is ``jax-local``, which serves the same SPI in-process.
+"""
+
+from __future__ import annotations
+
+import json
+import uuid
+from typing import Any, Dict, List, Optional
+
+from langstream_tpu.api.service import (
+    ChatChunk,
+    ChatCompletionResult,
+    ChatMessage,
+    CompletionsService,
+    EmbeddingsService,
+    ServiceProvider,
+    StreamingChunksConsumer,
+)
+
+
+class OpenAICompatCompletionsService(CompletionsService):
+    def __init__(self, config: Dict[str, Any]) -> None:
+        self.url = (config.get("url") or "https://api.openai.com/v1").rstrip("/")
+        self.access_key = config.get("access-key", "")
+        self.default_model = config.get("model")
+        self._session = None
+
+    async def _get_session(self):
+        if self._session is None:
+            import aiohttp
+
+            self._session = aiohttp.ClientSession(
+                headers={"Authorization": f"Bearer {self.access_key}"}
+            )
+        return self._session
+
+    async def get_chat_completions(
+        self,
+        messages: List[ChatMessage],
+        options: Dict[str, Any],
+        stream_consumer: Optional[StreamingChunksConsumer] = None,
+    ) -> ChatCompletionResult:
+        session = await self._get_session()
+        body: Dict[str, Any] = {
+            "model": options.get("model", self.default_model),
+            "messages": [{"role": m.role, "content": m.content} for m in messages],
+            "stream": stream_consumer is not None,
+        }
+        for key in ("max-tokens", "temperature", "top-p", "stop",
+                    "presence-penalty", "frequency-penalty"):
+            if options.get(key) is not None:
+                body[key.replace("-", "_")] = options[key]
+        endpoint = f"{self.url}/chat/completions"
+        if stream_consumer is None:
+            async with session.post(endpoint, json=body) as response:
+                response.raise_for_status()
+                payload = await response.json()
+            choice = payload["choices"][0]
+            usage = payload.get("usage", {})
+            return ChatCompletionResult(
+                content=choice["message"]["content"],
+                finish_reason=choice.get("finish_reason", "stop"),
+                prompt_tokens=usage.get("prompt_tokens", 0),
+                completion_tokens=usage.get("completion_tokens", 0),
+            )
+        # SSE streaming
+        answer_id = uuid.uuid4().hex
+        parts: List[str] = []
+        index = 0
+        last_emitted = False
+        async with session.post(endpoint, json=body) as response:
+            response.raise_for_status()
+            async for raw_line in response.content:
+                line = raw_line.decode("utf-8").strip()
+                if not line.startswith("data:"):
+                    continue
+                data = line[len("data:"):].strip()
+                if data == "[DONE]":
+                    break
+                event = json.loads(data)
+                delta = event["choices"][0].get("delta", {})
+                content = delta.get("content")
+                finished = event["choices"][0].get("finish_reason") is not None
+                if content:
+                    parts.append(content)
+                    stream_consumer.consume_chunk(
+                        answer_id, index,
+                        ChatChunk(content=content, index=index),
+                        last=finished,
+                    )
+                    index += 1
+                    last_emitted = finished
+                elif finished:
+                    stream_consumer.consume_chunk(
+                        answer_id, index, ChatChunk(content="", index=index), last=True
+                    )
+                    last_emitted = True
+        if not last_emitted:
+            # servers that end with bare [DONE] (no finish_reason event):
+            # flush the terminal marker so chunk batchers drain their tail
+            stream_consumer.consume_chunk(
+                answer_id, index, ChatChunk(content="", index=index), last=True
+            )
+        return ChatCompletionResult(content="".join(parts))
+
+    async def close(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+
+class OpenAICompatEmbeddingsService(EmbeddingsService):
+    def __init__(self, config: Dict[str, Any], model: Optional[str]) -> None:
+        self.url = (config.get("url") or "https://api.openai.com/v1").rstrip("/")
+        self.access_key = config.get("access-key", "")
+        self.model = model or config.get("embeddings-model", "text-embedding-3-small")
+        self._session = None
+
+    async def compute_embeddings(self, texts: List[str]) -> List[List[float]]:
+        if self._session is None:
+            import aiohttp
+
+            self._session = aiohttp.ClientSession(
+                headers={"Authorization": f"Bearer {self.access_key}"}
+            )
+        async with self._session.post(
+            f"{self.url}/embeddings", json={"model": self.model, "input": texts}
+        ) as response:
+            response.raise_for_status()
+            payload = await response.json()
+        data = sorted(payload["data"], key=lambda d: d["index"])
+        return [d["embedding"] for d in data]
+
+    async def close(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+
+class OpenAICompatServiceProvider(ServiceProvider):
+    name = "open-ai"
+
+    def supports(self, resource_config: Dict[str, Any]) -> bool:
+        return (
+            resource_config.get("type") in ("open-ai", "open-ai-configuration")
+            or "open-ai" in resource_config
+        )
+
+    def get_completions_service(self, resource_config: Dict[str, Any]) -> CompletionsService:
+        return OpenAICompatCompletionsService(resource_config)
+
+    def get_embeddings_service(
+        self, resource_config: Dict[str, Any], model: Optional[str] = None
+    ) -> EmbeddingsService:
+        return OpenAICompatEmbeddingsService(resource_config, model)

@@ -1,0 +1,112 @@
+"""Provider registry: resource config → completions/embeddings services.
+
+Equivalent of the reference's ServiceLoader registry
+(``langstream-agents/langstream-ai-agents/src/main/java/ai/langstream/ai/agents/services/ServiceProviderRegistry.java:58``):
+given the app's ``resources:`` entries, find the provider that owns each and
+build (cached) service instances.
+"""
+
+from __future__ import annotations
+
+import importlib
+import logging
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from langstream_tpu.api.service import (
+    CompletionsService,
+    EmbeddingsService,
+    ServiceProvider,
+)
+
+logger = logging.getLogger(__name__)
+
+_PROVIDER_FACTORIES: List[Callable[[], ServiceProvider]] = []
+
+
+def register_provider(factory: Callable[[], ServiceProvider]) -> None:
+    _PROVIDER_FACTORIES.append(factory)
+
+
+def _lazy(module_name: str, class_name: str) -> Callable[[], ServiceProvider]:
+    def factory() -> ServiceProvider:
+        module = importlib.import_module(module_name)
+        return getattr(module, class_name)()
+
+    return factory
+
+
+register_provider(_lazy("langstream_tpu.providers.mock", "MockServiceProvider"))
+register_provider(_lazy("langstream_tpu.providers.jax_local.provider", "JaxLocalServiceProvider"))
+register_provider(_lazy("langstream_tpu.providers.openai_compat", "OpenAICompatServiceProvider"))
+register_provider(_lazy("langstream_tpu.providers.huggingface", "HuggingFaceServiceProvider"))
+
+
+class ServiceProviderRegistry:
+    """Resolves and caches services per resource entry."""
+
+    def __init__(self, resources: Optional[Dict[str, Dict[str, Any]]] = None):
+        self.resources = resources or {}
+        self._providers: Optional[List[ServiceProvider]] = None
+        self._completions: Dict[str, CompletionsService] = {}
+        self._embeddings: Dict[Tuple[str, Optional[str]], EmbeddingsService] = {}
+
+    def _provider_instances(self) -> List[ServiceProvider]:
+        if self._providers is None:
+            self._providers = []
+            for factory in _PROVIDER_FACTORIES:
+                try:
+                    self._providers.append(factory())
+                except Exception as error:  # noqa: BLE001 — optional deps
+                    logger.debug("provider factory failed: %s", error)
+        return self._providers
+
+    def _find(self, resource_name: Optional[str]) -> Tuple[str, Dict[str, Any], ServiceProvider]:
+        candidates: List[Tuple[str, Dict[str, Any]]]
+        if resource_name:
+            if resource_name not in self.resources:
+                raise ValueError(
+                    f"unknown resource {resource_name!r}; declared: "
+                    f"{sorted(self.resources)}"
+                )
+            candidates = [(resource_name, self.resources[resource_name])]
+        else:
+            candidates = list(self.resources.items())
+        for name, resource in candidates:
+            for provider in self._provider_instances():
+                if provider.supports(resource):
+                    return name, resource, provider
+        raise ValueError(
+            "no AI service provider matches the declared resources "
+            f"({sorted(self.resources)}); declare one in configuration.yaml"
+        )
+
+    def completions(self, resource_name: Optional[str] = None) -> CompletionsService:
+        name, resource, provider = self._find(resource_name)
+        if name not in self._completions:
+            self._completions[name] = provider.get_completions_service(
+                resource.get("configuration", resource)
+            )
+        return self._completions[name]
+
+    def embeddings(
+        self, resource_name: Optional[str] = None, model: Optional[str] = None
+    ) -> EmbeddingsService:
+        name, resource, provider = self._find(resource_name)
+        key = (name, model)
+        if key not in self._embeddings:
+            self._embeddings[key] = provider.get_embeddings_service(
+                resource.get("configuration", resource), model=model
+            )
+        return self._embeddings[key]
+
+    async def close(self) -> None:
+        for service in self._completions.values():
+            await service.close()
+        for service in self._embeddings.values():
+            await service.close()
+        self._completions.clear()
+        self._embeddings.clear()
+
+
+def default_registry(resources: Dict[str, Dict[str, Any]]) -> ServiceProviderRegistry:
+    return ServiceProviderRegistry(resources)

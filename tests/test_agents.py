@@ -1,0 +1,331 @@
+import asyncio
+import json
+
+import pytest
+
+from langstream_tpu.api import Record
+from langstream_tpu.api.agent import AgentContext
+from langstream_tpu.runtime.registry import create_agent
+from langstream_tpu.runtime.runner import process_and_collect
+from langstream_tpu.topics.memory import MemoryBroker, MemoryTopicConnectionsRuntime
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def make(agent_type, config, **ctx_kwargs):
+    agent = create_agent(agent_type)
+    agent.agent_id = f"test-{agent_type}"
+    await agent.init(config)
+    await agent.set_context(AgentContext(agent_id="t", **ctx_kwargs))
+    await agent.start()
+    return agent
+
+
+async def one(agent, record):
+    results = await process_and_collect(agent, [record])
+    if results[0].error:
+        raise results[0].error
+    return results[0].result_records
+
+
+# ----------------------------- text agents ----------------------------- #
+def test_document_to_json():
+    async def main():
+        agent = await make("document-to-json", {"text-field": "question"})
+        out = await one(agent, Record(value=b"hello", headers=(("h", "1"),)))
+        assert out[0].value == {"question": "hello", "h": "1"}
+
+    run(main())
+
+
+def test_text_splitter_chunks_and_headers():
+    async def main():
+        agent = await make(
+            "text-splitter",
+            {"chunk_size": 6, "chunk_overlap": 0, "length_function": "length"},
+        )
+        text = "aaa bbb ccc ddd"
+        out = await one(agent, Record(value=text))
+        assert len(out) > 1
+        assert "".join(r.value.replace(" ", "") for r in out) == text.replace(" ", "")
+        assert out[0].header("chunk_id") == "0"
+        assert out[0].header("text_num_chunks") == str(len(out))
+
+    run(main())
+
+
+def test_text_splitter_overlap():
+    async def main():
+        agent = await make(
+            "text-splitter",
+            {"chunk_size": 10, "chunk_overlap": 4, "length_function": "length"},
+        )
+        out = await one(agent, Record(value="one two three four five"))
+        chunks = [r.value for r in out]
+        assert len(chunks) >= 2
+        # overlap: consecutive chunks share some text
+        assert any(
+            chunks[i].split()[-1] == chunks[i + 1].split()[0]
+            for i in range(len(chunks) - 1)
+        )
+
+    run(main())
+
+
+def test_text_normaliser():
+    async def main():
+        agent = await make("text-normaliser", {})
+        out = await one(agent, Record(value="  Hello   WORLD  \n  second  "))
+        assert out[0].value == "hello world\nsecond"
+
+    run(main())
+
+
+def test_language_detector():
+    async def main():
+        agent = await make("language-detector", {"property": "language"})
+        out = await one(
+            agent, Record(value="the cat is in the house and it is happy")
+        )
+        assert out[0].header("language") == "en"
+        agent2 = await make(
+            "language-detector", {"allowedLanguages": ["fr"]}
+        )
+        filtered = await one(
+            agent2, Record(value="the cat is in the house and it is happy")
+        )
+        assert filtered == []
+
+    run(main())
+
+
+def test_text_extractor_html():
+    async def main():
+        agent = await make("text-extractor", {})
+        html_doc = "<html><head><style>x{}</style></head><body><h1>Title</h1><p>Body &amp; soul</p><script>var x;</script></body></html>"
+        out = await one(agent, Record(value=html_doc))
+        assert "Title" in out[0].value
+        assert "Body & soul" in out[0].value
+        assert "var x" not in out[0].value
+
+    run(main())
+
+
+# ----------------------------- flow agents ----------------------------- #
+def test_dispatch_routes():
+    async def main():
+        broker = MemoryBroker()
+        rt = MemoryTopicConnectionsRuntime(broker)
+        agent = await make(
+            "dispatch",
+            {
+                "routes": [
+                    {"when": "properties['lang'] == 'fr'", "destination": "french"},
+                    {"when": "properties['lang'] == 'spam'", "action": "drop"},
+                ]
+            },
+            topic_connections=rt,
+        )
+        passed = await one(agent, Record(value="v", headers=(("lang", "en"),)))
+        assert len(passed) == 1
+        routed = await one(agent, Record(value="bonjour", headers=(("lang", "fr"),)))
+        assert routed == []
+        dropped = await one(agent, Record(value="x", headers=(("lang", "spam"),)))
+        assert dropped == []
+
+        from langstream_tpu.api import OffsetPosition
+
+        reader = rt.create_reader({"topic": "french"}, OffsetPosition.EARLIEST)
+        french = await reader.read()
+        assert [r.value for r in french] == ["bonjour"]
+        await agent.close()
+
+    run(main())
+
+
+def test_timer_source():
+    async def main():
+        agent = await make(
+            "timer-source",
+            {
+                "period-seconds": 0.05,
+                "fields": [{"name": "value.tick", "expression": "fn.now()"}],
+            },
+        )
+        got = []
+        deadline = asyncio.get_event_loop().time() + 3
+        while len(got) < 2 and asyncio.get_event_loop().time() < deadline:
+            got.extend(await agent.read())
+        assert len(got) >= 2
+        assert got[0].value["tick"] > 0
+
+    run(main())
+
+
+def test_trigger_event():
+    async def main():
+        broker = MemoryBroker()
+        rt = MemoryTopicConnectionsRuntime(broker)
+        agent = await make(
+            "trigger-event",
+            {
+                "when": "value.n > 10",
+                "destination": "alerts",
+                "fields": [{"name": "value.alert", "expression": "value.n"}],
+            },
+            topic_connections=rt,
+        )
+        out1 = await one(agent, Record(value={"n": 5}))
+        out2 = await one(agent, Record(value={"n": 50}))
+        assert len(out1) == 1 and len(out2) == 1  # continue-processing default
+
+        from langstream_tpu.api import OffsetPosition
+
+        reader = rt.create_reader({"topic": "alerts"}, OffsetPosition.EARLIEST)
+        alerts = await reader.read()
+        assert [a.value for a in alerts] == [{"alert": 50}]
+        await agent.close()
+
+    run(main())
+
+
+# --------------------------- vector agents ----------------------------- #
+def test_vector_sink_and_query_roundtrip():
+    async def main():
+        import langstream_tpu.agents.vectorstore as vs
+
+        vs._SHARED_STORES.clear()
+        resources = {
+            "vdb": {
+                "type": "datasource",
+                "configuration": {
+                    "service": "vector",
+                    "name": "test-store",
+                    "dimensions": 3,
+                },
+            }
+        }
+        sink = await make(
+            "vector-db-sink",
+            {
+                "datasource": "vdb",
+                "vector.id": "value.doc_id",
+                "vector.vector": "value.embeddings",
+                "vector.text": "value.text",
+            },
+            resources=resources,
+        )
+        docs = [
+            ("a", [1.0, 0.0, 0.0], "doc about jax"),
+            ("b", [0.0, 1.0, 0.0], "doc about xla"),
+            ("c", [0.9, 0.1, 0.0], "doc about pallas"),
+        ]
+        for doc_id, vec, text in docs:
+            await sink.write(
+                Record(value={"doc_id": doc_id, "embeddings": vec, "text": text})
+            )
+
+        query = await make(
+            "query-vector-db",
+            {
+                "datasource": "vdb",
+                "query": json.dumps(
+                    {"action": "search", "vector": "?", "top-k": 2}
+                ),
+                "fields": ["value.question_embeddings"],
+                "output-field": "value.results",
+            },
+            resources=resources,
+        )
+        out = await one(
+            query, Record(value={"question_embeddings": [1.0, 0.05, 0.0]})
+        )
+        results = out[0].value["results"]
+        assert [r["id"] for r in results] == ["a", "c"]
+        assert results[0]["text"] == "doc about jax"
+        assert results[0]["similarity"] > 0.9
+
+    run(main())
+
+
+def test_rerank_mmr():
+    async def main():
+        agent = await make(
+            "re-rank",
+            {
+                "field": "value.candidates",
+                "output-field": "value.ranked",
+                "query-embeddings": "value.qv",
+                "vector-field": "vector",
+                "max": 2,
+                "lambda": 0.3,  # diversity-favoring: MMR must pick 'div' over 'dup2'
+            },
+        )
+        # two near-duplicates close to the query + one diverse
+        record = Record(
+            value={
+                "qv": [1.0, 0.0],
+                "candidates": [
+                    {"id": "dup1", "vector": [1.0, 0.0]},
+                    {"id": "dup2", "vector": [0.99, 0.01]},
+                    {"id": "div", "vector": [0.5, 0.5]},
+                ],
+            }
+        )
+        out = await one(agent, record)
+        ranked = [c["id"] for c in out[0].value["ranked"]]
+        # MMR picks the diverse doc second, not the duplicate
+        assert ranked == ["dup1", "div"]
+
+    run(main())
+
+
+# --------------------------- datasources ------------------------------- #
+def test_memory_datasource():
+    async def main():
+        from langstream_tpu.agents.datasource import MemoryDataSource
+
+        source = MemoryDataSource(
+            {"tables": {"users": [{"id": 1, "name": "ada"}, {"id": 2, "name": "alan"}]}}
+        )
+        rows = await source.query(
+            json.dumps({"table": "users", "where": {"id": "?"}}).replace('"?"', "?"),
+            [2],
+        )
+        assert rows == [{"id": 2, "name": "alan"}]
+
+    run(main())
+
+
+def test_gated_datasource_errors():
+    async def main():
+        from langstream_tpu.agents.datasource import DataSourceRegistry
+
+        registry = DataSourceRegistry(
+            {"db": {"configuration": {"service": "milvus"}}}
+        )
+        with pytest.raises(ValueError, match="client library"):
+            registry.resolve("db")
+
+    run(main())
+
+
+# --------------------------- file source ------------------------------- #
+def test_file_source(tmp_path):
+    async def main():
+        (tmp_path / "a.txt").write_text("alpha")
+        (tmp_path / "b.txt").write_text("beta")
+        (tmp_path / "c.bin").write_text("skip")
+        agent = await make(
+            "file-source",
+            {"path": str(tmp_path), "file-extensions": "txt",
+             "delete-objects": True, "idle-time": 0.01},
+        )
+        records = await agent.read()
+        assert sorted(r.value for r in records) == [b"alpha", b"beta"]
+        await agent.commit(records)
+        assert sorted(p.name for p in tmp_path.iterdir()) == ["c.bin"]
+
+    run(main())

@@ -1,0 +1,82 @@
+import pytest
+
+from langstream_tpu.agents.el import (
+    ExpressionError,
+    evaluate,
+    evaluate_predicate,
+    render_template,
+)
+
+
+CTX = {
+    "value": {"question": "what is jax?", "count": 3, "nested": {"deep": "yes"}},
+    "key": "k1",
+    "properties": {"lang": "en"},
+    "timestamp": 1000,
+}
+
+
+def test_field_access():
+    assert evaluate("value.question", CTX) == "what is jax?"
+    assert evaluate("value.nested.deep", CTX) == "yes"
+    assert evaluate("key", CTX) == "k1"
+    assert evaluate("properties['lang']", CTX) == "en"
+    assert evaluate("value.missing", CTX) is None
+
+
+def test_operators_and_predicates():
+    assert evaluate("value.count + 1", CTX) == 4
+    assert evaluate_predicate("value.count > 2", CTX)
+    assert not evaluate_predicate("value.count > 5", CTX)
+    assert evaluate_predicate("value.question == 'what is jax?'", CTX)
+    assert evaluate("'yes' if value.count > 1 else 'no'", CTX) == "yes"
+
+
+def test_fn_namespace():
+    assert evaluate("fn.uppercase(value.question)", CTX) == "WHAT IS JAX?"
+    assert evaluate("fn.concat(key, '-', properties['lang'])", CTX) == "k1-en"
+    assert evaluate("fn.coalesce(value.missing, 'dflt')", CTX) == "dflt"
+    assert evaluate("fn.len(value.question)", CTX) == 12
+    assert evaluate("fn.split('a,b,c', ',')", CTX) == ["a", "b", "c"]
+    assert evaluate("fn.toInt('42')", CTX) == 42
+    assert evaluate("fn.timestampAdd(timestamp, 1, 'seconds')", CTX) == 2000
+
+
+def test_jstl_colon_syntax_accepted():
+    assert evaluate("fn:uppercase(value.question)", CTX) == "WHAT IS JAX?"
+    assert evaluate("${value.count + 1}", CTX) == 4
+
+
+def test_sandbox_blocks_dangerous_code():
+    for bad in [
+        "__import__('os').system('true')",
+        "().__class__.__bases__",
+        "open('/etc/passwd')",
+        "exec('x=1')",
+        "lambda: 1",
+        "[x for x in value]",
+    ]:
+        with pytest.raises(ExpressionError):
+            evaluate(bad, CTX)
+
+
+def test_safe_builtins_allowed():
+    assert evaluate("len(value.question)", CTX) == 12
+    assert evaluate("max(1, value.count)", CTX) == 3
+    assert evaluate("str(value.count)", CTX) == "3"
+
+
+def test_render_template():
+    out = render_template(
+        "Q: {{ value.question }} ({{ properties['lang'] }})", CTX
+    )
+    assert out == "Q: what is jax? (en)"
+    assert render_template("{{ value.missing }}", CTX) == ""
+    assert render_template("{{{ value.question }}}", CTX) == "what is jax?"
+    # dict values render as JSON
+    assert render_template("{{ value.nested }}", CTX) == '{"deep": "yes"}'
+
+
+def test_error_messages():
+    with pytest.raises(ExpressionError, match="bad expression"):
+        evaluate("value..", CTX)

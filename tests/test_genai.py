@@ -1,0 +1,237 @@
+import asyncio
+import json
+
+import pytest
+
+from langstream_tpu.api import Record
+from langstream_tpu.api.agent import AgentContext
+from langstream_tpu.runtime.registry import create_agent
+from langstream_tpu.runtime.runner import process_and_collect
+from langstream_tpu.topics.memory import MemoryBroker, MemoryTopicConnectionsRuntime
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def make_agent(steps, resources=None, topic_runtime=None):
+    agent = create_agent("ai-tools")
+    agent.agent_id = "test-ai-tools"
+    await agent.init({"steps": steps})
+    await agent.set_context(
+        AgentContext(
+            agent_id="test",
+            resources=resources or {},
+            topic_connections=topic_runtime,
+        )
+    )
+    await agent.start()
+    return agent
+
+
+async def one(agent, record):
+    results = await process_and_collect(agent, [record])
+    if results[0].error:
+        raise results[0].error
+    return results[0].result_records
+
+
+MOCK_AI = {"ai": {"type": "mock-ai", "configuration": {}}}
+
+
+def test_structural_steps():
+    async def main():
+        agent = await make_agent(
+            [
+                {"type": "merge-key-value"},
+                {"type": "drop-fields", "fields": ["secret"]},
+                {"type": "compute", "fields": [
+                    {"name": "value.total", "expression": "value.a + value.b"},
+                ]},
+                {"type": "flatten"},
+            ]
+        )
+        record = Record(
+            value={"a": 1, "b": 2, "secret": "x", "nest": {"in": 5}},
+            key={"id": "k7"},
+        )
+        out = await one(agent, record)
+        assert out[0].value == {"id": "k7", "a": 1, "b": 2, "total": 3, "nest_in": 5}
+        await agent.close()
+
+    run(main())
+
+
+def test_cast_and_drop_and_when():
+    async def main():
+        agent = await make_agent(
+            [
+                {"type": "drop", "when": "value.n < 0"},
+                {"type": "cast", "schema-type": "string"},
+            ]
+        )
+        keep = await one(agent, Record(value={"n": 5}))
+        assert keep[0].value == '{"n": 5}'
+        dropped = await one(agent, Record(value={"n": -1}))
+        assert dropped == []
+        await agent.close()
+
+    run(main())
+
+
+def test_unwrap_key_value():
+    async def main():
+        agent = await make_agent([{"type": "unwrap-key-value"}])
+        out = await one(agent, Record(value={"v": 1}, key={"k": 2}))
+        assert out[0].value == {"v": 1}
+        assert out[0].key is None
+        agent2 = await make_agent([{"type": "unwrap-key-value", "unwrapKey": True}])
+        out2 = await one(agent2, Record(value={"v": 1}, key={"k": 2}))
+        assert out2[0].value == {"k": 2}
+
+    run(main())
+
+
+def test_chat_completions_with_mock():
+    async def main():
+        agent = await make_agent(
+            [
+                {
+                    "type": "ai-chat-completions",
+                    "model": "test-model",
+                    "completion-field": "value.answer",
+                    "log-field": "value.prompt",
+                    "messages": [
+                        {"role": "user", "content": "Answer: {{ value.question }}"}
+                    ],
+                }
+            ],
+            resources=MOCK_AI,
+        )
+        out = await one(agent, Record(value={"question": "why?"}))
+        value = out[0].value
+        assert value["answer"] == "echo: Answer: why?"
+        log = json.loads(value["prompt"])
+        assert log["model"] == "test-model"
+        assert log["messages"][0]["content"] == "Answer: why?"
+        await agent.close()
+
+    run(main())
+
+
+def test_chat_completions_streaming_chunks():
+    async def main():
+        broker = MemoryBroker()
+        rt = MemoryTopicConnectionsRuntime(broker)
+        agent = await make_agent(
+            [
+                {
+                    "type": "ai-chat-completions",
+                    "model": "m",
+                    "completion-field": "value.answer",
+                    "stream-to-topic": "chunks",
+                    "stream-response-completion-field": "value",
+                    "min-chunks-per-message": 4,
+                    "messages": [
+                        {"role": "user", "content": "{{ value.question }}"}
+                    ],
+                }
+            ],
+            resources={
+                "ai": {
+                    "type": "mock-ai",
+                    "configuration": {
+                        "response-template": "one two three four five six seven",
+                    },
+                }
+            },
+            topic_runtime=rt,
+        )
+        out = await one(agent, Record(value={"question": "q"}, key="sess-1"))
+        assert out[0].value["answer"] == "one two three four five six seven"
+
+        from langstream_tpu.api import OffsetPosition
+
+        reader = rt.create_reader({"topic": "chunks"}, OffsetPosition.EARLIEST)
+        chunks = await reader.read()
+        # exponential batching: 1, 2, 4 then remainder => 1,2,4 grouping
+        texts = [c.value for c in chunks]
+        assert "".join(texts) == "one two three four five six seven"
+        assert len(texts) < 7  # batched, not one-per-token
+        assert chunks[0].header("stream-index") == "0"
+        assert chunks[-1].header("stream-last-message") == "true"
+        assert all(c.header("stream-id") == chunks[0].header("stream-id") for c in chunks)
+        # chunk records keep the source key for session affinity
+        assert all(c.key == "sess-1" for c in chunks)
+        await agent.close()
+
+    run(main())
+
+
+def test_compute_embeddings_batches():
+    async def main():
+        agent = await make_agent(
+            [
+                {
+                    "type": "compute-ai-embeddings",
+                    "model": "emb",
+                    "text": "{{ value.text }}",
+                    "embeddings-field": "value.embeddings",
+                    "batch-size": 4,
+                    "flush-interval": 0.02,
+                }
+            ],
+            resources={"ai": {"type": "mock-ai", "configuration": {"dimensions": 4}}},
+        )
+        records = [Record(value={"text": f"t{i}"}) for i in range(8)]
+        results = await process_and_collect(agent, records)
+        for result in results:
+            assert result.error is None
+            vec = result.result_records[0].value["embeddings"]
+            assert len(vec) == 4
+        # the mock service records batch shapes: must be batched, not 1-by-1
+        service = agent.service_registry()._embeddings[("ai", "emb")]
+        assert max(len(batch) for batch in service.calls) > 1
+        await agent.close()
+
+    run(main())
+
+
+def test_query_step_sqlite():
+    async def main():
+        resources = {
+            "db": {
+                "type": "datasource",
+                "configuration": {"service": "sqlite", "path": ":memory:"},
+            }
+        }
+        setup = await make_agent(
+            [
+                {"type": "query", "datasource": "db", "mode": "execute",
+                 "query": "CREATE TABLE t (id INTEGER, name TEXT)",
+                 "output-field": "value.ignore"},
+                {"type": "query", "datasource": "db", "mode": "execute",
+                 "query": "INSERT INTO t VALUES (1, 'jax'), (2, 'xla')",
+                 "output-field": "value.ignore"},
+                {"type": "query", "datasource": "db",
+                 "query": "SELECT name FROM t WHERE id = ?",
+                 "fields": ["value.lookup"],
+                 "output-field": "value.result",
+                 "only-first": True},
+            ],
+            resources=resources,
+        )
+        out = await one(setup, Record(value={"lookup": 2}))
+        assert out[0].value["result"] == {"name": "xla"}
+        await setup.close()
+
+    run(main())
+
+
+def test_unknown_step_type():
+    async def main():
+        agent = create_agent("ai-tools")
+        with pytest.raises(ValueError, match="unknown GenAI step type"):
+            await agent.init({"steps": [{"type": "teleport"}]})
+
+    run(main())
