@@ -315,8 +315,9 @@ class ChatCompletionsStep(Step):
         self._options = {
             key: config.get(key)
             for key in (
-                "model", "max-tokens", "temperature", "top-p", "stop",
-                "presence-penalty", "frequency-penalty", "session-field",
+                "model", "max-tokens", "temperature", "top-p", "top-k",
+                "stop", "presence-penalty", "frequency-penalty",
+                "session-field",
             )
             if config.get(key) is not None
         }
@@ -362,6 +363,14 @@ class ChatCompletionsStep(Step):
 
         options = dict(self._options)
         options["min-chunks-per-message"] = self.min_chunks
+        # session affinity for KV-cache reuse (BASELINE config #5): the
+        # gateway's session header, else the record key (broker partitioning
+        # by key then gives replica affinity too)
+        session = ctx.properties.get("langstream-client-session-id")
+        if session is None and ctx.record.key is not None:
+            session = str(ctx.record.key)
+        if session is not None:
+            options["session-id"] = session
         result = await self._service.get_chat_completions(
             messages, options, consumer
         )

@@ -1,0 +1,22 @@
+"""Core JAX/Pallas ops: attention, norms, rotary embeddings.
+
+These are the framework's "kernels". XLA already fuses elementwise chains
+into the surrounding matmuls; Pallas kernels are reserved for the ops XLA
+cannot schedule optimally (flash attention over long prefill, ring
+attention over the sp axis).
+"""
+
+from langstream_tpu.ops.norms import rms_norm
+from langstream_tpu.ops.rope import apply_rope, rope_frequencies
+from langstream_tpu.ops.attention import (
+    decode_attention,
+    prefill_attention,
+)
+
+__all__ = [
+    "apply_rope",
+    "decode_attention",
+    "prefill_attention",
+    "rms_norm",
+    "rope_frequencies",
+]

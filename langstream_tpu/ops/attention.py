@@ -1,0 +1,89 @@
+"""Attention ops for prefill and decode (GQA), XLA-first.
+
+Decode attention over a static-length KV cache and causal prefill
+attention. Plain einsum formulations — on TPU, XLA fuses the
+softmax chain into the two matmuls and keeps them on the MXU; the Pallas
+flash kernel (``ops/flash_attention.py``) takes over for long-sequence
+prefill where the O(T²) materialization would spill HBM.
+
+Conventions: q/k/v are [batch, seq, heads, head_dim]; the KV cache is
+[batch, max_len, kv_heads, head_dim]; GQA repeats kv heads on the fly
+(a gather XLA folds into the matmul, not a materialized repeat).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+def _group_query(q: jnp.ndarray, kv_heads: int) -> jnp.ndarray:
+    """Reshape [B, T, H, D] → [B, T, KVH, G, D] grouping queries by their
+    kv head (G = H // KVH)."""
+    batch, seq, heads, dim = q.shape
+    groups = heads // kv_heads
+    return q.reshape(batch, seq, kv_heads, groups, dim)
+
+
+def prefill_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    mask: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Causal self-attention over a full (padded) prompt.
+
+    q: [B, T, H, D], k/v: [B, T, KVH, D] → [B, T, H, D].
+    ``mask`` [B, T] marks valid tokens (padding excluded).
+    """
+    batch, seq, heads, dim = q.shape
+    kv_heads = k.shape[2]
+    scale = dim ** -0.5
+    qg = _group_query(q, kv_heads)  # [B, T, KVH, G, D]
+    scores = jnp.einsum(
+        "bqkgd,bskd->bkgqs", qg.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale  # [B, KVH, G, Tq, Ts]
+    causal = jnp.tril(jnp.ones((seq, seq), dtype=bool))
+    allowed = causal[None, None, None]
+    if mask is not None:
+        allowed = jnp.logical_and(allowed, mask[:, None, None, None, :])
+    scores = jnp.where(allowed, scores, -1e30)
+    weights = _softmax(scores)
+    out = jnp.einsum("bkgqs,bskd->bqkgd", weights.astype(v.dtype), v)
+    return out.reshape(batch, seq, heads, dim)
+
+
+def decode_attention(
+    q: jnp.ndarray,
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    lengths: jnp.ndarray,
+) -> jnp.ndarray:
+    """One-token decode attention against the cache.
+
+    q: [B, H, D] (the new token's queries), k/v_cache: [B, T, KVH, D],
+    lengths: [B] number of valid cache entries (including the new token,
+    already written at position lengths-1). Returns [B, H, D].
+    """
+    batch, heads, dim = q.shape
+    max_len = k_cache.shape[1]
+    kv_heads = k_cache.shape[2]
+    groups = heads // kv_heads
+    scale = dim ** -0.5
+    qg = q.reshape(batch, kv_heads, groups, dim)
+    scores = jnp.einsum(
+        "bkgd,bskd->bkgs", qg.astype(jnp.float32), k_cache.astype(jnp.float32)
+    ) * scale  # [B, KVH, G, T]
+    valid = jnp.arange(max_len)[None, :] < lengths[:, None]  # [B, T]
+    scores = jnp.where(valid[:, None, None, :], scores, -1e30)
+    weights = _softmax(scores)
+    out = jnp.einsum("bkgs,bskd->bkgd", weights.astype(v_cache.dtype), v_cache)
+    return out.reshape(batch, heads, dim)
+
+
+def _softmax(scores: jnp.ndarray) -> jnp.ndarray:
+    scores = scores - jnp.max(scores, axis=-1, keepdims=True)
+    exp = jnp.exp(scores)
+    return exp / jnp.sum(exp, axis=-1, keepdims=True)

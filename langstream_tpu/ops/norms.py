@@ -1,0 +1,16 @@
+"""Normalization ops."""
+
+from __future__ import annotations
+
+import jax.lax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    """RMSNorm in f32 accumulation regardless of input dtype (the TPU
+    recipe: keep reductions in f32, matmuls in bf16)."""
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    variance = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    normed = x32 * jax.lax.rsqrt(variance + eps)
+    return (normed * weight.astype(jnp.float32)).astype(dtype)

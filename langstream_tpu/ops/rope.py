@@ -1,0 +1,43 @@
+"""Rotary position embeddings (RoPE), Llama-3 style."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+def rope_frequencies(
+    head_dim: int,
+    max_positions: int,
+    theta: float = 500000.0,
+    dtype=jnp.float32,
+) -> jnp.ndarray:
+    """Precomputed [max_positions, head_dim//2] complex angles as (cos, sin)
+    stacked on a leading axis of size 2."""
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    positions = jnp.arange(max_positions, dtype=jnp.float32)
+    angles = jnp.outer(positions, inv_freq)
+    return jnp.stack([jnp.cos(angles), jnp.sin(angles)]).astype(dtype)
+
+
+def apply_rope(
+    x: jnp.ndarray,
+    freqs: jnp.ndarray,
+    positions: jnp.ndarray,
+) -> jnp.ndarray:
+    """Rotate ``x`` of shape [..., seq, heads, head_dim] by the angles at
+    ``positions`` [..., seq]. Interleaved-pair convention (HF Llama's
+    rotate_half layout: first half / second half)."""
+    cos = freqs[0][positions]  # [..., seq, head_dim//2]
+    sin = freqs[1][positions]
+    cos = jnp.expand_dims(cos, axis=-2)  # broadcast over heads
+    sin = jnp.expand_dims(sin, axis=-2)
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    rotated = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    )
+    return rotated.astype(x.dtype)

@@ -1,0 +1,27 @@
+"""Mesh / sharding / collectives helpers — the distributed backbone.
+
+The reference's "communication backend" is the broker plus NCCL-less remote
+calls (SURVEY.md §2.5); model tensors never span processes. Here model
+parallelism is first-class: a `jax.sharding.Mesh` over the TPU slice with
+named axes, logical-axis sharding rules, and XLA collectives over ICI/DCN.
+"""
+
+from langstream_tpu.parallel.mesh import (
+    L,
+    LogicalAxes,
+    MeshConfig,
+    build_mesh,
+    logical_to_physical,
+    param_shardings,
+    shard_params,
+)
+
+__all__ = [
+    "L",
+    "LogicalAxes",
+    "MeshConfig",
+    "build_mesh",
+    "logical_to_physical",
+    "param_shardings",
+    "shard_params",
+]

@@ -1,0 +1,9 @@
+"""``jax-local``: in-process TPU inference — the flagship service provider.
+
+Replaces the reference's outbound-HTTPS model providers
+(``OpenAICompletionService.java:52`` etc.) with JAX/XLA running on the TPU
+attached to the agent pod: a Llama-family decoder served by a
+continuous-batching engine with slot-based KV cache, plus a BERT-style
+encoder for embeddings. Model parallelism (tp/fsdp/sp) is provider config,
+not pipeline YAML — one `jax.sharding.Mesh` per process.
+"""

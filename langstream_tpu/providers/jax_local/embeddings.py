@@ -1,0 +1,251 @@
+"""TPU-native text embeddings: a BERT-style bidirectional encoder in JAX.
+
+Replaces the reference's DJL/PyTorch local-embeddings path
+(``AbstractHuggingFaceEmbeddingService.java:38`` — all-MiniLM class models)
+with an in-process JAX encoder: embed + learned positions, N post-norm
+transformer layers with bidirectional attention, masked mean pooling, L2
+normalize. Weights import from a local HuggingFace BERT checkpoint
+(MiniLM / mpnet shapes); random init serves tests and benches.
+
+Batches arrive already coalesced by the embeddings step's batch executor;
+here they are padded to a few fixed length buckets so XLA compiles a
+handful of shapes, then run as one fused device call.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+logger = None
+
+
+@dataclasses.dataclass(frozen=True)
+class EncoderConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 384
+    intermediate_size: int = 1536
+    num_layers: int = 6
+    num_heads: int = 12
+    max_positions: int = 512
+    norm_eps: float = 1e-12
+    dtype: Any = jnp.float32
+
+    @classmethod
+    def minilm_l6(cls) -> "EncoderConfig":
+        return cls()
+
+    @classmethod
+    def tiny(cls) -> "EncoderConfig":
+        return cls(vocab_size=300, hidden_size=32, intermediate_size=64,
+                   num_layers=2, num_heads=4, max_positions=64)
+
+    @classmethod
+    def from_dict(cls, config: Dict[str, Any]) -> "EncoderConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        clean = {k.replace("-", "_"): v for k, v in config.items()}
+        preset = clean.pop("preset", None)
+        if preset == "minilm-l6":
+            return cls.minilm_l6()
+        if preset == "tiny":
+            return cls.tiny()
+        return cls(**{k: v for k, v in clean.items() if k in known})
+
+
+def init_encoder_params(config: EncoderConfig, seed: int = 0) -> Dict[str, Any]:
+    key = jax.random.PRNGKey(seed)
+    keys = jax.random.split(key, 12)
+    h, f, v = config.hidden_size, config.intermediate_size, config.vocab_size
+    L = config.num_layers
+    dt = config.dtype
+
+    def normal(k, shape, scale=0.02):
+        return (jax.random.normal(k, shape, dtype=jnp.float32) * scale).astype(dt)
+
+    return {
+        "tok_emb": normal(keys[0], (v, h)),
+        "pos_emb": normal(keys[1], (config.max_positions, h)),
+        "emb_norm_w": jnp.ones((h,), jnp.float32),
+        "emb_norm_b": jnp.zeros((h,), jnp.float32),
+        "wq": normal(keys[2], (L, h, h)), "bq": jnp.zeros((L, h), dt),
+        "wk": normal(keys[3], (L, h, h)), "bk": jnp.zeros((L, h), dt),
+        "wv": normal(keys[4], (L, h, h)), "bv": jnp.zeros((L, h), dt),
+        "wo": normal(keys[5], (L, h, h)), "bo": jnp.zeros((L, h), dt),
+        "attn_norm_w": jnp.ones((L, h), jnp.float32),
+        "attn_norm_b": jnp.zeros((L, h), jnp.float32),
+        "w_in": normal(keys[6], (L, h, f)), "b_in": jnp.zeros((L, f), dt),
+        "w_out": normal(keys[7], (L, f, h)), "b_out": jnp.zeros((L, h), dt),
+        "mlp_norm_w": jnp.ones((L, h), jnp.float32),
+        "mlp_norm_b": jnp.zeros((L, h), jnp.float32),
+    }
+
+
+def _layer_norm(x, weight, bias, eps):
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    out = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    return (out * weight + bias).astype(x.dtype)
+
+
+def encode(
+    config: EncoderConfig,
+    params: Dict[str, Any],
+    tokens: jnp.ndarray,  # [B, T]
+    mask: jnp.ndarray,    # [B, T] bool
+) -> jnp.ndarray:
+    """Forward pass → L2-normalized mean-pooled embeddings [B, H]."""
+    batch, seq = tokens.shape
+    heads = config.num_heads
+    hd = config.hidden_size // heads
+    x = params["tok_emb"][tokens] + params["pos_emb"][:seq][None]
+    x = _layer_norm(x, params["emb_norm_w"], params["emb_norm_b"], config.norm_eps)
+    x = x.astype(config.dtype)
+
+    layer_params = (
+        params["wq"], params["bq"], params["wk"], params["bk"],
+        params["wv"], params["bv"], params["wo"], params["bo"],
+        params["attn_norm_w"], params["attn_norm_b"],
+        params["w_in"], params["b_in"], params["w_out"], params["b_out"],
+        params["mlp_norm_w"], params["mlp_norm_b"],
+    )
+
+    def layer_fn(x, layer):
+        (wq, bq, wk, bk, wv, bv, wo, bo, anw, anb,
+         w_in, b_in, w_out, b_out, mnw, mnb) = layer
+        q = (jnp.einsum("bth,hd->btd", x, wq) + bq).reshape(batch, seq, heads, hd)
+        k = (jnp.einsum("bth,hd->btd", x, wk) + bk).reshape(batch, seq, heads, hd)
+        v = (jnp.einsum("bth,hd->btd", x, wv) + bv).reshape(batch, seq, heads, hd)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+        scores = scores / math.sqrt(hd)
+        scores = jnp.where(mask[:, None, None, :], scores, -1e30)
+        weights = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", weights, v).reshape(batch, seq, -1)
+        attn = jnp.einsum("bth,hd->btd", attn, wo) + bo
+        x = _layer_norm(x + attn, anw, anb, config.norm_eps)
+        mlp = jax.nn.gelu(jnp.einsum("bth,hf->btf", x, w_in) + b_in)
+        mlp = jnp.einsum("btf,fh->bth", mlp, w_out) + b_out
+        x = _layer_norm(x + mlp, mnw, mnb, config.norm_eps)
+        return x, None
+
+    x, _ = jax.lax.scan(layer_fn, x, layer_params)
+    # masked mean pooling + L2 normalize (sentence-transformers recipe)
+    weights = mask.astype(jnp.float32)[..., None]
+    pooled = (x.astype(jnp.float32) * weights).sum(1) / jnp.maximum(
+        weights.sum(1), 1e-9
+    )
+    norm = jnp.linalg.norm(pooled, axis=-1, keepdims=True)
+    return pooled / jnp.maximum(norm, 1e-9)
+
+
+def load_hf_bert(path_or_model, dtype=jnp.float32) -> Tuple[EncoderConfig, Dict[str, Any]]:
+    """Convert a HuggingFace BERT-architecture checkpoint (MiniLM etc.)."""
+    import torch
+
+    if isinstance(path_or_model, str):
+        from transformers import AutoModel
+
+        model = AutoModel.from_pretrained(
+            path_or_model, torch_dtype=torch.float32, local_files_only=True
+        )
+    else:
+        model = path_or_model
+    hf = model.config
+    config = EncoderConfig(
+        vocab_size=hf.vocab_size,
+        hidden_size=hf.hidden_size,
+        intermediate_size=hf.intermediate_size,
+        num_layers=hf.num_hidden_layers,
+        num_heads=hf.num_attention_heads,
+        max_positions=hf.max_position_embeddings,
+        norm_eps=hf.layer_norm_eps,
+        dtype=dtype,
+    )
+    state = model.state_dict()
+    L = config.num_layers
+
+    def get(name, transpose=False):
+        t = state[name].to(torch.float32).numpy()
+        return jnp.asarray(t.T if transpose else t, dtype=dtype)
+
+    def stack(pattern, transpose=True):
+        return jnp.asarray(
+            np.stack([
+                state[pattern.format(i)].to(torch.float32).numpy().T
+                if transpose else state[pattern.format(i)].to(torch.float32).numpy()
+                for i in range(L)
+            ]),
+            dtype=dtype,
+        )
+
+    prefix = "encoder.layer.{}."
+    params = {
+        "tok_emb": get("embeddings.word_embeddings.weight"),
+        "pos_emb": get("embeddings.position_embeddings.weight"),
+        "emb_norm_w": get("embeddings.LayerNorm.weight").astype(jnp.float32),
+        "emb_norm_b": get("embeddings.LayerNorm.bias").astype(jnp.float32),
+        "wq": stack(prefix + "attention.self.query.weight"),
+        "bq": stack(prefix + "attention.self.query.bias", transpose=False),
+        "wk": stack(prefix + "attention.self.key.weight"),
+        "bk": stack(prefix + "attention.self.key.bias", transpose=False),
+        "wv": stack(prefix + "attention.self.value.weight"),
+        "bv": stack(prefix + "attention.self.value.bias", transpose=False),
+        "wo": stack(prefix + "attention.output.dense.weight"),
+        "bo": stack(prefix + "attention.output.dense.bias", transpose=False),
+        "attn_norm_w": stack(prefix + "attention.output.LayerNorm.weight", transpose=False).astype(jnp.float32),
+        "attn_norm_b": stack(prefix + "attention.output.LayerNorm.bias", transpose=False).astype(jnp.float32),
+        "w_in": stack(prefix + "intermediate.dense.weight"),
+        "b_in": stack(prefix + "intermediate.dense.bias", transpose=False),
+        "w_out": stack(prefix + "output.dense.weight"),
+        "b_out": stack(prefix + "output.dense.bias", transpose=False),
+        "mlp_norm_w": stack(prefix + "output.LayerNorm.weight", transpose=False).astype(jnp.float32),
+        "mlp_norm_b": stack(prefix + "output.LayerNorm.bias", transpose=False).astype(jnp.float32),
+    }
+    # token_type embeddings fold into token embeddings (single-segment use)
+    if "embeddings.token_type_embeddings.weight" in state:
+        params["tok_emb"] = params["tok_emb"] + get(
+            "embeddings.token_type_embeddings.weight"
+        )[0][None, :]
+    return config, params
+
+
+class JaxEmbedder:
+    """Bucketed-length batch embedding front-end."""
+
+    def __init__(
+        self,
+        config: EncoderConfig,
+        params: Dict[str, Any],
+        tokenizer,
+        max_length: int = 256,
+    ) -> None:
+        self.config = config
+        self.params = params
+        self.tokenizer = tokenizer
+        self.max_length = min(max_length, config.max_positions)
+        self._jit = jax.jit(
+            lambda p, t, m: encode(config, p, t, m)
+        )
+
+    def embed(self, texts: List[str]) -> List[List[float]]:
+        token_lists = [
+            self.tokenizer.encode(text)[: self.max_length] for text in texts
+        ]
+        longest = max((len(t) for t in token_lists), default=1)
+        bucket = 16
+        while bucket < longest:
+            bucket *= 2
+        bucket = min(bucket, self.max_length)
+        batch = np.zeros((len(texts), bucket), dtype=np.int32)
+        mask = np.zeros((len(texts), bucket), dtype=bool)
+        for i, tokens in enumerate(token_lists):
+            tokens = tokens[:bucket]
+            batch[i, : len(tokens)] = tokens
+            mask[i, : len(tokens)] = True
+        out = self._jit(self.params, jnp.asarray(batch), jnp.asarray(mask))
+        return np.asarray(out).tolist()

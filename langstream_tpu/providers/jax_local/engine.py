@@ -1,0 +1,691 @@
+"""Continuous-batching decode engine — the serving core of ``jax-local``.
+
+Design (TPU-first, see SURVEY.md §7 phase 4/5):
+
+- **Slot-based static batch**: the KV cache holds ``max_slots`` sequences
+  of ``max_seq_len``; every decode step runs ALL slots through one jitted
+  ``decode_step`` — static shapes, one compilation, MXU-friendly batched
+  matmuls. Empty slots ride along masked (their tokens are ignored), so
+  admission/retirement never recompiles.
+- **Continuous batching**: requests join mid-flight. A joining request
+  prefills into its slot (bucketed prompt lengths → few compilations) while
+  other slots keep decoding; a finishing request frees its slot
+  immediately. No batch barrier — exactly the property the runner's
+  emit-as-you-complete contract preserves upstream.
+- **Dedicated device thread**: the asyncio side enqueues requests
+  (thread-safe) and receives per-token callbacks via
+  ``loop.call_soon_threadsafe``; device dispatch never blocks the event
+  loop.
+- **Session KV reuse** (BASELINE config #5): a finished request may pin its
+  slot under a session id; a follow-up with the same session id whose
+  prompt extends the pinned history skips re-prefilling the shared prefix
+  (teacher-forced suffix only). Keyed by record key upstream, so broker
+  partitioning gives replica affinity.
+- **In-jit sampling**: greedy / temperature / top-k sampling runs on
+  device inside the decode jit; only the sampled token ids [S] cross to
+  host per step.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import functools
+import logging
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from langstream_tpu.ops.rope import rope_frequencies
+from langstream_tpu.parallel.mesh import (
+    MeshConfig,
+    build_mesh,
+    logical_to_physical,
+    param_shardings,
+    shard_params,
+)
+from langstream_tpu.providers.jax_local import model as model_lib
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class SamplingParams:
+    temperature: float = 0.0  # 0 = greedy
+    top_k: int = 0            # 0 = no top-k
+    top_p: float = 0.0        # 0 = no nucleus truncation
+    max_new_tokens: int = 256
+
+
+@dataclasses.dataclass
+class GenerationRequest:
+    prompt_tokens: List[int]
+    sampling: SamplingParams
+    stop_tokens: Set[int] = dataclasses.field(default_factory=set)
+    # called from the engine thread via call_soon_threadsafe(loop) with
+    # (token_id, is_last)
+    on_token: Optional[Callable[[int, bool], None]] = None
+    session_id: Optional[str] = None
+    future: Optional[Any] = None  # asyncio.Future or concurrent future
+    loop: Optional[Any] = None
+
+
+@dataclasses.dataclass
+class GenerationResult:
+    tokens: List[int]
+    prompt_tokens: int
+    finish_reason: str = "stop"
+    prefill_time: float = 0.0
+    decode_time: float = 0.0
+
+
+@dataclasses.dataclass
+class _Slot:
+    request: Optional[GenerationRequest] = None
+    length: int = 0                 # valid cache length
+    generated: Optional[List[int]] = None
+    history: Optional[List[int]] = None  # full token history in cache
+    session_id: Optional[str] = None     # pinned session (slot free but warm)
+
+    @property
+    def active(self) -> bool:
+        return self.request is not None
+
+
+def _bucket(length: int, buckets: List[int]) -> int:
+    for size in buckets:
+        if length <= size:
+            return size
+    return buckets[-1]
+
+
+class DecodeEngine:
+    """Runs one model on one mesh with continuous batching."""
+
+    def __init__(
+        self,
+        config: model_lib.LlamaConfig,
+        params: Dict[str, Any],
+        *,
+        mesh_config: Optional[MeshConfig] = None,
+        max_slots: int = 8,
+        max_seq_len: Optional[int] = None,
+        prefill_buckets: Optional[List[int]] = None,
+        decode_chunk: int = 8,
+        seed: int = 0,
+    ) -> None:
+        self.config = config
+        self.max_slots = max_slots
+        self.decode_chunk = max(1, decode_chunk)
+        self.max_seq_len = min(
+            max_seq_len or config.max_seq_len, config.max_seq_len
+        )
+        self.prefill_buckets = prefill_buckets or self._default_buckets()
+        if mesh_config is None:
+            # default: single device. Sharding is opt-in via provider
+            # config (mesh: {tp: N}) so small models never get axes that
+            # don't divide their head counts.
+            mesh_config = MeshConfig()
+        if mesh_config.tp > 1:
+            for name, size in (
+                ("num_kv_heads", config.num_kv_heads),
+                ("num_heads", config.num_heads),
+                ("intermediate_size", config.intermediate_size),
+            ):
+                if size % mesh_config.tp != 0:
+                    raise ValueError(
+                        f"tp={mesh_config.tp} must divide {name}={size}"
+                    )
+        self.mesh = build_mesh(
+            mesh_config, devices=jax.devices()[: mesh_config.size]
+        )
+        axes = model_lib.logical_axes(config)
+        with self.mesh:
+            self.params = shard_params(params, axes, self.mesh)
+        self.freqs = rope_frequencies(
+            config.dims_per_head, config.max_seq_len, config.rope_theta
+        )
+        cache_sharding = param_shardings(
+            model_lib.cache_logical_axes(), self.mesh
+        )
+        with self.mesh:
+            self.cache = jax.device_put(
+                model_lib.init_cache(config, max_slots, self.max_seq_len),
+                cache_sharding,
+            )
+        self.slots = [_Slot() for _ in range(max_slots)]
+        self._rng = jax.random.PRNGKey(seed)
+
+        self._queue: "queue.Queue[Optional[GenerationRequest]]" = queue.Queue()
+        self._pending: List[GenerationRequest] = []
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+        self._compiled_prefill: Dict[int, Any] = {}
+        self._decode_fns: Dict[int, Any] = {}
+        self.stats = {
+            "tokens_generated": 0,
+            "requests": 0,
+            "prefill_calls": 0,
+            "decode_steps": 0,
+            "session_hits": 0,
+        }
+
+    def _default_buckets(self) -> List[int]:
+        buckets, size = [], 64
+        limit = self.max_seq_len if hasattr(self, "max_seq_len") else 4096
+        while size < limit:
+            buckets.append(size)
+            size *= 2
+        buckets.append(limit)
+        return buckets
+
+    # ------------------------------------------------------------------ #
+    # jitted device functions
+    # ------------------------------------------------------------------ #
+    def _get_prefill(self, bucket: int):
+        fn = self._compiled_prefill.get(bucket)
+        if fn is None:
+            config, freqs = self.config, self.freqs
+
+            @functools.partial(jax.jit, donate_argnums=(1,))
+            def run(params, cache, tokens, lengths, slot_ids):
+                return model_lib.prefill(
+                    config, params, cache, tokens, lengths, slot_ids, freqs
+                )
+
+            fn = run
+            self._compiled_prefill[bucket] = fn
+        return fn
+
+    def _get_decode(self, steps: int = 1):
+        """Jitted K-step decode: a ``lax.scan`` of decode+sample, so one
+        host↔device dispatch yields K tokens per slot. Chunking amortizes
+        dispatch latency (which dominates when the chip sits behind a
+        network tunnel or when the model is small); stop conditions are
+        applied host-side afterwards, surplus steps for a finished slot
+        are discarded and its length pointer rewound."""
+        fn = self._decode_fns.get(steps)
+        if fn is None:
+            config, freqs = self.config, self.freqs
+
+            @functools.partial(jax.jit, donate_argnums=(1,))
+            def run(params, cache, tokens, lengths, active, write_mask,
+                    temperature, top_k, top_p, rng):
+                def body(carry, key):
+                    cache, tokens, lengths = carry
+                    cache, logits = model_lib.decode_step(
+                        config, params, cache, tokens, lengths, freqs, write_mask
+                    )
+                    sampled = _sample(logits, temperature, top_k, key, top_p)
+                    sampled = jnp.where(active, sampled, 0)
+                    lengths = jnp.where(active, lengths + 1, lengths)
+                    return (cache, sampled, lengths), sampled
+
+                keys = jax.random.split(rng, steps)
+                (cache, _, _), out = jax.lax.scan(
+                    body, (cache, tokens, lengths), keys
+                )
+                return cache, out.T  # [S, K]
+
+            fn = run
+            self._decode_fns[steps] = fn
+        return fn
+
+    # ------------------------------------------------------------------ #
+    # public API (thread-safe)
+    # ------------------------------------------------------------------ #
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._running = True
+        self._thread = threading.Thread(
+            target=self._run_loop, name="jax-local-engine", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        self._queue.put(None)
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+
+    def submit(self, request: GenerationRequest) -> None:
+        limit = min(self.max_seq_len - 1, self.prefill_buckets[-1])
+        if len(request.prompt_tokens) > limit:
+            raise ValueError(
+                f"prompt of {len(request.prompt_tokens)} tokens exceeds the "
+                f"engine limit of {limit} (max_seq_len {self.max_seq_len}, "
+                f"largest prefill bucket {self.prefill_buckets[-1]})"
+            )
+        self._queue.put(request)
+
+    async def generate(
+        self,
+        prompt_tokens: List[int],
+        sampling: SamplingParams,
+        *,
+        stop_tokens: Optional[Set[int]] = None,
+        on_token: Optional[Callable[[int, bool], None]] = None,
+        session_id: Optional[str] = None,
+    ) -> GenerationResult:
+        """Asyncio entry: submit and await the result."""
+        loop = asyncio.get_running_loop()
+        future: "asyncio.Future[GenerationResult]" = loop.create_future()
+        request = GenerationRequest(
+            prompt_tokens=list(prompt_tokens),
+            sampling=sampling,
+            stop_tokens=stop_tokens or set(),
+            on_token=on_token,
+            session_id=session_id,
+            future=future,
+            loop=loop,
+        )
+        self.start()
+        self.submit(request)
+        return await future
+
+    # ------------------------------------------------------------------ #
+    # engine thread
+    # ------------------------------------------------------------------ #
+    def _run_loop(self) -> None:
+        logger.info(
+            "engine started: %d slots × %d ctx, mesh %s",
+            self.max_slots, self.max_seq_len, dict(self.mesh.shape),
+        )
+        try:
+            with self.mesh:
+                while self._running:
+                    self._drain_queue(block=not self._any_active() and not self._pending)
+                    if not self._running:
+                        break
+                    if self._pending and any(not s.active for s in self.slots):
+                        # admission linger: give a burst of submissions a
+                        # beat to land so prefill batches fill up and decode
+                        # waves stay aligned (amortizes dispatch latency)
+                        time.sleep(0.003)
+                        self._drain_queue(block=False)
+                    self._admit()
+                    if self._any_active():
+                        self._decode_once()
+        except BaseException:  # noqa: BLE001
+            logger.exception("engine loop crashed")
+            self._fail_all_pending()
+            raise
+
+    def _any_active(self) -> bool:
+        return any(slot.active for slot in self.slots)
+
+    def _drain_queue(self, block: bool) -> None:
+        try:
+            item = self._queue.get(timeout=0.05) if block else self._queue.get_nowait()
+            if item is not None:
+                self._pending.append(item)
+        except queue.Empty:
+            return
+        while True:
+            try:
+                item = self._queue.get_nowait()
+                if item is not None:
+                    self._pending.append(item)
+            except queue.Empty:
+                return
+
+    def _find_slot(self, request: GenerationRequest) -> Optional[int]:
+        # session hit first
+        if request.session_id is not None:
+            for i, slot in enumerate(self.slots):
+                if (
+                    not slot.active
+                    and slot.session_id == request.session_id
+                    and slot.history is not None
+                ):
+                    return i
+        for i, slot in enumerate(self.slots):
+            if not slot.active and slot.session_id is None:
+                return i
+        # evict the least-recently pinned session slot
+        for i, slot in enumerate(self.slots):
+            if not slot.active:
+                return i
+        return None
+
+    # a warm suffix longer than this re-prefills cold instead: the forcing
+    # path is one full decode dispatch per token, so past this point the
+    # batched bucketed prefill wins (proper chunked prefill-at-offset is
+    # future work)
+    MAX_WARM_SUFFIX = 48
+
+    def _session_warm(self, index: int, request: GenerationRequest) -> bool:
+        slot = self.slots[index]
+        prompt = request.prompt_tokens
+        return (
+            request.session_id is not None
+            and slot.session_id == request.session_id
+            and slot.history is not None
+            and len(slot.history) < len(prompt)
+            and len(prompt) - len(slot.history) <= self.MAX_WARM_SUFFIX
+            and prompt[: len(slot.history)] == slot.history
+        )
+
+    def _admit(self) -> None:
+        """Move pending requests into slots. Cold requests sharing a prompt
+        bucket are prefilled in ONE batched device call (batch padded to a
+        power of two so compilations stay bounded); warm-session requests
+        take the teacher-forcing path individually."""
+        while self._pending:
+            cold: List[Tuple[int, GenerationRequest]] = []
+            cold_bucket: Optional[int] = None
+            progressed = False
+            while self._pending:
+                request = self._pending[0]
+                index = self._find_slot(request)
+                if index is None:
+                    break
+                if self._session_warm(index, request):
+                    self._pending.pop(0)
+                    self._prefill_warm(index, request)
+                    progressed = True
+                    continue
+                bucket = _bucket(len(request.prompt_tokens), self.prefill_buckets)
+                if cold_bucket is None:
+                    cold_bucket = bucket
+                elif bucket != cold_bucket:
+                    break  # different bucket: next outer round
+                self._pending.pop(0)
+                self.slots[index].request = request  # reserve the slot
+                cold.append((index, request))
+                # batch caps at the largest power of two ≤ max_slots
+                if len(cold) >= self.max_slots:
+                    break
+            if cold:
+                self._prefill_batch(cold, cold_bucket)
+                progressed = True
+            if not progressed:
+                return
+
+    def _prefill_batch(
+        self, batch: List[Tuple[int, GenerationRequest]], bucket: int
+    ) -> None:
+        started = time.perf_counter()
+        # split into power-of-two group sizes (no padding rows — a padding
+        # row would have to scatter somewhere in the cache) so the per-
+        # (bucket, batch) compilation count stays logarithmic
+        groups: List[List[Tuple[int, GenerationRequest]]] = []
+        remaining = batch
+        while remaining:
+            size = 1
+            while size * 2 <= len(remaining):
+                size *= 2
+            groups.append(remaining[:size])
+            remaining = remaining[size:]
+        for group in groups:
+            size = len(group)
+            tokens = np.zeros((size, bucket), dtype=np.int32)
+            lengths = np.zeros((size,), dtype=np.int32)
+            slot_ids = np.zeros((size,), dtype=np.int32)
+            for row, (index, request) in enumerate(group):
+                prompt = request.prompt_tokens
+                tokens[row, : len(prompt)] = prompt
+                lengths[row] = len(prompt)
+                slot_ids[row] = index
+                slot = self.slots[index]
+                slot.generated = []
+                slot.history = list(prompt)
+                slot.session_id = None
+                slot.length = len(prompt)
+            run = self._get_prefill(bucket)
+            self.cache, logits = run(
+                self.params,
+                self.cache,
+                jnp.asarray(tokens),
+                jnp.asarray(lengths),
+                jnp.asarray(slot_ids),
+            )
+            self.stats["prefill_calls"] += 1
+            for row, (index, request) in enumerate(group):
+                first = self._sample_host(logits[row], request.sampling)
+                self._emit_token(index, int(first))
+                request._prefill_time = time.perf_counter() - started  # type: ignore[attr-defined]
+
+    def _prefill_warm(self, index: int, request: GenerationRequest) -> None:
+        """Warm-session admission: the cache already holds the shared
+        prefix; teacher-force only the new suffix."""
+        slot = self.slots[index]
+        prompt = request.prompt_tokens
+        started = time.perf_counter()
+        reused = len(slot.history)
+        self.stats["session_hits"] += 1
+        slot.request = request
+        slot.generated = []
+        slot.history = list(prompt)
+        slot.session_id = None
+        slot.length = reused
+        for token in prompt[reused:]:
+            self._force_token(index, int(token))
+        first = self._decode_single_for_logits(index, request.sampling)
+        self._emit_token(index, int(first))
+        request._prefill_time = time.perf_counter() - started  # type: ignore[attr-defined]
+
+    def _force_token(self, index: int, token: int) -> None:
+        """Advance one slot by a known token (no sampling)."""
+        slot = self.slots[index]
+        tokens = np.zeros((self.max_slots,), dtype=np.int32)
+        lengths = np.array([s.length for s in self.slots], dtype=np.int32)
+        tokens[index] = token
+        lengths[index] = slot.length + 1
+        write_mask = np.zeros((self.max_slots,), dtype=bool)
+        write_mask[index] = True
+        run = self._get_decode(1)
+        self._rng, step_key = jax.random.split(self._rng)
+        self.cache, _ = run(
+            self.params, self.cache, jnp.asarray(tokens), jnp.asarray(lengths),
+            jnp.zeros((self.max_slots,), dtype=bool),
+            jnp.asarray(write_mask),
+            jnp.zeros((self.max_slots,), dtype=jnp.float32),
+            jnp.zeros((self.max_slots,), dtype=jnp.int32),
+            jnp.zeros((self.max_slots,), dtype=jnp.float32),
+            step_key,
+        )
+        slot.length += 1
+
+    def _decode_single_for_logits(self, index: int, sampling: SamplingParams) -> int:
+        """After forcing a suffix, the next sampled token needs the last
+        token's logits; re-run the last position as a 1-token prefill of
+        length slot.length (positions already cached — we recompute the
+        last token's logits via a masked decode where we re-feed the last
+        history token WITHOUT advancing the slot length)."""
+        slot = self.slots[index]
+        last_token = slot.history[-1] if slot.history else 0
+        tokens = np.zeros((self.max_slots,), dtype=np.int32)
+        lengths = np.array([s.length for s in self.slots], dtype=np.int32)
+        tokens[index] = last_token
+        # re-write at the same position: length stays
+        config, freqs = self.config, self.freqs
+        cache, logits = model_lib.decode_step(
+            config, self.params, self.cache, jnp.asarray(tokens),
+            jnp.asarray(lengths), freqs,
+            write_mask=jnp.zeros((self.max_slots,), dtype=bool),
+        )
+        self.cache = cache
+        return self._sample_host(logits[index], sampling)
+
+    def _sample_host(self, logits, sampling: SamplingParams) -> int:
+        self._rng, key = jax.random.split(self._rng)
+        token = _sample(
+            logits[None],
+            jnp.asarray([sampling.temperature], dtype=jnp.float32),
+            jnp.asarray([sampling.top_k], dtype=jnp.int32),
+            key,
+            jnp.asarray([sampling.top_p], dtype=jnp.float32),
+        )
+        return int(np.asarray(token)[0])
+
+    def _decode_once(self) -> None:
+        tokens = np.zeros((self.max_slots,), dtype=np.int32)
+        lengths = np.zeros((self.max_slots,), dtype=np.int32)
+        active = np.zeros((self.max_slots,), dtype=bool)
+        temperature = np.zeros((self.max_slots,), dtype=np.float32)
+        top_k = np.zeros((self.max_slots,), dtype=np.int32)
+        top_p = np.zeros((self.max_slots,), dtype=np.float32)
+        steps = self.decode_chunk
+        for i, slot in enumerate(self.slots):
+            lengths[i] = slot.length
+            if slot.active:
+                active[i] = True
+                tokens[i] = slot.history[-1]
+                lengths[i] = slot.length + 1
+                temperature[i] = slot.request.sampling.temperature
+                top_k[i] = slot.request.sampling.top_k
+                top_p[i] = slot.request.sampling.top_p
+                # a chunk writes cache positions up to length+steps-1;
+                # drop to single-step near the context boundary
+                if self.max_seq_len - slot.length - 1 < steps:
+                    steps = 1
+        run = self._get_decode(steps)
+        self._rng, step_key = jax.random.split(self._rng)
+        self.cache, out_tokens = run(
+            self.params, self.cache, jnp.asarray(tokens), jnp.asarray(lengths),
+            jnp.asarray(active), jnp.asarray(active), jnp.asarray(temperature),
+            jnp.asarray(top_k), jnp.asarray(top_p), step_key,
+        )
+        out_host = np.asarray(out_tokens)  # [S, steps]
+        self.stats["decode_steps"] += steps
+        for i, slot in enumerate(self.slots):
+            if not active[i]:
+                continue
+            for j in range(steps):
+                if not slot.active:
+                    # finished mid-chunk: surplus sampled tokens discarded;
+                    # the length pointer stopped where the stop hit, so the
+                    # garbage cache rows beyond it are dead
+                    break
+                slot.length += 1
+                self._emit_token(i, int(out_host[i, j]))
+
+    def _emit_token(self, index: int, token: int) -> None:
+        """Record a newly generated token for a slot; finish if stopping."""
+        slot = self.slots[index]
+        request = slot.request
+        slot.generated.append(token)
+        hit_stop = token in request.stop_tokens
+        if not hit_stop:
+            # stop tokens stay out of the history so a session follow-up
+            # prompt (which re-renders the answer without the stop marker)
+            # still prefix-matches the warm cache
+            slot.history.append(token)
+        self.stats["tokens_generated"] += 1
+        done = (
+            hit_stop
+            or len(slot.generated) >= request.sampling.max_new_tokens
+            or slot.length + 1 >= self.max_seq_len
+        )
+        if request.on_token is not None and not hit_stop:
+            self._post(request, request.on_token, token, done)
+        if done:
+            self._finish(index, "stop" if hit_stop else "length")
+
+    def _finish(self, index: int, reason: str) -> None:
+        slot = self.slots[index]
+        request = slot.request
+        generated = list(slot.generated)
+        if generated and generated[-1] in request.stop_tokens:
+            generated = generated[:-1]
+        result = GenerationResult(
+            tokens=generated,
+            prompt_tokens=len(request.prompt_tokens),
+            finish_reason=reason,
+            prefill_time=getattr(request, "_prefill_time", 0.0),
+        )
+        self.stats["requests"] += 1
+        # pin the slot for session reuse; otherwise free it fully
+        slot.request = None
+        slot.generated = None
+        if request.session_id is not None:
+            slot.session_id = request.session_id
+            # keep only the history that is actually IN the cache (the
+            # final sampled token is never written before finish)
+            slot.history = slot.history[: slot.length]
+        else:
+            slot.session_id = None
+            slot.history = None
+            slot.length = 0
+        if request.future is not None:
+            self._post_future(request, result)
+
+    def _post(self, request: GenerationRequest, fn, *args) -> None:
+        if request.loop is not None:
+            request.loop.call_soon_threadsafe(fn, *args)
+        else:
+            fn(*args)
+
+    def _post_future(self, request: GenerationRequest, result) -> None:
+        def resolve():
+            if not request.future.done():
+                request.future.set_result(result)
+
+        if request.loop is not None:
+            request.loop.call_soon_threadsafe(resolve)
+        else:
+            request.future.set_result(result)
+
+    def _fail_all_pending(self) -> None:
+        error = RuntimeError("decode engine crashed; see logs")
+
+        def fail(request: GenerationRequest) -> None:
+            if request.future is None:
+                return
+
+            def resolve() -> None:
+                if not request.future.done():
+                    request.future.set_exception(error)
+
+            if request.loop is not None:
+                request.loop.call_soon_threadsafe(resolve)
+            else:
+                resolve()
+
+        for request in self._pending:
+            fail(request)
+        for slot in self.slots:
+            if slot.active:
+                fail(slot.request)
+
+
+def _sample(
+    logits: jnp.ndarray,      # [S, V] f32
+    temperature: jnp.ndarray, # [S]
+    top_k: jnp.ndarray,       # [S] (0 = disabled)
+    rng: jnp.ndarray,
+    top_p: Optional[jnp.ndarray] = None,  # [S] (0 = disabled)
+) -> jnp.ndarray:
+    """Per-slot sampling on device: greedy when temperature==0, else
+    temperature softmax with optional top-k and/or top-p truncation."""
+    slots, vocab = logits.shape
+    greedy = jnp.argmax(logits, axis=-1)
+    sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]  # descending
+    # top-k mask: keep logits >= k-th largest (k clamped to [1, V])
+    k = jnp.clip(top_k, 0, vocab)
+    kth_index = jnp.clip(k - 1, 0, vocab - 1)
+    kth_value = jnp.take_along_axis(sorted_logits, kth_index[:, None], axis=1)
+    masked = jnp.where(
+        (k[:, None] > 0) & (logits < kth_value), -jnp.inf, logits
+    )
+    if top_p is not None:
+        # nucleus: keep the smallest set of tokens whose prob mass >= p
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cumulative = jnp.cumsum(probs, axis=-1)
+        # threshold = smallest sorted logit still inside the nucleus
+        inside = cumulative - probs < top_p[:, None]
+        cut = jnp.where(inside, sorted_logits, jnp.inf).min(axis=-1)
+        masked = jnp.where(
+            (top_p[:, None] > 0) & (masked < cut[:, None]), -jnp.inf, masked
+        )
+    scaled = masked / jnp.maximum(temperature, 1e-6)[:, None]
+    sampled = jax.random.categorical(rng, scaled, axis=-1)
+    return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)

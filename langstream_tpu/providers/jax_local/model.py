@@ -1,0 +1,434 @@
+"""Llama-family decoder in functional JAX (stacked layers, lax.scan).
+
+Pure-pytree formulation (no flax module state): parameters are a dict of
+stacked per-layer arrays so the layer loop is one ``lax.scan`` — one
+compilation for 8 or 80 layers, and the scan carry keeps activations in
+registers/VMEM instead of re-reading HBM per layer.
+
+Architecture: pre-norm transformer with RMSNorm, RoPE, GQA attention, and
+SwiGLU MLP — Llama 2/3 family (config covers TinyLlama through 70B).
+Weights import from a local HuggingFace checkpoint (torch state dict →
+stacked jax arrays), or random-init for benchmarks.
+
+Logical sharding axes per parameter feed the mesh rules in
+``langstream_tpu.parallel.mesh`` (tp shards heads/mlp, fsdp shards embed).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from langstream_tpu.ops.attention import decode_attention, prefill_attention
+from langstream_tpu.ops.norms import rms_norm
+from langstream_tpu.ops.rope import apply_rope, rope_frequencies
+from langstream_tpu.parallel.mesh import L
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 2048
+    intermediate_size: int = 5632
+    num_layers: int = 22
+    num_heads: int = 32
+    num_kv_heads: int = 4
+    head_dim: Optional[int] = None
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    max_seq_len: int = 4096
+    tie_embeddings: bool = False
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def dims_per_head(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    @classmethod
+    def llama3_8b(cls, max_seq_len: int = 8192) -> "LlamaConfig":
+        return cls(
+            vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+            num_layers=32, num_heads=32, num_kv_heads=8,
+            rope_theta=500000.0, max_seq_len=max_seq_len,
+        )
+
+    @classmethod
+    def llama3_70b(cls, max_seq_len: int = 8192) -> "LlamaConfig":
+        return cls(
+            vocab_size=128256, hidden_size=8192, intermediate_size=28672,
+            num_layers=80, num_heads=64, num_kv_heads=8,
+            rope_theta=500000.0, max_seq_len=max_seq_len,
+        )
+
+    @classmethod
+    def llama3_1b(cls, max_seq_len: int = 8192) -> "LlamaConfig":
+        # Llama-3.2-1B shape
+        return cls(
+            vocab_size=128256, hidden_size=2048, intermediate_size=8192,
+            num_layers=16, num_heads=32, num_kv_heads=8, head_dim=64,
+            rope_theta=500000.0, max_seq_len=max_seq_len, tie_embeddings=True,
+        )
+
+    @classmethod
+    def tiny(cls, max_seq_len: int = 256) -> "LlamaConfig":
+        """Test-size config for CPU runs."""
+        return cls(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_layers=2, num_heads=4, num_kv_heads=2,
+            max_seq_len=max_seq_len, dtype=jnp.float32,
+        )
+
+    @classmethod
+    def from_dict(cls, config: Dict[str, Any]) -> "LlamaConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        clean = {k.replace("-", "_"): v for k, v in config.items()}
+        presets = {
+            "llama-3-8b": cls.llama3_8b, "llama-3-70b": cls.llama3_70b,
+            "llama-3-1b": cls.llama3_1b, "tiny": cls.tiny,
+        }
+        preset = clean.pop("preset", None)
+        if preset:
+            base = presets[preset]()
+            return dataclasses.replace(
+                base, **{k: v for k, v in clean.items() if k in known}
+            )
+        return cls(**{k: v for k, v in clean.items() if k in known})
+
+    def num_params(self) -> int:
+        head_dim = self.dims_per_head
+        attn = self.hidden_size * head_dim * (2 * self.num_heads + 2 * self.num_kv_heads)
+        mlp = 3 * self.hidden_size * self.intermediate_size
+        per_layer = attn + mlp + 2 * self.hidden_size
+        emb = self.vocab_size * self.hidden_size * (1 if self.tie_embeddings else 2)
+        return self.num_layers * per_layer + emb + self.hidden_size
+
+
+def init_params(config: LlamaConfig, seed: int = 0) -> Dict[str, jnp.ndarray]:
+    """Random-init (scaled normal) parameter pytree with stacked layers."""
+    key = jax.random.PRNGKey(seed)
+    keys = jax.random.split(key, 10)
+    h, f, v = config.hidden_size, config.intermediate_size, config.vocab_size
+    nh, nkv, hd = config.num_heads, config.num_kv_heads, config.dims_per_head
+    layers = config.num_layers
+    dtype = config.dtype
+
+    def normal(key, shape, scale):
+        return (jax.random.normal(key, shape, dtype=jnp.float32) * scale).astype(dtype)
+
+    scale = 1.0 / math.sqrt(h)
+    params = {
+        "embedding": normal(keys[0], (v, h), 1.0 / math.sqrt(h)),
+        "wq": normal(keys[1], (layers, h, nh * hd), scale),
+        "wk": normal(keys[2], (layers, h, nkv * hd), scale),
+        "wv": normal(keys[3], (layers, h, nkv * hd), scale),
+        "wo": normal(keys[4], (layers, nh * hd, h), scale / math.sqrt(2 * layers)),
+        "w_gate": normal(keys[5], (layers, h, f), scale),
+        "w_up": normal(keys[6], (layers, h, f), scale),
+        "w_down": normal(keys[7], (layers, f, h), scale / math.sqrt(2 * layers)),
+        "attn_norm": jnp.ones((layers, h), dtype=jnp.float32),
+        "mlp_norm": jnp.ones((layers, h), dtype=jnp.float32),
+        "final_norm": jnp.ones((h,), dtype=jnp.float32),
+    }
+    if not config.tie_embeddings:
+        params["lm_head"] = normal(keys[8], (h, v), scale)
+    return params
+
+
+def logical_axes(config: LlamaConfig) -> Dict[str, Any]:
+    """Logical sharding axes per parameter (fed to parallel.mesh rules)."""
+    axes = {
+        "embedding": L("vocab", "embed"),
+        "wq": L("layers", "embed", "heads"),
+        "wk": L("layers", "embed", "heads"),
+        "wv": L("layers", "embed", "heads"),
+        "wo": L("layers", "heads", "embed"),
+        "w_gate": L("layers", "embed", "mlp"),
+        "w_up": L("layers", "embed", "mlp"),
+        "w_down": L("layers", "mlp", "embed"),
+        "attn_norm": L("layers", None),
+        "mlp_norm": L("layers", None),
+        "final_norm": L(None),
+    }
+    if not config.tie_embeddings:
+        axes["lm_head"] = L("embed", "vocab")
+    return axes
+
+
+def init_cache(
+    config: LlamaConfig, batch: int, max_len: Optional[int] = None
+) -> Dict[str, jnp.ndarray]:
+    """KV cache: [layers, batch, max_len, kv_heads, head_dim]."""
+    max_len = max_len or config.max_seq_len
+    shape = (config.num_layers, batch, max_len, config.num_kv_heads, config.dims_per_head)
+    return {
+        "k": jnp.zeros(shape, dtype=config.dtype),
+        "v": jnp.zeros(shape, dtype=config.dtype),
+    }
+
+
+def cache_logical_axes() -> Dict[str, Any]:
+    return {
+        "k": L("layers", "cache_batch", "cache_sequence", "kv_heads", None),
+        "v": L("layers", "cache_batch", "cache_sequence", "kv_heads", None),
+    }
+
+
+def _stack_layer_params(params: Dict[str, jnp.ndarray]):
+    return (
+        params["attn_norm"], params["wq"], params["wk"], params["wv"],
+        params["wo"], params["mlp_norm"], params["w_gate"], params["w_up"],
+        params["w_down"],
+    )
+
+
+def _logits(config: LlamaConfig, params, x):
+    head = params["embedding"].T if config.tie_embeddings else params["lm_head"]
+    return jnp.einsum("...h,hv->...v", x, head.astype(x.dtype)).astype(jnp.float32)
+
+
+def prefill(
+    config: LlamaConfig,
+    params: Dict[str, jnp.ndarray],
+    cache: Dict[str, jnp.ndarray],
+    tokens: jnp.ndarray,     # [B, T] int32 (right-padded)
+    lengths: jnp.ndarray,    # [B] true prompt lengths
+    slot_ids: jnp.ndarray,   # [B] cache slots to write
+    freqs: jnp.ndarray,
+) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
+    """Run the prompt through the model, write the KV cache at the given
+    slots, return logits of each prompt's last real token [B, V]."""
+    batch, seq = tokens.shape
+    hd = config.dims_per_head
+    positions = jnp.arange(seq)[None, :].repeat(batch, 0)
+    mask = positions < lengths[:, None]
+    x = params["embedding"][tokens].astype(config.dtype)  # [B, T, H]
+
+    layer_inputs = _stack_layer_params(params)
+
+    def layer_fn(x, layer):
+        attn_norm, wq, wk, wv, wo, mlp_norm, w_gate, w_up, w_down = layer
+        normed = rms_norm(x, attn_norm, config.norm_eps)
+        q = jnp.einsum("bth,hd->btd", normed, wq).reshape(
+            batch, seq, config.num_heads, hd
+        )
+        k = jnp.einsum("bth,hd->btd", normed, wk).reshape(
+            batch, seq, config.num_kv_heads, hd
+        )
+        v = jnp.einsum("bth,hd->btd", normed, wv).reshape(
+            batch, seq, config.num_kv_heads, hd
+        )
+        q = apply_rope(q, freqs, positions)
+        k = apply_rope(k, freqs, positions)
+        attn = prefill_attention(q, k, v, mask=mask)
+        attn = jnp.einsum(
+            "btd,dh->bth", attn.reshape(batch, seq, config.num_heads * hd), wo
+        )
+        x = x + attn
+        normed = rms_norm(x, mlp_norm, config.norm_eps)
+        gate = jnp.einsum("bth,hf->btf", normed, w_gate)
+        up = jnp.einsum("bth,hf->btf", normed, w_up)
+        x = x + jnp.einsum("btf,fh->bth", jax.nn.silu(gate) * up, w_down)
+        return x, (k, v)
+
+    x, layer_kv = jax.lax.scan(layer_fn, x, layer_inputs)
+    # layer_kv: k/v each [L, B, T, KVH, hd] — scatter into cache slots
+    new_k, new_v = layer_kv
+    max_len = cache["k"].shape[2]
+    pad = max_len - seq
+    if pad > 0:
+        new_k = jnp.pad(new_k, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0)))
+        new_v = jnp.pad(new_v, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0)))
+    k_cache = cache["k"].at[:, slot_ids].set(new_k)
+    v_cache = cache["v"].at[:, slot_ids].set(new_v)
+
+    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    last = x[jnp.arange(batch), (lengths - 1).astype(jnp.int32)]  # [B, H]
+    logits = _logits(config, params, last)
+    return {"k": k_cache, "v": v_cache}, logits
+
+
+def decode_step(
+    config: LlamaConfig,
+    params: Dict[str, jnp.ndarray],
+    cache: Dict[str, jnp.ndarray],
+    tokens: jnp.ndarray,     # [S] int32 — one new token per slot
+    lengths: jnp.ndarray,    # [S] current length INCLUDING the new token
+    freqs: jnp.ndarray,
+    write_mask: Optional[jnp.ndarray] = None,  # [S] bool; False = don't
+                                               # touch this slot's cache
+) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
+    """One decode step for every slot: write the new token's KV, attend
+    over the cache, return next-token logits [S, V]. Cache is donated by
+    the engine's jit wrapper (in-place on device). ``write_mask`` protects
+    slots that are merely riding along (inactive, or logits-only reruns)
+    from having their cache row clobbered."""
+    slots = tokens.shape[0]
+    hd = config.dims_per_head
+    positions = (lengths - 1).astype(jnp.int32)  # [S]
+    if write_mask is None:
+        write_mask = jnp.ones((slots,), dtype=bool)
+    x = params["embedding"][tokens].astype(config.dtype)  # [S, H]
+
+    layer_inputs = _stack_layer_params(params)
+    k_cache, v_cache = cache["k"], cache["v"]
+
+    def write(c, pos, new, enabled):
+        return c.at[pos].set(jnp.where(enabled, new, c[pos]))
+
+    def layer_fn(carry, inputs):
+        x = carry
+        (attn_norm, wq, wk, wv, wo, mlp_norm, w_gate, w_up, w_down), kc, vc = inputs
+        normed = rms_norm(x, attn_norm, config.norm_eps)
+        q = jnp.einsum("sh,hd->sd", normed, wq).reshape(slots, config.num_heads, hd)
+        k = jnp.einsum("sh,hd->sd", normed, wk).reshape(slots, config.num_kv_heads, hd)
+        v = jnp.einsum("sh,hd->sd", normed, wv).reshape(slots, config.num_kv_heads, hd)
+        q = apply_rope(q[:, None], freqs, positions[:, None])[:, 0]
+        k = apply_rope(k[:, None], freqs, positions[:, None])[:, 0]
+        kc = jax.vmap(write)(kc, positions, k, write_mask)
+        vc = jax.vmap(write)(vc, positions, v, write_mask)
+        attn = decode_attention(q, kc, vc, lengths)
+        x = x + jnp.einsum("sd,dh->sh", attn.reshape(slots, config.num_heads * hd), wo)
+        normed = rms_norm(x, mlp_norm, config.norm_eps)
+        gate = jnp.einsum("sh,hf->sf", normed, w_gate)
+        up = jnp.einsum("sh,hf->sf", normed, w_up)
+        x = x + jnp.einsum("sf,fh->sh", jax.nn.silu(gate) * up, w_down)
+        return x, (kc, vc)
+
+    x, (k_cache, v_cache) = jax.lax.scan(
+        layer_fn, x, (layer_inputs, k_cache, v_cache)
+    )
+    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    logits = _logits(config, params, x)
+    return {"k": k_cache, "v": v_cache}, logits
+
+
+def forward(
+    config: LlamaConfig,
+    params: Dict[str, jnp.ndarray],
+    tokens: jnp.ndarray,   # [B, T]
+    mask: Optional[jnp.ndarray] = None,  # [B, T] valid-token mask
+    freqs: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Cache-free full-sequence forward → logits [B, T, V] (training /
+    scoring path; serving uses :func:`prefill`/:func:`decode_step`)."""
+    batch, seq = tokens.shape
+    hd = config.dims_per_head
+    if freqs is None:
+        freqs = rope_frequencies(hd, config.max_seq_len, config.rope_theta)
+    positions = jnp.arange(seq)[None, :].repeat(batch, 0)
+    x = params["embedding"][tokens].astype(config.dtype)
+    layer_inputs = _stack_layer_params(params)
+
+    def layer_fn(x, layer):
+        attn_norm, wq, wk, wv, wo, mlp_norm, w_gate, w_up, w_down = layer
+        normed = rms_norm(x, attn_norm, config.norm_eps)
+        q = jnp.einsum("bth,hd->btd", normed, wq).reshape(
+            batch, seq, config.num_heads, hd
+        )
+        k = jnp.einsum("bth,hd->btd", normed, wk).reshape(
+            batch, seq, config.num_kv_heads, hd
+        )
+        v = jnp.einsum("bth,hd->btd", normed, wv).reshape(
+            batch, seq, config.num_kv_heads, hd
+        )
+        q = apply_rope(q, freqs, positions)
+        k = apply_rope(k, freqs, positions)
+        attn = prefill_attention(q, k, v, mask=mask)
+        x = x + jnp.einsum(
+            "btd,dh->bth", attn.reshape(batch, seq, config.num_heads * hd), wo
+        )
+        normed = rms_norm(x, mlp_norm, config.norm_eps)
+        gate = jnp.einsum("bth,hf->btf", normed, w_gate)
+        up = jnp.einsum("bth,hf->btf", normed, w_up)
+        x = x + jnp.einsum("btf,fh->bth", jax.nn.silu(gate) * up, w_down)
+        return x, None
+
+    x, _ = jax.lax.scan(layer_fn, x, layer_inputs)
+    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    return _logits(config, params, x)
+
+
+# ---------------------------------------------------------------------- #
+# HuggingFace checkpoint import
+# ---------------------------------------------------------------------- #
+def config_from_hf(hf_config) -> LlamaConfig:
+    return LlamaConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.hidden_size,
+        intermediate_size=hf_config.intermediate_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_heads=hf_config.num_attention_heads,
+        num_kv_heads=getattr(hf_config, "num_key_value_heads", hf_config.num_attention_heads),
+        head_dim=getattr(hf_config, "head_dim", None),
+        rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+        norm_eps=hf_config.rms_norm_eps,
+        max_seq_len=hf_config.max_position_embeddings,
+        tie_embeddings=getattr(hf_config, "tie_word_embeddings", False),
+    )
+
+
+def load_hf_checkpoint(path_or_model, dtype=jnp.bfloat16):
+    """Convert a HuggingFace Llama checkpoint (local path or loaded torch
+    model) into (LlamaConfig, stacked-params pytree).
+
+    The per-layer torch tensors are stacked along a leading layer axis to
+    match the lax.scan layout. Linear weights transpose (torch stores
+    [out, in]; we use [in, out] so forward is x @ W).
+    """
+    import torch
+
+    if isinstance(path_or_model, str):
+        from transformers import AutoModelForCausalLM
+
+        model = AutoModelForCausalLM.from_pretrained(
+            path_or_model, torch_dtype=torch.float32, local_files_only=True
+        )
+    else:
+        model = path_or_model
+    config = config_from_hf(model.config)
+    config = dataclasses.replace(config, dtype=dtype)
+    state = model.state_dict()
+
+    def get(name):
+        return jnp.asarray(state[name].to(torch.float32).numpy(), dtype=dtype)
+
+    def stack(pattern, transpose=True):
+        arrays = []
+        for layer in range(config.num_layers):
+            tensor = state[pattern.format(layer)].to(torch.float32).numpy()
+            arrays.append(tensor.T if transpose else tensor)
+        return jnp.asarray(np.stack(arrays), dtype=dtype)
+
+    params = {
+        "embedding": get("model.embed_tokens.weight"),
+        "wq": stack("model.layers.{}.self_attn.q_proj.weight"),
+        "wk": stack("model.layers.{}.self_attn.k_proj.weight"),
+        "wv": stack("model.layers.{}.self_attn.v_proj.weight"),
+        "wo": stack("model.layers.{}.self_attn.o_proj.weight"),
+        "w_gate": stack("model.layers.{}.mlp.gate_proj.weight"),
+        "w_up": stack("model.layers.{}.mlp.up_proj.weight"),
+        "w_down": stack("model.layers.{}.mlp.down_proj.weight"),
+        "attn_norm": jnp.asarray(
+            np.stack([
+                state[f"model.layers.{i}.input_layernorm.weight"].numpy()
+                for i in range(config.num_layers)
+            ]), dtype=jnp.float32,
+        ),
+        "mlp_norm": jnp.asarray(
+            np.stack([
+                state[f"model.layers.{i}.post_attention_layernorm.weight"].numpy()
+                for i in range(config.num_layers)
+            ]), dtype=jnp.float32,
+        ),
+        "final_norm": jnp.asarray(
+            state["model.norm.weight"].numpy(), dtype=jnp.float32
+        ),
+    }
+    if not config.tie_embeddings:
+        params["lm_head"] = get("lm_head.weight").T
+    return config, params

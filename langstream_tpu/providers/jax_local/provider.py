@@ -1,0 +1,213 @@
+"""The ``jax-local`` ServiceProvider: completions + embeddings on the TPU.
+
+Owns ``resources:`` entries of type ``jax-local``. Example:
+
+.. code-block:: yaml
+
+    configuration:
+      resources:
+        - type: "jax-local"
+          name: "tpu-llm"
+          configuration:
+            model:
+              preset: "llama-3-8b"        # or explicit dims
+            checkpoint: "/models/llama-3-8b"   # HF dir; omit = random init
+            tokenizer: {type: "hf", path: "/models/llama-3-8b"}
+            mesh: {tp: 8}                  # jax.sharding axes
+            engine: {max-slots: 16, max-seq-len: 4096}
+            embeddings-model:
+              preset: "minilm-l6"
+              checkpoint: "/models/all-MiniLM-L6-v2"
+
+One engine (and one embedder) is built per resource entry and shared by
+every agent in the process (the runner loop batches into it). This is the
+in-process replacement for the reference's HTTPS providers — the
+ServiceProvider SPI surface is identical
+(``services/ServiceProvider.java:24``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import uuid
+from typing import Any, Dict, List, Optional
+
+from langstream_tpu.api.service import (
+    ChatChunk,
+    ChatCompletionResult,
+    ChatMessage,
+    CompletionsService,
+    EmbeddingsService,
+    ServiceProvider,
+    StreamingChunksConsumer,
+)
+from langstream_tpu.parallel.mesh import MeshConfig
+
+logger = logging.getLogger(__name__)
+
+
+class JaxCompletionsService(CompletionsService):
+    def __init__(self, config: Dict[str, Any]) -> None:
+        from langstream_tpu.providers.jax_local import model as model_lib
+        from langstream_tpu.providers.jax_local.engine import DecodeEngine
+        from langstream_tpu.providers.jax_local.tokenizer import get_tokenizer
+
+        model_config = model_lib.LlamaConfig.from_dict(config.get("model", {"preset": "tiny"}))
+        checkpoint = config.get("checkpoint")
+        if checkpoint:
+            model_config, params = model_lib.load_hf_checkpoint(checkpoint)
+            logger.info("loaded checkpoint %s (%d params)", checkpoint, model_config.num_params())
+        else:
+            params = model_lib.init_params(model_config, seed=int(config.get("seed", 0)))
+            logger.warning(
+                "jax-local: no checkpoint configured — RANDOM weights "
+                "(%.2fB params, benchmarking only)", model_config.num_params() / 1e9
+            )
+        self.tokenizer = get_tokenizer(config.get("tokenizer"))
+        engine_config = config.get("engine", {}) or {}
+        mesh_config = (
+            MeshConfig.from_config(config.get("mesh")) if config.get("mesh") else None
+        )
+        self.engine = DecodeEngine(
+            model_config,
+            params,
+            mesh_config=mesh_config,
+            max_slots=int(engine_config.get("max-slots", 8)),
+            max_seq_len=engine_config.get("max-seq-len"),
+        )
+        self.engine.start()
+
+    async def get_chat_completions(
+        self,
+        messages: List[ChatMessage],
+        options: Dict[str, Any],
+        stream_consumer: Optional[StreamingChunksConsumer] = None,
+    ) -> ChatCompletionResult:
+        from langstream_tpu.providers.jax_local.engine import SamplingParams
+
+        prompt_tokens = self.tokenizer.apply_chat_template(
+            [{"role": m.role, "content": m.content} for m in messages]
+        )
+        sampling = SamplingParams(
+            temperature=float(options.get("temperature") or 0.0),
+            top_k=int(options.get("top-k") or 0),
+            top_p=float(options.get("top-p") or 0.0),
+            max_new_tokens=int(options.get("max-tokens") or 256),
+        )
+        session_id = options.get("session-id")
+        answer_id = uuid.uuid4().hex
+        on_token = None
+        decoder = None
+        index_box = [0]
+        last_sent = [False]
+        if stream_consumer is not None:
+            decoder = self.tokenizer.stream_decoder()
+
+            def on_token(token_id: int, is_last: bool) -> None:
+                text = decoder.push(token_id)
+                if is_last:
+                    # deliver any bytes the decoder was withholding as a
+                    # possible partial UTF-8 sequence — last chance
+                    text += decoder.flush()
+                if text or is_last:
+                    index = index_box[0]
+                    index_box[0] += 1
+                    if is_last:
+                        last_sent[0] = True
+                    stream_consumer.consume_chunk(
+                        answer_id, index,
+                        ChatChunk(content=text, index=index),
+                        last=is_last,
+                    )
+
+        result = await self.engine.generate(
+            prompt_tokens,
+            sampling,
+            stop_tokens=set(self.tokenizer.eos_ids),
+            on_token=on_token,
+            session_id=session_id,
+        )
+        text = self.tokenizer.decode(result.tokens)
+        if stream_consumer is not None and not last_sent[0]:
+            # terminal marker for chunk batchers when the stop token arrived
+            # without a trailing streamed delta (on_token is not called for
+            # stop tokens, so no last=True was emitted yet)
+            tail = decoder.flush()
+            stream_consumer.consume_chunk(
+                answer_id, index_box[0],
+                ChatChunk(content=tail, index=index_box[0]),
+                last=True,
+            )
+        return ChatCompletionResult(
+            content=text,
+            finish_reason=result.finish_reason,
+            prompt_tokens=result.prompt_tokens,
+            completion_tokens=len(result.tokens),
+        )
+
+    async def close(self) -> None:
+        self.engine.stop()
+
+
+class JaxEmbeddingsService(EmbeddingsService):
+    def __init__(self, config: Dict[str, Any], model: Optional[str]) -> None:
+        from langstream_tpu.providers.jax_local.embeddings import (
+            EncoderConfig,
+            JaxEmbedder,
+            init_encoder_params,
+            load_hf_bert,
+        )
+        from langstream_tpu.providers.jax_local.tokenizer import get_tokenizer
+
+        embeddings_config = config.get("embeddings-model", {}) or {}
+        checkpoint = embeddings_config.get("checkpoint") or (
+            model if model and "/" in str(model) else None
+        )
+        if checkpoint:
+            encoder_config, params = load_hf_bert(checkpoint)
+            from langstream_tpu.providers.jax_local.tokenizer import HFTokenizer
+
+            tokenizer = HFTokenizer(checkpoint)
+        else:
+            encoder_config = EncoderConfig.from_dict(
+                embeddings_config if embeddings_config else {"preset": "tiny"}
+            )
+            params = init_encoder_params(encoder_config)
+            tokenizer = get_tokenizer(config.get("tokenizer"))
+            if not embeddings_config:
+                logger.warning(
+                    "jax-local embeddings: no checkpoint — random tiny encoder"
+                )
+        self.embedder = JaxEmbedder(
+            encoder_config, params, tokenizer,
+            max_length=int(embeddings_config.get("max-length", 256)),
+        )
+
+    async def compute_embeddings(self, texts: List[str]) -> List[List[float]]:
+        # run the device call off the event loop
+        return await asyncio.get_running_loop().run_in_executor(
+            None, self.embedder.embed, texts
+        )
+
+
+class JaxLocalServiceProvider(ServiceProvider):
+    """Service instances are cached per resource entry by
+    :class:`~langstream_tpu.providers.registry.ServiceProviderRegistry`,
+    which is what guarantees one engine per resource."""
+
+    name = "jax-local"
+
+    def supports(self, resource_config: Dict[str, Any]) -> bool:
+        return (
+            resource_config.get("type") in ("jax-local", "jax")
+            or "jax-local" in resource_config
+        )
+
+    def get_completions_service(self, resource_config: Dict[str, Any]) -> CompletionsService:
+        return JaxCompletionsService(resource_config)
+
+    def get_embeddings_service(
+        self, resource_config: Dict[str, Any], model: Optional[str] = None
+    ) -> EmbeddingsService:
+        return JaxEmbeddingsService(resource_config, model)

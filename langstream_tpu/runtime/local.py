@@ -61,7 +61,13 @@ class LocalApplicationRunner:
         self.runners: List[Any] = []
         self._tasks: List[asyncio.Task] = []
         self._started = asyncio.Event()
-        self._service_provider_registry = None
+        # one provider registry per app: all agents share the same device
+        # engines (one model, one mesh, one KV cache pool per resource)
+        from langstream_tpu.providers.registry import ServiceProviderRegistry
+
+        self._service_provider_registry = ServiceProviderRegistry(
+            self.application.resources
+        )
 
     # ------------------------------------------------------------------ #
     # deploy (reference: ApplicationSetupRunner topics/assets setup)
@@ -184,15 +190,21 @@ class LocalApplicationRunner:
     async def stop(self, timeout: float = 30.0) -> None:
         for runner in self.runners:
             runner.stop()
+        failure = None
         if self._tasks:
             done, pending = await asyncio.wait(self._tasks, timeout=timeout)
             for task in pending:
                 task.cancel()
             for task in done:
                 error = task.exception()
-                if error is not None:
-                    raise error
+                if error is not None and failure is None:
+                    failure = error
+        # always release engines/brokers, even when a runner died — the
+        # engine thread and device HBM must not outlive the app
+        await self._service_provider_registry.close()
         await self.topic_runtime.close()
+        if failure is not None:
+            raise failure
 
     async def join(self) -> None:
         """Wait until any runner fails (propagates) or all complete."""

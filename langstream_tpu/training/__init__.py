@@ -1,0 +1,11 @@
+"""Fine-tuning: sharded train step over the dp/fsdp/tp mesh.
+
+The reference has no training at all (models are remote APIs); this
+subsystem exists because a TPU-native framework that serves models should
+also fine-tune them in place (LoRA/full-parameter next-token training on
+the same sharded model definition the engine serves).
+"""
+
+from langstream_tpu.training.trainer import Trainer, TrainConfig
+
+__all__ = ["Trainer", "TrainConfig"]

@@ -1,0 +1,151 @@
+"""Sharded next-token training on the Llama model definition.
+
+One jitted train step over a ``dp × fsdp × tp`` mesh:
+
+- parameters are placed by the same logical-axis rules the serving engine
+  uses (``parallel.mesh.DEFAULT_RULES``: tp shards heads/mlp, fsdp shards
+  the embed axis — ZeRO-3 style);
+- the batch shards over dp (and fsdp, which also acts as a data axis for
+  the forward);
+- optimizer state mirrors parameter shardings (optax adamw);
+- gradients are averaged by XLA's automatic collectives — no explicit
+  psum: sharding constraints on inputs/outputs drive the partitioner.
+
+``jax.checkpoint`` wraps the layer scan to rematerialize activations —
+trading FLOPs for HBM, the standard TPU training recipe.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from langstream_tpu.parallel.mesh import (
+    MeshConfig,
+    build_mesh,
+    logical_to_physical,
+    param_shardings,
+    shard_params,
+)
+from langstream_tpu.providers.jax_local import model as model_lib
+
+
+@dataclasses.dataclass
+class TrainConfig:
+    learning_rate: float = 1e-5
+    weight_decay: float = 0.0
+    b1: float = 0.9
+    b2: float = 0.95
+    grad_clip: float = 1.0
+    remat: bool = True
+
+
+def loss_fn(config, params, tokens, mask, freqs):
+    """Causal next-token cross-entropy (mean over valid positions)."""
+    logits = model_lib.forward(config, params, tokens, mask=mask, freqs=freqs)
+    targets = tokens[:, 1:]
+    logits = logits[:, :-1]
+    valid = mask[:, 1:].astype(jnp.float32)
+    log_probs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    token_ll = jnp.take_along_axis(
+        log_probs, targets[..., None].astype(jnp.int32), axis=-1
+    )[..., 0]
+    total = jnp.maximum(valid.sum(), 1.0)
+    return -(token_ll * valid).sum() / total
+
+
+class Trainer:
+    def __init__(
+        self,
+        model_config: model_lib.LlamaConfig,
+        params: Dict[str, Any],
+        *,
+        mesh_config: Optional[MeshConfig] = None,
+        train_config: Optional[TrainConfig] = None,
+    ) -> None:
+        from langstream_tpu.ops.rope import rope_frequencies
+
+        self.model_config = model_config
+        self.train_config = train_config or TrainConfig()
+        self.mesh = build_mesh(
+            mesh_config or MeshConfig(),
+            devices=jax.devices()[: (mesh_config or MeshConfig()).size],
+        )
+        axes = model_lib.logical_axes(model_config)
+        with self.mesh:
+            self.params = shard_params(params, axes, self.mesh)
+        self._param_shardings = param_shardings(axes, self.mesh)
+        self.freqs = rope_frequencies(
+            model_config.dims_per_head,
+            model_config.max_seq_len,
+            model_config.rope_theta,
+        )
+
+        tc = self.train_config
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(tc.grad_clip),
+            optax.adamw(
+                tc.learning_rate, b1=tc.b1, b2=tc.b2,
+                weight_decay=tc.weight_decay,
+            ),
+        )
+        with self.mesh:
+            self.opt_state = jax.jit(
+                self.optimizer.init,
+            )(self.params)
+        self._step_fn = None
+        self.step = 0
+
+    def _data_sharding(self):
+        from jax.sharding import NamedSharding
+
+        return NamedSharding(
+            self.mesh, logical_to_physical(("batch", None), self.mesh)
+        )
+
+    def _build_step(self):
+        config = self.model_config
+        freqs = self.freqs
+        optimizer = self.optimizer
+        remat = self.train_config.remat
+
+        def compute_loss(params, tokens, mask):
+            fn = loss_fn
+            if remat:
+                fn = jax.checkpoint(
+                    lambda p, t, m: loss_fn(config, p, t, m, freqs),
+                    policy=jax.checkpoint_policies.nothing_saveable,
+                )
+                return fn(params, tokens, mask)
+            return loss_fn(config, params, tokens, mask, freqs)
+
+        @functools.partial(
+            jax.jit,
+            donate_argnums=(0, 1),
+        )
+        def train_step(params, opt_state, tokens, mask):
+            loss, grads = jax.value_and_grad(compute_loss)(params, tokens, mask)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        return train_step
+
+    def train_step(self, tokens, mask) -> float:
+        """Run one step; tokens/mask are host arrays [B, T]."""
+        if self._step_fn is None:
+            self._step_fn = self._build_step()
+        sharding = self._data_sharding()
+        with self.mesh:
+            tokens = jax.device_put(jnp.asarray(tokens, dtype=jnp.int32), sharding)
+            mask = jax.device_put(jnp.asarray(mask, dtype=bool), sharding)
+            self.params, self.opt_state, loss = self._step_fn(
+                self.params, self.opt_state, tokens, mask
+            )
+        self.step += 1
+        return float(loss)

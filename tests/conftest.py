@@ -1,20 +1,32 @@
-"""Test configuration.
+"""Test configuration: force a virtual 8-device CPU mesh.
 
-Tests run on a virtual 8-device CPU mesh (no TPU needed): the env vars must
-be set before jax initializes its backends, hence module-level here.
-Benchmarks (`bench.py`) run on real TPU hardware instead.
+The TPU plugin in this image force-selects its platform via
+``jax.config.update("jax_platforms", ...)`` at interpreter start
+(sitecustomize), which overrides the ``JAX_PLATFORMS`` env var — so tests
+must override it back *after* importing jax but before any backend
+initialization. Benchmarks (`bench.py`) run on the real TPU instead.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO_ROOT not in sys.path:
     sys.path.insert(0, REPO_ROOT)
+
+
+def pytest_sessionstart(session):
+    assert jax.default_backend() == "cpu", (
+        f"tests must run on CPU, got {jax.default_backend()}"
+    )
+    assert len(jax.devices()) == 8, "expected 8 virtual CPU devices"

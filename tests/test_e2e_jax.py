@@ -1,0 +1,47 @@
+"""The minimum end-to-end slice (SURVEY.md §7 phase 4): the baseline
+openai-completions app repointed at jax-local, running in the
+single-process runner on the in-memory broker."""
+
+import asyncio
+import os
+
+from langstream_tpu.api import OffsetPosition, Record
+from langstream_tpu.runtime.local import run_application
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+APP = os.path.join(REPO, "examples", "applications", "jax-completions")
+INSTANCE = os.path.join(REPO, "examples", "instances", "local-tiny.yaml")
+
+
+def test_jax_completions_app_end_to_end():
+    async def main():
+        runner = await run_application(APP, instance_file=INSTANCE)
+        try:
+            producer = runner.producer("input-topic")
+            await producer.write(
+                Record(
+                    value="what is a TPU?",
+                    key="user-1",
+                    headers=(("langstream-client-session-id", "sess-42"),),
+                )
+            )
+            history = runner.reader("history-topic")
+            out = []
+            deadline = asyncio.get_event_loop().time() + 60
+            while not out and asyncio.get_event_loop().time() < deadline:
+                out.extend(await history.read(timeout=0.2))
+            value = out[0].value
+            assert "answer" in value and isinstance(value["answer"], str)
+            assert "what is a TPU?" in value["prompt"]
+
+            chunks = await runner.reader("output-topic").read(timeout=1.0)
+            assert chunks, "expected streamed chunks on output-topic"
+            assert chunks[-1].header("stream-last-message") == "true"
+            # stream chunks carry the session header for gateway filtering
+            assert chunks[0].header("langstream-client-session-id") == "sess-42"
+            streamed = "".join(c.value if isinstance(c.value, str) else "" for c in chunks)
+            assert streamed == value["answer"]
+        finally:
+            await runner.stop()
+
+    asyncio.run(main())
