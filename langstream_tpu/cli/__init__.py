@@ -1,0 +1,2 @@
+"""Command-line interface (reference analogue: ``langstream-cli`` picocli
+commands — apps run / gateway chat / docs)."""

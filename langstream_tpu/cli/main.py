@@ -1,0 +1,238 @@
+"""``langstream-tpu`` CLI.
+
+Reference parity (``langstream-cli/src/main/java/ai/langstream/cli/commands/RootCmd.java:38``):
+
+- ``apps run <dir>``     — the ``langstream docker run`` local path
+  (``docker/LocalRunApplicationCmd.java:56``): run the whole app in-process
+  with the in-memory broker + gateway.
+- ``apps plan <dir>``    — print the compiled execution plan.
+- ``gateway chat|produce|consume`` — WebSocket client commands
+  (``gateway/ChatGatewayCmd.java:39``).
+- ``docs``               — agent-type documentation listing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import uuid
+from typing import List, Optional
+
+
+def _parse_params(values: List[str]) -> dict:
+    out = {}
+    for item in values or []:
+        if "=" not in item:
+            raise SystemExit(f"bad parameter {item!r}: expected name=value")
+        name, _, value = item.partition("=")
+        out[name] = value
+    return out
+
+
+# ---------------------------------------------------------------------- #
+# apps
+# ---------------------------------------------------------------------- #
+async def _apps_run(args) -> None:
+    from langstream_tpu.gateway import GatewayServer
+    from langstream_tpu.runtime.local import run_application
+
+    runner = await run_application(
+        args.app_dir, instance_file=args.instance, secrets_file=args.secrets
+    )
+    print(f"application {runner.application.application_id} running:")
+    for node in runner.plan.agents:
+        print(
+            f"  agent {node.id}: {node.input_topic or '(source)'} -> "
+            f"{node.output_topic or '(sink)'}"
+        )
+    gateway = None
+    if runner.application.gateways:
+        gateway = GatewayServer(port=args.gateway_port)
+        gateway.register_local_runner(runner, tenant=args.tenant)
+        await gateway.start()
+        print(f"gateway on ws://127.0.0.1:{args.gateway_port}/v1/...")
+    try:
+        await runner.join()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if gateway is not None:
+            await gateway.stop()
+        await runner.stop()
+
+
+def _apps_plan(args) -> None:
+    from langstream_tpu.compiler import build_application, build_execution_plan
+
+    application = build_application(
+        args.app_dir, instance_file=args.instance, secrets_file=args.secrets
+    )
+    plan = build_execution_plan(application)
+    out = {
+        "topics": {
+            name: {"partitions": t.partitions, "implicit": t.implicit}
+            for name, t in plan.topics.items()
+        },
+        "agents": [
+            {
+                "id": node.id,
+                "input": node.input_topic,
+                "output": node.output_topic,
+                "source": node.source.agent_type if node.source else None,
+                "processors": [p.agent_type for p in node.processors],
+                "sink": node.sink.agent_type if node.sink else None,
+                "service": node.service.agent_type if node.service else None,
+                "parallelism": node.resources.parallelism,
+            }
+            for node in plan.agents
+        ],
+        "gateways": [g.id for g in application.gateways],
+    }
+    print(json.dumps(out, indent=2))
+
+
+# ---------------------------------------------------------------------- #
+# gateway client
+# ---------------------------------------------------------------------- #
+def _gateway_url(args, kind: str) -> str:
+    base = args.url.rstrip("/")
+    url = f"{base}/v1/{kind}/{args.tenant}/{args.application}/{args.gateway}"
+    query = [f"param:{k}={v}" for k, v in _parse_params(args.param).items()]
+    if args.credentials:
+        query.append(f"credentials={args.credentials}")
+    if query:
+        url += "?" + "&".join(query)
+    return url
+
+
+async def _gateway_chat(args) -> None:
+    import websockets
+
+    session = args.session or uuid.uuid4().hex
+    if not any(p.startswith("session-id=") for p in (args.param or [])):
+        args.param = (args.param or []) + [f"session-id={session}"]
+    url = _gateway_url(args, "chat")
+    print(f"connected to {url}")
+    async with websockets.connect(url) as ws:
+
+        async def reader():
+            async for frame in ws:
+                message = json.loads(frame)
+                record = message.get("record", {})
+                value = record.get("value")
+                headers = record.get("headers", {})
+                if headers.get("stream-last-message") == "true":
+                    print(f"\n< {value}" if value else "")
+                elif headers.get("stream-index"):
+                    print(value, end="", flush=True)
+                else:
+                    print(f"< {value}")
+
+        reader_task = asyncio.ensure_future(reader())
+        loop = asyncio.get_running_loop()
+        try:
+            while True:
+                line = await loop.run_in_executor(None, sys.stdin.readline)
+                if not line:
+                    break
+                await ws.send(json.dumps({"value": line.strip()}))
+        finally:
+            reader_task.cancel()
+
+
+async def _gateway_produce(args) -> None:
+    import websockets
+
+    url = _gateway_url(args, "produce")
+    async with websockets.connect(url) as ws:
+        await ws.send(
+            json.dumps({"key": args.key, "value": args.value, "headers": {}})
+        )
+        print(await ws.recv())
+
+
+async def _gateway_consume(args) -> None:
+    import websockets
+
+    url = _gateway_url(args, "consume")
+    if args.position:
+        url += ("&" if "?" in url else "?") + f"option:position={args.position}"
+    async with websockets.connect(url) as ws:
+        async for frame in ws:
+            print(frame)
+
+
+# ---------------------------------------------------------------------- #
+# docs
+# ---------------------------------------------------------------------- #
+def _docs(args) -> None:
+    from langstream_tpu.compiler.planner import GENAI_STEP_TYPES, _KIND
+    from langstream_tpu.runtime.registry import agent_types, _ensure_builtin_loaded
+
+    _ensure_builtin_loaded()
+    print("agent types:")
+    for agent_type in agent_types():
+        kind = _KIND.get(agent_type)
+        print(f"  {agent_type:28s} {kind.value if kind else ''}")
+    print("declarative GenAI steps (compile to the ai-tools executor):")
+    for step in sorted(GENAI_STEP_TYPES):
+        print(f"  {step}")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(prog="langstream-tpu")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    apps = sub.add_parser("apps", help="application commands")
+    apps_sub = apps.add_subparsers(dest="apps_command", required=True)
+    for name in ("run", "plan"):
+        cmd = apps_sub.add_parser(name)
+        cmd.add_argument("app_dir")
+        cmd.add_argument("-i", "--instance", default=None)
+        cmd.add_argument("-s", "--secrets", default=None)
+        if name == "run":
+            cmd.add_argument("--gateway-port", type=int, default=8091)
+            cmd.add_argument("--tenant", default="default")
+
+    gateway = sub.add_parser("gateway", help="gateway client commands")
+    gateway_sub = gateway.add_subparsers(dest="gateway_command", required=True)
+    for name in ("chat", "produce", "consume"):
+        cmd = gateway_sub.add_parser(name)
+        cmd.add_argument("-u", "--url", default="ws://127.0.0.1:8091")
+        cmd.add_argument("-t", "--tenant", default="default")
+        cmd.add_argument("-a", "--application", required=True)
+        cmd.add_argument("-g", "--gateway", required=True)
+        cmd.add_argument("-p", "--param", action="append", default=[])
+        cmd.add_argument("--credentials", default=None)
+        if name == "chat":
+            cmd.add_argument("--session", default=None)
+        if name == "produce":
+            cmd.add_argument("-k", "--key", default=None)
+            cmd.add_argument("-v", "--value", required=True)
+        if name == "consume":
+            cmd.add_argument("--position", default=None)
+
+    sub.add_parser("docs", help="list agent types")
+    return parser
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    args = build_parser().parse_args(argv)
+    if args.command == "apps" and args.apps_command == "run":
+        asyncio.run(_apps_run(args))
+    elif args.command == "apps" and args.apps_command == "plan":
+        _apps_plan(args)
+    elif args.command == "gateway" and args.gateway_command == "chat":
+        asyncio.run(_gateway_chat(args))
+    elif args.command == "gateway" and args.gateway_command == "produce":
+        asyncio.run(_gateway_produce(args))
+    elif args.command == "gateway" and args.gateway_command == "consume":
+        asyncio.run(_gateway_consume(args))
+    elif args.command == "docs":
+        _docs(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,12 @@
+"""WebSocket/HTTP gateway — the user-facing ingress to pipeline topics.
+
+Equivalent of the reference's ``langstream-api-gateway`` module (Spring
+WebSocket + HTTP): WS ``/v1/{produce,consume,chat}/{tenant}/{app}/{gateway}``
+and HTTP ``/api/gateways/...`` including the ``service`` request/response
+round-trip. Implemented on aiohttp, sharing the event loop with the local
+application runner.
+"""
+
+from langstream_tpu.gateway.server import GatewayServer
+
+__all__ = ["GatewayServer"]

@@ -1,0 +1,160 @@
+"""Gateway authentication providers.
+
+Equivalent of the reference's pluggable gateway auth
+(``langstream-api-gateway-auth``: ``github``, ``google``, ``jwt``, generic
+``http`` providers loaded by ``GatewayAuthenticationProviderRegistry``).
+
+Providers here:
+
+- ``test``      — accepts any credential (the reference's test-credentials
+  path); principal = the credential string.
+- ``http``      — POST the credential to a configured endpoint; 2xx = ok,
+  JSON body becomes the principal attributes.
+- ``jwt``       — HS256 verification with a shared secret, implemented on
+  stdlib hmac (no external JWT lib); claims become principal attributes.
+  RS256/JWKS (the reference's Kubernetes JWKS path) is gated until a
+  crypto dependency is available.
+- ``google`` / ``github`` — gated: they need outbound calls to the identity
+  provider; configs validate but authentication fails with a clear error.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import time
+from typing import Any, Dict, Optional
+
+
+class AuthenticationFailed(Exception):
+    pass
+
+
+class Principal:
+    def __init__(self, subject: str, attributes: Optional[Dict[str, Any]] = None):
+        self.subject = subject
+        self.attributes = attributes or {}
+
+    def get(self, field: str) -> Any:
+        if field == "subject":
+            return self.subject
+        return self.attributes.get(field)
+
+
+class GatewayAuthProvider:
+    async def authenticate(self, credentials: str) -> Principal:
+        raise NotImplementedError
+
+    async def close(self) -> None:
+        ...
+
+
+class TestAuthProvider(GatewayAuthProvider):
+    def __init__(self, config: Dict[str, Any]) -> None:
+        self.config = config
+
+    async def authenticate(self, credentials: str) -> Principal:
+        return Principal(subject=credentials or "anonymous")
+
+
+class HttpAuthProvider(GatewayAuthProvider):
+    def __init__(self, config: Dict[str, Any]) -> None:
+        self.endpoint = config["endpoint"]
+        self.method = config.get("method", "POST")
+        self._session = None
+
+    async def authenticate(self, credentials: str) -> Principal:
+        import aiohttp
+
+        if self._session is None:
+            self._session = aiohttp.ClientSession()
+        async with self._session.request(
+            self.method,
+            self.endpoint,
+            headers={"Authorization": f"Bearer {credentials}"},
+        ) as response:
+            if response.status >= 300:
+                raise AuthenticationFailed(f"auth endpoint HTTP {response.status}")
+            try:
+                attributes = await response.json()
+            except Exception:  # noqa: BLE001
+                attributes = {}
+        if not isinstance(attributes, dict):
+            attributes = {}
+        return Principal(
+            subject=str(attributes.get("subject", attributes.get("sub", "user"))),
+            attributes=attributes,
+        )
+
+    async def close(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+
+
+def _b64url_decode(data: str) -> bytes:
+    padding = "=" * (-len(data) % 4)
+    return base64.urlsafe_b64decode(data + padding)
+
+
+class JwtHS256AuthProvider(GatewayAuthProvider):
+    """HS256 JWT validation on stdlib hmac (``langstream-auth-jwt``
+    analogue for shared-secret deployments)."""
+
+    def __init__(self, config: Dict[str, Any]) -> None:
+        self.secret = config.get("secret-key", config.get("secret", ""))
+        if not self.secret:
+            raise ValueError("jwt auth requires 'secret-key'")
+        self.audience = config.get("audience")
+        self.verify_expiry = bool(config.get("verify-expiry", True))
+
+    async def authenticate(self, credentials: str) -> Principal:
+        try:
+            header_b64, payload_b64, signature_b64 = credentials.split(".")
+        except ValueError as error:
+            raise AuthenticationFailed("malformed JWT") from error
+        header = json.loads(_b64url_decode(header_b64))
+        if header.get("alg") != "HS256":
+            raise AuthenticationFailed(
+                f"unsupported JWT alg {header.get('alg')!r} (only HS256 in "
+                "this build; RS256/JWKS requires a crypto dependency)"
+            )
+        expected = hmac.new(
+            self.secret.encode(),
+            f"{header_b64}.{payload_b64}".encode(),
+            hashlib.sha256,
+        ).digest()
+        if not hmac.compare_digest(expected, _b64url_decode(signature_b64)):
+            raise AuthenticationFailed("bad JWT signature")
+        claims = json.loads(_b64url_decode(payload_b64))
+        if self.verify_expiry and "exp" in claims and claims["exp"] < time.time():
+            raise AuthenticationFailed("JWT expired")
+        if self.audience and claims.get("aud") != self.audience:
+            raise AuthenticationFailed("JWT audience mismatch")
+        return Principal(subject=str(claims.get("sub", "user")), attributes=claims)
+
+
+class GatedAuthProvider(GatewayAuthProvider):
+    def __init__(self, name: str) -> None:
+        self.name = name
+
+    async def authenticate(self, credentials: str) -> Principal:
+        raise AuthenticationFailed(
+            f"auth provider {self.name!r} requires outbound identity-provider "
+            "access not available in this build; use 'jwt' or 'http'"
+        )
+
+
+def create_auth_provider(config: Dict[str, Any]) -> GatewayAuthProvider:
+    provider = config.get("provider", "test")
+    configuration = config.get("configuration", {}) or {}
+    if provider == "test":
+        return TestAuthProvider(configuration)
+    if provider == "http":
+        return HttpAuthProvider(configuration)
+    if provider == "jwt":
+        return JwtHS256AuthProvider(configuration)
+    if provider in ("google", "github"):
+        return GatedAuthProvider(provider)
+    raise ValueError(f"unknown auth provider {provider!r}")

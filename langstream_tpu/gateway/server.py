@@ -1,0 +1,491 @@
+"""The gateway server: WS produce/consume/chat + HTTP produce/service.
+
+Endpoint and wire parity with the reference gateway:
+
+- WS ``/v1/{produce|consume|chat}/{tenant}/{application}/{gateway}``
+  (``websocket/WebSocketConfig.java:46-48``); query args use the
+  reference's conventions (``GatewayRequestHandler.java:105-116``):
+  ``param:<name>=...`` for declared gateway parameters,
+  ``option:<name>=...`` for options (e.g. ``option:position=earliest``),
+  ``credentials=...`` / ``test-credentials=...`` for auth.
+- Produce frames are ``{"key", "value", "headers"}``
+  (``api/ProduceRequest.java:20``); consume pushes are
+  ``{"record": {...}, "offset": "..."}`` (``api/ConsumePushMessage.java:20``).
+- HTTP ``POST /api/gateways/produce/{tenant}/{app}/{gateway}`` and the
+  ``service`` gateway ``/api/gateways/service/...`` topic round-trip
+  correlated by ``langstream-service-request-id``
+  (``http/GatewayResource.java:74-96,156-190``).
+- Gateway lifecycle events (ClientConnected/Disconnected) go to the
+  configured events-topic (``events/EventRecord.java:13-29``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+from aiohttp import WSMsgType, web
+
+from langstream_tpu.api.records import Record, now_millis
+from langstream_tpu.api.topics import OffsetPosition
+from langstream_tpu.gateway.auth import (
+    AuthenticationFailed,
+    Principal,
+    create_auth_provider,
+)
+from langstream_tpu.model.application import Application, Gateway
+
+logger = logging.getLogger(__name__)
+
+
+class GatewayError(Exception):
+    def __init__(self, status: int, message: str) -> None:
+        super().__init__(message)
+        self.status = status
+
+
+class _RegisteredApp:
+    def __init__(self, application: Application, topic_runtime) -> None:
+        self.application = application
+        self.topic_runtime = topic_runtime
+        self.producers: Dict[str, Any] = {}
+
+    async def producer(self, topic: str):
+        producer = self.producers.get(topic)
+        if producer is None:
+            producer = self.topic_runtime.create_producer("gateway", {"topic": topic})
+            await producer.start()
+            self.producers[topic] = producer
+        return producer
+
+
+class GatewayServer:
+    """Serves every registered application's gateways on one port."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8091) -> None:
+        self.host = host
+        self.port = port
+        self._apps: Dict[Tuple[str, str], _RegisteredApp] = {}
+        self._runner: Optional[web.AppRunner] = None
+        self._auth_cache: Dict[int, Any] = {}
+
+    # ------------------------------------------------------------------ #
+    # registration / lifecycle
+    # ------------------------------------------------------------------ #
+    def register(self, tenant: str, application: Application, topic_runtime) -> None:
+        self._apps[(tenant, application.application_id)] = _RegisteredApp(
+            application, topic_runtime
+        )
+
+    def register_local_runner(self, local_runner, tenant: str = "default") -> None:
+        self.register(tenant, local_runner.application, local_runner.topic_runtime)
+
+    async def start(self) -> None:
+        app = web.Application()
+        app.router.add_get("/v1/produce/{tenant}/{application}/{gateway}", self._ws_produce)
+        app.router.add_get("/v1/consume/{tenant}/{application}/{gateway}", self._ws_consume)
+        app.router.add_get("/v1/chat/{tenant}/{application}/{gateway}", self._ws_chat)
+        app.router.add_post(
+            "/api/gateways/produce/{tenant}/{application}/{gateway}", self._http_produce
+        )
+        app.router.add_post(
+            "/api/gateways/service/{tenant}/{application}/{gateway}", self._http_service
+        )
+        app.router.add_get("/healthz", self._healthz)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        logger.info("gateway listening on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    async def _healthz(self, request) -> web.Response:
+        return web.json_response({"status": "OK", "apps": len(self._apps)})
+
+    # ------------------------------------------------------------------ #
+    # request validation (GatewayRequestHandler.validateRequest parity)
+    # ------------------------------------------------------------------ #
+    def _resolve(
+        self, request, expected_type: str
+    ) -> Tuple[_RegisteredApp, Gateway, Dict[str, str], Dict[str, str], Optional[str]]:
+        tenant = request.match_info["tenant"]
+        application_id = request.match_info["application"]
+        gateway_id = request.match_info["gateway"]
+        registered = self._apps.get((tenant, application_id))
+        if registered is None:
+            raise GatewayError(404, f"unknown application {tenant}/{application_id}")
+        gateway = None
+        for candidate in registered.application.gateways:
+            if candidate.id == gateway_id:
+                gateway = candidate
+                break
+        if gateway is None:
+            raise GatewayError(404, f"unknown gateway {gateway_id!r}")
+        if gateway.type != expected_type:
+            raise GatewayError(
+                400,
+                f"gateway {gateway_id!r} is of type {gateway.type!r}, "
+                f"expected {expected_type!r}",
+            )
+        options: Dict[str, str] = {}
+        parameters: Dict[str, str] = {}
+        credentials: Optional[str] = None
+        for key, value in request.query.items():
+            if key in ("credentials", "test-credentials"):
+                credentials = value
+            elif key.startswith("option:"):
+                options[key[len("option:"):]] = value
+            elif key.startswith("param:"):
+                parameters[key[len("param:"):]] = value
+            else:
+                raise GatewayError(
+                    400,
+                    f"invalid query parameter {key!r}. To specify a gateway "
+                    "parameter, use the format param:<parameter_name>. "
+                    "To specify an option, use the format option:<option_name>.",
+                )
+        required = set(gateway.parameters) | self._referenced_parameters(gateway)
+        for name in sorted(required):
+            if not parameters.get(name):
+                raise GatewayError(
+                    400,
+                    f"missing required parameter {name!r}. "
+                    f"Required parameters: {sorted(required)}",
+                )
+        unknown = set(parameters) - required
+        if unknown:
+            raise GatewayError(400, f"unknown parameters: {sorted(unknown)}")
+        return registered, gateway, parameters, options, credentials
+
+    @staticmethod
+    def _referenced_parameters(gateway: Gateway) -> set:
+        names = set()
+        for options in (
+            gateway.produce_options,
+            gateway.consume_options.get("filters", {}),
+            gateway.chat_options,
+        ):
+            for header in options.get("headers", []) or []:
+                name = header.get("value-from-parameters")
+                if name:
+                    names.add(name)
+        return names
+
+    async def _authenticate(
+        self, gateway: Gateway, credentials: Optional[str]
+    ) -> Optional[Principal]:
+        if not gateway.authentication:
+            return Principal(credentials or "anonymous") if credentials else None
+        provider_key = id(gateway)
+        provider = self._auth_cache.get(provider_key)
+        if provider is None:
+            provider = create_auth_provider(gateway.authentication)
+            self._auth_cache[provider_key] = provider
+        if credentials is None:
+            raise GatewayError(401, "credentials required")
+        try:
+            return await provider.authenticate(credentials)
+        except AuthenticationFailed as error:
+            raise GatewayError(401, str(error)) from error
+
+    @staticmethod
+    def _resolve_headers(
+        entries: List[Dict[str, Any]],
+        parameters: Dict[str, str],
+        principal: Optional[Principal],
+    ) -> List[Tuple[str, str]]:
+        """Resolve configured gateway headers: literal ``value``,
+        ``value-from-parameters`` or ``value-from-authentication``. Entries
+        without a ``key`` default to the client-session header (the shape
+        used by chat-options in the reference examples)."""
+        out = []
+        for entry in entries or []:
+            key = entry.get("key", "langstream-client-session-id")
+            if "value" in entry:
+                value = entry["value"]
+            elif "value-from-parameters" in entry:
+                value = parameters.get(entry["value-from-parameters"], "")
+            elif "value-from-authentication" in entry:
+                if principal is None:
+                    raise GatewayError(401, "authentication required for header")
+                value = principal.get(entry["value-from-authentication"])
+            else:
+                value = ""
+            out.append((key, str(value) if value is not None else ""))
+        return out
+
+    async def _emit_event(
+        self, registered: _RegisteredApp, gateway: Gateway, event_type: str,
+        parameters: Dict[str, str],
+    ) -> None:
+        topic = gateway.events_topic
+        if not topic:
+            return
+        producer = await registered.producer(topic)
+        await producer.write(
+            Record(
+                value={
+                    "type": event_type,
+                    "timestamp": now_millis(),
+                    "source": {"gateway": gateway.id, "type": gateway.type},
+                    "data": {"user-parameters": parameters},
+                }
+            )
+        )
+
+    # ------------------------------------------------------------------ #
+    # produce
+    # ------------------------------------------------------------------ #
+    @staticmethod
+    def _parse_produce(payload: str) -> Tuple[Any, Any, List[Tuple[str, str]]]:
+        try:
+            body = json.loads(payload)
+        except json.JSONDecodeError as error:
+            raise GatewayError(400, f"invalid JSON: {error}") from error
+        if not isinstance(body, dict):
+            raise GatewayError(400, "produce payload must be a JSON object")
+        headers = [
+            (str(k), str(v)) for k, v in (body.get("headers") or {}).items()
+        ]
+        return body.get("key"), body.get("value"), headers
+
+    async def _do_produce(
+        self, registered, gateway, parameters, principal, payload: str
+    ) -> None:
+        key, value, user_headers = self._parse_produce(payload)
+        gateway_headers = self._resolve_headers(
+            gateway.produce_options.get("headers"), parameters, principal
+        )
+        await (await registered.producer(gateway.topic)).write(
+            Record(
+                value=value,
+                key=key,
+                headers=tuple(user_headers) + tuple(gateway_headers),
+            )
+        )
+
+    async def _ws_produce(self, request) -> web.WebSocketResponse:
+        try:
+            registered, gateway, parameters, _options, credentials = self._resolve(
+                request, "produce"
+            )
+            principal = await self._authenticate(gateway, credentials)
+        except GatewayError as error:
+            raise web.HTTPBadRequest(text=str(error)) if error.status == 400 else (
+                web.HTTPNotFound(text=str(error)) if error.status == 404
+                else web.HTTPUnauthorized(text=str(error))
+            )
+        ws = web.WebSocketResponse()
+        await ws.prepare(request)
+        await self._emit_event(registered, gateway, "ClientConnected", parameters)
+        try:
+            async for message in ws:
+                if message.type != WSMsgType.TEXT:
+                    continue
+                try:
+                    await self._do_produce(
+                        registered, gateway, parameters, principal, message.data
+                    )
+                    await ws.send_json({"status": "OK"})
+                except GatewayError as error:
+                    await ws.send_json({"status": "BAD_REQUEST", "reason": str(error)})
+        finally:
+            await self._emit_event(registered, gateway, "ClientDisconnected", parameters)
+        return ws
+
+    async def _http_produce(self, request) -> web.Response:
+        try:
+            registered, gateway, parameters, _options, credentials = self._resolve(
+                request, "produce"
+            )
+            principal = await self._authenticate(gateway, credentials)
+            await self._do_produce(
+                registered, gateway, parameters, principal, await request.text()
+            )
+        except GatewayError as error:
+            return web.json_response(
+                {"status": "ERROR", "reason": str(error)}, status=error.status
+            )
+        return web.json_response({"status": "OK"})
+
+    # ------------------------------------------------------------------ #
+    # consume
+    # ------------------------------------------------------------------ #
+    @staticmethod
+    def _record_to_json(record: Record) -> Dict[str, Any]:
+        value = record.value
+        if isinstance(value, bytes):
+            value = value.decode("utf-8", errors="replace")
+        offset = ""
+        partition = getattr(record, "partition", None)
+        if partition is not None:
+            offset = f"{partition}-{getattr(record, 'offset', '')}"
+        return {
+            "record": {
+                "key": record.key,
+                "value": value,
+                "headers": {str(k): str(v) for k, v in record.headers},
+            },
+            "offset": offset,
+        }
+
+    @staticmethod
+    def _matches(record: Record, filters: List[Tuple[str, str]]) -> bool:
+        return all(str(record.header(k)) == v for k, v in filters)
+
+    async def _consume_loop(
+        self, ws, registered, topic: str, filters, position: OffsetPosition
+    ) -> None:
+        reader = registered.topic_runtime.create_reader(
+            {"topic": topic}, position
+        )
+        await reader.start()
+        try:
+            while not ws.closed:
+                batch = await reader.read(timeout=0.2)
+                for record in batch:
+                    if self._matches(record, filters):
+                        await ws.send_json(self._record_to_json(record))
+        except (ConnectionResetError, asyncio.CancelledError):
+            pass
+        finally:
+            await reader.close()
+
+    def _consume_filters(self, gateway, parameters, principal):
+        return self._resolve_headers(
+            gateway.consume_options.get("filters", {}).get("headers"),
+            parameters,
+            principal,
+        )
+
+    async def _ws_consume(self, request) -> web.WebSocketResponse:
+        try:
+            registered, gateway, parameters, options, credentials = self._resolve(
+                request, "consume"
+            )
+            principal = await self._authenticate(gateway, credentials)
+        except GatewayError as error:
+            raise web.HTTPBadRequest(text=str(error))
+        position = OffsetPosition.LATEST
+        if options.get("position") == "earliest":
+            position = OffsetPosition.EARLIEST
+        filters = self._consume_filters(gateway, parameters, principal)
+        ws = web.WebSocketResponse()
+        await ws.prepare(request)
+        await self._emit_event(registered, gateway, "ClientConnected", parameters)
+        consume_task = asyncio.ensure_future(
+            self._consume_loop(ws, registered, gateway.topic, filters, position)
+        )
+        try:
+            async for message in ws:
+                # client offset acks are accepted and ignored (the reader is
+                # positional; reconnect with option:position to replay)
+                continue
+        finally:
+            consume_task.cancel()
+            await self._emit_event(registered, gateway, "ClientDisconnected", parameters)
+        return ws
+
+    # ------------------------------------------------------------------ #
+    # chat (produce + filtered consume on one socket; ChatHandler.java:42)
+    # ------------------------------------------------------------------ #
+    async def _ws_chat(self, request) -> web.WebSocketResponse:
+        try:
+            registered, gateway, parameters, _options, credentials = self._resolve(
+                request, "chat"
+            )
+            principal = await self._authenticate(gateway, credentials)
+        except GatewayError as error:
+            raise web.HTTPBadRequest(text=str(error))
+        chat = gateway.chat_options
+        questions_topic = chat.get("questions-topic")
+        answers_topic = chat.get("answers-topic")
+        if not questions_topic or not answers_topic:
+            raise web.HTTPBadRequest(
+                text="chat gateway requires chat-options.questions-topic and answers-topic"
+            )
+        headers = self._resolve_headers(chat.get("headers"), parameters, principal)
+        ws = web.WebSocketResponse()
+        await ws.prepare(request)
+        await self._emit_event(registered, gateway, "ClientConnected", parameters)
+        consume_task = asyncio.ensure_future(
+            self._consume_loop(
+                ws, registered, answers_topic, headers, OffsetPosition.LATEST
+            )
+        )
+        try:
+            async for message in ws:
+                if message.type != WSMsgType.TEXT:
+                    continue
+                try:
+                    key, value, user_headers = self._parse_produce(message.data)
+                    await (await registered.producer(questions_topic)).write(
+                        Record(
+                            value=value,
+                            key=key,
+                            headers=tuple(user_headers) + tuple(headers),
+                        )
+                    )
+                except GatewayError as error:
+                    await ws.send_json({"status": "BAD_REQUEST", "reason": str(error)})
+        finally:
+            consume_task.cancel()
+            await self._emit_event(registered, gateway, "ClientDisconnected", parameters)
+        return ws
+
+    # ------------------------------------------------------------------ #
+    # service gateway (topic round-trip; GatewayResource.java:156-190)
+    # ------------------------------------------------------------------ #
+    async def _http_service(self, request) -> web.Response:
+        try:
+            registered, gateway, parameters, _options, credentials = self._resolve(
+                request, "service"
+            )
+            principal = await self._authenticate(gateway, credentials)
+        except GatewayError as error:
+            return web.json_response(
+                {"status": "ERROR", "reason": str(error)}, status=error.status
+            )
+        service = gateway.service_options
+        input_topic = service.get("input-topic")
+        output_topic = service.get("output-topic")
+        if not input_topic or not output_topic:
+            return web.json_response(
+                {"status": "ERROR", "reason": "service gateway needs input/output topics"},
+                status=400,
+            )
+        request_id = uuid.uuid4().hex
+        reader = registered.topic_runtime.create_reader(
+            {"topic": output_topic}, OffsetPosition.LATEST
+        )
+        await reader.start()
+        key, value, user_headers = self._parse_produce(await request.text())
+        await (await registered.producer(input_topic)).write(
+            Record(
+                value=value,
+                key=key,
+                headers=tuple(user_headers)
+                + (("langstream-service-request-id", request_id),),
+            )
+        )
+        timeout = float(service.get("timeout-seconds", 30))
+        deadline = time.monotonic() + timeout
+        try:
+            while time.monotonic() < deadline:
+                for record in await reader.read(timeout=0.2):
+                    if record.header("langstream-service-request-id") == request_id:
+                        return web.json_response(self._record_to_json(record))
+        finally:
+            await reader.close()
+        return web.json_response(
+            {"status": "ERROR", "reason": "timed out waiting for the response"},
+            status=504,
+        )

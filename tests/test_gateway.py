@@ -1,0 +1,279 @@
+import asyncio
+import json
+import textwrap
+
+import pytest
+
+
+def write_app(tmp_path, files):
+    app_dir = tmp_path / "app"
+    app_dir.mkdir(exist_ok=True)
+    for name, content in files.items():
+        path = app_dir / name
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(content))
+    return str(app_dir)
+
+
+APP_FILES = {
+    "pipeline.yaml": """
+        topics:
+          - name: "q"
+            creation-mode: create-if-not-exists
+          - name: "a"
+            creation-mode: create-if-not-exists
+        pipeline:
+          - id: "upper"
+            type: "python-processor"
+            input: "q"
+            output: "a"
+            configuration: {className: "gw_agent.Upper"}
+    """,
+    "python/gw_agent.py": """
+        class Upper:
+            def process(self, record):
+                return [record]
+    """,
+    "gateways.yaml": """
+        gateways:
+          - id: "in"
+            type: produce
+            topic: q
+            parameters: [sessionId]
+            produce-options:
+              headers:
+                - key: langstream-client-session-id
+                  value-from-parameters: sessionId
+          - id: "out"
+            type: consume
+            topic: a
+            parameters: [sessionId]
+            consume-options:
+              filters:
+                headers:
+                  - key: langstream-client-session-id
+                    value-from-parameters: sessionId
+          - id: "chat"
+            type: chat
+            chat-options:
+              questions-topic: q
+              answers-topic: a
+              headers:
+                - value-from-parameters: session-id
+          - id: "svc"
+            type: service
+            service-options:
+              input-topic: q
+              output-topic: a
+    """,
+}
+
+
+async def start_app_and_gateway(tmp_path, port):
+    from langstream_tpu.gateway import GatewayServer
+    from langstream_tpu.runtime.local import run_application
+
+    app_dir = write_app(tmp_path, APP_FILES)
+    runner = await run_application(app_dir)
+    gateway = GatewayServer(port=port)
+    gateway.register_local_runner(runner)
+    await gateway.start()
+    return runner, gateway
+
+
+def test_ws_produce_and_consume(tmp_path):
+    async def main():
+        import aiohttp
+
+        runner, gateway = await start_app_and_gateway(tmp_path, 18091)
+        base = "http://127.0.0.1:18091"
+        try:
+            async with aiohttp.ClientSession() as session:
+                async with session.ws_connect(
+                    f"{base}/v1/consume/default/app/out?param:sessionId=s1"
+                ) as consume_ws:
+                    async with session.ws_connect(
+                        f"{base}/v1/produce/default/app/in?param:sessionId=s1"
+                    ) as produce_ws:
+                        await produce_ws.send_json(
+                            {"key": "k", "value": "hello", "headers": {"h": "1"}}
+                        )
+                        ack = await produce_ws.receive_json(timeout=5)
+                        assert ack == {"status": "OK"}
+                    message = await consume_ws.receive_json(timeout=5)
+                    record = message["record"]
+                    assert record["value"] == "hello"
+                    assert record["key"] == "k"
+                    assert record["headers"]["h"] == "1"
+                    assert record["headers"]["langstream-client-session-id"] == "s1"
+                    assert message["offset"]
+        finally:
+            await gateway.stop()
+            await runner.stop()
+
+    asyncio.run(main())
+
+
+def test_consume_filters_by_session(tmp_path):
+    async def main():
+        import aiohttp
+
+        runner, gateway = await start_app_and_gateway(tmp_path, 18092)
+        base = "http://127.0.0.1:18092"
+        try:
+            async with aiohttp.ClientSession() as session:
+                async with session.ws_connect(
+                    f"{base}/v1/consume/default/app/out?param:sessionId=mine"
+                ) as consume_ws:
+                    async with session.ws_connect(
+                        f"{base}/v1/produce/default/app/in?param:sessionId=other"
+                    ) as ws:
+                        await ws.send_json({"value": "not-mine"})
+                        await ws.receive_json(timeout=5)
+                    async with session.ws_connect(
+                        f"{base}/v1/produce/default/app/in?param:sessionId=mine"
+                    ) as ws:
+                        await ws.send_json({"value": "mine"})
+                        await ws.receive_json(timeout=5)
+                    message = await consume_ws.receive_json(timeout=5)
+                    assert message["record"]["value"] == "mine"
+        finally:
+            await gateway.stop()
+            await runner.stop()
+
+    asyncio.run(main())
+
+
+def test_chat_roundtrip(tmp_path):
+    async def main():
+        import aiohttp
+
+        runner, gateway = await start_app_and_gateway(tmp_path, 18093)
+        base = "http://127.0.0.1:18093"
+        try:
+            async with aiohttp.ClientSession() as session:
+                async with session.ws_connect(
+                    f"{base}/v1/chat/default/app/chat?param:session-id=c1"
+                ) as chat_ws:
+                    await chat_ws.send_json({"value": "ping"})
+                    message = await chat_ws.receive_json(timeout=5)
+                    assert message["record"]["value"] == "ping"
+                    headers = message["record"]["headers"]
+                    assert headers["langstream-client-session-id"] == "c1"
+        finally:
+            await gateway.stop()
+            await runner.stop()
+
+    asyncio.run(main())
+
+
+def test_http_produce_and_service(tmp_path):
+    async def main():
+        import aiohttp
+
+        runner, gateway = await start_app_and_gateway(tmp_path, 18094)
+        base = "http://127.0.0.1:18094"
+        try:
+            async with aiohttp.ClientSession() as session:
+                response = await session.post(
+                    f"{base}/api/gateways/produce/default/app/in?param:sessionId=s1",
+                    data=json.dumps({"value": "via-http"}),
+                )
+                assert (await response.json())["status"] == "OK"
+
+                # service gateway: round-trip through the pipeline
+                response = await session.post(
+                    f"{base}/api/gateways/service/default/app/svc",
+                    data=json.dumps({"value": "request"}),
+                )
+                payload = await response.json()
+                assert payload["record"]["value"] == "request"
+                assert payload["record"]["headers"]["langstream-service-request-id"]
+        finally:
+            await gateway.stop()
+            await runner.stop()
+
+    asyncio.run(main())
+
+
+def test_validation_errors(tmp_path):
+    async def main():
+        import aiohttp
+
+        runner, gateway = await start_app_and_gateway(tmp_path, 18095)
+        base = "http://127.0.0.1:18095"
+        try:
+            async with aiohttp.ClientSession() as session:
+                # missing required parameter
+                response = await session.post(
+                    f"{base}/api/gateways/produce/default/app/in",
+                    data=json.dumps({"value": "x"}),
+                )
+                assert response.status == 400
+                assert "missing required parameter" in (await response.json())["reason"]
+                # unknown query parameter format
+                response = await session.post(
+                    f"{base}/api/gateways/produce/default/app/in?bogus=1",
+                    data=json.dumps({"value": "x"}),
+                )
+                assert response.status == 400
+                # unknown gateway
+                response = await session.post(
+                    f"{base}/api/gateways/produce/default/app/nope?param:sessionId=s",
+                    data=json.dumps({"value": "x"}),
+                )
+                assert response.status == 404
+        finally:
+            await gateway.stop()
+            await runner.stop()
+
+    asyncio.run(main())
+
+
+def test_jwt_auth():
+    async def main():
+        import base64
+        import hashlib
+        import hmac as hmac_mod
+
+        from langstream_tpu.gateway.auth import (
+            AuthenticationFailed,
+            JwtHS256AuthProvider,
+        )
+
+        secret = "topsecret"
+        provider = JwtHS256AuthProvider({"secret-key": secret})
+
+        def b64(data: bytes) -> str:
+            return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+        header = b64(json.dumps({"alg": "HS256", "typ": "JWT"}).encode())
+        payload = b64(json.dumps({"sub": "alice", "exp": 9999999999}).encode())
+        signature = b64(
+            hmac_mod.new(
+                secret.encode(), f"{header}.{payload}".encode(), hashlib.sha256
+            ).digest()
+        )
+        principal = await provider.authenticate(f"{header}.{payload}.{signature}")
+        assert principal.subject == "alice"
+
+        with pytest.raises(AuthenticationFailed):
+            await provider.authenticate(f"{header}.{payload}.AAAA")
+
+    asyncio.run(main())
+
+
+def test_cli_plan_and_docs(tmp_path, capsys):
+    from langstream_tpu.cli.main import main as cli_main
+
+    app_dir = write_app(tmp_path, APP_FILES)
+    cli_main(["apps", "plan", app_dir])
+    out = capsys.readouterr().out
+    plan = json.loads(out)
+    assert plan["agents"][0]["id"] == "upper"
+    assert plan["gateways"] == ["in", "out", "chat", "svc"]
+
+    cli_main(["docs"])
+    out = capsys.readouterr().out
+    assert "ai-tools" in out
+    assert "compute-ai-embeddings" in out
