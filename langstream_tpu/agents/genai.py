@@ -110,6 +110,10 @@ class CastStep(Step):
 def _cast(value: Any, schema_type: str) -> Any:
     if value is None:
         return None
+    # the reference's type names are case-insensitive in practice: compute
+    # fields use upper-case (ComputeFieldType.java:19, examples use
+    # `type: STRING`) while cast uses lower-case schema-type values
+    schema_type = str(schema_type).lower()
     if schema_type == "string":
         if isinstance(value, (dict, list)):
             return json.dumps(value, ensure_ascii=False, default=str)

@@ -165,6 +165,20 @@ async def _gateway_consume(args) -> None:
 
 
 # ---------------------------------------------------------------------- #
+# broker
+# ---------------------------------------------------------------------- #
+async def _broker_serve(args) -> None:
+    from langstream_tpu.topics.log.server import serve
+
+    server = await serve(args.directory, host=args.host, port=args.port)
+    print(f"tpulog broker serving {args.directory} on {server.address}")
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await server.close()
+
+
+# ---------------------------------------------------------------------- #
 # docs
 # ---------------------------------------------------------------------- #
 def _docs(args) -> None:
@@ -214,6 +228,11 @@ def build_parser() -> argparse.ArgumentParser:
         if name == "consume":
             cmd.add_argument("--position", default=None)
 
+    broker = sub.add_parser("broker", help="serve a durable tpulog broker")
+    broker.add_argument("directory", help="broker data directory")
+    broker.add_argument("--host", default="127.0.0.1")
+    broker.add_argument("--port", type=int, default=4551)
+
     sub.add_parser("docs", help="list agent types")
     return parser
 
@@ -230,6 +249,8 @@ def main(argv: Optional[List[str]] = None) -> None:
         asyncio.run(_gateway_produce(args))
     elif args.command == "gateway" and args.gateway_command == "consume":
         asyncio.run(_gateway_consume(args))
+    elif args.command == "broker":
+        asyncio.run(_broker_serve(args))
     elif args.command == "docs":
         _docs(args)
 

@@ -1,0 +1,98 @@
+"""Native (C++) components, compiled lazily with the system toolchain.
+
+The reference ships native code only indirectly (DJL's JNI); this framework
+uses a small C++ core for the durable log store (``logstore.cpp``) — the
+role Kafka's log layer plays in the reference data plane. Binaries are
+compiled once per source-hash into a cache directory and loaded with
+``ctypes`` (pybind11 is not in this image).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import pathlib
+import subprocess
+import tempfile
+import threading
+from typing import Optional
+
+_HERE = pathlib.Path(__file__).resolve().parent
+_LOCK = threading.Lock()
+_LIBS = {}
+
+
+def _cache_dir() -> pathlib.Path:
+    root = os.environ.get("LANGSTREAM_TPU_CACHE") or os.path.join(
+        os.path.expanduser("~"), ".cache", "langstream_tpu"
+    )
+    path = pathlib.Path(root)
+    path.mkdir(parents=True, exist_ok=True)
+    return path
+
+
+def build_library(
+    source_name: str, extra_flags: Optional[list] = None
+) -> Optional[pathlib.Path]:
+    """Compile ``native/<source_name>`` into a cached .so; None on failure."""
+    source = _HERE / source_name
+    text = source.read_bytes()
+    tag = hashlib.sha256(text).hexdigest()[:16]
+    out = _cache_dir() / f"{source.stem}-{tag}.so"
+    if out.exists():
+        return out
+    flags = ["-O2", "-shared", "-fPIC", "-std=c++17"] + (extra_flags or [])
+    with tempfile.TemporaryDirectory() as tmp:
+        tmp_out = pathlib.Path(tmp) / out.name
+        cmd = ["g++", *flags, str(source), "-o", str(tmp_out), "-lz"]
+        try:
+            subprocess.run(
+                cmd, check=True, capture_output=True, timeout=120
+            )
+        except (OSError, subprocess.SubprocessError):
+            return None
+        tmp_out.replace(out)
+    return out
+
+
+def load_logstore() -> Optional[ctypes.CDLL]:
+    """Load (compiling if needed) the segmented log store library."""
+    with _LOCK:
+        if "logstore" in _LIBS:
+            return _LIBS["logstore"]
+        lib = None
+        path = build_library("logstore.cpp")
+        if path is not None:
+            try:
+                lib = ctypes.CDLL(str(path))
+            except OSError:
+                lib = None
+        if lib is not None:
+            lib.ls_open.restype = ctypes.c_void_p
+            lib.ls_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+            lib.ls_append.restype = ctypes.c_int64
+            lib.ls_append.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_char_p,
+                ctypes.c_uint32,
+            ]
+            lib.ls_end_offset.restype = ctypes.c_int64
+            lib.ls_end_offset.argtypes = [ctypes.c_void_p]
+            lib.ls_base_offset.restype = ctypes.c_int64
+            lib.ls_base_offset.argtypes = [ctypes.c_void_p]
+            lib.ls_read_batch.restype = ctypes.c_int64
+            lib.ls_read_batch.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_int64,
+                ctypes.c_int64,
+                ctypes.c_char_p,
+                ctypes.c_uint64,
+                ctypes.POINTER(ctypes.c_uint64),
+            ]
+            lib.ls_sync.restype = ctypes.c_int
+            lib.ls_sync.argtypes = [ctypes.c_void_p]
+            lib.ls_close.restype = None
+            lib.ls_close.argtypes = [ctypes.c_void_p]
+        _LIBS["logstore"] = lib
+        return lib

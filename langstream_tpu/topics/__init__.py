@@ -9,8 +9,11 @@ The reference ships three broker data planes — Kafka, Pulsar, Pravega
   and tests, and the transport of the single-process runner
   (the reference's analogue is the noop/in-process pattern under
   ``langstream-core/.../impl/noop/`` + the runtime-tester).
-- ``stream``  — a durable log-backed broker (file-backed segments) for
-  multi-process deployments on one host.
+- ``tpulog``  — the framework's own durable partitioned log broker (native
+  C++ segment store, consumer groups, persisted commit watermarks). With a
+  ``directory`` configuration it runs embedded in-process; with an
+  ``address`` it connects to a served broker
+  (``python -m langstream_tpu broker``) for multi-process apps.
 
 Registry: look up a runtime by the ``streamingCluster.type`` value of
 ``instance.yaml`` (reference SPI:
@@ -19,14 +22,21 @@ Registry: look up a runtime by the ``streamingCluster.type`` value of
 
 from __future__ import annotations
 
+import inspect
 from typing import Any, Callable, Dict
 
 from langstream_tpu.api.topics import TopicConnectionsRuntime
 
-_FACTORIES: Dict[str, Callable[[], TopicConnectionsRuntime]] = {}
+_FACTORIES: Dict[str, Callable[..., TopicConnectionsRuntime]] = {}
 
 
-def register_topic_runtime(name: str, factory: Callable[[], TopicConnectionsRuntime]) -> None:
+def register_topic_runtime(
+    name: str, factory: Callable[..., TopicConnectionsRuntime]
+) -> None:
+    """Register a runtime factory. The factory is called with the
+    ``streamingCluster.configuration`` dict when it accepts one argument,
+    with no arguments otherwise (back-compat with broker-object factories
+    like ``MemoryTopicConnectionsRuntime``)."""
     _FACTORIES[name] = factory
 
 
@@ -39,13 +49,38 @@ def create_topic_runtime(streaming_cluster: Dict[str, Any]) -> TopicConnectionsR
         raise ValueError(
             f"unknown streaming cluster type {kind!r}; known: {sorted(_FACTORIES)}"
         )
-    return factory()
+    configuration = (streaming_cluster or {}).get("configuration", {}) or {}
+    try:
+        inspect.signature(factory).bind(configuration)
+    except TypeError:
+        return factory()
+    return factory(configuration)
+
+
+def _make_tpulog(configuration: Dict[str, Any]) -> TopicConnectionsRuntime:
+    if configuration.get("address"):
+        from langstream_tpu.topics.log.client import (
+            RemoteTopicConnectionsRuntime,
+        )
+
+        return RemoteTopicConnectionsRuntime(configuration["address"])
+    directory = configuration.get("directory")
+    if not directory:
+        raise ValueError(
+            "tpulog streamingCluster needs a configuration with either "
+            "'address' (served broker) or 'directory' (embedded broker); "
+            f"got {sorted(configuration)}"
+        )
+    from langstream_tpu.topics.log.broker import LogTopicConnectionsRuntime
+
+    return LogTopicConnectionsRuntime(root=str(directory))
 
 
 def _register_builtin() -> None:
     from langstream_tpu.topics.memory import MemoryTopicConnectionsRuntime
 
-    register_topic_runtime("memory", MemoryTopicConnectionsRuntime)
+    register_topic_runtime("memory", lambda configuration=None: MemoryTopicConnectionsRuntime())
+    register_topic_runtime("tpulog", _make_tpulog)
 
 
 _register_builtin()

@@ -1,0 +1,285 @@
+"""Tests for the durable tpulog broker: native store, embedded broker,
+and the TCP server/client runtime."""
+
+import asyncio
+import struct
+import zlib
+
+import pytest
+
+from langstream_tpu.api import OffsetPosition, Record
+from langstream_tpu.api.topics import TopicSpec
+from langstream_tpu.topics.log.broker import (
+    LogBroker,
+    LogTopicConnectionsRuntime,
+    stable_partition,
+)
+from langstream_tpu.topics.log.client import RemoteTopicConnectionsRuntime
+from langstream_tpu.topics.log.server import BrokerServer
+from langstream_tpu.topics.log.store import (
+    _PyPartitionLog,
+    open_partition_log,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# ---------------------------------------------------------------------- #
+# store layer
+# ---------------------------------------------------------------------- #
+def test_store_append_read_roundtrip(tmp_path):
+    log = open_partition_log(str(tmp_path / "p0"))
+    offsets = [log.append(f"record-{i}".encode()) for i in range(10)]
+    assert offsets == list(range(10))
+    assert log.end_offset() == 10
+    batch = log.read_batch(3, 4)
+    assert [(o, p.decode()) for o, p in batch] == [
+        (3, "record-3"), (4, "record-4"), (5, "record-5"), (6, "record-6"),
+    ]
+    log.close()
+
+
+def test_store_persists_across_reopen(tmp_path):
+    path = str(tmp_path / "p0")
+    log = open_partition_log(path)
+    for i in range(5):
+        log.append(f"v{i}".encode())
+    log.sync()
+    log.close()
+    log2 = open_partition_log(path)
+    assert log2.end_offset() == 5
+    assert [p.decode() for _, p in log2.read_batch(0, 10)] == [
+        "v0", "v1", "v2", "v3", "v4",
+    ]
+    log2.close()
+
+
+def test_store_segment_roll(tmp_path):
+    log = open_partition_log(str(tmp_path / "p0"), segment_bytes=64)
+    for i in range(20):
+        log.append(b"x" * 16)
+    assert log.end_offset() == 20
+    assert len(log.read_batch(0, 100)) == 20
+    # reads spanning segment boundaries
+    batch = log.read_batch(1, 18)
+    assert [o for o, _ in batch] == list(range(1, 19))
+    log.close()
+    # reopen across segments
+    log2 = open_partition_log(str(tmp_path / "p0"), segment_bytes=64)
+    assert log2.end_offset() == 20
+    log2.close()
+
+
+def test_store_recovers_from_torn_write(tmp_path):
+    path = str(tmp_path / "p0")
+    log = open_partition_log(path)
+    for i in range(3):
+        log.append(f"v{i}".encode())
+    log.close()
+    # corrupt the tail: append a frame header with a bad crc + index entry
+    log_file = next((tmp_path / "p0").glob("*.log"))
+    idx_file = next((tmp_path / "p0").glob("*.idx"))
+    pos = log_file.stat().st_size
+    with open(log_file, "ab") as f:
+        f.write(struct.pack("<II", 4, 0xDEADBEEF) + b"torn")
+    with open(idx_file, "ab") as f:
+        f.write(struct.pack("<Q", pos))
+    log2 = open_partition_log(path)
+    assert log2.end_offset() == 3  # torn record dropped
+    offset = log2.append(b"v3")
+    assert offset == 3
+    log2.close()
+
+
+def test_py_and_native_store_formats_interoperate(tmp_path):
+    """The pure-Python fallback writes the same format the native reads."""
+    path = str(tmp_path / "p0")
+    py_log = _PyPartitionLog(path, 1 << 20)
+    for i in range(4):
+        py_log.append(f"py-{i}".encode())
+    py_log.close()
+    log = open_partition_log(path)  # native if toolchain present
+    assert log.end_offset() == 4
+    log.append(b"native-4")
+    assert [p.decode() for _, p in log.read_batch(0, 10)] == [
+        "py-0", "py-1", "py-2", "py-3", "native-4",
+    ]
+    log.close()
+
+
+def test_stable_partition_is_deterministic():
+    assert stable_partition("session-1", 8) == stable_partition("session-1", 8)
+    assert stable_partition(b"k", 4) == zlib.crc32(b"k") % 4
+
+
+# ---------------------------------------------------------------------- #
+# embedded broker
+# ---------------------------------------------------------------------- #
+def test_embedded_broker_roundtrip_and_watermark(tmp_path):
+    async def main():
+        rt = LogTopicConnectionsRuntime(broker=LogBroker(str(tmp_path)))
+        producer = rt.create_producer("a", {"topic": "t"})
+        consumer = rt.create_consumer("a", {"topic": "t", "group": "g"})
+        for i in range(5):
+            await producer.write(Record(value=i))
+        batch = await consumer.read()
+        assert [r.value for r in batch] == [0, 1, 2, 3, 4]
+        await consumer.commit(batch[2:])
+        assert consumer.committed_offsets() == [0]
+        await consumer.commit(batch[:2])
+        assert consumer.committed_offsets() == [5]
+
+    run(main())
+
+
+def test_embedded_broker_commit_survives_restart(tmp_path):
+    async def main():
+        broker = LogBroker(str(tmp_path))
+        rt = LogTopicConnectionsRuntime(broker=broker)
+        producer = rt.create_producer("a", {"topic": "t"})
+        consumer = rt.create_consumer("a", {"topic": "t", "group": "g"})
+        for i in range(4):
+            await producer.write(Record(value=i))
+        batch = await consumer.read()
+        await consumer.commit(batch[:2])
+        await consumer.close()
+        broker.close()
+
+        # "restart": fresh broker over the same files resumes at offset 2
+        broker2 = LogBroker(str(tmp_path))
+        rt2 = LogTopicConnectionsRuntime(broker=broker2)
+        consumer2 = rt2.create_consumer("a", {"topic": "t", "group": "g"})
+        batch2 = await consumer2.read()
+        assert [r.value for r in batch2] == [2, 3]
+        broker2.close()
+
+    run(main())
+
+
+def test_embedded_broker_values_roundtrip_types(tmp_path):
+    async def main():
+        rt = LogTopicConnectionsRuntime(broker=LogBroker(str(tmp_path)))
+        producer = rt.create_producer("a", {"topic": "t"})
+        consumer = rt.create_consumer("a", {"topic": "t", "group": "g"})
+        await producer.write(
+            Record(
+                value={"text": "héllo", "blob": b"\x00\x01", "n": 3},
+                key=b"raw-key",
+                headers=(("h1", "v1"), ("h2", b"\xff")),
+            )
+        )
+        (record,) = await consumer.read()
+        assert record.value == {"text": "héllo", "blob": b"\x00\x01", "n": 3}
+        assert record.key == b"raw-key"
+        assert record.header("h1") == "v1"
+        assert record.header("h2") == b"\xff"
+
+    run(main())
+
+
+# ---------------------------------------------------------------------- #
+# served broker (TCP)
+# ---------------------------------------------------------------------- #
+def test_served_broker_end_to_end(tmp_path):
+    async def main():
+        server = BrokerServer(LogBroker(str(tmp_path)), port=0)
+        await server.start()
+        try:
+            rt = RemoteTopicConnectionsRuntime(server.address)
+            admin = rt.create_admin()
+            await admin.create_topic(TopicSpec(name="t", partitions=2))
+            producer = rt.create_producer("a", {"topic": "t"})
+            consumer = rt.create_consumer("a", {"topic": "t", "group": "g"})
+            for i in range(6):
+                await producer.write(Record(value=i, key=f"k{i}"))
+            got = []
+            for _ in range(10):
+                batch = await consumer.read(timeout=0.2)
+                got.extend(batch)
+                await consumer.commit(batch)
+                if len(got) >= 6:
+                    break
+            assert sorted(r.value for r in got) == [0, 1, 2, 3, 4, 5]
+            await consumer.close()
+            await producer.close()
+            await admin.close()
+        finally:
+            await server.close()
+
+    run(main())
+
+
+def test_served_broker_two_members_split_partitions(tmp_path):
+    async def main():
+        server = BrokerServer(LogBroker(str(tmp_path)), port=0)
+        await server.start()
+        try:
+            rt = RemoteTopicConnectionsRuntime(server.address)
+            admin = rt.create_admin()
+            await admin.create_topic(TopicSpec(name="t", partitions=4))
+            c1 = rt.create_consumer("a", {"topic": "t", "group": "g"})
+            c2 = rt.create_consumer("a", {"topic": "t", "group": "g"})
+            await c1.start()
+            await c2.start()
+            producer = rt.create_producer("a", {"topic": "t"})
+            for i in range(40):
+                await producer.write(Record(value=i, key=f"key-{i}"))
+            got1, got2 = [], []
+            for _ in range(20):
+                got1.extend(await c1.read(timeout=0.05))
+                got2.extend(await c2.read(timeout=0.05))
+                if len(got1) + len(got2) >= 40:
+                    break
+            assert len(got1) + len(got2) == 40
+            assert got1 and got2  # both members saw work
+            # disjoint partitions
+            assert not (
+                {r.partition for r in got1} & {r.partition for r in got2}
+            )
+            await c1.close()
+            await c2.close()
+        finally:
+            await server.close()
+
+    run(main())
+
+
+def test_served_broker_rebalance_redelivers_uncommitted(tmp_path):
+    async def main():
+        server = BrokerServer(LogBroker(str(tmp_path)), port=0)
+        await server.start()
+        try:
+            rt = RemoteTopicConnectionsRuntime(server.address)
+            admin = rt.create_admin()
+            await admin.create_topic(TopicSpec(name="t", partitions=1))
+            producer = rt.create_producer("a", {"topic": "t"})
+            for i in range(4):
+                await producer.write(Record(value=i))
+            c1 = rt.create_consumer("a", {"topic": "t", "group": "g"})
+            batch = await c1.read(timeout=0.2)
+            assert [r.value for r in batch] == [0, 1, 2, 3]
+            await c1.commit(batch[:2])  # only first two committed
+            await c1.close()  # leave -> rebalance
+            c2 = rt.create_consumer("a", {"topic": "t", "group": "g"})
+            batch2 = await c2.read(timeout=0.2)
+            assert [r.value for r in batch2] == [2, 3]  # redelivery
+            await c2.close()
+        finally:
+            await server.close()
+
+    run(main())
+
+
+def test_tpulog_registered_in_runtime_registry(tmp_path):
+    from langstream_tpu.topics import create_topic_runtime
+
+    rt = create_topic_runtime(
+        {"type": "tpulog", "configuration": {"directory": str(tmp_path)}}
+    )
+    assert isinstance(rt, LogTopicConnectionsRuntime)
+    remote = create_topic_runtime(
+        {"type": "tpulog", "configuration": {"address": "127.0.0.1:9"}}
+    )
+    assert isinstance(remote, RemoteTopicConnectionsRuntime)

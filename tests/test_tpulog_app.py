@@ -1,0 +1,108 @@
+"""End-to-end: a YAML app running over the durable tpulog broker, both
+embedded and via the served (TCP) broker — the multi-process data plane."""
+
+import asyncio
+import textwrap
+
+from langstream_tpu.api import Record
+from langstream_tpu.runtime.local import run_application
+from langstream_tpu.topics.log.broker import LogBroker
+from langstream_tpu.topics.log.server import BrokerServer
+
+PIPELINE = """
+    topics:
+      - name: "in"
+        creation-mode: create-if-not-exists
+      - name: "out"
+        creation-mode: create-if-not-exists
+    pipeline:
+      - id: "shout"
+        type: "python-processor"
+        input: "in"
+        output: "out"
+        configuration:
+          className: "shout_agent.Shout"
+"""
+
+AGENT = """
+    class Shout:
+        def process(self, record):
+            return [record.value.upper() + "!"]
+"""
+
+
+def write_app(tmp_path, instance_yaml):
+    app_dir = tmp_path / "app"
+    (app_dir / "python").mkdir(parents=True, exist_ok=True)
+    (app_dir / "pipeline.yaml").write_text(textwrap.dedent(PIPELINE))
+    (app_dir / "python" / "shout_agent.py").write_text(textwrap.dedent(AGENT))
+    instance = tmp_path / "instance.yaml"
+    instance.write_text(textwrap.dedent(instance_yaml))
+    return str(app_dir), str(instance)
+
+
+async def read_n(reader, n, timeout=5.0):
+    out = []
+    deadline = asyncio.get_event_loop().time() + timeout
+    while len(out) < n:
+        if asyncio.get_event_loop().time() > deadline:
+            raise TimeoutError(f"got {len(out)}/{n}: {out}")
+        out.extend(await reader.read(timeout=0.2))
+    return out
+
+
+def test_app_on_embedded_tpulog(tmp_path):
+    app_dir, instance = write_app(
+        tmp_path,
+        f"""
+        instance:
+          streamingCluster:
+            type: tpulog
+            configuration:
+              directory: "{tmp_path / 'broker-data'}"
+        """,
+    )
+
+    async def main():
+        runner = await run_application(app_dir, instance_file=instance)
+        try:
+            producer = runner.producer("in")
+            await producer.write(Record(value="hello"))
+            reader = runner.reader("out")
+            (record,) = await read_n(reader, 1)
+            assert record.value == "HELLO!"
+        finally:
+            await runner.stop()
+
+    asyncio.run(main())
+    # the records are durable: broker files exist on disk
+    assert (tmp_path / "broker-data" / "in").is_dir()
+    assert (tmp_path / "broker-data" / "out").is_dir()
+
+
+def test_app_on_served_tpulog(tmp_path):
+    async def main():
+        server = BrokerServer(LogBroker(str(tmp_path / "broker-data")), port=0)
+        await server.start()
+        app_dir, instance = write_app(
+            tmp_path,
+            f"""
+            instance:
+              streamingCluster:
+                type: tpulog
+                configuration:
+                  address: "{server.address}"
+            """,
+        )
+        runner = await run_application(app_dir, instance_file=instance)
+        try:
+            producer = runner.producer("in")
+            await producer.write(Record(value="over tcp"))
+            reader = runner.reader("out")
+            (record,) = await read_n(reader, 1)
+            assert record.value == "OVER TCP!"
+        finally:
+            await runner.stop()
+            await server.close()
+
+    asyncio.run(main())
