@@ -1,0 +1,231 @@
+"""Pallas TPU flash attention for causal prefill.
+
+The plain-XLA prefill attention (``ops/attention.py``) materializes the
+O(Tq·Ts) score matrix in HBM. For long prompts that dominates HBM traffic,
+so this kernel computes attention blockwise in VMEM with the online-softmax
+recurrence: the score tile, the softmax statistics (running max / running
+sum), and the output accumulator all live in VMEM scratch; HBM sees only
+q/k/v tile reads and one output tile write per q block.
+
+Kernel layout (the canonical TPU flash schedule):
+
+- grid = (batch, q_heads, Tq/block_q, Tk/block_k); the last grid axis is
+  innermost and sequential on TPU, so VMEM scratch carries the online
+  softmax state across k blocks of the same q block.
+- q/k/v tiles are MXU-shaped ([block, head_dim], 128-aligned); the two
+  matmuls (q·kᵀ and p·v) run on the MXU in the input dtype with f32
+  accumulation; masking and the softmax recurrence run on the VPU in f32.
+- GQA is folded into the k/v BlockSpec index maps (query head h reads kv
+  head h // group) — no materialized head repetition.
+- causal blocks strictly above the diagonal skip their compute entirely
+  via ``pl.when`` (they still prefetch, which the pipeline overlaps).
+- per-batch valid lengths ride in SMEM (right-padding mask).
+
+Reference parity: this replaces the HBM-bound attention inside what the
+reference would run as a remote model call (it has no kernels of its own —
+`langstream-agents/langstream-ai-agents/.../OpenAICompletionService.java:52`
+delegates to a provider); the kernel is the TPU-native interior of the
+`jax-local` completions service.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(
+    lengths_ref,  # SMEM [1, 1] — valid length for this batch row
+    q_ref,        # VMEM [1, 1, block_q, d]
+    k_ref,        # VMEM [1, 1, block_k, d]
+    v_ref,        # VMEM [1, 1, block_k, d]
+    out_ref,      # VMEM [1, 1, block_q, d]
+    m_scratch,    # VMEM [block_q, 128] f32 — running row max
+    l_scratch,    # VMEM [block_q, 128] f32 — running row sum
+    acc_scratch,  # VMEM [block_q, d] f32 — unnormalized output
+    *,
+    scale: float,
+    block_q: int,
+    block_k: int,
+):
+    qi = pl.program_id(2)
+    kj = pl.program_id(3)
+    num_k = pl.num_programs(3)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_scratch[:] = jnp.full_like(m_scratch, NEG_INF)
+        l_scratch[:] = jnp.zeros_like(l_scratch)
+        acc_scratch[:] = jnp.zeros_like(acc_scratch)
+
+    q_start = qi * block_q
+    k_start = kj * block_k
+
+    # Causal: the whole k block is in the future of the whole q block.
+    @pl.when(k_start <= q_start + block_q - 1)
+    def _compute():
+        length = lengths_ref[0, 0]
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [block_q, block_k]
+
+        rows = q_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        cols = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        mask = jnp.logical_and(cols <= rows, cols < length)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_scratch[:, :1]                      # [block_q, 1]
+        row_max = jnp.max(s, axis=-1, keepdims=True)   # [block_q, 1]
+        m_new = jnp.maximum(m_prev, row_max)
+        # p is zeroed (not just -inf shifted) so fully-masked rows stay 0.
+        p = jnp.exp(s - m_new) * mask.astype(jnp.float32)
+        alpha = jnp.exp(m_prev - m_new)                # [block_q, 1]
+
+        l_prev = l_scratch[:, :1]
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+
+        v = v_ref[0, 0]
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [block_q, d]
+        acc_scratch[:] = acc_scratch[:] * alpha + pv
+        m_scratch[:] = jnp.broadcast_to(m_new, m_scratch.shape)
+        l_scratch[:] = jnp.broadcast_to(l_new, l_scratch.shape)
+
+    @pl.when(kj == num_k - 1)
+    def _finalize():
+        l = l_scratch[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        out_ref[0, 0] = (acc_scratch[:] / l_safe).astype(out_ref.dtype)
+
+
+def _pallas_flash(
+    q: jnp.ndarray,        # [B, H, T, D]
+    k: jnp.ndarray,        # [B, KVH, T, D]
+    v: jnp.ndarray,
+    lengths: jnp.ndarray,  # [B] int32
+    *,
+    block_q: int,
+    block_k: int,
+    interpret: bool,
+) -> jnp.ndarray:
+    batch, heads, seq, dim = q.shape
+    kv_heads = k.shape[1]
+    group = heads // kv_heads
+    scale = dim ** -0.5
+    grid = (batch, heads, seq // block_q, seq // block_k)
+
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, block_q=block_q, block_k=block_k
+    )
+    lengths_2d = lengths.reshape(batch, 1).astype(jnp.int32)
+
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1), lambda b, h, i, j: (b, 0),
+                memory_space=pltpu.SMEM,
+            ),
+            pl.BlockSpec(
+                (1, 1, block_q, dim), lambda b, h, i, j: (b, h, i, 0),
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, dim), lambda b, h, i, j: (b, h // group, j, 0),
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, dim), lambda b, h, i, j: (b, h // group, j, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, dim), lambda b, h, i, j: (b, h, i, 0),
+        ),
+        out_shape=jax.ShapeDtypeStruct((batch, heads, seq, dim), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, dim), jnp.float32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=4 * batch * heads * seq * seq * dim,
+            bytes_accessed=(
+                q.size + k.size + v.size + q.size
+            ) * q.dtype.itemsize,
+            transcendentals=batch * heads * seq * seq,
+        ),
+        interpret=interpret,
+    )(lengths_2d, q, k, v)
+
+
+def flash_prefill_attention(
+    q: jnp.ndarray,  # [B, T, H, D]
+    k: jnp.ndarray,  # [B, T, KVH, D]
+    v: jnp.ndarray,
+    *,
+    mask: Optional[jnp.ndarray] = None,   # [B, T] right-padded valid mask
+    lengths: Optional[jnp.ndarray] = None,  # [B] (alternative to mask)
+    block_q: int = 256,
+    block_k: int = 256,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Causal flash attention over right-padded prompts ([B, T, H, D] in
+    and out). ``mask`` must be CONTIGUOUS right-padding (True for the
+    first ``lengths[b]`` positions, False after) — it is collapsed to
+    per-row lengths for the kernel's SMEM masking, so a non-contiguous
+    (packed / loss-style) mask would be silently misapplied; use
+    :func:`langstream_tpu.ops.attention.prefill_attention` for those."""
+    batch, seq, heads, dim = q.shape
+    if lengths is None:
+        lengths = (
+            jnp.sum(mask.astype(jnp.int32), axis=-1)
+            if mask is not None
+            else jnp.full((batch,), seq, dtype=jnp.int32)
+        )
+
+    block_q = min(block_q, _round_up(seq, 128))
+    block_k = min(block_k, _round_up(seq, 128))
+    padded = _round_up(seq, max(block_q, block_k))
+
+    # [B, T, H, D] → [B, H, T, D]; pad T to a block multiple (the length
+    # mask keeps padded keys out of the softmax).
+    def to_kernel_layout(x):
+        x = jnp.swapaxes(x, 1, 2)
+        if padded != seq:
+            x = jnp.pad(x, ((0, 0), (0, 0), (0, padded - seq), (0, 0)))
+        return x
+
+    out = _pallas_flash(
+        to_kernel_layout(q), to_kernel_layout(k), to_kernel_layout(v),
+        lengths,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+    out = jnp.swapaxes(out, 1, 2)
+    return out[:, :seq] if padded != seq else out
+
+
+def _round_up(n: int, multiple: int) -> int:
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+def use_flash(seq: int, dim: int) -> bool:
+    """Flash pays off once the score matrix dwarfs the tiles: long enough
+    sequence, MXU-aligned head_dim, and a real TPU backend."""
+    return (
+        jax.default_backend() == "tpu" and seq >= 1024 and dim % 128 == 0
+    )

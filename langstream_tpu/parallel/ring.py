@@ -1,0 +1,153 @@
+"""Ring attention: causal attention with the sequence sharded over a mesh
+axis (context parallelism for long prompts).
+
+Each device on the ``sp`` axis holds one contiguous chunk of the sequence
+(q, k, v all [B, T/sp, H, D] locally). The kv chunks rotate around the
+ring with ``jax.lax.ppermute`` while every device accumulates its local
+queries' attention with the online-softmax recurrence — the same math as
+the flash kernel (``ops/flash_attention.py``), but with the blocking axis
+laid across chips instead of across VMEM tiles. XLA overlaps each
+ppermute (ICI RDMA) with the previous step's matmuls, so the ring is
+bandwidth-hidden once per-chunk compute exceeds the transfer.
+
+The reference has no analogue — sequence length never spans processes
+there (SURVEY §5 "long-context: ABSENT"); this is a net-new subsystem of
+the TPU build, surfaced as the ``sp`` mesh axis of the jax-local provider.
+
+Use :func:`ring_attention` inside ``shard_map`` (it needs a named axis) or
+:func:`ring_attention_sharded` for the wrapped version.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax ≥ 0.8
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+NEG_INF = -1e30
+
+
+def _chunk_attend(
+    q: jnp.ndarray,        # [B, Tq, KVH, G, D] grouped queries, f32 scores
+    k: jnp.ndarray,        # [B, Tk, KVH, D]
+    v: jnp.ndarray,        # [B, Tk, KVH, D]
+    allowed: jnp.ndarray,  # [B, Tq, Tk] mask
+    m: jnp.ndarray,        # [B, KVH, G, Tq, 1]
+    l: jnp.ndarray,        # [B, KVH, G, Tq, 1]
+    acc: jnp.ndarray,      # [B, Tq, KVH, G, D] f32
+    scale: float,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One online-softmax update of local queries against one kv chunk."""
+    s = jnp.einsum(
+        "bqkgd,bskd->bkgqs", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale  # [B, KVH, G, Tq, Tk]
+    mask = allowed[:, None, None]  # [B, 1, 1, Tq, Tk]
+    s = jnp.where(mask, s, NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new) * mask.astype(jnp.float32)
+    alpha = jnp.exp(m - m_new)
+    l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    pv = jnp.einsum("bkgqs,bskd->bqkgd", p.astype(v.dtype), v).astype(
+        jnp.float32
+    )
+    # alpha is [B, KVH, G, Tq, 1] → acc layout [B, Tq, KVH, G, D]
+    alpha_acc = jnp.moveaxis(alpha, 3, 1)  # [B, Tq, KVH, G, 1]
+    acc_new = acc * alpha_acc + pv
+    return m_new, l_new, acc_new
+
+
+def ring_attention(
+    q: jnp.ndarray,  # local [B, Tl, H, D]
+    k: jnp.ndarray,  # local [B, Tl, KVH, D]
+    v: jnp.ndarray,
+    *,
+    axis_name: str = "sp",
+    axis_size: int,
+    mask: Optional[jnp.ndarray] = None,  # local [B, Tl] valid-token mask
+    causal: bool = True,
+) -> jnp.ndarray:
+    """Causal attention over the globally-sharded sequence. Must run inside
+    ``shard_map`` over ``axis_name``; ``axis_size`` must be the static size
+    of that axis (python loop bound — shapes are static under jit)."""
+    batch, t_local, heads, dim = q.shape
+    kv_heads = k.shape[2]
+    groups = heads // kv_heads
+    scale = dim ** -0.5
+    my_idx = jax.lax.axis_index(axis_name)
+
+    qg = q.reshape(batch, t_local, kv_heads, groups, dim)
+    if mask is None:
+        mask = jnp.ones((batch, t_local), dtype=bool)
+
+    q_pos = my_idx * t_local + jnp.arange(t_local)  # [Tl] global positions
+
+    m = jnp.full((batch, kv_heads, groups, t_local, 1), NEG_INF)
+    l = jnp.zeros((batch, kv_heads, groups, t_local, 1))
+    acc = jnp.zeros((batch, t_local, kv_heads, groups, dim))
+
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    kv = (k, v, mask)
+    for step in range(axis_size):
+        # After `step` rotations we hold the kv chunk originally on device
+        # (my_idx - step); its global key positions follow from that.
+        src = (my_idx - step) % axis_size
+        k_cur, v_cur, mask_cur = kv
+        k_pos = src * t_local + jnp.arange(t_local)
+        allowed = mask_cur[:, None, :]  # [B, 1, Tl] key validity
+        if causal:
+            allowed = jnp.logical_and(
+                allowed, (k_pos[None, :] <= q_pos[:, None])[None]
+            )
+        else:
+            allowed = jnp.broadcast_to(allowed, (batch, t_local, t_local))
+        m, l, acc = _chunk_attend(qg, k_cur, v_cur, allowed, m, l, acc, scale)
+        if step != axis_size - 1:
+            kv = jax.lax.ppermute(kv, axis_name, perm)
+
+    l_acc = jnp.moveaxis(l, 3, 1)  # [B, Tl, KVH, G, 1]
+    out = acc / jnp.where(l_acc == 0.0, 1.0, l_acc)
+    return out.reshape(batch, t_local, heads, dim).astype(q.dtype)
+
+
+def ring_attention_sharded(
+    q: jnp.ndarray,  # global [B, T, H, D]
+    k: jnp.ndarray,  # global [B, T, KVH, D]
+    v: jnp.ndarray,
+    mesh: Mesh,
+    *,
+    axis_name: str = "sp",
+    mask: Optional[jnp.ndarray] = None,  # global [B, T]
+    causal: bool = True,
+) -> jnp.ndarray:
+    """Shard q/k/v's sequence axis over ``axis_name`` and run the ring."""
+    axis_size = mesh.shape[axis_name]
+    if q.shape[1] % axis_size:
+        raise ValueError(
+            f"sequence {q.shape[1]} not divisible by {axis_name}={axis_size}"
+        )
+    qkv_spec = P(None, axis_name, None, None)
+    mask_spec = P(None, axis_name)
+    fn = functools.partial(
+        ring_attention, axis_name=axis_name, axis_size=axis_size,
+        causal=causal,
+    )
+
+    def wrapped(q, k, v, mask):
+        return fn(q, k, v, mask=mask)
+
+    if mask is None:
+        mask = jnp.ones(q.shape[:2], dtype=bool)
+    sharded = shard_map(
+        wrapped, mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
+        out_specs=qkv_spec,
+    )
+    return sharded(q, k, v, mask)

@@ -141,6 +141,12 @@ class DecodeEngine:
                     raise ValueError(
                         f"tp={mesh_config.tp} must divide {name}={size}"
                     )
+            # A Mosaic pallas_call has no SPMD partitioning rule, so the
+            # flash prefill kernel can't run inside a tp-sharded jit —
+            # keep the XLA attention there until the kernel is wrapped in
+            # shard_map over the head axis.
+            config = dataclasses.replace(config, use_flash=False)
+            self.config = config
         self.mesh = build_mesh(
             mesh_config, devices=jax.devices()[: mesh_config.size]
         )

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from langstream_tpu.ops.attention import decode_attention, prefill_attention
+from langstream_tpu.ops.flash_attention import flash_prefill_attention, use_flash
 from langstream_tpu.ops.norms import rms_norm
 from langstream_tpu.ops.rope import apply_rope, rope_frequencies
 from langstream_tpu.parallel.mesh import L
@@ -44,6 +45,9 @@ class LlamaConfig:
     max_seq_len: int = 4096
     tie_embeddings: bool = False
     dtype: Any = jnp.bfloat16
+    # Pallas flash prefill (TPU only; the engine turns this off on
+    # tp-sharded meshes where the kernel can't be auto-partitioned).
+    use_flash: bool = True
 
     @property
     def dims_per_head(self) -> int:
@@ -191,6 +195,20 @@ def _logits(config: LlamaConfig, params, x):
     return jnp.einsum("...h,hv->...v", x, head.astype(x.dtype)).astype(jnp.float32)
 
 
+def _prefill_attn(config, q, k, v, mask):
+    """Flash kernel on TPU for long MXU-aligned prompts, XLA einsum path
+    otherwise (CPU tests, short prompts, odd head dims, tp-sharded meshes
+    — a Mosaic kernel has no SPMD partitioning rule, so under tp>1 the
+    engine sets ``config.use_flash=False``). Only called from the serving
+    prefill path: the kernel has no VJP, so the differentiable
+    :func:`forward` keeps the XLA formulation. Masks here are always
+    right-padded (built from lengths), which is what the kernel's
+    lengths-based masking assumes."""
+    if config.use_flash and use_flash(q.shape[1], q.shape[3]):
+        return flash_prefill_attention(q, k, v, mask=mask)
+    return prefill_attention(q, k, v, mask=mask)
+
+
 def prefill(
     config: LlamaConfig,
     params: Dict[str, jnp.ndarray],
@@ -224,7 +242,7 @@ def prefill(
         )
         q = apply_rope(q, freqs, positions)
         k = apply_rope(k, freqs, positions)
-        attn = prefill_attention(q, k, v, mask=mask)
+        attn = _prefill_attn(config, q, k, v, mask)
         attn = jnp.einsum(
             "btd,dh->bth", attn.reshape(batch, seq, config.num_heads * hd), wo
         )

@@ -33,11 +33,22 @@ _FACTORIES: Dict[str, Callable[..., TopicConnectionsRuntime]] = {}
 def register_topic_runtime(
     name: str, factory: Callable[..., TopicConnectionsRuntime]
 ) -> None:
-    """Register a runtime factory. The factory is called with the
-    ``streamingCluster.configuration`` dict when it accepts one argument,
-    with no arguments otherwise (back-compat with broker-object factories
-    like ``MemoryTopicConnectionsRuntime``)."""
+    """Register a runtime factory. The factory receives the
+    ``streamingCluster.configuration`` dict only when its first parameter
+    is literally named ``configuration`` (or ``config``); any other
+    factory — e.g. a runtime class whose ``__init__`` takes an optional
+    broker object — is called with no arguments. Parameter *name*, not
+    arity, is the contract: an arity heuristic would feed the config dict
+    to factories whose first optional parameter means something else."""
     _FACTORIES[name] = factory
+
+
+def _wants_configuration(factory: Callable[..., Any]) -> bool:
+    try:
+        params = list(inspect.signature(factory).parameters.values())
+    except (TypeError, ValueError):
+        return False
+    return bool(params) and params[0].name in ("configuration", "config")
 
 
 def create_topic_runtime(streaming_cluster: Dict[str, Any]) -> TopicConnectionsRuntime:
@@ -50,11 +61,9 @@ def create_topic_runtime(streaming_cluster: Dict[str, Any]) -> TopicConnectionsR
             f"unknown streaming cluster type {kind!r}; known: {sorted(_FACTORIES)}"
         )
     configuration = (streaming_cluster or {}).get("configuration", {}) or {}
-    try:
-        inspect.signature(factory).bind(configuration)
-    except TypeError:
-        return factory()
-    return factory(configuration)
+    if _wants_configuration(factory):
+        return factory(configuration)
+    return factory()
 
 
 def _make_tpulog(configuration: Dict[str, Any]) -> TopicConnectionsRuntime:

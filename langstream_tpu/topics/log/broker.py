@@ -30,6 +30,7 @@ import os
 import pathlib
 import tempfile
 import threading
+import urllib.parse
 import zlib
 from typing import Any, Dict, List, Optional, Set, Tuple
 
@@ -195,7 +196,15 @@ class LogBroker:
         with self._lock:
             state = self.groups.get(key)
             if state is None:
-                safe = f"{group_id}__{topic_name}".replace("/", "_")
+                # percent-encode each part so the separator '@' (which
+                # quote() always escapes) can't collide with characters
+                # inside group or topic names — "a__b"/"c" and "a"/"b__c"
+                # must not share a watermark file.
+                safe = (
+                    urllib.parse.quote(group_id, safe="")
+                    + "@"
+                    + urllib.parse.quote(topic_name, safe="")
+                )
                 state = _LogGroupState(
                     self.root / "__groups__" / f"{safe}.json",
                     len(topic.partitions),

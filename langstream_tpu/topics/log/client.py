@@ -60,11 +60,21 @@ class BrokerConnection:
             await self._ensure()
             assert self._reader is not None and self._writer is not None
             payload = json.dumps(message, default=str).encode()
-            self._writer.write(_LEN.pack(len(payload)) + payload)
-            await self._writer.drain()
-            header = await self._reader.readexactly(_LEN.size)
-            (length,) = _LEN.unpack(header)
-            body = await self._reader.readexactly(length)
+            try:
+                self._writer.write(_LEN.pack(len(payload)) + payload)
+                await self._writer.drain()
+                header = await self._reader.readexactly(_LEN.size)
+                (length,) = _LEN.unpack(header)
+                body = await self._reader.readexactly(length)
+            except (OSError, asyncio.IncompleteReadError):
+                # A clean broker FIN leaves the transport half-open
+                # (is_closing() stays False), so _ensure would keep
+                # reusing the dead socket — drop it so the next request
+                # reconnects.
+                self._writer.close()
+                self._writer = None
+                self._reader = None
+                raise
         response = json.loads(body)
         if not response.get("ok"):
             raise RuntimeError(

@@ -160,15 +160,30 @@ class _PyPartitionLog:
                     continue
                 if start < base:
                     start = base
+                first = start - base
+                upto = min(count, first + (max_records - len(out)))
                 log_path, idx_path = self._paths(base)
-                idx = idx_path.read_bytes()
-                log = log_path.read_bytes()
-                while start < base + count and len(out) < max_records:
-                    (pos,) = _IDX.unpack_from(idx, (start - base) * _IDX.size)
+                # seek-read only the needed span — that's what the .idx
+                # position index is for; reading whole (up to 64 MB)
+                # segments per poll would swamp the consumer loop.
+                with open(idx_path, "rb") as xf:
+                    xf.seek(first * _IDX.size)
+                    idx = xf.read((upto - first + 1) * _IDX.size)
+                (first_pos,) = _IDX.unpack_from(idx, 0)
+                if upto < count:
+                    (end_pos,) = _IDX.unpack_from(idx, (upto - first) * _IDX.size)
+                else:
+                    end_pos = log_path.stat().st_size
+                with open(log_path, "rb") as lf:
+                    lf.seek(first_pos)
+                    log = lf.read(end_pos - first_pos)
+                pos = 0
+                while start < base + upto:
                     length, _ = _FRAME.unpack_from(log, pos)
                     out.append(
                         (start, log[pos + _FRAME.size : pos + _FRAME.size + length])
                     )
+                    pos += _FRAME.size + length
                     start += 1
             return out
 
