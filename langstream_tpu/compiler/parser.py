@@ -135,6 +135,8 @@ def parse_application_directory(
             application.instance = parse_instance(content)
         elif stem == "secrets":
             application.secrets = parse_secrets(content)
+        elif stem == "archetype":
+            pass  # archetype manifest (metadata only, not a pipeline)
         else:
             parse_pipeline_file(application, name, content)
     if instance_file:

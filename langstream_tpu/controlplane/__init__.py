@@ -1,0 +1,42 @@
+"""Control plane: application/tenant stores, code storage, deployment
+service, and the REST webservice.
+
+The reference's control plane is a Spring Boot webservice plus a K8s
+operator (`langstream-webservice/`, `langstream-k8s-deployer/`,
+`langstream-k8s-storage/` — SURVEY §2.6). Here the same responsibilities
+are native Python services designed around the single-binary local runner
+and the TPU deployer:
+
+- :mod:`codestorage` — app archive storage (CodeStorage SPI).
+- :mod:`stores`      — ApplicationStore / GlobalMetadataStore SPIs with
+  in-memory and filesystem backends.
+- :mod:`tenants`     — tenant registry + resource-limit checking.
+- :mod:`service`     — ApplicationService: parse/validate/deploy/delete.
+- :mod:`webservice`  — aiohttp REST surface mirroring the reference's
+  `/api/applications`, `/api/tenants`, `/api/archetypes` endpoints.
+"""
+
+from langstream_tpu.controlplane.codestorage import (  # noqa: F401
+    CodeStorage,
+    LocalDiskCodeStorage,
+    create_code_storage,
+)
+from langstream_tpu.controlplane.stores import (  # noqa: F401
+    ApplicationStore,
+    FileSystemApplicationStore,
+    GlobalMetadataStore,
+    InMemoryApplicationStore,
+    StoredApplication,
+)
+from langstream_tpu.controlplane.tenants import (  # noqa: F401
+    TenantAlreadyExists,
+    TenantConfiguration,
+    TenantNotFound,
+    TenantService,
+)
+from langstream_tpu.controlplane.service import (  # noqa: F401
+    ApplicationAlreadyExists,
+    ApplicationNotFound,
+    ApplicationService,
+    ResourceLimitExceeded,
+)

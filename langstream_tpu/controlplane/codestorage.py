@@ -1,0 +1,131 @@
+"""Code storage: versioned application archives.
+
+Reference SPI: ``langstream-api/src/main/java/ai/langstream/api/codestorage/
+CodeStorage.java:22`` (store/download/delete archives per tenant), with S3
+and Azure implementations under ``langstream-k8s-storage/.../codestorage/``
+and a local-disk one in ``langstream-core/.../LocalDiskCodeStorage.java``.
+
+Archives are opaque bytes (a zip of the application directory). Each
+upload gets a unique code-archive id; the store keeps every version so a
+running deployment can still fetch the archive it was planned from while a
+newer version rolls out.
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import shutil
+import uuid
+from typing import Any, Dict, List, Optional, Protocol
+
+
+class CodeArchiveNotFound(KeyError):
+    pass
+
+
+class CodeStorage(Protocol):
+    def store(self, tenant: str, application_id: str, archive: bytes) -> str:
+        """Store an archive, return its unique code-archive id."""
+        ...
+
+    def download(self, tenant: str, code_id: str) -> bytes:
+        ...
+
+    def delete(self, tenant: str, code_id: str) -> None:
+        ...
+
+    def list(self, tenant: str) -> List[str]:
+        ...
+
+
+class LocalDiskCodeStorage:
+    """Archives on the local filesystem:
+    ``<root>/<tenant>/<code_id>.zip``."""
+
+    def __init__(self, root: str) -> None:
+        self.root = pathlib.Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+
+    def _path(self, tenant: str, code_id: str) -> pathlib.Path:
+        if "/" in code_id or "/" in tenant or ".." in (tenant, code_id):
+            raise ValueError(f"invalid tenant/code id {tenant!r}/{code_id!r}")
+        return self.root / tenant / f"{code_id}.zip"
+
+    def store(self, tenant: str, application_id: str, archive: bytes) -> str:
+        code_id = f"{application_id}-{uuid.uuid4().hex[:12]}"
+        path = self._path(tenant, code_id)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = path.with_suffix(".tmp")
+        tmp.write_bytes(archive)
+        os.replace(tmp, path)
+        return code_id
+
+    def download(self, tenant: str, code_id: str) -> bytes:
+        path = self._path(tenant, code_id)
+        if not path.exists():
+            raise CodeArchiveNotFound(f"{tenant}/{code_id}")
+        return path.read_bytes()
+
+    def delete(self, tenant: str, code_id: str) -> None:
+        path = self._path(tenant, code_id)
+        if path.exists():
+            path.unlink()
+
+    def delete_tenant(self, tenant: str) -> None:
+        shutil.rmtree(self.root / tenant, ignore_errors=True)
+
+    def list(self, tenant: str) -> List[str]:
+        directory = self.root / tenant
+        if not directory.is_dir():
+            return []
+        return sorted(p.stem for p in directory.glob("*.zip"))
+
+
+class InMemoryCodeStorage:
+    """Archive store for tests and the single-process runner."""
+
+    def __init__(self) -> None:
+        self._archives: Dict[str, Dict[str, bytes]] = {}
+
+    def store(self, tenant: str, application_id: str, archive: bytes) -> str:
+        code_id = f"{application_id}-{uuid.uuid4().hex[:12]}"
+        self._archives.setdefault(tenant, {})[code_id] = archive
+        return code_id
+
+    def download(self, tenant: str, code_id: str) -> bytes:
+        try:
+            return self._archives[tenant][code_id]
+        except KeyError:
+            raise CodeArchiveNotFound(f"{tenant}/{code_id}") from None
+
+    def delete(self, tenant: str, code_id: str) -> None:
+        self._archives.get(tenant, {}).pop(code_id, None)
+
+    def delete_tenant(self, tenant: str) -> None:
+        self._archives.pop(tenant, None)
+
+    def list(self, tenant: str) -> List[str]:
+        return sorted(self._archives.get(tenant, {}))
+
+
+def create_code_storage(config: Optional[Dict[str, Any]] = None) -> CodeStorage:
+    """Factory keyed on ``type``: ``local-disk`` (default), ``memory``;
+    ``s3``/``azure`` are declared but gated (no object-store clients in
+    this image — the reference's S3CodeStorage contract is the shape to
+    fill in when one is available)."""
+    config = config or {}
+    kind = config.get("type", "local-disk")
+    if kind in ("local-disk", "local"):
+        root = config.get("path") or config.get("root")
+        if not root:
+            raise ValueError("local-disk code storage needs a 'path'")
+        return LocalDiskCodeStorage(root)
+    if kind in ("memory", "in-memory"):
+        return InMemoryCodeStorage()
+    if kind in ("s3", "azure", "azure-blob-storage"):
+        raise NotImplementedError(
+            f"code storage type {kind!r} requires an object-store client "
+            "not present in this environment; use 'local-disk'"
+        )
+    raise ValueError(f"unknown code storage type {kind!r}")

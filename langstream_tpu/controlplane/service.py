@@ -1,0 +1,354 @@
+"""ApplicationService: the deploy/update/delete engine behind the REST
+webservice and the CLI.
+
+Reference: ``langstream-webservice/.../application/ApplicationService.java:54``
++ ``ApplicationResource.java:82``. Deploy flow parity (SURVEY §3.1): zip
+upload → parse+validate (``ModelBuilder.buildApplicationInstance``) →
+archive to CodeStorage → ApplicationStore put → the deployer picks it up.
+Here the deployer is pluggable: the in-process executor actually runs the
+app (the reference's runtime-tester/"docker run" pattern, server-side),
+while the kubernetes deployer renders manifests for a cluster.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import io
+import logging
+import os
+import tempfile
+import time
+import zipfile
+from typing import Any, Dict, List, Optional, Protocol
+
+import copy
+import shutil
+
+from langstream_tpu.compiler.parser import (
+    application_checksum,
+    parse_application_directory,
+    resolve_placeholders,
+)
+from langstream_tpu.compiler.planner import build_execution_plan
+from langstream_tpu.controlplane.codestorage import CodeStorage
+from langstream_tpu.controlplane.stores import (
+    ApplicationStore,
+    StoredApplication,
+)
+from langstream_tpu.controlplane.tenants import (
+    TenantService,
+    application_resource_units,
+)
+from langstream_tpu.model.application import Application
+
+logger = logging.getLogger(__name__)
+
+
+class ApplicationNotFound(KeyError):
+    pass
+
+
+class ApplicationAlreadyExists(ValueError):
+    pass
+
+
+class ResourceLimitExceeded(ValueError):
+    pass
+
+
+class ApplicationExecutor(Protocol):
+    """Where deployed apps actually run. Implementations: the in-process
+    local executor below; the K8s deployer (``deployer`` package) which
+    reconciles stored apps into StatefulSets."""
+
+    async def deploy(self, stored: StoredApplication, application: Application) -> None: ...
+    async def delete(self, tenant: str, application_id: str) -> None: ...
+    def logs(self, tenant: str, application_id: str) -> List[str]: ...
+
+
+class NullExecutor:
+    """Store-only control plane (deployment handled by an external
+    reconciler polling the store, as in the reference where the operator
+    watches CRs)."""
+
+    async def deploy(self, stored: StoredApplication, application: Application) -> None:
+        return None
+
+    async def delete(self, tenant: str, application_id: str) -> None:
+        return None
+
+    def logs(self, tenant: str, application_id: str) -> List[str]:
+        return []
+
+
+class LocalExecutor:
+    """Runs each deployed app in-process with LocalApplicationRunner —
+    the server-side twin of `langstream docker run` (reference
+    ``LocalApplicationRunner.java:56``)."""
+
+    def __init__(self) -> None:
+        self._runners: Dict[tuple, Any] = {}
+        self._logs: Dict[tuple, List[str]] = {}
+
+    def _log(self, key: tuple, message: str) -> None:
+        self._logs.setdefault(key, []).append(
+            f"{time.strftime('%Y-%m-%dT%H:%M:%S')} {message}"
+        )
+
+    async def deploy(self, stored: StoredApplication, application: Application) -> None:
+        from langstream_tpu.runtime.local import LocalApplicationRunner
+
+        key = (stored.tenant, stored.application_id)
+        await self.delete(*key)
+        plan = build_execution_plan(application)
+        runner = LocalApplicationRunner(plan)
+        await runner.setup()
+        await runner.start()
+        self._runners[key] = runner
+        self._log(key, f"deployed {stored.application_id} "
+                       f"({len(plan.agents)} agents, {len(plan.topics)} topics)")
+
+    async def delete(self, tenant: str, application_id: str) -> None:
+        key = (tenant, application_id)
+        runner = self._runners.pop(key, None)
+        if runner is not None:
+            await runner.stop()
+            self._log(key, f"stopped {application_id}")
+
+    def runner(self, tenant: str, application_id: str):
+        return self._runners.get((tenant, application_id))
+
+    def logs(self, tenant: str, application_id: str) -> List[str]:
+        return list(self._logs.get((tenant, application_id), []))
+
+
+class ApplicationService:
+    def __init__(
+        self,
+        store: ApplicationStore,
+        code_storage: CodeStorage,
+        tenants: TenantService,
+        executor: Optional[ApplicationExecutor] = None,
+    ) -> None:
+        self.store = store
+        self.code_storage = code_storage
+        self.tenants = tenants
+        self.executor = executor or NullExecutor()
+        self._work_root: Optional[str] = None
+
+    # -- parse ------------------------------------------------------- #
+    def _materialize(
+        self,
+        tenant: str,
+        application_id: str,
+        archive: bytes,
+        instance_yaml: Optional[str],
+        secrets_yaml: Optional[str],
+        *,
+        keep_workdir: bool,
+    ) -> tuple:
+        """Unzip + parse once + resolve a deep copy (resolution mutates).
+        The stored definition is the unresolved parse (secrets stay
+        placeholders in the document, as in the reference). When the app
+        ships a ``python/`` dir and ``keep_workdir`` is set, the extracted
+        tree is kept under the service's work root so the executor can
+        import user agent code after the temp dir is gone."""
+        with tempfile.TemporaryDirectory(prefix="langstream-app-") as tmp:
+            app_dir = os.path.join(tmp, "app")
+            os.makedirs(app_dir)
+            with zipfile.ZipFile(io.BytesIO(archive)) as zf:
+                for member in zf.namelist():
+                    target = os.path.normpath(os.path.join(app_dir, member))
+                    if not target.startswith(app_dir + os.sep):
+                        raise ValueError(f"archive escapes app dir: {member}")
+                zf.extractall(app_dir)
+            instance_file = secrets_file = None
+            if instance_yaml:
+                instance_file = os.path.join(tmp, "instance.yaml")
+                with open(instance_file, "w") as f:
+                    f.write(instance_yaml)
+            if secrets_yaml:
+                secrets_file = os.path.join(tmp, "secrets.yaml")
+                with open(secrets_file, "w") as f:
+                    f.write(secrets_yaml)
+            checksum = application_checksum(app_dir)
+            raw = parse_application_directory(
+                app_dir, instance_file=instance_file, secrets_file=secrets_file
+            )
+            application = resolve_placeholders(copy.deepcopy(raw))
+            if application.python_path and keep_workdir:
+                workdir = self._workdir(tenant, application_id)
+                shutil.rmtree(workdir, ignore_errors=True)
+                shutil.copytree(application.python_path, workdir)
+                application.python_path = workdir
+                raw.python_path = workdir
+            # validation: the plan must build (implicit topics, agent
+            # types, gateway topic references)
+            build_execution_plan(application)
+            definition = dataclasses.asdict(raw)
+            secrets = definition.pop("secrets", {})
+            instance = definition.pop("instance", {})
+            return application, definition, instance, secrets, checksum
+
+    def _workdir(self, tenant: str, application_id: str) -> str:
+        if self._work_root is None:
+            self._work_root = tempfile.mkdtemp(prefix="langstream-cp-")
+        return os.path.join(self._work_root, tenant, application_id, "python")
+
+    # -- lifecycle --------------------------------------------------- #
+    async def deploy(
+        self,
+        tenant: str,
+        application_id: str,
+        archive: bytes,
+        instance_yaml: Optional[str] = None,
+        secrets_yaml: Optional[str] = None,
+        *,
+        update: bool = False,
+        dry_run: bool = False,
+    ) -> StoredApplication:
+        self.tenants.get(tenant)  # raises TenantNotFound
+        existing = self.store.get(tenant, application_id)
+        if existing is not None and not update:
+            raise ApplicationAlreadyExists(application_id)
+        if existing is None and update:
+            raise ApplicationNotFound(application_id)
+
+        application, definition, instance, secrets, checksum = (
+            self._materialize(
+                tenant, application_id, archive, instance_yaml, secrets_yaml,
+                keep_workdir=not dry_run,
+            )
+        )
+        application.application_id = application_id
+        application.tenant = tenant
+
+        units = application_resource_units(application)
+        current = sum(
+            application_resource_units(self._stored_to_application(app))
+            for app in self.store.list(tenant)
+            if app.application_id != application_id
+        )
+        self.tenants.check_resource_limit(tenant, units, current)
+
+        if dry_run:
+            return StoredApplication(
+                application_id=application_id, tenant=tenant,
+                definition=definition, instance=instance, secrets={},
+                checksum=checksum, status="VALIDATED",
+            )
+
+        code_id = self.code_storage.store(tenant, application_id, archive)
+        previous_code_id = existing.code_archive_id if existing else None
+        stored = StoredApplication(
+            application_id=application_id, tenant=tenant,
+            definition=definition, instance=instance, secrets=secrets,
+            code_archive_id=code_id, checksum=checksum, status="DEPLOYING",
+        )
+        self.store.put(stored)
+        try:
+            await self.executor.deploy(stored, application)
+            stored.status = "DEPLOYED"
+            stored.status_detail = ""
+        except Exception as err:  # noqa: BLE001 — status carries the error
+            stored.status = "ERROR"
+            stored.status_detail = f"{type(err).__name__}: {err}"
+            self.store.put(stored)
+            raise
+        self.store.put(stored)
+        # the update is live: the superseded archive version can go
+        if previous_code_id and previous_code_id != code_id:
+            self.code_storage.delete(tenant, previous_code_id)
+        return stored
+
+    async def delete(self, tenant: str, application_id: str) -> None:
+        stored = self.store.get(tenant, application_id)
+        if stored is None:
+            raise ApplicationNotFound(application_id)
+        stored.status = "DELETING"
+        self.store.put(stored)
+        await self.executor.delete(tenant, application_id)
+        if stored.code_archive_id:
+            self.code_storage.delete(tenant, stored.code_archive_id)
+        self.store.delete(tenant, application_id)
+        if self._work_root is not None:
+            shutil.rmtree(
+                os.path.join(self._work_root, tenant, application_id),
+                ignore_errors=True,
+            )
+
+    def on_tenant_deleted(self, tenant: str) -> None:
+        """Drop tenant-scoped leftovers (archives, workdirs, store docs)."""
+        delete_tenant = getattr(self.code_storage, "delete_tenant", None)
+        if delete_tenant is not None:
+            delete_tenant(tenant)
+        self.store.on_tenant_deleted(tenant)
+        if self._work_root is not None:
+            shutil.rmtree(
+                os.path.join(self._work_root, tenant), ignore_errors=True
+            )
+
+    def get(self, tenant: str, application_id: str) -> StoredApplication:
+        stored = self.store.get(tenant, application_id)
+        if stored is None:
+            raise ApplicationNotFound(application_id)
+        return stored
+
+    def list(self, tenant: str) -> List[StoredApplication]:
+        self.tenants.get(tenant)
+        return self.store.list(tenant)
+
+    def download_code(self, tenant: str, application_id: str) -> bytes:
+        stored = self.get(tenant, application_id)
+        if not stored.code_archive_id:
+            raise ApplicationNotFound(f"{application_id} has no code archive")
+        return self.code_storage.download(tenant, stored.code_archive_id)
+
+    def logs(self, tenant: str, application_id: str) -> List[str]:
+        self.get(tenant, application_id)
+        return self.executor.logs(tenant, application_id)
+
+    # -- helpers ----------------------------------------------------- #
+    def _stored_to_application(self, stored: StoredApplication) -> Application:
+        """Rebuild enough of the Application model from a stored document
+        to compute resource units (parallelism/size per agent)."""
+        from langstream_tpu.model.application import (
+            AgentConfiguration,
+            Application,
+            Module,
+            Pipeline,
+            ResourcesSpec,
+        )
+
+        app = Application(application_id=stored.application_id)
+        for module_id, module_doc in (stored.definition.get("modules") or {}).items():
+            module = Module(id=module_id)
+            for pipeline_id, pipeline_doc in (module_doc.get("pipelines") or {}).items():
+                pipeline = Pipeline(id=pipeline_id)
+                for agent_doc in pipeline_doc.get("agents", []):
+                    resources = agent_doc.get("resources") or {}
+                    pipeline.agents.append(
+                        AgentConfiguration(
+                            type=agent_doc.get("type", ""),
+                            id=agent_doc.get("id"),
+                            resources=ResourcesSpec(
+                                parallelism=resources.get("parallelism", 1),
+                                size=resources.get("size", 1),
+                            ),
+                        )
+                    )
+                module.pipelines[pipeline_id] = pipeline
+            app.modules[module_id] = module
+        return app
+
+
+def zip_directory(app_dir: str) -> bytes:
+    """Zip an application directory (what the CLI does before upload)."""
+    buffer = io.BytesIO()
+    with zipfile.ZipFile(buffer, "w", zipfile.ZIP_DEFLATED) as zf:
+        for root, _dirs, files in os.walk(app_dir):
+            for name in sorted(files):
+                path = os.path.join(root, name)
+                zf.write(path, os.path.relpath(path, app_dir))
+    return buffer.getvalue()

@@ -1,0 +1,218 @@
+"""Application and global-metadata stores.
+
+Reference SPIs: ``langstream-api/.../storage/ApplicationStore.java:29``
+(tenant app CRUD + status + logs) and ``GlobalMetadataStore``. The
+reference's production impl stores apps AS Kubernetes custom resources
+(``KubernetesApplicationStore.java:66``); here the durable backend is a
+filesystem document store (one JSON doc per app under the tenant
+directory), with an in-memory twin for tests — the K8s deployer consumes
+the same documents when scheduling onto a cluster.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import pathlib
+import threading
+import time
+from typing import Any, Dict, List, Optional, Protocol
+
+
+@dataclasses.dataclass
+class StoredApplication:
+    """The stored form of a deployed app: the raw (unresolved) application
+    document plus deployment bookkeeping. Secrets are stored separately
+    from the public document and never listed."""
+
+    application_id: str
+    tenant: str
+    definition: Dict[str, Any]          # serialized Application (no secrets)
+    instance: Dict[str, Any]
+    secrets: Dict[str, Any]
+    code_archive_id: Optional[str] = None
+    checksum: Optional[str] = None
+    status: str = "CREATED"             # CREATED|DEPLOYING|DEPLOYED|ERROR|DELETING
+    status_detail: str = ""
+    created_at: float = dataclasses.field(default_factory=time.time)
+    updated_at: float = dataclasses.field(default_factory=time.time)
+
+    def public_view(self) -> Dict[str, Any]:
+        return {
+            "application-id": self.application_id,
+            "tenant": self.tenant,
+            "application": self.definition,
+            "instance": _redact_instance(self.instance),
+            "code-archive-id": self.code_archive_id,
+            "checksum": self.checksum,
+            "status": {"status": self.status, "detail": self.status_detail},
+            "created-at": self.created_at,
+            "updated-at": self.updated_at,
+        }
+
+
+def _redact_instance(instance: Dict[str, Any]) -> Dict[str, Any]:
+    """Drop credential-ish keys from cluster configurations before they
+    leave the control plane (the reference redacts secrets the same way by
+    storing them in a separate Secret resource)."""
+    def clean(value: Any) -> Any:
+        if isinstance(value, dict):
+            return {
+                k: ("***" if _sensitive(k) else clean(v))
+                for k, v in value.items()
+            }
+        if isinstance(value, list):
+            return [clean(v) for v in value]
+        return value
+
+    return clean(instance or {})
+
+
+def _sensitive(key: str) -> bool:
+    lowered = key.lower().replace("_", "-")
+    return any(
+        token in lowered
+        for token in ("password", "secret", "token", "access-key", "api-key")
+    )
+
+
+class ApplicationStore(Protocol):
+    def put(self, app: StoredApplication) -> None: ...
+    def get(self, tenant: str, application_id: str) -> Optional[StoredApplication]: ...
+    def delete(self, tenant: str, application_id: str) -> None: ...
+    def list(self, tenant: str) -> List[StoredApplication]: ...
+    def on_tenant_deleted(self, tenant: str) -> None: ...
+
+
+class InMemoryApplicationStore:
+    """Reference analogue: the runtime-tester's
+    ``InMemoryApplicationStore.java:42``."""
+
+    def __init__(self) -> None:
+        self._apps: Dict[str, Dict[str, StoredApplication]] = {}
+        self._lock = threading.Lock()
+
+    def put(self, app: StoredApplication) -> None:
+        app.updated_at = time.time()
+        with self._lock:
+            self._apps.setdefault(app.tenant, {})[app.application_id] = app
+
+    def get(self, tenant: str, application_id: str) -> Optional[StoredApplication]:
+        with self._lock:
+            return self._apps.get(tenant, {}).get(application_id)
+
+    def delete(self, tenant: str, application_id: str) -> None:
+        with self._lock:
+            self._apps.get(tenant, {}).pop(application_id, None)
+
+    def list(self, tenant: str) -> List[StoredApplication]:
+        with self._lock:
+            return sorted(
+                self._apps.get(tenant, {}).values(),
+                key=lambda app: app.application_id,
+            )
+
+    def on_tenant_deleted(self, tenant: str) -> None:
+        with self._lock:
+            self._apps.pop(tenant, None)
+
+
+class FileSystemApplicationStore:
+    """One JSON document per app: ``<root>/<tenant>/<app-id>.json``.
+    Writes are atomic (tmp + rename) so a crashed control plane never
+    leaves a torn document."""
+
+    def __init__(self, root: str) -> None:
+        self.root = pathlib.Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+        self._lock = threading.Lock()
+
+    def _path(self, tenant: str, application_id: str) -> pathlib.Path:
+        for part in (tenant, application_id):
+            if "/" in part or os.sep in part or part in ("", ".", ".."):
+                raise ValueError(
+                    f"invalid tenant/application id {tenant!r}/{application_id!r}"
+                )
+        return self.root / tenant / f"{application_id}.json"
+
+    def put(self, app: StoredApplication) -> None:
+        app.updated_at = time.time()
+        path = self._path(app.tenant, app.application_id)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        doc = dataclasses.asdict(app)
+        with self._lock:
+            tmp = path.with_suffix(".tmp")
+            tmp.write_text(json.dumps(doc))
+            tmp.replace(path)
+
+    def get(self, tenant: str, application_id: str) -> Optional[StoredApplication]:
+        path = self._path(tenant, application_id)
+        with self._lock:
+            if not path.exists():
+                return None
+            doc = json.loads(path.read_text())
+        return StoredApplication(**doc)
+
+    def delete(self, tenant: str, application_id: str) -> None:
+        path = self._path(tenant, application_id)
+        with self._lock:
+            if path.exists():
+                path.unlink()
+
+    def list(self, tenant: str) -> List[StoredApplication]:
+        directory = self.root / tenant
+        with self._lock:
+            if not directory.is_dir():
+                return []
+            docs = [
+                json.loads(p.read_text())
+                for p in sorted(directory.glob("*.json"))
+            ]
+        return [StoredApplication(**doc) for doc in docs]
+
+    def on_tenant_deleted(self, tenant: str) -> None:
+        directory = self.root / tenant
+        with self._lock:
+            if directory.is_dir():
+                for path in directory.glob("*.json"):
+                    path.unlink()
+
+
+class GlobalMetadataStore:
+    """Cluster-global key/value metadata (reference:
+    ``GlobalMetadataStore.java`` — ConfigMap-backed in production). The
+    tenant registry persists through this."""
+
+    def __init__(self, path: Optional[str] = None) -> None:
+        self._path = pathlib.Path(path) if path else None
+        self._data: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+        if self._path and self._path.exists():
+            self._data = json.loads(self._path.read_text())
+
+    def get(self, key: str, default: Any = None) -> Any:
+        with self._lock:
+            return self._data.get(key, default)
+
+    def put(self, key: str, value: Any) -> None:
+        with self._lock:
+            self._data[key] = value
+            self._flush()
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            self._data.pop(key, None)
+            self._flush()
+
+    def keys(self) -> List[str]:
+        with self._lock:
+            return sorted(self._data)
+
+    def _flush(self) -> None:
+        if self._path is None:
+            return
+        self._path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = self._path.with_suffix(".tmp")
+        tmp.write_text(json.dumps(self._data))
+        tmp.replace(self._path)

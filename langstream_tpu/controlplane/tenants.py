@@ -1,0 +1,142 @@
+"""Tenant registry and per-tenant resource limits.
+
+Reference: tenants CRUD in ``langstream-webservice/.../common/
+TenantResource.java`` and quota enforcement in
+``langstream-k8s-deployer/.../limits/ApplicationResourceLimitsChecker.java``
+(an app's total resource units = Σ replicas × cpu-size across agents,
+checked against the tenant's ``maxTotalResourceUnits`` before deploy).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional
+
+from langstream_tpu.controlplane.stores import GlobalMetadataStore
+from langstream_tpu.model.application import Application
+
+_TENANTS_KEY = "tenants"
+
+
+class TenantNotFound(KeyError):
+    pass
+
+
+class TenantAlreadyExists(ValueError):
+    pass
+
+
+@dataclasses.dataclass
+class TenantConfiguration:
+    name: str
+    # 0 = unlimited (reference default)
+    max_total_resource_units: int = 0
+    created_at: float = dataclasses.field(default_factory=time.time)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, doc: Dict[str, Any]) -> "TenantConfiguration":
+        return cls(
+            name=doc["name"],
+            max_total_resource_units=int(
+                doc.get("max_total_resource_units", 0)
+                or doc.get("max-total-resource-units", 0)
+                or 0
+            ),
+            created_at=doc.get("created_at", time.time()),
+        )
+
+
+def application_resource_units(application: Application) -> float:
+    """Σ over agents of replicas × size — the unit the tenant quota is
+    denominated in (reference ``ApplicationResourceLimitsChecker``)."""
+    total = 0.0
+    for module in application.modules.values():
+        for pipeline in module.pipelines.values():
+            for agent in pipeline.agents:
+                resources = agent.resources
+                total += float(resources.parallelism) * float(resources.size)
+    return total
+
+
+class TenantService:
+    def __init__(self, metadata_store: Optional[GlobalMetadataStore] = None):
+        self._store = metadata_store or GlobalMetadataStore()
+
+    def _all(self) -> Dict[str, Dict[str, Any]]:
+        return dict(self._store.get(_TENANTS_KEY, {}) or {})
+
+    def create(
+        self, name: str, configuration: Optional[Dict[str, Any]] = None
+    ) -> TenantConfiguration:
+        tenants = self._all()
+        if name in tenants:
+            raise TenantAlreadyExists(name)
+        tenant = TenantConfiguration.from_dict(
+            {"name": name, **(configuration or {})}
+        )
+        tenants[name] = tenant.to_dict()
+        self._store.put(_TENANTS_KEY, tenants)
+        return tenant
+
+    def update(
+        self, name: str, configuration: Dict[str, Any]
+    ) -> TenantConfiguration:
+        tenants = self._all()
+        if name not in tenants:
+            raise TenantNotFound(name)
+        merged = {**tenants[name], **configuration, "name": name}
+        tenant = TenantConfiguration.from_dict(merged)
+        tenants[name] = tenant.to_dict()
+        self._store.put(_TENANTS_KEY, tenants)
+        return tenant
+
+    def put(
+        self, name: str, configuration: Optional[Dict[str, Any]] = None
+    ) -> TenantConfiguration:
+        """Create-or-update (the reference PUT semantics)."""
+        try:
+            return self.create(name, configuration)
+        except TenantAlreadyExists:
+            return self.update(name, configuration or {})
+
+    def get(self, name: str) -> TenantConfiguration:
+        tenants = self._all()
+        if name not in tenants:
+            raise TenantNotFound(name)
+        return TenantConfiguration.from_dict(tenants[name])
+
+    def exists(self, name: str) -> bool:
+        return name in self._all()
+
+    def delete(self, name: str) -> None:
+        tenants = self._all()
+        if name not in tenants:
+            raise TenantNotFound(name)
+        del tenants[name]
+        self._store.put(_TENANTS_KEY, tenants)
+
+    def list(self) -> List[TenantConfiguration]:
+        return [
+            TenantConfiguration.from_dict(doc)
+            for _, doc in sorted(self._all().items())
+        ]
+
+    def check_resource_limit(
+        self, name: str, new_app_units: float, current_units: float
+    ) -> None:
+        """Raise if deploying an app of ``new_app_units`` would push the
+        tenant past its quota (``current_units`` = sum over its other
+        deployed apps)."""
+        tenant = self.get(name)
+        limit = tenant.max_total_resource_units
+        if limit and current_units + new_app_units > limit:
+            from langstream_tpu.controlplane.service import ResourceLimitExceeded
+
+            raise ResourceLimitExceeded(
+                f"tenant {name!r}: app needs {new_app_units} units, "
+                f"{current_units} in use, limit {limit}"
+            )

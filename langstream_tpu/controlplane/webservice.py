@@ -1,0 +1,299 @@
+"""REST control-plane webservice (aiohttp).
+
+Endpoint parity with the reference Spring Boot webservice
+(``langstream-webservice/.../application/ApplicationResource.java:125-505``,
+``common/TenantResource.java``, ``archetype/ArchetypeResource.java:50``):
+
+- ``POST   /api/applications/{tenant}/{id}``  multipart deploy
+  (fields: ``app`` zip, ``instance`` yaml, ``secrets`` yaml; ``?dry-run``)
+- ``PUT    /api/applications/{tenant}/{id}``  update
+- ``GET    /api/applications/{tenant}``       list
+- ``GET    /api/applications/{tenant}/{id}``  describe (+status)
+- ``DELETE /api/applications/{tenant}/{id}``
+- ``GET    /api/applications/{tenant}/{id}/logs``
+- ``GET    /api/applications/{tenant}/{id}/code``  archive download
+- ``GET|PUT|DELETE /api/tenants[/{name}]``
+- ``GET /api/archetypes/{tenant}``, ``GET /api/archetypes/{tenant}/{id}``,
+  ``POST /api/archetypes/{tenant}/{id}/applications/{app-id}``
+
+Auth: optional static bearer token (the reference's JWT admin auth slot —
+``application.properties`` + ``langstream-auth-jwt``); token comparison is
+constant-time.
+"""
+
+from __future__ import annotations
+
+import hmac
+import json
+import logging
+import os
+from typing import Any, Dict, Optional
+
+import yaml
+from aiohttp import web
+
+from langstream_tpu.controlplane.codestorage import CodeArchiveNotFound
+from langstream_tpu.controlplane.service import (
+    ApplicationAlreadyExists,
+    ApplicationNotFound,
+    ApplicationService,
+    ResourceLimitExceeded,
+    zip_directory,
+)
+from langstream_tpu.controlplane.tenants import (
+    TenantAlreadyExists,
+    TenantNotFound,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class ControlPlaneWebService:
+    def __init__(
+        self,
+        service: ApplicationService,
+        *,
+        auth_token: Optional[str] = None,
+        archetypes_path: Optional[str] = None,
+    ) -> None:
+        self.service = service
+        self.auth_token = auth_token
+        self.archetypes_path = archetypes_path
+        self.app = web.Application(middlewares=[self._errors_middleware])
+        self._routes()
+        self._runner: Optional[web.AppRunner] = None
+        self._site: Optional[web.TCPSite] = None
+        self.port: Optional[int] = None
+
+    # -- plumbing ----------------------------------------------------- #
+    def _routes(self) -> None:
+        add = self.app.router.add_route
+        add("GET", "/api/applications/{tenant}", self.list_applications)
+        add("POST", "/api/applications/{tenant}/{id}", self.deploy_application)
+        add("PUT", "/api/applications/{tenant}/{id}", self.update_application)
+        add("GET", "/api/applications/{tenant}/{id}", self.get_application)
+        add("DELETE", "/api/applications/{tenant}/{id}", self.delete_application)
+        add("GET", "/api/applications/{tenant}/{id}/logs", self.get_logs)
+        add("GET", "/api/applications/{tenant}/{id}/code", self.download_code)
+        add("GET", "/api/tenants", self.list_tenants)
+        add("GET", "/api/tenants/{name}", self.get_tenant)
+        add("PUT", "/api/tenants/{name}", self.put_tenant)
+        add("POST", "/api/tenants/{name}", self.put_tenant)
+        add("DELETE", "/api/tenants/{name}", self.delete_tenant)
+        add("GET", "/api/archetypes/{tenant}", self.list_archetypes)
+        add("GET", "/api/archetypes/{tenant}/{id}", self.get_archetype)
+        add(
+            "POST",
+            "/api/archetypes/{tenant}/{id}/applications/{app_id}",
+            self.deploy_from_archetype,
+        )
+        add("GET", "/healthz", self.healthz)
+
+    @web.middleware
+    async def _errors_middleware(self, request: web.Request, handler):
+        if self.auth_token and request.path != "/healthz":
+            header = request.headers.get("Authorization", "")
+            token = header[7:] if header.startswith("Bearer ") else ""
+            if not hmac.compare_digest(token, self.auth_token):
+                return web.json_response(
+                    {"error": "unauthorized"}, status=401
+                )
+        try:
+            return await handler(request)
+        except (
+            ApplicationNotFound,
+            TenantNotFound,
+            CodeArchiveNotFound,
+            FileNotFoundError,
+        ) as err:
+            return web.json_response({"error": str(err)}, status=404)
+        except (ApplicationAlreadyExists, TenantAlreadyExists) as err:
+            return web.json_response({"error": str(err)}, status=409)
+        except ResourceLimitExceeded as err:
+            return web.json_response({"error": str(err)}, status=429)
+        except (ValueError, KeyError) as err:
+            logger.info("bad request: %s", err)
+            return web.json_response({"error": str(err)}, status=400)
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        self._site = web.TCPSite(self._runner, host, port)
+        await self._site.start()
+        self.port = self._site._server.sockets[0].getsockname()[1]  # type: ignore[union-attr]
+        return self.port
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    # -- applications -------------------------------------------------- #
+    async def _read_deploy_parts(self, request: web.Request):
+        archive = instance_yaml = secrets_yaml = None
+        reader = await request.multipart()
+        async for part in reader:
+            if part.name == "app":
+                archive = await part.read(decode=False)
+            elif part.name == "instance":
+                instance_yaml = (await part.read(decode=False)).decode()
+            elif part.name == "secrets":
+                secrets_yaml = (await part.read(decode=False)).decode()
+        if archive is None:
+            raise ValueError("multipart field 'app' (zip) is required")
+        return archive, instance_yaml, secrets_yaml
+
+    async def deploy_application(self, request: web.Request) -> web.Response:
+        return await self._deploy(request, update=False)
+
+    async def update_application(self, request: web.Request) -> web.Response:
+        return await self._deploy(request, update=True)
+
+    async def _deploy(self, request: web.Request, update: bool) -> web.Response:
+        tenant = request.match_info["tenant"]
+        app_id = request.match_info["id"]
+        archive, instance_yaml, secrets_yaml = await self._read_deploy_parts(
+            request
+        )
+        dry_run = request.query.get("dry-run", "").lower() in ("1", "true")
+        stored = await self.service.deploy(
+            tenant, app_id, archive, instance_yaml, secrets_yaml,
+            update=update, dry_run=dry_run,
+        )
+        return web.json_response(stored.public_view())
+
+    async def list_applications(self, request: web.Request) -> web.Response:
+        tenant = request.match_info["tenant"]
+        return web.json_response(
+            [app.public_view() for app in self.service.list(tenant)]
+        )
+
+    async def get_application(self, request: web.Request) -> web.Response:
+        tenant = request.match_info["tenant"]
+        app_id = request.match_info["id"]
+        return web.json_response(self.service.get(tenant, app_id).public_view())
+
+    async def delete_application(self, request: web.Request) -> web.Response:
+        tenant = request.match_info["tenant"]
+        app_id = request.match_info["id"]
+        await self.service.delete(tenant, app_id)
+        return web.json_response({"deleted": app_id})
+
+    async def get_logs(self, request: web.Request) -> web.Response:
+        tenant = request.match_info["tenant"]
+        app_id = request.match_info["id"]
+        lines = self.service.logs(tenant, app_id)
+        return web.Response(text="\n".join(lines) + ("\n" if lines else ""))
+
+    async def download_code(self, request: web.Request) -> web.Response:
+        tenant = request.match_info["tenant"]
+        app_id = request.match_info["id"]
+        data = self.service.download_code(tenant, app_id)
+        return web.Response(
+            body=data,
+            content_type="application/zip",
+            headers={
+                "Content-Disposition": f'attachment; filename="{app_id}.zip"'
+            },
+        )
+
+    # -- tenants ------------------------------------------------------- #
+    async def list_tenants(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {t.name: t.to_dict() for t in self.service.tenants.list()}
+        )
+
+    async def get_tenant(self, request: web.Request) -> web.Response:
+        tenant = self.service.tenants.get(request.match_info["name"])
+        return web.json_response(tenant.to_dict())
+
+    async def put_tenant(self, request: web.Request) -> web.Response:
+        config: Dict[str, Any] = {}
+        if request.can_read_body and request.content_length:
+            config = await request.json()
+        tenant = self.service.tenants.put(request.match_info["name"], config)
+        return web.json_response(tenant.to_dict())
+
+    async def delete_tenant(self, request: web.Request) -> web.Response:
+        name = request.match_info["name"]
+        for app in self.service.store.list(name):
+            await self.service.delete(name, app.application_id)
+        self.service.tenants.delete(name)
+        self.service.on_tenant_deleted(name)
+        return web.json_response({"deleted": name})
+
+    # -- archetypes ---------------------------------------------------- #
+    def _archetype_dir(self, archetype_id: str) -> str:
+        if not self.archetypes_path:
+            raise FileNotFoundError("no archetypes configured")
+        path = os.path.normpath(
+            os.path.join(self.archetypes_path, archetype_id)
+        )
+        root = os.path.normpath(self.archetypes_path)
+        if not path.startswith(root + os.sep):
+            raise ValueError("invalid archetype id")
+        if not os.path.isdir(path):
+            raise FileNotFoundError(f"archetype {archetype_id!r}")
+        return path
+
+    async def list_archetypes(self, request: web.Request) -> web.Response:
+        if not self.archetypes_path or not os.path.isdir(self.archetypes_path):
+            return web.json_response([])
+        out = []
+        for name in sorted(os.listdir(self.archetypes_path)):
+            manifest = os.path.join(self.archetypes_path, name, "archetype.yaml")
+            if os.path.isfile(manifest):
+                with open(manifest) as f:
+                    doc = yaml.safe_load(f) or {}
+                out.append({"id": name, **(doc.get("archetype") or {})})
+        return web.json_response(out)
+
+    async def get_archetype(self, request: web.Request) -> web.Response:
+        path = self._archetype_dir(request.match_info["id"])
+        manifest = os.path.join(path, "archetype.yaml")
+        doc: Dict[str, Any] = {}
+        if os.path.isfile(manifest):
+            with open(manifest) as f:
+                doc = yaml.safe_load(f) or {}
+        return web.json_response(
+            {"id": request.match_info["id"], **(doc.get("archetype") or {})}
+        )
+
+    async def deploy_from_archetype(self, request: web.Request) -> web.Response:
+        """Deploy an app from an archetype: body = JSON parameter values,
+        injected as instance globals (the reference renders archetype
+        parameters into the app's configuration the same way)."""
+        tenant = request.match_info["tenant"]
+        app_id = request.match_info["app_id"]
+        path = self._archetype_dir(request.match_info["id"])
+        parameters: Dict[str, Any] = {}
+        if request.can_read_body and request.content_length:
+            parameters = await request.json()
+        archive = zip_directory(path)
+        # merge parameters into the archetype's own instance (its cluster
+        # configuration must survive; parameters only add/override globals)
+        instance_doc: Dict[str, Any] = {}
+        instance_path = os.path.join(path, "instance.yaml")
+        if os.path.isfile(instance_path):
+            with open(instance_path) as f:
+                instance_doc = (yaml.safe_load(f) or {}).get("instance", {}) or {}
+        merged_globals = {**(instance_doc.get("globals") or {}), **parameters}
+        instance_doc["globals"] = merged_globals
+        instance_yaml = yaml.safe_dump({"instance": instance_doc})
+        stored = await self.service.deploy(
+            tenant, app_id, archive, instance_yaml, None
+        )
+        return web.json_response(stored.public_view())
+
+    async def healthz(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+
+async def serve(
+    service: ApplicationService,
+    host: str = "0.0.0.0",
+    port: int = 8090,
+    **kwargs: Any,
+) -> ControlPlaneWebService:
+    ws = ControlPlaneWebService(service, **kwargs)
+    await ws.start(host, port)
+    return ws

@@ -1,0 +1,415 @@
+"""Control-plane tests: stores, tenants/limits, service deploy flow, and
+the REST webservice end-to-end (aiohttp test client)."""
+
+import asyncio
+import io
+import json
+import zipfile
+
+import pytest
+import yaml
+from aiohttp.test_utils import TestClient, TestServer
+
+from langstream_tpu.controlplane import (
+    ApplicationAlreadyExists,
+    ApplicationNotFound,
+    ApplicationService,
+    FileSystemApplicationStore,
+    GlobalMetadataStore,
+    InMemoryApplicationStore,
+    ResourceLimitExceeded,
+    StoredApplication,
+    TenantNotFound,
+    TenantService,
+)
+from langstream_tpu.controlplane.codestorage import (
+    InMemoryCodeStorage,
+    LocalDiskCodeStorage,
+)
+from langstream_tpu.controlplane.service import LocalExecutor, zip_directory
+from langstream_tpu.controlplane.webservice import ControlPlaneWebService
+
+PIPELINE = """
+topics:
+  - name: input-topic
+    creation-mode: create-if-not-exists
+  - name: output-topic
+    creation-mode: create-if-not-exists
+pipeline:
+  - name: "upper"
+    type: compute
+    input: input-topic
+    output: output-topic
+    configuration:
+      fields:
+        - name: value.text
+          expression: "fn:uppercase(value.text)"
+"""
+
+INSTANCE = """
+instance:
+  streamingCluster:
+    type: memory
+  computeCluster:
+    type: local
+"""
+
+
+def make_app_zip(pipeline=PIPELINE, parallelism=1) -> bytes:
+    if parallelism != 1:
+        pipeline = pipeline.replace(
+            'type: compute',
+            f'type: compute\n    resources:\n      parallelism: {parallelism}',
+        )
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as zf:
+        zf.writestr("pipeline.yaml", pipeline)
+    return buf.getvalue()
+
+
+def make_service(executor=None, tmp_path=None):
+    store = (
+        FileSystemApplicationStore(str(tmp_path / "apps"))
+        if tmp_path is not None
+        else InMemoryApplicationStore()
+    )
+    code = (
+        LocalDiskCodeStorage(str(tmp_path / "code"))
+        if tmp_path is not None
+        else InMemoryCodeStorage()
+    )
+    tenants = TenantService(GlobalMetadataStore())
+    tenants.create("default")
+    return ApplicationService(store, code, tenants, executor=executor)
+
+
+# --------------------------------------------------------------------- #
+# stores
+# --------------------------------------------------------------------- #
+def test_filesystem_store_roundtrip(tmp_path):
+    store = FileSystemApplicationStore(str(tmp_path))
+    app = StoredApplication(
+        application_id="a1", tenant="t", definition={"modules": {}},
+        instance={}, secrets={"s": 1},
+    )
+    store.put(app)
+    loaded = store.get("t", "a1")
+    assert loaded is not None and loaded.secrets == {"s": 1}
+    assert [a.application_id for a in store.list("t")] == ["a1"]
+    store.delete("t", "a1")
+    assert store.get("t", "a1") is None
+
+
+def test_public_view_redacts_instance_credentials():
+    app = StoredApplication(
+        application_id="a", tenant="t", definition={},
+        instance={"streamingCluster": {"configuration": {
+            "bootstrap": "k:9092", "sasl-password": "hunter2",
+        }}},
+        secrets={"openai": {"access-key": "k"}},
+    )
+    view = app.public_view()
+    assert "secrets" not in view
+    config = view["instance"]["streamingCluster"]["configuration"]
+    assert config["sasl-password"] == "***"
+    assert config["bootstrap"] == "k:9092"
+
+
+def test_code_storage_versions(tmp_path):
+    storage = LocalDiskCodeStorage(str(tmp_path))
+    id1 = storage.store("t", "app", b"v1")
+    id2 = storage.store("t", "app", b"v2")
+    assert id1 != id2
+    assert storage.download("t", id1) == b"v1"
+    assert storage.download("t", id2) == b"v2"
+    storage.delete("t", id1)
+    with pytest.raises(KeyError):
+        storage.download("t", id1)
+
+
+# --------------------------------------------------------------------- #
+# tenants + limits
+# --------------------------------------------------------------------- #
+def test_tenant_crud_and_limits():
+    tenants = TenantService(GlobalMetadataStore())
+    tenants.create("acme", {"max_total_resource_units": 4})
+    assert tenants.get("acme").max_total_resource_units == 4
+    tenants.put("acme", {"max_total_resource_units": 2})
+    assert tenants.get("acme").max_total_resource_units == 2
+    with pytest.raises(TenantNotFound):
+        tenants.get("nope")
+    tenants.delete("acme")
+    assert not tenants.exists("acme")
+
+
+def test_deploy_respects_resource_limits():
+    asyncio.run(_test_deploy_respects_resource_limits())
+
+
+async def _test_deploy_respects_resource_limits():
+    service = make_service()
+    service.tenants.put("default", {"max_total_resource_units": 2})
+    with pytest.raises(ResourceLimitExceeded):
+        await service.deploy(
+            "default", "big", make_app_zip(parallelism=3), INSTANCE
+        )
+    await service.deploy(
+        "default", "ok", make_app_zip(parallelism=2), INSTANCE
+    )
+    # second app would exceed the remaining quota
+    with pytest.raises(ResourceLimitExceeded):
+        await service.deploy(
+            "default", "second", make_app_zip(), INSTANCE
+        )
+
+
+# --------------------------------------------------------------------- #
+# service flow
+# --------------------------------------------------------------------- #
+def test_deploy_get_update_delete():
+    asyncio.run(_test_deploy_get_update_delete())
+
+
+async def _test_deploy_get_update_delete():
+    service = make_service()
+    stored = await service.deploy("default", "app1", make_app_zip(), INSTANCE)
+    assert stored.status == "DEPLOYED"
+    assert stored.code_archive_id
+    with pytest.raises(ApplicationAlreadyExists):
+        await service.deploy("default", "app1", make_app_zip(), INSTANCE)
+    updated = await service.deploy(
+        "default", "app1", make_app_zip(), INSTANCE, update=True
+    )
+    assert updated.checksum == stored.checksum
+    assert service.download_code("default", "app1") == make_app_zip()
+    await service.delete("default", "app1")
+    with pytest.raises(ApplicationNotFound):
+        service.get("default", "app1")
+
+
+def test_deploy_validation_failure_does_not_store():
+    asyncio.run(_test_deploy_validation_failure_does_not_store())
+
+
+async def _test_deploy_validation_failure_does_not_store():
+    service = make_service()
+    bad = io.BytesIO()
+    with zipfile.ZipFile(bad, "w") as zf:
+        zf.writestr("pipeline.yaml", "pipeline:\n  - name: x\n")  # no type
+    with pytest.raises(ValueError):
+        await service.deploy("default", "bad", bad.getvalue(), INSTANCE)
+    with pytest.raises(ApplicationNotFound):
+        service.get("default", "bad")
+
+
+def test_local_executor_runs_pipeline():
+    asyncio.run(_test_local_executor_runs_pipeline())
+
+
+async def _test_local_executor_runs_pipeline():
+    executor = LocalExecutor()
+    service = make_service(executor=executor)
+    await service.deploy("default", "app1", make_app_zip(), INSTANCE)
+    runner = executor.runner("default", "app1")
+    assert runner is not None
+    producer = runner.producer("input-topic")
+    reader = runner.reader("output-topic", position="earliest")
+    from langstream_tpu.api.records import Record
+
+    await producer.write(Record(value={"text": "hello"}))
+    record = None
+    for _ in range(100):
+        batch = await reader.read(max_records=1)
+        if batch:
+            record = batch[0]
+            break
+        await asyncio.sleep(0.05)
+    assert record is not None and record.value["text"] == "HELLO"
+    assert any("deployed" in line for line in service.logs("default", "app1"))
+    await service.delete("default", "app1")
+    assert executor.runner("default", "app1") is None
+
+
+# --------------------------------------------------------------------- #
+# webservice e2e
+# --------------------------------------------------------------------- #
+def _multipart(archive: bytes):
+    import aiohttp
+
+    form = aiohttp.FormData()
+    form.add_field("app", archive, filename="app.zip",
+                   content_type="application/zip")
+    form.add_field("instance", INSTANCE)
+    form.add_field("secrets", "secrets: []")
+    return form
+
+
+def test_webservice_end_to_end(tmp_path):
+    asyncio.run(_test_webservice_end_to_end(tmp_path))
+
+
+async def _test_webservice_end_to_end(tmp_path):
+    service = make_service(executor=LocalExecutor(), tmp_path=tmp_path)
+    ws = ControlPlaneWebService(service)
+    client = TestClient(TestServer(ws.app))
+    await client.start_server()
+    try:
+        # tenants
+        resp = await client.put("/api/tenants/acme", json={})
+        assert resp.status == 200
+        resp = await client.get("/api/tenants")
+        assert "acme" in await resp.json()
+
+        # deploy
+        resp = await client.post(
+            "/api/applications/acme/demo", data=_multipart(make_app_zip())
+        )
+        assert resp.status == 200, await resp.text()
+        doc = await resp.json()
+        assert doc["status"]["status"] == "DEPLOYED"
+
+        # duplicate → 409
+        resp = await client.post(
+            "/api/applications/acme/demo", data=_multipart(make_app_zip())
+        )
+        assert resp.status == 409
+
+        # list + get
+        resp = await client.get("/api/applications/acme")
+        assert [a["application-id"] for a in await resp.json()] == ["demo"]
+        resp = await client.get("/api/applications/acme/demo")
+        assert (await resp.json())["checksum"]
+
+        # logs + code download
+        resp = await client.get("/api/applications/acme/demo/logs")
+        assert "deployed" in await resp.text()
+        resp = await client.get("/api/applications/acme/demo/code")
+        assert resp.status == 200
+        body = await resp.read()
+        with zipfile.ZipFile(io.BytesIO(body)) as zf:
+            assert "pipeline.yaml" in zf.namelist()
+
+        # unknown tenant → 404
+        resp = await client.get("/api/applications/nope")
+        assert resp.status == 404
+
+        # delete app, delete tenant
+        resp = await client.delete("/api/applications/acme/demo")
+        assert resp.status == 200
+        resp = await client.delete("/api/tenants/acme")
+        assert resp.status == 200
+        resp = await client.get("/api/tenants/acme")
+        assert resp.status == 404
+    finally:
+        await client.close()
+
+
+def test_webservice_auth():
+    asyncio.run(_test_webservice_auth())
+
+
+async def _test_webservice_auth():
+    service = make_service()
+    ws = ControlPlaneWebService(service, auth_token="sesame")
+    client = TestClient(TestServer(ws.app))
+    await client.start_server()
+    try:
+        resp = await client.get("/api/tenants")
+        assert resp.status == 401
+        resp = await client.get(
+            "/api/tenants", headers={"Authorization": "Bearer sesame"}
+        )
+        assert resp.status == 200
+        resp = await client.get("/healthz")
+        assert resp.status == 200
+    finally:
+        await client.close()
+
+
+def test_archetypes(tmp_path):
+    asyncio.run(_test_archetypes(tmp_path))
+
+
+async def _test_archetypes(tmp_path):
+    arch = tmp_path / "archetypes" / "basic"
+    arch.mkdir(parents=True)
+    (arch / "archetype.yaml").write_text(yaml.safe_dump({
+        "archetype": {
+            "title": "Basic compute",
+            "sections": [{"parameters": [{"name": "greeting"}]}],
+        }
+    }))
+    (arch / "pipeline.yaml").write_text(PIPELINE)
+    (arch / "instance.yaml").write_text(INSTANCE)
+
+    service = make_service()
+    ws = ControlPlaneWebService(
+        service, archetypes_path=str(tmp_path / "archetypes")
+    )
+    client = TestClient(TestServer(ws.app))
+    await client.start_server()
+    try:
+        resp = await client.get("/api/archetypes/default")
+        docs = await resp.json()
+        assert docs and docs[0]["id"] == "basic"
+        resp = await client.get("/api/archetypes/default/basic")
+        assert (await resp.json())["title"] == "Basic compute"
+        resp = await client.post(
+            "/api/archetypes/default/basic/applications/from-arch",
+            json={"greeting": "hi"},
+        )
+        assert resp.status == 200, await resp.text()
+        doc = await resp.json()
+        assert doc["application-id"] == "from-arch"
+    finally:
+        await client.close()
+
+
+def test_python_agent_workdir_survives_deploy(tmp_path):
+    asyncio.run(_test_python_agent_workdir_survives_deploy(tmp_path))
+
+
+async def _test_python_agent_workdir_survives_deploy(tmp_path):
+    """The app's python/ dir must outlive _materialize's temp dir so the
+    executor can import user agent code after deploy returns."""
+    agent_code = (
+        "class Exclaim:\n"
+        "    def process(self, record):\n"
+        "        return [record.value + '!']\n"
+    )
+    pipeline = """
+topics:
+  - name: input-topic
+    creation-mode: create-if-not-exists
+  - name: output-topic
+    creation-mode: create-if-not-exists
+pipeline:
+  - name: "shout"
+    type: python-processor
+    input: input-topic
+    output: output-topic
+    configuration:
+      className: shout.Exclaim
+"""
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as zf:
+        zf.writestr("pipeline.yaml", pipeline)
+        zf.writestr("python/shout.py", agent_code)
+    executor = LocalExecutor()
+    service = make_service(executor=executor, tmp_path=tmp_path)
+    stored = await service.deploy("default", "pyapp", buf.getvalue(), INSTANCE)
+    assert stored.status == "DEPLOYED"
+    runner = executor.runner("default", "pyapp")
+    from langstream_tpu.api.records import Record
+
+    reader = runner.reader("output-topic", position="earliest")
+    await runner.producer("input-topic").write(Record(value="hey"))
+    for _ in range(100):
+        batch = await reader.read(max_records=1)
+        if batch:
+            assert batch[0].value == "hey!"
+            break
+        await asyncio.sleep(0.05)
+    else:
+        raise AssertionError("no output from python agent")
+    await service.delete("default", "pyapp")
