@@ -1,0 +1,34 @@
+"""Kubernetes deployer: CRD documents, resource factories, and the
+operator reconcile loop.
+
+Reference: ``langstream-k8s-deployer/`` (SURVEY §2.6) — CRDs
+``applications.langstream.ai`` / ``agents.langstream.ai``, the
+``AppResourcesFactory``/``AgentResourcesFactory`` manifest generators, and
+the Quarkus JOSDK operator (``AppController``/``AgentController``). Here:
+
+- :mod:`crds`      — custom-resource documents + CRD schemas.
+- :mod:`resources` — manifest generation (StatefulSets targeting GKE TPU
+  node pools, setup/deployer Jobs, Secrets, Services, PVCs).
+- :mod:`kube`      — a minimal K8s API abstraction with an in-memory mock
+  (the reference tests against a fabric8 mock the same way,
+  ``KubeTestServer.java:46``); a real-cluster client can implement the
+  same interface over the REST API.
+- :mod:`operator`  — reconcile app CRs → agent CRs → StatefulSets, status
+  aggregation, retry with backoff.
+"""
+
+from langstream_tpu.deployer.crds import (  # noqa: F401
+    AgentCustomResource,
+    ApplicationCustomResource,
+    agent_crd_schema,
+    application_crd_schema,
+)
+from langstream_tpu.deployer.kube import MockKubeApi  # noqa: F401
+from langstream_tpu.deployer.operator import Operator  # noqa: F401
+from langstream_tpu.deployer.resources import (  # noqa: F401
+    generate_agent_secret,
+    generate_deployer_job,
+    generate_gateway_service,
+    generate_setup_job,
+    generate_statefulset,
+)

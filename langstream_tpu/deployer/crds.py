@@ -1,0 +1,194 @@
+"""Custom-resource documents and CRD schemas.
+
+Reference CRDs: ``applications.langstream.ai`` and ``agents.langstream.ai``
+(``helm/crds/{applications,agents}.langstream.ai-v1.yml``; spec classes
+``langstream-k8s-deployer-api/.../crds/apps/ApplicationSpec.java:33`` and
+``crds/agents/AgentSpec.java:33``). The documents here are plain dicts in
+Kubernetes shape (apiVersion/kind/metadata/spec/status) so they serialize
+directly to manifests and round-trip through any API server.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, List, Optional
+
+API_GROUP = "langstream.tpu"
+API_VERSION = f"{API_GROUP}/v1"
+APPLICATIONS_PLURAL = "applications"
+AGENTS_PLURAL = "agents"
+
+
+@dataclasses.dataclass
+class ApplicationCustomResource:
+    """The stored-app CR the control plane writes and the operator
+    reconciles (reference ``ApplicationCustomResource``; apps are stored
+    AS these, ``KubernetesApplicationStore.java:137-190``)."""
+
+    name: str                       # application id
+    namespace: str                  # tenant namespace
+    application: Dict[str, Any]     # serialized application definition
+    instance: Dict[str, Any]
+    code_archive_id: Optional[str] = None
+    checksum: Optional[str] = None
+    generation: int = 1
+    status: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def to_manifest(self) -> Dict[str, Any]:
+        return {
+            "apiVersion": API_VERSION,
+            "kind": "Application",
+            "metadata": {
+                "name": self.name,
+                "namespace": self.namespace,
+                "generation": self.generation,
+            },
+            "spec": {
+                "application": json.dumps(self.application),
+                "instance": json.dumps(self.instance),
+                "codeArchiveId": self.code_archive_id,
+                "checksum": self.checksum,
+            },
+            "status": self.status,
+        }
+
+    @classmethod
+    def from_manifest(cls, doc: Dict[str, Any]) -> "ApplicationCustomResource":
+        meta, spec = doc.get("metadata", {}), doc.get("spec", {})
+        return cls(
+            name=meta["name"],
+            namespace=meta.get("namespace", "default"),
+            application=json.loads(spec.get("application") or "{}"),
+            instance=json.loads(spec.get("instance") or "{}"),
+            code_archive_id=spec.get("codeArchiveId"),
+            checksum=spec.get("checksum"),
+            generation=meta.get("generation", 1),
+            status=doc.get("status", {}) or {},
+        )
+
+
+@dataclasses.dataclass
+class AgentCustomResource:
+    """One execution-plan node as a CR (reference ``AgentCustomResource``
+    written per plan node by ``KubernetesClusterRuntime.java:93-144``)."""
+
+    name: str                        # <application-id>-<node-id>
+    namespace: str
+    application_id: str
+    agent_node: Dict[str, Any]       # serialized AgentNode (runner config)
+    streaming_cluster: Dict[str, Any]
+    parallelism: int = 1
+    size: int = 1                    # compute units → TPU chips per replica
+    disk: Optional[Dict[str, Any]] = None
+    code_archive_id: Optional[str] = None
+    checksum: Optional[str] = None
+    generation: int = 1
+    status: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def to_manifest(self) -> Dict[str, Any]:
+        return {
+            "apiVersion": API_VERSION,
+            "kind": "Agent",
+            "metadata": {
+                "name": self.name,
+                "namespace": self.namespace,
+                "generation": self.generation,
+                "labels": {
+                    "app.kubernetes.io/managed-by": "langstream-tpu",
+                    "langstream.tpu/application": self.application_id,
+                },
+            },
+            "spec": {
+                "applicationId": self.application_id,
+                "agentNode": json.dumps(self.agent_node),
+                "streamingCluster": json.dumps(self.streaming_cluster),
+                "parallelism": self.parallelism,
+                "size": self.size,
+                "disk": self.disk,
+                "codeArchiveId": self.code_archive_id,
+                "checksum": self.checksum,
+            },
+            "status": self.status,
+        }
+
+    @classmethod
+    def from_manifest(cls, doc: Dict[str, Any]) -> "AgentCustomResource":
+        meta, spec = doc.get("metadata", {}), doc.get("spec", {})
+        return cls(
+            name=meta["name"],
+            namespace=meta.get("namespace", "default"),
+            application_id=spec.get("applicationId", ""),
+            agent_node=json.loads(spec.get("agentNode") or "{}"),
+            streaming_cluster=json.loads(spec.get("streamingCluster") or "{}"),
+            parallelism=int(spec.get("parallelism", 1)),
+            size=int(spec.get("size", 1)),
+            disk=spec.get("disk"),
+            code_archive_id=spec.get("codeArchiveId"),
+            checksum=spec.get("checksum"),
+            generation=meta.get("generation", 1),
+            status=doc.get("status", {}) or {},
+        )
+
+
+def _crd(plural: str, kind: str, spec_properties: Dict[str, Any]) -> Dict[str, Any]:
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"{plural}.{API_GROUP}"},
+        "spec": {
+            "group": API_GROUP,
+            "names": {
+                "kind": kind,
+                "plural": plural,
+                "singular": kind.lower(),
+            },
+            "scope": "Namespaced",
+            "versions": [{
+                "name": "v1",
+                "served": True,
+                "storage": True,
+                "schema": {
+                    "openAPIV3Schema": {
+                        "type": "object",
+                        "properties": {
+                            "spec": {
+                                "type": "object",
+                                "properties": spec_properties,
+                            },
+                            "status": {
+                                "type": "object",
+                                "x-kubernetes-preserve-unknown-fields": True,
+                            },
+                        },
+                    }
+                },
+                "subresources": {"status": {}},
+            }],
+        },
+    }
+
+
+def application_crd_schema() -> Dict[str, Any]:
+    return _crd(APPLICATIONS_PLURAL, "Application", {
+        "application": {"type": "string"},
+        "instance": {"type": "string"},
+        "codeArchiveId": {"type": "string"},
+        "checksum": {"type": "string"},
+    })
+
+
+def agent_crd_schema() -> Dict[str, Any]:
+    return _crd(AGENTS_PLURAL, "Agent", {
+        "applicationId": {"type": "string"},
+        "agentNode": {"type": "string"},
+        "streamingCluster": {"type": "string"},
+        "parallelism": {"type": "integer"},
+        "size": {"type": "integer"},
+        "disk": {
+            "type": "object",
+            "x-kubernetes-preserve-unknown-fields": True,
+        },
+        "codeArchiveId": {"type": "string"},
+        "checksum": {"type": "string"},
+    })

@@ -1,0 +1,290 @@
+"""Kubernetes manifest generation for agent runners on TPU node pools.
+
+Reference: ``AgentResourcesFactory.java`` (StatefulSet generation 136-311:
+init containers, ports, PVC 356, probes 419-434, Secret 494-510,
+parallelism→replicas 520-542) and ``AppResourcesFactory.java`` (setup Job
+214, deployer Job 75). The TPU-native changes:
+
+- ``resources.size`` means **TPU chips per replica** (the reference's
+  abstract cpu/mem units); it maps to ``google.com/tpu`` resource requests
+  plus GKE TPU node-pool selectors
+  (``cloud.google.com/gke-tpu-accelerator``/``-topology``).
+- replicas keep the reference's data-parallel semantics (one consumer
+  group across replicas); each replica's chips form its ICI mesh for
+  tensor/sequence parallelism, configured by the agent's ``mesh`` config.
+- multi-host slices (chips > 8 on v5e) use a headless service +
+  ``TPU_WORKER_HOSTNAMES`` so jax initializes the DCN mesh across the
+  StatefulSet's pods — the SPMD sidecar pattern the reference never needed
+  (SURVEY §7 hard part (e)).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+from langstream_tpu.deployer.crds import (
+    AgentCustomResource,
+    ApplicationCustomResource,
+)
+
+DEFAULT_IMAGE = "langstream-tpu/runtime:latest"
+AGENT_HTTP_PORT = 8080   # /metrics, /info (reference AgentRunner.java:99-113)
+AGENT_SERVICE_PORT = 8000
+
+# v5e chips → GKE topology string (per-host slices up to 8 chips; larger
+# slices are multi-host: topology columns × rows, 4 chips per host).
+_V5E_TOPOLOGY = {1: "1x1", 4: "2x2", 8: "2x4", 16: "4x4", 32: "4x8", 64: "8x8"}
+
+
+def tpu_topology(chips: int, accelerator: str = "tpu-v5-lite-podslice") -> Dict[str, str]:
+    if chips not in _V5E_TOPOLOGY:
+        raise ValueError(
+            f"unsupported chips-per-replica {chips}; supported: "
+            f"{sorted(_V5E_TOPOLOGY)}"
+        )
+    return {
+        "cloud.google.com/gke-tpu-accelerator": accelerator,
+        "cloud.google.com/gke-tpu-topology": _V5E_TOPOLOGY[chips],
+    }
+
+
+def hosts_per_replica(chips: int) -> int:
+    return max(1, chips // 8) if chips >= 8 else 1
+
+
+def _runtime_pod_configuration(agent: AgentCustomResource) -> Dict[str, Any]:
+    """The mounted pod config (reference ``RuntimePodConfiguration`` read
+    by ``AgentRunnerStarter.java:39``)."""
+    return {
+        "agentNode": agent.agent_node,
+        "streamingCluster": agent.streaming_cluster,
+        "applicationId": agent.application_id,
+        "codeArchiveId": agent.code_archive_id,
+        "tenant": agent.namespace,
+    }
+
+
+def generate_agent_secret(agent: AgentCustomResource) -> Dict[str, Any]:
+    import base64
+
+    payload = json.dumps(_runtime_pod_configuration(agent)).encode()
+    return {
+        "apiVersion": "v1",
+        "kind": "Secret",
+        "metadata": {"name": agent.name, "namespace": agent.namespace},
+        "data": {
+            "pod-configuration.json": base64.b64encode(payload).decode()
+        },
+    }
+
+
+def generate_statefulset(
+    agent: AgentCustomResource,
+    *,
+    image: str = DEFAULT_IMAGE,
+    accelerator: str = "tpu-v5-lite-podslice",
+    code_storage_config: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    chips = agent.size
+    labels = {
+        "app": agent.name,
+        "app.kubernetes.io/managed-by": "langstream-tpu",
+        "langstream.tpu/application": agent.application_id,
+    }
+    volume_mounts = [
+        {"name": "pod-config", "mountPath": "/app/config", "readOnly": True},
+        {"name": "code", "mountPath": "/app/code"},
+    ]
+    volumes: List[Dict[str, Any]] = [
+        {"name": "pod-config", "secret": {"secretName": agent.name}},
+        {"name": "code", "emptyDir": {}},
+    ]
+    volume_claims: List[Dict[str, Any]] = []
+    if agent.disk:
+        # reference: DiskSpec → PVC (AgentResourcesFactory.java:356)
+        volume_mounts.append(
+            {"name": "state", "mountPath": "/app/state"}
+        )
+        volume_claims.append({
+            "metadata": {"name": "state"},
+            "spec": {
+                "accessModes": ["ReadWriteOnce"],
+                "storageClassName": agent.disk.get("type") or None,
+                "resources": {
+                    "requests": {"storage": agent.disk.get("size", "1Gi")}
+                },
+            },
+        })
+
+    container_resources: Dict[str, Any] = {}
+    node_selector: Dict[str, str] = {}
+    env = [
+        {"name": "LANGSTREAM_POD_CONFIG",
+         "value": "/app/config/pod-configuration.json"},
+        {"name": "LANGSTREAM_CODE_DIR", "value": "/app/code"},
+        {"name": "LANGSTREAM_STATE_DIR", "value": "/app/state"},
+    ]
+    if chips > 0:
+        per_host = min(chips, 8) if chips >= 8 else chips
+        container_resources = {
+            "requests": {"google.com/tpu": str(per_host)},
+            "limits": {"google.com/tpu": str(per_host)},
+        }
+        node_selector = tpu_topology(chips, accelerator)
+    else:
+        # size 0 = CPU-only agent (pure transforms / IO)
+        container_resources = {
+            "requests": {"cpu": "500m", "memory": "512Mi"},
+        }
+
+    init_containers = [{
+        # reference: AgentCodeDownloader init container
+        "name": "code-download",
+        "image": image,
+        "command": [
+            "python", "-m", "langstream_tpu", "code-download",
+            "--config", "/app/config/pod-configuration.json",
+            "--target", "/app/code",
+        ],
+        "env": [{
+            "name": "LANGSTREAM_CODE_STORAGE",
+            "value": json.dumps(code_storage_config or {}),
+        }],
+        "volumeMounts": volume_mounts,
+    }]
+
+    probe = {
+        "httpGet": {"path": "/info", "port": AGENT_HTTP_PORT},
+        "initialDelaySeconds": 10,
+        "periodSeconds": 10,
+        "timeoutSeconds": 5,
+    }
+
+    hosts = hosts_per_replica(chips)
+    replicas = agent.parallelism * hosts
+    if hosts > 1:
+        # all hosts of one replica must enter the same pjit program; the
+        # runner derives its slice group from the ordinal (pods r*hosts ..
+        # r*hosts+hosts-1 form replica r's DCN mesh)
+        env.append({"name": "LANGSTREAM_HOSTS_PER_REPLICA", "value": str(hosts)})
+
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "StatefulSet",
+        "metadata": {
+            "name": agent.name,
+            "namespace": agent.namespace,
+            "labels": labels,
+            "annotations": {"langstream.tpu/checksum": agent.checksum or ""},
+        },
+        "spec": {
+            "replicas": replicas,
+            "podManagementPolicy": "Parallel",
+            "serviceName": agent.name,
+            "selector": {"matchLabels": {"app": agent.name}},
+            "template": {
+                "metadata": {"labels": labels},
+                "spec": {
+                    "nodeSelector": node_selector,
+                    "initContainers": init_containers,
+                    "containers": [{
+                        "name": "runner",
+                        "image": image,
+                        "command": [
+                            "python", "-m", "langstream_tpu", "agent-runner",
+                            "--config",
+                            "/app/config/pod-configuration.json",
+                        ],
+                        "env": env,
+                        "ports": [
+                            {"name": "http", "containerPort": AGENT_HTTP_PORT},
+                            {"name": "service",
+                             "containerPort": AGENT_SERVICE_PORT},
+                        ],
+                        "resources": container_resources,
+                        "livenessProbe": probe,
+                        "readinessProbe": probe,
+                        "volumeMounts": volume_mounts,
+                    }],
+                    "volumes": volumes,
+                    "terminationGracePeriodSeconds": 75,  # > 60s drain
+                },
+            },
+            "volumeClaimTemplates": volume_claims,
+        },
+    }
+
+
+def generate_headless_service(agent: AgentCustomResource) -> Dict[str, Any]:
+    """Headless service for the StatefulSet (stable DNS for multi-host
+    DCN mesh bootstrap and the gateway's service-gateway proxy)."""
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": agent.name, "namespace": agent.namespace},
+        "spec": {
+            "clusterIP": "None",
+            "selector": {"app": agent.name},
+            "ports": [
+                {"name": "http", "port": AGENT_HTTP_PORT},
+                {"name": "service", "port": AGENT_SERVICE_PORT},
+            ],
+        },
+    }
+
+
+# kept under its factory-style alias used by the package __init__
+generate_gateway_service = generate_headless_service
+
+
+def _job(name: str, namespace: str, command: List[str], image: str,
+         app: ApplicationCustomResource) -> Dict[str, Any]:
+    return {
+        "apiVersion": "batch/v1",
+        "kind": "Job",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {
+            "backoffLimit": 6,
+            "template": {
+                "metadata": {"labels": {"job-name": name}},
+                "spec": {
+                    "restartPolicy": "OnFailure",
+                    "containers": [{
+                        "name": "main",
+                        "image": image,
+                        "command": command,
+                        "env": [{
+                            "name": "LANGSTREAM_APPLICATION",
+                            "value": json.dumps(app.to_manifest()["spec"]),
+                        }],
+                    }],
+                },
+            },
+        },
+    }
+
+
+def generate_setup_job(
+    app: ApplicationCustomResource, *, image: str = DEFAULT_IMAGE
+) -> Dict[str, Any]:
+    """Topics + assets setup (reference ``AppResourcesFactory.java:214`` →
+    ``ApplicationSetupRunner``)."""
+    return _job(
+        f"{app.name}-setup", app.namespace,
+        ["python", "-m", "langstream_tpu", "application-setup"],
+        image, app,
+    )
+
+
+def generate_deployer_job(
+    app: ApplicationCustomResource, *, image: str = DEFAULT_IMAGE,
+    delete: bool = False,
+) -> Dict[str, Any]:
+    """Plan build + agent-CR writes (reference ``AppResourcesFactory.java:75``
+    → ``RuntimeDeployer``)."""
+    suffix = "cleanup" if delete else "deployer"
+    command = ["python", "-m", "langstream_tpu", "deployer"]
+    if delete:
+        command.append("--delete")
+    return _job(f"{app.name}-{suffix}", app.namespace, command, image, app)

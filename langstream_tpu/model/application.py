@@ -198,3 +198,84 @@ class Application:
         for module in self.modules.values():
             out.update(module.topics)
         return out
+
+    @classmethod
+    def from_document(
+        cls,
+        definition: Dict[str, Any],
+        instance: Optional[Dict[str, Any]] = None,
+        secrets: Optional[Dict[str, Any]] = None,
+    ) -> "Application":
+        """Rebuild an Application from its ``dataclasses.asdict`` document
+        (the form the control plane stores and ships in CRs). Inverse of
+        ``asdict`` for the snake_case field names used there."""
+        app = cls(
+            application_id=definition.get("application_id", "app"),
+            tenant=definition.get("tenant", "default"),
+            resources=definition.get("resources", {}) or {},
+            dependencies=definition.get("dependencies", []) or [],
+            python_path=definition.get("python_path"),
+        )
+        for module_id, module_doc in (definition.get("modules") or {}).items():
+            module = Module(id=module_id)
+            for name, topic_doc in (module_doc.get("topics") or {}).items():
+                module.topics[name] = TopicDefinition(
+                    name=topic_doc.get("name", name),
+                    creation_mode=topic_doc.get("creation_mode", "none"),
+                    deletion_mode=topic_doc.get("deletion_mode", "none"),
+                    partitions=topic_doc.get("partitions", 1),
+                    keep_alive=topic_doc.get("keep_alive", False),
+                    schema=topic_doc.get("schema"),
+                    options=topic_doc.get("options", {}) or {},
+                    config=topic_doc.get("config", {}) or {},
+                    implicit=topic_doc.get("implicit", False),
+                )
+            for pipeline_id, pipe_doc in (module_doc.get("pipelines") or {}).items():
+                pipeline = Pipeline(
+                    id=pipeline_id,
+                    module=pipe_doc.get("module", module_id),
+                    name=pipe_doc.get("name"),
+                    errors=ErrorsSpec.from_config(pipe_doc.get("errors")),
+                )
+                for agent_doc in pipe_doc.get("agents", []) or []:
+                    pipeline.agents.append(AgentConfiguration(
+                        type=agent_doc["type"],
+                        id=agent_doc.get("id"),
+                        name=agent_doc.get("name"),
+                        input=agent_doc.get("input"),
+                        output=agent_doc.get("output"),
+                        configuration=agent_doc.get("configuration", {}) or {},
+                        resources=ResourcesSpec.from_config(
+                            agent_doc.get("resources")
+                        ),
+                        errors=ErrorsSpec.from_config(agent_doc.get("errors")),
+                    ))
+                module.pipelines[pipeline_id] = pipeline
+            app.modules[module_id] = module
+        for gw_doc in definition.get("gateways", []) or []:
+            app.gateways.append(Gateway(
+                id=gw_doc["id"],
+                type=gw_doc["type"],
+                topic=gw_doc.get("topic"),
+                parameters=gw_doc.get("parameters", []) or [],
+                authentication=gw_doc.get("authentication"),
+                produce_options=gw_doc.get("produce_options", {}) or {},
+                consume_options=gw_doc.get("consume_options", {}) or {},
+                chat_options=gw_doc.get("chat_options", {}) or {},
+                service_options=gw_doc.get("service_options", {}) or {},
+                events_topic=gw_doc.get("events_topic"),
+            ))
+        if instance is not None:
+            app.instance = Instance(
+                streaming_cluster=instance.get("streaming_cluster")
+                or instance.get("streamingCluster") or {"type": "memory"},
+                compute_cluster=instance.get("compute_cluster")
+                or instance.get("computeCluster") or {"type": "local"},
+                globals_=instance.get("globals_")
+                or instance.get("globals") or {},
+            )
+        if secrets is not None:
+            # only the wrapped asdict(Secrets) form — {"secrets": {...}};
+            # guessing at unwrapped mappings could silently drop entries
+            app.secrets = Secrets(secrets=secrets.get("secrets") or {})
+        return app

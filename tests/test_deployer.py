@@ -1,0 +1,233 @@
+"""Deployer tests: CRD round-trips, StatefulSet/TPU manifest generation,
+operator reconcile on the mock K8s API, and the control-plane→operator
+composition (KubernetesExecutor)."""
+
+import asyncio
+import io
+import zipfile
+
+import pytest
+
+from langstream_tpu.compiler.parser import build_application
+from langstream_tpu.deployer import (
+    AgentCustomResource,
+    ApplicationCustomResource,
+    MockKubeApi,
+    Operator,
+    agent_crd_schema,
+    application_crd_schema,
+    generate_setup_job,
+    generate_statefulset,
+)
+from langstream_tpu.deployer.operator import KubernetesExecutor
+from langstream_tpu.deployer.resources import tpu_topology
+
+PIPELINE = """
+topics:
+  - name: input-topic
+    creation-mode: create-if-not-exists
+  - name: output-topic
+    creation-mode: create-if-not-exists
+pipeline:
+  - name: "chat"
+    type: ai-chat-completions
+    input: input-topic
+    output: output-topic
+    resources:
+      parallelism: 2
+      size: 8
+      disk:
+        size: 10Gi
+    configuration:
+      completion-field: value.answer
+      messages:
+        - role: user
+          content: "{{ value.question }}"
+"""
+
+
+def make_agent_cr(parallelism=2, size=8, disk=None):
+    return AgentCustomResource(
+        name="demo-chat", namespace="acme", application_id="demo",
+        agent_node={"id": "chat"}, streaming_cluster={"type": "memory"},
+        parallelism=parallelism, size=size, disk=disk, checksum="abc",
+    )
+
+
+def test_crd_schemas_are_k8s_shaped():
+    for schema in (application_crd_schema(), agent_crd_schema()):
+        assert schema["kind"] == "CustomResourceDefinition"
+        version = schema["spec"]["versions"][0]
+        assert version["schema"]["openAPIV3Schema"]["type"] == "object"
+
+
+def test_agent_cr_manifest_roundtrip():
+    cr = make_agent_cr(disk={"size": "10Gi"})
+    doc = cr.to_manifest()
+    back = AgentCustomResource.from_manifest(doc)
+    assert back == cr
+
+
+def test_statefulset_tpu_mapping():
+    sts = generate_statefulset(make_agent_cr(parallelism=2, size=8))
+    spec = sts["spec"]
+    assert spec["replicas"] == 2
+    pod = spec["template"]["spec"]
+    assert pod["nodeSelector"] == tpu_topology(8)
+    container = pod["containers"][0]
+    assert container["resources"]["limits"]["google.com/tpu"] == "8"
+    assert container["livenessProbe"]["httpGet"]["path"] == "/info"
+    assert pod["initContainers"][0]["name"] == "code-download"
+
+
+def test_statefulset_multihost_replicas():
+    # 16 chips/replica on v5e = 2 hosts per replica → replicas × hosts pods
+    sts = generate_statefulset(make_agent_cr(parallelism=2, size=16))
+    assert sts["spec"]["replicas"] == 4
+    env = {
+        e["name"]: e.get("value")
+        for e in sts["spec"]["template"]["spec"]["containers"][0]["env"]
+    }
+    assert env["LANGSTREAM_HOSTS_PER_REPLICA"] == "2"
+
+
+def test_statefulset_cpu_agent_and_pvc():
+    sts = generate_statefulset(
+        make_agent_cr(parallelism=1, size=0, disk={"size": "5Gi"})
+    )
+    pod = sts["spec"]["template"]["spec"]
+    assert pod["nodeSelector"] == {}
+    assert "google.com/tpu" not in pod["containers"][0]["resources"].get(
+        "requests", {}
+    )
+    claims = sts["spec"]["volumeClaimTemplates"]
+    assert claims[0]["spec"]["resources"]["requests"]["storage"] == "5Gi"
+
+
+def test_invalid_chip_count_rejected():
+    with pytest.raises(ValueError):
+        generate_statefulset(make_agent_cr(size=3))
+
+
+def _app_cr(tmp_path, pipeline=PIPELINE):
+    app_dir = tmp_path / "app"
+    app_dir.mkdir(exist_ok=True)
+    (app_dir / "pipeline.yaml").write_text(pipeline)
+    application = build_application(str(app_dir))
+    import dataclasses
+
+    definition = dataclasses.asdict(application)
+    definition.pop("secrets")
+    instance = definition.pop("instance")
+    return ApplicationCustomResource(
+        name="demo", namespace="acme", application=definition,
+        instance=instance, checksum="c1", code_archive_id="code-1",
+    )
+
+
+def test_operator_reconciles_app_to_statefulsets(tmp_path):
+    kube = MockKubeApi()
+    operator = Operator(kube)
+    kube.apply(_app_cr(tmp_path).to_manifest())
+    operator.reconcile()
+
+    agents = kube.list("Agent", "acme")
+    assert len(agents) == 1
+    sts = kube.list("StatefulSet", "acme")
+    assert len(sts) == 1
+    assert sts[0]["spec"]["replicas"] == 2
+    app = kube.get("Application", "acme", "demo")
+    assert app["status"]["phase"] == "DEPLOYED"
+    agent = kube.get("Agent", "acme", agents[0]["metadata"]["name"])
+    assert agent["status"]["phase"] == "DEPLOYED"
+    # reconcile is idempotent
+    operator.reconcile()
+    assert len(kube.list("StatefulSet", "acme")) == 1
+
+
+def test_operator_cleans_up_orphans(tmp_path):
+    kube = MockKubeApi()
+    operator = Operator(kube)
+    kube.apply(_app_cr(tmp_path).to_manifest())
+    operator.reconcile()
+    assert kube.list("StatefulSet", "acme")
+    kube.delete("Application", "acme", "demo")
+    operator.reconcile()
+    assert not kube.list("Agent", "acme")
+    assert not kube.list("StatefulSet", "acme")
+    assert not kube.list("Secret", "acme")
+
+
+def test_operator_handles_spec_update(tmp_path):
+    kube = MockKubeApi()
+    operator = Operator(kube)
+    cr = _app_cr(tmp_path)
+    kube.apply(cr.to_manifest())
+    operator.reconcile()
+    # scale down: parallelism 2 → 1
+    cr2 = _app_cr(tmp_path, PIPELINE.replace("parallelism: 2", "parallelism: 1"))
+    cr2.checksum = "c2"
+    kube.apply(cr2.to_manifest())
+    operator.reconcile()
+    sts = kube.list("StatefulSet", "acme")
+    assert sts[0]["spec"]["replicas"] == 1
+    assert sts[0]["metadata"]["annotations"]["langstream.tpu/checksum"] == "c2"
+
+
+def test_operator_marks_bad_app_error():
+    kube = MockKubeApi()
+    operator = Operator(kube)
+    bad = ApplicationCustomResource(
+        name="bad", namespace="acme",
+        application={"modules": {"default": {"pipelines": {"p": {
+            "agents": [{"type": "no-such-agent-type",
+                        "input": "a", "output": "b"}]}}}}},
+        instance={},
+    )
+    kube.apply(bad.to_manifest())
+    operator.reconcile()  # must not raise
+    doc = kube.get("Application", "acme", "bad")
+    assert doc["status"]["phase"] == "ERROR"
+    assert "no-such-agent-type" in doc["status"]["detail"]
+
+
+def test_setup_job_manifest(tmp_path):
+    job = generate_setup_job(_app_cr(tmp_path))
+    assert job["kind"] == "Job"
+    assert job["metadata"]["name"] == "demo-setup"
+    command = job["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert "application-setup" in command
+
+
+def test_kubernetes_executor_composes_with_controlplane(tmp_path):
+    asyncio.run(_test_kubernetes_executor(tmp_path))
+
+
+async def _test_kubernetes_executor(tmp_path):
+    from langstream_tpu.controlplane import (
+        ApplicationService,
+        GlobalMetadataStore,
+        InMemoryApplicationStore,
+        TenantService,
+    )
+    from langstream_tpu.controlplane.codestorage import InMemoryCodeStorage
+
+    kube = MockKubeApi()
+    operator = Operator(kube)
+    executor = KubernetesExecutor(kube, operator)
+    tenants = TenantService(GlobalMetadataStore())
+    tenants.create("acme")
+    service = ApplicationService(
+        InMemoryApplicationStore(), InMemoryCodeStorage(), tenants,
+        executor=executor,
+    )
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as zf:
+        zf.writestr("pipeline.yaml", PIPELINE)
+    stored = await service.deploy("acme", "demo", buf.getvalue(), None)
+    assert stored.status == "DEPLOYED"
+    assert kube.list("StatefulSet", "acme")
+    assert any("agent" in line for line in service.logs("acme", "demo"))
+    await service.delete("acme", "demo")
+    assert not kube.list("StatefulSet", "acme")
+    assert not kube.list("Application", "acme")
