@@ -1,0 +1,242 @@
+"""Mixture-of-experts MLP with grouped, capacity-based top-k routing.
+
+TPU-first formulation (GShard/Switch style): instead of gathering each
+expert's tokens with dynamic shapes — which XLA cannot tile onto the MXU —
+tokens are routed through *static* dispatch/combine einsums against a
+fixed per-expert capacity. Routing happens within fixed-size token groups
+so the dispatch tensors stay [G, S, E, C] with constant S and C — memory
+and FLOPs scale linearly in sequence length, not quadratically.
+
+The expert axis of the weights carries the logical ``expert`` name, which
+the mesh rules map to the ``ep`` axis
+(``langstream_tpu.parallel.mesh.DEFAULT_RULES``); XLA then inserts the
+all-to-alls between token-sharded activations and expert-sharded weights
+automatically.
+
+Two regimes:
+
+- **training** (``capacity_factor`` set): tokens overflowing an expert's
+  capacity are dropped (zero MLP delta) — the standard Switch trade that
+  keeps compute balanced; the aux loss pushes the router toward balance.
+- **exact / serving** (``capacity_factor=None``): every expert runs
+  densely on every token and outputs combine with the renormalized top-k
+  gates (zero weight for unselected experts). This matches a
+  dropless-trained checkpoint (e.g. Mixtral) bit-for-bit in routing
+  semantics, and is *strictly cheaper* than capacity-based dropless
+  routing: dense costs E rows/token vs the dropless capacity bound's
+  E·k rows/token, with no dispatch/combine einsums at all.
+
+A ``valid`` mask keeps padding tokens from consuming capacity or skewing
+the aux loss.
+
+Reference parity: the reference has no local models at all (it proxies to
+OpenAI et al. — see SURVEY.md §2.4, langstream-agents/langstream-ai-agents/
+src/main/java/com/datastax/oss/streaming/ai/services/ServiceProvider.java:24).
+MoE model support is net-new capability for the jax-local provider
+(Mixtral-family), mirroring what the external providers offer.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def moe_capacity(
+    group_tokens: int,
+    num_experts: int,
+    num_selected: int,
+    capacity_factor: Optional[float],
+) -> int:
+    """Per-expert capacity within one routing group:
+    ``ceil(factor * S * k / E)`` clamped to the all-fits bound ``S * k``
+    (``None`` factor → that bound; note the exact regime in
+    :func:`moe_mlp` uses the dense path instead, which is cheaper).
+    """
+    dropless = group_tokens * num_selected
+    if capacity_factor is None:
+        return dropless
+    return max(
+        1,
+        min(
+            dropless,
+            int(
+                math.ceil(
+                    capacity_factor * group_tokens * num_selected / num_experts
+                )
+            ),
+        ),
+    )
+
+
+def moe_routing(
+    logits: jnp.ndarray,  # [S, E] float32 router logits for one group
+    num_selected: int,
+    capacity: int,
+    valid: Optional[jnp.ndarray] = None,  # [S] bool; False = padding
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Top-k routing with per-expert capacity inside one group.
+
+    Returns:
+      dispatch  [S, E, C] float  — 0/1 routing of tokens into expert rows
+      combine   [S, E, C] float  — dispatch weighted by normalized gates
+      aux_loss  scalar           — Switch-style load-balancing loss
+                                   (over valid tokens only)
+    """
+    num_tokens, num_experts = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)  # [S, E]
+    gate_vals, gate_idx = jax.lax.top_k(probs, num_selected)  # [S, k]
+    # renormalize the selected gates so the expert mix sums to 1 per token
+    gate_vals = gate_vals / jnp.maximum(
+        gate_vals.sum(axis=-1, keepdims=True), 1e-9
+    )
+
+    onehot = jax.nn.one_hot(gate_idx, num_experts, dtype=jnp.float32)  # [S,k,E]
+    if valid is not None:
+        onehot = onehot * valid[:, None, None].astype(jnp.float32)
+    # Position of each (token, choice) within its expert: priority is
+    # choice-major (all first choices before any second choice), so a
+    # token's primary expert wins capacity over others' secondaries.
+    flat = onehot.transpose(1, 0, 2).reshape(num_selected * num_tokens, num_experts)
+    pos_flat = jnp.cumsum(flat, axis=0) - flat  # [k*S, E]
+    pos = pos_flat.reshape(num_selected, num_tokens, num_experts).transpose(1, 0, 2)
+    pos_in_expert = (pos * onehot).sum(-1).astype(jnp.int32)  # [S, k]
+    # masked-out choices (padding tokens) have all-zero onehot rows
+    fits = (pos_in_expert < capacity) & (onehot.sum(-1) > 0)  # [S, k]
+
+    pos_onehot = jax.nn.one_hot(pos_in_expert, capacity, dtype=jnp.float32)
+    pos_onehot = pos_onehot * fits[..., None].astype(jnp.float32)
+    dispatch = jnp.einsum("ske,skc->sec", onehot, pos_onehot)
+    combine = jnp.einsum("sk,ske,skc->sec", gate_vals, onehot, pos_onehot)
+
+    # load-balance loss: E * sum_e mean(frac routed to e) * mean(prob e),
+    # means taken over valid tokens only
+    if valid is None:
+        denom = jnp.float32(num_tokens)
+        probs_masked = probs
+    else:
+        denom = jnp.maximum(valid.sum().astype(jnp.float32), 1.0)
+        probs_masked = probs * valid[:, None].astype(jnp.float32)
+    frac_routed = onehot[:, 0].sum(axis=0) / denom  # first-choice share
+    mean_prob = probs_masked.sum(axis=0) / denom
+    aux_loss = num_experts * jnp.sum(frac_routed * mean_prob)
+    return dispatch, combine, aux_loss
+
+
+def moe_mlp(
+    x: jnp.ndarray,        # [..., H]
+    router_w: jnp.ndarray,  # [H, E]
+    w_gate: jnp.ndarray,   # [E, H, F]
+    w_up: jnp.ndarray,     # [E, H, F]
+    w_down: jnp.ndarray,   # [E, F, H]
+    *,
+    num_selected: int = 2,
+    capacity_factor: Optional[float] = 2.0,
+    group_size: int = 64,
+    valid: Optional[jnp.ndarray] = None,  # [...] bool, x's leading shape
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """SwiGLU expert MLP over grouped capacity-routed tokens.
+
+    Returns (output with x's shape, load-balancing aux loss). All shapes
+    static: dispatch/combine are [G, S, E, C] einsum operands, so under an
+    ``ep``-sharded mesh the per-expert matmuls stay dense MXU work and the
+    routing einsums become all-to-alls. ``capacity_factor=None`` = the
+    dropless serving regime.
+    """
+    orig_shape = x.shape
+    hidden = x.shape[-1]
+    x2 = x.reshape(-1, hidden)
+    num_tokens = x2.shape[0]
+    num_experts = router_w.shape[-1]
+    num_selected = min(num_selected, num_experts)
+
+    if capacity_factor is None:
+        valid2 = None if valid is None else valid.reshape(-1)
+        y, aux = _moe_mlp_dense(
+            x2, router_w, w_gate, w_up, w_down,
+            num_selected=num_selected, valid=valid2,
+        )
+        return y.reshape(orig_shape), aux
+
+    group = min(group_size, num_tokens)
+    pad = (-num_tokens) % group
+    valid2 = (
+        jnp.ones((num_tokens,), dtype=bool)
+        if valid is None
+        else valid.reshape(-1)
+    )
+    if pad:
+        x2 = jnp.pad(x2, ((0, pad), (0, 0)))
+        valid2 = jnp.pad(valid2, (0, pad))
+    num_groups = x2.shape[0] // group
+    xg = x2.reshape(num_groups, group, hidden)
+    vg = valid2.reshape(num_groups, group)
+    capacity = moe_capacity(group, num_experts, num_selected, capacity_factor)
+
+    logits = jnp.einsum(
+        "gsh,he->gse", xg.astype(jnp.float32), router_w.astype(jnp.float32)
+    )
+    dispatch, combine, aux = jax.vmap(
+        lambda l, v: moe_routing(l, num_selected, capacity, v)
+    )(logits, vg)
+    aux_loss = aux.mean()
+
+    dtype = x2.dtype
+    expert_in = jnp.einsum("gsec,gsh->egch", dispatch.astype(dtype), xg)
+    gate = jnp.einsum("egch,ehf->egcf", expert_in, w_gate)
+    up = jnp.einsum("egch,ehf->egcf", expert_in, w_up)
+    expert_out = jnp.einsum("egcf,efh->egch", jax.nn.silu(gate) * up, w_down)
+    y = jnp.einsum("gsec,egch->gsh", combine.astype(dtype), expert_out)
+    y = y.reshape(-1, hidden)[:num_tokens]
+    return y.reshape(orig_shape), aux_loss
+
+
+def _moe_mlp_dense(
+    x2: jnp.ndarray,        # [T, H]
+    router_w: jnp.ndarray,
+    w_gate: jnp.ndarray,
+    w_up: jnp.ndarray,
+    w_down: jnp.ndarray,
+    *,
+    num_selected: int,
+    valid: Optional[jnp.ndarray],
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Exact MoE: every expert runs on every token; outputs combine with
+    renormalized top-k gate weights (zero for unselected experts). No
+    token is ever dropped and no dispatch tensors exist. Under an
+    ep-sharded mesh the [E, T, F] activations shard over ep, and XLA
+    reduces the final combine over the expert axis with one psum."""
+    num_experts = router_w.shape[-1]
+    logits = jnp.einsum(
+        "th,he->te", x2.astype(jnp.float32), router_w.astype(jnp.float32)
+    )
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate_vals, gate_idx = jax.lax.top_k(probs, num_selected)
+    gate_vals = gate_vals / jnp.maximum(
+        gate_vals.sum(axis=-1, keepdims=True), 1e-9
+    )
+    onehot = jax.nn.one_hot(gate_idx, num_experts, dtype=jnp.float32)
+    gates = jnp.einsum("tk,tke->te", gate_vals, onehot)  # [T, E]
+
+    dtype = x2.dtype
+    gate_proj = jnp.einsum("th,ehf->etf", x2, w_gate)
+    up_proj = jnp.einsum("th,ehf->etf", x2, w_up)
+    out = jnp.einsum("etf,efh->eth", jax.nn.silu(gate_proj) * up_proj, w_down)
+    y = jnp.einsum("te,eth->th", gates.astype(dtype), out)
+
+    if valid is None:
+        denom = jnp.float32(x2.shape[0])
+        probs_masked = probs
+        first_choice = onehot[:, 0]
+    else:
+        vf = valid.astype(jnp.float32)
+        denom = jnp.maximum(vf.sum(), 1.0)
+        probs_masked = probs * vf[:, None]
+        first_choice = onehot[:, 0] * vf[:, None]
+    aux_loss = num_experts * jnp.sum(
+        (first_choice.sum(0) / denom) * (probs_masked.sum(0) / denom)
+    )
+    return y, aux_loss

@@ -147,6 +147,17 @@ class DecodeEngine:
             # shard_map over the head axis.
             config = dataclasses.replace(config, use_flash=False)
             self.config = config
+        if mesh_config.ep > 1:
+            if not config.num_experts:
+                raise ValueError(
+                    f"ep={mesh_config.ep} requires an MoE model "
+                    "(num_experts > 0); this model is dense"
+                )
+            if config.num_experts % mesh_config.ep != 0:
+                raise ValueError(
+                    f"ep={mesh_config.ep} must divide "
+                    f"num_experts={config.num_experts}"
+                )
         self.mesh = build_mesh(
             mesh_config, devices=jax.devices()[: mesh_config.size]
         )

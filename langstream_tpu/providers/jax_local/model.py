@@ -44,6 +44,10 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     max_seq_len: int = 4096
     tie_embeddings: bool = False
+    # Mixture-of-experts (Mixtral family). 0 = dense SwiGLU MLP.
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    capacity_factor: float = 2.0
     dtype: Any = jnp.bfloat16
     # Pallas flash prefill (TPU only; the engine turns this off on
     # tp-sharded meshes where the kernel can't be auto-partitioned).
@@ -79,6 +83,15 @@ class LlamaConfig:
         )
 
     @classmethod
+    def mixtral_8x7b(cls, max_seq_len: int = 8192) -> "LlamaConfig":
+        return cls(
+            vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+            num_layers=32, num_heads=32, num_kv_heads=8,
+            rope_theta=1e6, max_seq_len=max_seq_len,
+            num_experts=8, num_experts_per_tok=2,
+        )
+
+    @classmethod
     def tiny(cls, max_seq_len: int = 256) -> "LlamaConfig":
         """Test-size config for CPU runs."""
         return cls(
@@ -88,12 +101,20 @@ class LlamaConfig:
         )
 
     @classmethod
+    def tiny_moe(cls, max_seq_len: int = 256) -> "LlamaConfig":
+        """Test-size MoE config for CPU runs."""
+        return dataclasses.replace(
+            cls.tiny(max_seq_len), num_experts=4, num_experts_per_tok=2
+        )
+
+    @classmethod
     def from_dict(cls, config: Dict[str, Any]) -> "LlamaConfig":
         known = {f.name for f in dataclasses.fields(cls)}
         clean = {k.replace("-", "_"): v for k, v in config.items()}
         presets = {
             "llama-3-8b": cls.llama3_8b, "llama-3-70b": cls.llama3_70b,
             "llama-3-1b": cls.llama3_1b, "tiny": cls.tiny,
+            "mixtral-8x7b": cls.mixtral_8x7b, "tiny-moe": cls.tiny_moe,
         }
         preset = clean.pop("preset", None)
         if preset:
@@ -107,6 +128,8 @@ class LlamaConfig:
         head_dim = self.dims_per_head
         attn = self.hidden_size * head_dim * (2 * self.num_heads + 2 * self.num_kv_heads)
         mlp = 3 * self.hidden_size * self.intermediate_size
+        if self.num_experts:
+            mlp = mlp * self.num_experts + self.hidden_size * self.num_experts
         per_layer = attn + mlp + 2 * self.hidden_size
         emb = self.vocab_size * self.hidden_size * (1 if self.tie_embeddings else 2)
         return self.num_layers * per_layer + emb + self.hidden_size
@@ -125,15 +148,27 @@ def init_params(config: LlamaConfig, seed: int = 0) -> Dict[str, jnp.ndarray]:
         return (jax.random.normal(key, shape, dtype=jnp.float32) * scale).astype(dtype)
 
     scale = 1.0 / math.sqrt(h)
+    if config.num_experts:
+        e = config.num_experts
+        mlp_params = {
+            "w_gate": normal(keys[5], (layers, e, h, f), scale),
+            "w_up": normal(keys[6], (layers, e, h, f), scale),
+            "w_down": normal(keys[7], (layers, e, f, h), scale / math.sqrt(2 * layers)),
+            "router": normal(keys[9], (layers, h, e), scale),
+        }
+    else:
+        mlp_params = {
+            "w_gate": normal(keys[5], (layers, h, f), scale),
+            "w_up": normal(keys[6], (layers, h, f), scale),
+            "w_down": normal(keys[7], (layers, f, h), scale / math.sqrt(2 * layers)),
+        }
     params = {
         "embedding": normal(keys[0], (v, h), 1.0 / math.sqrt(h)),
         "wq": normal(keys[1], (layers, h, nh * hd), scale),
         "wk": normal(keys[2], (layers, h, nkv * hd), scale),
         "wv": normal(keys[3], (layers, h, nkv * hd), scale),
         "wo": normal(keys[4], (layers, nh * hd, h), scale / math.sqrt(2 * layers)),
-        "w_gate": normal(keys[5], (layers, h, f), scale),
-        "w_up": normal(keys[6], (layers, h, f), scale),
-        "w_down": normal(keys[7], (layers, f, h), scale / math.sqrt(2 * layers)),
+        **mlp_params,
         "attn_norm": jnp.ones((layers, h), dtype=jnp.float32),
         "mlp_norm": jnp.ones((layers, h), dtype=jnp.float32),
         "final_norm": jnp.ones((h,), dtype=jnp.float32),
@@ -145,15 +180,26 @@ def init_params(config: LlamaConfig, seed: int = 0) -> Dict[str, jnp.ndarray]:
 
 def logical_axes(config: LlamaConfig) -> Dict[str, Any]:
     """Logical sharding axes per parameter (fed to parallel.mesh rules)."""
+    if config.num_experts:
+        mlp_axes = {
+            "w_gate": L("layers", "expert", "embed", "mlp"),
+            "w_up": L("layers", "expert", "embed", "mlp"),
+            "w_down": L("layers", "expert", "mlp", "embed"),
+            "router": L("layers", "embed", None),
+        }
+    else:
+        mlp_axes = {
+            "w_gate": L("layers", "embed", "mlp"),
+            "w_up": L("layers", "embed", "mlp"),
+            "w_down": L("layers", "mlp", "embed"),
+        }
     axes = {
         "embedding": L("vocab", "embed"),
         "wq": L("layers", "embed", "heads"),
         "wk": L("layers", "embed", "heads"),
         "wv": L("layers", "embed", "heads"),
         "wo": L("layers", "heads", "embed"),
-        "w_gate": L("layers", "embed", "mlp"),
-        "w_up": L("layers", "embed", "mlp"),
-        "w_down": L("layers", "mlp", "embed"),
+        **mlp_axes,
         "attn_norm": L("layers", None),
         "mlp_norm": L("layers", None),
         "final_norm": L(None),
@@ -183,11 +229,43 @@ def cache_logical_axes() -> Dict[str, Any]:
 
 
 def _stack_layer_params(params: Dict[str, jnp.ndarray]):
+    mlp = (params["w_gate"], params["w_up"], params["w_down"])
+    if "router" in params:
+        mlp = mlp + (params["router"],)
     return (
         params["attn_norm"], params["wq"], params["wk"], params["wv"],
-        params["wo"], params["mlp_norm"], params["w_gate"], params["w_up"],
-        params["w_down"],
+        params["wo"], params["mlp_norm"], mlp,
     )
+
+
+def _mlp_block(
+    config: LlamaConfig,
+    normed: jnp.ndarray,
+    mlp_weights,
+    valid=None,
+    dropless: bool = False,
+):
+    """SwiGLU MLP (dense or MoE) on normed activations [..., H].
+
+    Returns (residual delta, MoE load-balance aux loss — 0 for dense).
+    ``valid`` masks padding out of MoE capacity; ``dropless`` selects the
+    serving capacity regime (no token ever dropped — required for
+    checkpoints trained dropless, e.g. Mixtral)."""
+    if config.num_experts:
+        from langstream_tpu.ops.moe import moe_mlp
+
+        w_gate, w_up, w_down, router = mlp_weights
+        return moe_mlp(
+            normed, router, w_gate, w_up, w_down,
+            num_selected=config.num_experts_per_tok,
+            capacity_factor=None if dropless else config.capacity_factor,
+            valid=valid,
+        )
+    w_gate, w_up, w_down = mlp_weights
+    gate = jnp.einsum("...h,hf->...f", normed, w_gate)
+    up = jnp.einsum("...h,hf->...f", normed, w_up)
+    out = jnp.einsum("...f,fh->...h", jax.nn.silu(gate) * up, w_down)
+    return out, jnp.zeros((), dtype=jnp.float32)
 
 
 def _logits(config: LlamaConfig, params, x):
@@ -229,7 +307,7 @@ def prefill(
     layer_inputs = _stack_layer_params(params)
 
     def layer_fn(x, layer):
-        attn_norm, wq, wk, wv, wo, mlp_norm, w_gate, w_up, w_down = layer
+        attn_norm, wq, wk, wv, wo, mlp_norm, mlp_weights = layer
         normed = rms_norm(x, attn_norm, config.norm_eps)
         q = jnp.einsum("bth,hd->btd", normed, wq).reshape(
             batch, seq, config.num_heads, hd
@@ -248,9 +326,8 @@ def prefill(
         )
         x = x + attn
         normed = rms_norm(x, mlp_norm, config.norm_eps)
-        gate = jnp.einsum("bth,hf->btf", normed, w_gate)
-        up = jnp.einsum("bth,hf->btf", normed, w_up)
-        x = x + jnp.einsum("btf,fh->bth", jax.nn.silu(gate) * up, w_down)
+        delta, _ = _mlp_block(config, normed, mlp_weights, valid=mask, dropless=True)
+        x = x + delta
         return x, (k, v)
 
     x, layer_kv = jax.lax.scan(layer_fn, x, layer_inputs)
@@ -300,7 +377,7 @@ def decode_step(
 
     def layer_fn(carry, inputs):
         x = carry
-        (attn_norm, wq, wk, wv, wo, mlp_norm, w_gate, w_up, w_down), kc, vc = inputs
+        (attn_norm, wq, wk, wv, wo, mlp_norm, mlp_weights), kc, vc = inputs
         normed = rms_norm(x, attn_norm, config.norm_eps)
         q = jnp.einsum("sh,hd->sd", normed, wq).reshape(slots, config.num_heads, hd)
         k = jnp.einsum("sh,hd->sd", normed, wk).reshape(slots, config.num_kv_heads, hd)
@@ -312,9 +389,10 @@ def decode_step(
         attn = decode_attention(q, kc, vc, lengths)
         x = x + jnp.einsum("sd,dh->sh", attn.reshape(slots, config.num_heads * hd), wo)
         normed = rms_norm(x, mlp_norm, config.norm_eps)
-        gate = jnp.einsum("sh,hf->sf", normed, w_gate)
-        up = jnp.einsum("sh,hf->sf", normed, w_up)
-        x = x + jnp.einsum("sf,fh->sh", jax.nn.silu(gate) * up, w_down)
+        # decode groups are tiny (S = slots) so dropless capacity is cheap;
+        # inactive slots can't evict anyone, so no valid mask is needed
+        delta, _ = _mlp_block(config, normed, mlp_weights, dropless=True)
+        x = x + delta
         return x, (kc, vc)
 
     x, (k_cache, v_cache) = jax.lax.scan(
@@ -331,9 +409,15 @@ def forward(
     tokens: jnp.ndarray,   # [B, T]
     mask: Optional[jnp.ndarray] = None,  # [B, T] valid-token mask
     freqs: Optional[jnp.ndarray] = None,
+    with_aux: bool = False,
+    dropless: bool = False,
 ) -> jnp.ndarray:
     """Cache-free full-sequence forward → logits [B, T, V] (training /
-    scoring path; serving uses :func:`prefill`/:func:`decode_step`)."""
+    scoring path; serving uses :func:`prefill`/:func:`decode_step`).
+    With ``with_aux`` also returns the mean MoE load-balancing loss.
+    ``dropless=True`` selects the exact MoE regime (no token dropping) —
+    use it when scoring a dropless-trained checkpoint; training keeps the
+    capacity regime so the router feels the balance pressure."""
     batch, seq = tokens.shape
     hd = config.dims_per_head
     if freqs is None:
@@ -342,8 +426,9 @@ def forward(
     x = params["embedding"][tokens].astype(config.dtype)
     layer_inputs = _stack_layer_params(params)
 
-    def layer_fn(x, layer):
-        attn_norm, wq, wk, wv, wo, mlp_norm, w_gate, w_up, w_down = layer
+    def layer_fn(carry, layer):
+        x, aux = carry
+        attn_norm, wq, wk, wv, wo, mlp_norm, mlp_weights = layer
         normed = rms_norm(x, attn_norm, config.norm_eps)
         q = jnp.einsum("bth,hd->btd", normed, wq).reshape(
             batch, seq, config.num_heads, hd
@@ -361,14 +446,20 @@ def forward(
             "btd,dh->bth", attn.reshape(batch, seq, config.num_heads * hd), wo
         )
         normed = rms_norm(x, mlp_norm, config.norm_eps)
-        gate = jnp.einsum("bth,hf->btf", normed, w_gate)
-        up = jnp.einsum("bth,hf->btf", normed, w_up)
-        x = x + jnp.einsum("btf,fh->bth", jax.nn.silu(gate) * up, w_down)
-        return x, None
+        delta, layer_aux = _mlp_block(
+            config, normed, mlp_weights, valid=mask, dropless=dropless
+        )
+        x = x + delta
+        return (x, aux + layer_aux), None
 
-    x, _ = jax.lax.scan(layer_fn, x, layer_inputs)
+    (x, aux), _ = jax.lax.scan(
+        layer_fn, (x, jnp.zeros((), dtype=jnp.float32)), layer_inputs
+    )
     x = rms_norm(x, params["final_norm"], config.norm_eps)
-    return _logits(config, params, x)
+    logits = _logits(config, params, x)
+    if with_aux:
+        return logits, aux / max(config.num_layers, 1)
+    return logits
 
 
 # ---------------------------------------------------------------------- #
@@ -387,6 +478,8 @@ def config_from_hf(hf_config) -> LlamaConfig:
         norm_eps=hf_config.rms_norm_eps,
         max_seq_len=hf_config.max_position_embeddings,
         tie_embeddings=getattr(hf_config, "tie_word_embeddings", False),
+        num_experts=getattr(hf_config, "num_local_experts", 0) or 0,
+        num_experts_per_tok=getattr(hf_config, "num_experts_per_tok", 2),
     )
 
 
@@ -416,21 +509,53 @@ def load_hf_checkpoint(path_or_model, dtype=jnp.bfloat16):
         return jnp.asarray(state[name].to(torch.float32).numpy(), dtype=dtype)
 
     def stack(pattern, transpose=True):
+        # cast each layer to the target dtype BEFORE stacking so transient
+        # host memory is one float32 layer, not the whole float32 stack
         arrays = []
         for layer in range(config.num_layers):
             tensor = state[pattern.format(layer)].to(torch.float32).numpy()
-            arrays.append(tensor.T if transpose else tensor)
-        return jnp.asarray(np.stack(arrays), dtype=dtype)
+            arrays.append(jnp.asarray(tensor.T if transpose else tensor, dtype=dtype))
+        return jnp.stack(arrays)
 
+    if config.num_experts:
+        # Mixtral layout: block_sparse_moe.experts.{e}.w1/w3/w2 + gate
+        def stack_experts(weight):
+            # per-expert dtype cast before stacking: transient host memory
+            # is one float32 expert matrix, not layers × experts of them
+            arrays = []
+            for layer in range(config.num_layers):
+                per_expert = [
+                    jnp.asarray(
+                        state[
+                            f"model.layers.{layer}.block_sparse_moe"
+                            f".experts.{e}.{weight}.weight"
+                        ].to(torch.float32).numpy().T,
+                        dtype=dtype,
+                    )
+                    for e in range(config.num_experts)
+                ]
+                arrays.append(jnp.stack(per_expert))
+            return jnp.stack(arrays)
+
+        mlp_weights = {
+            "w_gate": stack_experts("w1"),
+            "w_up": stack_experts("w3"),
+            "w_down": stack_experts("w2"),
+            "router": stack("model.layers.{}.block_sparse_moe.gate.weight"),
+        }
+    else:
+        mlp_weights = {
+            "w_gate": stack("model.layers.{}.mlp.gate_proj.weight"),
+            "w_up": stack("model.layers.{}.mlp.up_proj.weight"),
+            "w_down": stack("model.layers.{}.mlp.down_proj.weight"),
+        }
     params = {
         "embedding": get("model.embed_tokens.weight"),
         "wq": stack("model.layers.{}.self_attn.q_proj.weight"),
         "wk": stack("model.layers.{}.self_attn.k_proj.weight"),
         "wv": stack("model.layers.{}.self_attn.v_proj.weight"),
         "wo": stack("model.layers.{}.self_attn.o_proj.weight"),
-        "w_gate": stack("model.layers.{}.mlp.gate_proj.weight"),
-        "w_up": stack("model.layers.{}.mlp.up_proj.weight"),
-        "w_down": stack("model.layers.{}.mlp.down_proj.weight"),
+        **mlp_weights,
         "attn_norm": jnp.asarray(
             np.stack([
                 state[f"model.layers.{i}.input_layernorm.weight"].numpy()

@@ -43,11 +43,21 @@ class TrainConfig:
     b2: float = 0.95
     grad_clip: float = 1.0
     remat: bool = True
+    # MoE load-balancing loss weight (ignored for dense models)
+    moe_aux_weight: float = 0.01
 
 
-def loss_fn(config, params, tokens, mask, freqs):
-    """Causal next-token cross-entropy (mean over valid positions)."""
-    logits = model_lib.forward(config, params, tokens, mask=mask, freqs=freqs)
+def loss_fn(config, params, tokens, mask, freqs, moe_aux_weight):
+    """Causal next-token cross-entropy (mean over valid positions), plus
+    the router load-balancing aux loss for MoE models."""
+    aux = 0.0
+    if config.num_experts:
+        logits, aux = model_lib.forward(
+            config, params, tokens, mask=mask, freqs=freqs, with_aux=True
+        )
+        aux = moe_aux_weight * aux
+    else:
+        logits = model_lib.forward(config, params, tokens, mask=mask, freqs=freqs)
     targets = tokens[:, 1:]
     logits = logits[:, :-1]
     valid = mask[:, 1:].astype(jnp.float32)
@@ -56,7 +66,7 @@ def loss_fn(config, params, tokens, mask, freqs):
         log_probs, targets[..., None].astype(jnp.int32), axis=-1
     )[..., 0]
     total = jnp.maximum(valid.sum(), 1.0)
-    return -(token_ll * valid).sum() / total
+    return -(token_ll * valid).sum() / total + aux
 
 
 class Trainer:
@@ -114,15 +124,16 @@ class Trainer:
         optimizer = self.optimizer
         remat = self.train_config.remat
 
+        aux_w = self.train_config.moe_aux_weight
+
         def compute_loss(params, tokens, mask):
-            fn = loss_fn
             if remat:
                 fn = jax.checkpoint(
-                    lambda p, t, m: loss_fn(config, p, t, m, freqs),
+                    lambda p, t, m: loss_fn(config, p, t, m, freqs, aux_w),
                     policy=jax.checkpoint_policies.nothing_saveable,
                 )
                 return fn(params, tokens, mask)
-            return loss_fn(config, params, tokens, mask, freqs)
+            return loss_fn(config, params, tokens, mask, freqs, aux_w)
 
         @functools.partial(
             jax.jit,
