@@ -1,0 +1,24 @@
+"""Shared training/scoring losses (single source of truth for the plain
+and pipeline-parallel train paths)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def causal_ce_loss(
+    logits: jnp.ndarray,  # [B, T, V]
+    tokens: jnp.ndarray,  # [B, T] int
+    mask: jnp.ndarray,    # [B, T] valid-token mask
+) -> jnp.ndarray:
+    """Next-token cross-entropy, mean over valid target positions."""
+    targets = tokens[:, 1:]
+    logits = logits[:, :-1]
+    valid = mask[:, 1:].astype(jnp.float32)
+    log_probs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    token_ll = jnp.take_along_axis(
+        log_probs, targets[..., None].astype(jnp.int32), axis=-1
+    )[..., 0]
+    total = jnp.maximum(valid.sum(), 1.0)
+    return -(token_ll * valid).sum() / total

@@ -15,6 +15,16 @@ from langstream_tpu.parallel.mesh import (
     param_shardings,
     shard_params,
 )
+from langstream_tpu.parallel.pipeline import (
+    pipeline_apply,
+    pipelined_logits,
+    pipelined_loss_fn,
+)
+from langstream_tpu.parallel.ring import ring_attention, ring_attention_sharded
+from langstream_tpu.parallel.ulysses import (
+    ulysses_attention,
+    ulysses_attention_sharded,
+)
 
 __all__ = [
     "L",
@@ -24,4 +34,11 @@ __all__ = [
     "logical_to_physical",
     "param_shardings",
     "shard_params",
+    "pipeline_apply",
+    "pipelined_logits",
+    "pipelined_loss_fn",
+    "ring_attention",
+    "ring_attention_sharded",
+    "ulysses_attention",
+    "ulysses_attention_sharded",
 ]

@@ -4,6 +4,8 @@ Axes (the standard TPU serving/training decomposition):
 
 - ``dp``   — data parallel (batch) — maps across hosts over DCN or chips.
 - ``fsdp`` — parameter sharding for training (ZeRO-3 style).
+- ``pp``   — pipeline parallel (layer stages, GPipe microbatch schedule
+             in ``parallel.pipeline``) — rides DCN or outer ICI.
 - ``tp``   — tensor parallel (heads / ffn) — must ride ICI.
 - ``sp``   — sequence/context parallel (ring attention) — ICI.
 - ``ep``   — expert parallel for MoE.
@@ -24,13 +26,14 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-MESH_AXES = ("dp", "fsdp", "tp", "sp", "ep")
+MESH_AXES = ("dp", "fsdp", "pp", "tp", "sp", "ep")
 
 
 @dataclasses.dataclass(frozen=True)
 class MeshConfig:
     dp: int = 1
     fsdp: int = 1
+    pp: int = 1
     tp: int = 1
     sp: int = 1
     ep: int = 1
@@ -42,6 +45,7 @@ class MeshConfig:
         return cls(
             dp=int(config.get("dp", 1)),
             fsdp=int(config.get("fsdp", 1)),
+            pp=int(config.get("pp", config.get("pipeline-parallelism", 1))),
             tp=int(config.get("tp", config.get("tensor-parallelism", 1))),
             sp=int(config.get("sp", 1)),
             ep=int(config.get("ep", 1)),
@@ -49,10 +53,56 @@ class MeshConfig:
 
     @property
     def size(self) -> int:
-        return self.dp * self.fsdp * self.tp * self.sp * self.ep
+        return self.dp * self.fsdp * self.pp * self.tp * self.sp * self.ep
 
     def axis_sizes(self) -> Tuple[int, ...]:
-        return (self.dp, self.fsdp, self.tp, self.sp, self.ep)
+        return (self.dp, self.fsdp, self.pp, self.tp, self.sp, self.ep)
+
+
+def validate_mesh(
+    config: MeshConfig,
+    *,
+    num_heads: int,
+    num_kv_heads: int,
+    intermediate_size: int,
+    num_experts: int = 0,
+    num_layers: Optional[int] = None,
+    allow_pp: bool = False,
+) -> None:
+    """Reject mesh/model combinations that would silently misbehave.
+
+    Shared by the serving engine and the trainer so both fail with the
+    same actionable errors instead of opaque XLA sharding diagnostics.
+    """
+    if config.tp > 1:
+        for name, size in (
+            ("num_kv_heads", num_kv_heads),
+            ("num_heads", num_heads),
+            ("intermediate_size", intermediate_size),
+        ):
+            if size % config.tp != 0:
+                raise ValueError(f"tp={config.tp} must divide {name}={size}")
+    if config.ep > 1:
+        if not num_experts:
+            raise ValueError(
+                f"ep={config.ep} requires an MoE model (num_experts > 0); "
+                "this model is dense"
+            )
+        if num_experts % config.ep != 0:
+            raise ValueError(
+                f"ep={config.ep} must divide num_experts={num_experts}"
+            )
+    if config.pp > 1:
+        if not allow_pp:
+            raise ValueError(
+                f"pp={config.pp} is only supported by the pipeline trainer "
+                "(parallel.pipeline); this component has no pipeline "
+                "schedule — use tp/dp axes instead"
+            )
+        if num_layers is not None and num_layers % config.pp != 0:
+            raise ValueError(
+                f"pp={config.pp} must divide num_layers={num_layers}"
+            )
 
 
 def build_mesh(
@@ -83,7 +133,10 @@ DEFAULT_RULES: Dict[str, Tuple[Optional[str], ...]] = {
     "kv_heads": ("tp",),
     "head_dim": (),
     "mlp": ("tp",),
-    "layers": (),
+    # the stacked-layer axis shards over pp ONLY when the pipeline engine
+    # is driving (pp>1 meshes are used exclusively by parallel.pipeline);
+    # on pp=1 meshes the rule is skipped and layers stay replicated
+    "layers": ("pp",),
     "cache_batch": (),
     "cache_sequence": (),
     "expert": ("ep",),

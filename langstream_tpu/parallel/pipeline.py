@@ -1,0 +1,192 @@
+"""Pipeline parallelism: GPipe microbatch schedule over the ``pp`` axis.
+
+The transformer's stacked-layer parameter arrays shard their leading
+(layer) axis across the ``pp`` mesh axis, so each device holds a
+contiguous *stage* of ``num_layers / pp`` layers. Inside ``shard_map``,
+activations rotate stage→stage with ``jax.lax.ppermute`` while a
+``lax.scan`` over ticks runs the classic GPipe schedule: at tick *i*,
+stage *p* processes microbatch *i − p*; the pipe fills for ``pp − 1``
+ticks, streams ``M`` microbatches, and drains. Everything is
+differentiable (ppermute and scan have transpose rules), so one
+``jax.value_and_grad`` over the whole pipelined loss gives the backward
+pipeline for free — no hand-scheduled 1F1B needed; XLA overlaps the
+ppermute transfers with each stage's matmuls.
+
+The reference has no analogue — its only parallelism is replica data
+parallelism over Kafka partitions (SURVEY §2.5: "TP / PP / SP / EP …
+none exist in the reference"); pipeline parallelism is a net-new
+subsystem of the TPU build for models too deep for one chip's HBM.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax ≥ 0.8
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+
+def pipeline_apply(
+    stage_fn,                 # (stage_params, x [mb,...], mb_idx) -> (y, aux)
+    stage_params: Any,        # pytree, LOCAL slice (inside shard_map)
+    microbatches: jnp.ndarray,  # [M, mb, ...] (local dp shard)
+    *,
+    num_stages: int,
+    axis: str = "pp",
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Run the GPipe schedule. Must be called inside ``shard_map`` over
+    ``axis``. ``stage_fn`` returns (activations, scalar aux); aux from
+    every valid (stage, microbatch) pair is summed and psum-reduced over
+    the pipe. Returns (outputs [M, mb, ...] valid on every device — the
+    last stage's results are psum-broadcast — and the total aux)."""
+    stage = jax.lax.axis_index(axis)
+    num_mb = microbatches.shape[0]
+    ticks = num_mb + num_stages - 1
+    perm = [(p, (p + 1) % num_stages) for p in range(num_stages)]
+
+    def tick_fn(carry, i):
+        act, outputs, aux_sum = carry
+        mb_idx = i - stage  # microbatch this stage works on this tick
+        mb_safe = jnp.clip(mb_idx, 0, num_mb - 1)
+        inject = jax.lax.dynamic_index_in_dim(
+            microbatches, jnp.clip(i, 0, num_mb - 1), 0, keepdims=False
+        )
+        x = jnp.where(stage == 0, inject, act)
+        y, aux = stage_fn(stage_params, x, mb_safe)
+        in_flight = (mb_idx >= 0) & (mb_idx < num_mb)
+        aux_sum = aux_sum + jnp.where(in_flight, aux, 0.0)
+        # collect finished microbatches on the last stage
+        valid = (stage == num_stages - 1) & in_flight
+        sel = (jnp.arange(num_mb) == mb_safe) & valid
+        outputs = jnp.where(
+            sel.reshape((num_mb,) + (1,) * (y.ndim)), y[None], outputs
+        )
+        act = jax.lax.ppermute(y, axis, perm)
+        return (act, outputs, aux_sum), None
+
+    act0 = jnp.zeros_like(microbatches[0])
+    outputs0 = jnp.zeros_like(microbatches)
+    aux0 = jnp.zeros((), dtype=jnp.float32)
+    (_, outputs, aux_sum), _ = jax.lax.scan(
+        tick_fn, (act0, outputs0, aux0), jnp.arange(ticks)
+    )
+    # broadcast the last stage's collected outputs to every stage
+    is_last = stage == num_stages - 1
+    outputs = jax.lax.psum(
+        jnp.where(is_last, outputs, jnp.zeros_like(outputs)), axis
+    )
+    aux_sum = jax.lax.psum(aux_sum, axis)
+    return outputs, aux_sum
+
+
+def pipelined_logits(
+    config,
+    params,
+    tokens: jnp.ndarray,   # [B, T]
+    mask: Optional[jnp.ndarray],
+    freqs: jnp.ndarray,
+    mesh: Mesh,
+    num_microbatches: int,
+    with_aux: bool = False,
+) -> jnp.ndarray:
+    """Full-model forward with the layer stack pipelined over ``pp``.
+
+    Embedding/final-norm/lm-head stay replicated outside the shard_map;
+    only the layer stack runs in the pipeline. Microbatches additionally
+    shard over ``dp`` when that axis is present (each dp group runs its
+    own independent pipeline). Returns logits [B, T, V]; with
+    ``with_aux`` also the mean MoE load-balancing loss.
+    """
+    from langstream_tpu.providers.jax_local import model as model_lib
+
+    num_stages = mesh.shape["pp"]
+    dp = mesh.shape.get("dp", 1)
+    if config.num_layers % num_stages:
+        raise ValueError(
+            f"pp={num_stages} must divide num_layers={config.num_layers}"
+        )
+    batch, seq = tokens.shape
+    if batch % num_microbatches:
+        raise ValueError(
+            f"microbatches={num_microbatches} must divide batch={batch}"
+        )
+    mb = batch // num_microbatches
+    if mb % dp:
+        raise ValueError(
+            f"dp={dp} must divide the microbatch size {mb} "
+            f"(batch {batch} / microbatches {num_microbatches})"
+        )
+
+    x = params["embedding"][tokens].astype(config.dtype)  # [B, T, H]
+    xs = x.reshape(num_microbatches, mb, seq, config.hidden_size)
+    if mask is None:
+        mask = jnp.ones((batch, seq), dtype=bool)
+    masks = mask.reshape(num_microbatches, mb, seq)
+    layer_inputs = model_lib._stack_layer_params(params)
+
+    def stage_fn_inner(stage_layers, x, mb_idx, masks, freqs):
+        m = jax.lax.dynamic_index_in_dim(masks, mb_idx, 0, keepdims=False)
+        return model_lib.apply_layers(config, stage_layers, x, m, freqs)
+
+    def pipelined(stage_layers, xs, masks, freqs):
+        outs, aux = pipeline_apply(
+            lambda sp, x, i: stage_fn_inner(sp, x, i, masks, freqs),
+            stage_layers, xs, num_stages=num_stages,
+        )
+        # aux differs per dp group (different data): reduce it so the
+        # P() out_spec (replicated) is truthful
+        return outs, jax.lax.psum(aux, "dp")
+
+    layer_specs = jax.tree.map(lambda _: P("pp"), layer_inputs)
+    data_spec = P(None, "dp")  # microbatch rows shard over dp
+    fn = shard_map(
+        pipelined,
+        mesh=mesh,
+        in_specs=(layer_specs, data_spec, data_spec, P()),
+        out_specs=(data_spec, P()),
+        check_vma=False,
+    )
+    outs, aux = fn(layer_inputs, xs, masks, freqs)  # [M, mb, T, H], scalar
+
+    x = outs.reshape(batch, seq, config.hidden_size)
+    from langstream_tpu.ops.norms import rms_norm
+
+    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    logits = model_lib._logits(config, params, x)
+    if with_aux:
+        # aux was summed over layers × microbatches (and psum'd over dp
+        # copies of the pipe); normalize to the per-layer mean like
+        # model.forward(with_aux=True)
+        aux = aux / max(config.num_layers * num_microbatches * dp, 1)
+        return logits, aux
+    return logits
+
+
+def pipelined_loss_fn(
+    config, params, tokens, mask, freqs, mesh, num_microbatches,
+    moe_aux_weight: float = 0.0,
+) -> jnp.ndarray:
+    """Causal next-token cross-entropy over the pipelined forward (the
+    pp-mesh counterpart of ``training.trainer.loss_fn``), including the
+    MoE load-balancing aux term for MoE models."""
+    from langstream_tpu.ops.losses import causal_ce_loss
+
+    if mask is None:
+        mask = jnp.ones(tokens.shape, dtype=bool)
+    if config.num_experts and moe_aux_weight:
+        logits, aux = pipelined_logits(
+            config, params, tokens, mask, freqs, mesh, num_microbatches,
+            with_aux=True,
+        )
+        return causal_ce_loss(logits, tokens, mask) + moe_aux_weight * aux
+    logits = pipelined_logits(
+        config, params, tokens, mask, freqs, mesh, num_microbatches
+    )
+    return causal_ce_loss(logits, tokens, mask)

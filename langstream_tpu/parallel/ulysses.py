@@ -1,0 +1,82 @@
+"""Ulysses sequence parallelism: all-to-all head-sharded attention.
+
+The complement to ring attention (``parallel.ring``) for long contexts:
+instead of rotating KV chunks around the ``sp`` ring, two
+``jax.lax.all_to_all`` collectives re-shard the activations from
+*sequence-sharded* ([B, T/sp, H, D]) to *head-sharded* ([B, T, H/sp, D]),
+run ordinary full-sequence attention locally on each device's head
+slice, and swap back. Communication volume is 2 all-to-alls of the
+activations per attention — independent of sequence length per device —
+versus the ring's ``sp − 1`` KV rotations; Ulysses wins when heads ≥ sp
+and the per-chunk compute is too small to hide the ring latency
+(short-to-medium contexts, decode), the ring wins when sp exceeds the
+head count or memory forbids full-T scores.
+
+The reference has no analogue (SURVEY §5 "long-context … ABSENT"); this
+is a net-new subsystem of the TPU build, selected via the jax-local
+provider's ``sp`` mesh axis.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax ≥ 0.8
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from langstream_tpu.ops.attention import prefill_attention
+
+
+def ulysses_attention(
+    q: jnp.ndarray,  # [B, T/sp, NH, D]  local sequence shard
+    k: jnp.ndarray,  # [B, T/sp, NKV, D]
+    v: jnp.ndarray,  # [B, T/sp, NKV, D]
+    mask: Optional[jnp.ndarray] = None,  # [B, T] FULL-length valid mask
+    axis: str = "sp",
+) -> jnp.ndarray:
+    """Causal attention with sequence sharded over ``axis``; must run
+    inside ``shard_map``. Head counts must be divisible by the axis size.
+    Returns the local sequence shard of the attention output."""
+    sp = jax.lax.psum(1, axis)
+    if q.shape[2] % sp or k.shape[2] % sp:
+        raise ValueError(
+            f"ulysses needs heads divisible by sp={sp}: "
+            f"q heads {q.shape[2]}, kv heads {k.shape[2]}"
+        )
+    # seq-sharded → head-sharded: split heads (axis 2), gather seq (axis 1)
+    qg = jax.lax.all_to_all(q, axis, split_axis=2, concat_axis=1, tiled=True)
+    kg = jax.lax.all_to_all(k, axis, split_axis=2, concat_axis=1, tiled=True)
+    vg = jax.lax.all_to_all(v, axis, split_axis=2, concat_axis=1, tiled=True)
+    out = prefill_attention(qg, kg, vg, mask=mask)  # [B, T, NH/sp, D]
+    # head-sharded → seq-sharded: split seq, gather heads
+    return jax.lax.all_to_all(out, axis, split_axis=1, concat_axis=2, tiled=True)
+
+
+def ulysses_attention_sharded(
+    q: jnp.ndarray,  # [B, T, NH, D] global arrays
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    mask: Optional[jnp.ndarray] = None,
+    axis: str = "sp",
+) -> jnp.ndarray:
+    """Jit-callable wrapper: shards the sequence axis over ``axis`` of
+    ``mesh`` and runs :func:`ulysses_attention`."""
+    seq_spec = P(None, axis, None, None)
+    mask_spec = P()
+    fn = shard_map(
+        lambda q, k, v, m: ulysses_attention(q, k, v, m, axis=axis),
+        mesh=mesh,
+        in_specs=(seq_spec, seq_spec, seq_spec, mask_spec),
+        out_specs=seq_spec,
+        check_vma=False,
+    )
+    if mask is None:
+        mask = jnp.ones(q.shape[:2], dtype=bool)
+    return fn(q, k, v, mask)

@@ -48,6 +48,7 @@ from langstream_tpu.parallel.mesh import (
     logical_to_physical,
     param_shardings,
     shard_params,
+    validate_mesh,
 )
 from langstream_tpu.providers.jax_local import model as model_lib
 
@@ -131,33 +132,21 @@ class DecodeEngine:
             # config (mesh: {tp: N}) so small models never get axes that
             # don't divide their head counts.
             mesh_config = MeshConfig()
+        validate_mesh(
+            mesh_config,
+            num_heads=config.num_heads,
+            num_kv_heads=config.num_kv_heads,
+            intermediate_size=config.intermediate_size,
+            num_experts=config.num_experts,
+            allow_pp=False,  # serving has no pipeline schedule
+        )
         if mesh_config.tp > 1:
-            for name, size in (
-                ("num_kv_heads", config.num_kv_heads),
-                ("num_heads", config.num_heads),
-                ("intermediate_size", config.intermediate_size),
-            ):
-                if size % mesh_config.tp != 0:
-                    raise ValueError(
-                        f"tp={mesh_config.tp} must divide {name}={size}"
-                    )
             # A Mosaic pallas_call has no SPMD partitioning rule, so the
             # flash prefill kernel can't run inside a tp-sharded jit —
             # keep the XLA attention there until the kernel is wrapped in
             # shard_map over the head axis.
             config = dataclasses.replace(config, use_flash=False)
             self.config = config
-        if mesh_config.ep > 1:
-            if not config.num_experts:
-                raise ValueError(
-                    f"ep={mesh_config.ep} requires an MoE model "
-                    "(num_experts > 0); this model is dense"
-                )
-            if config.num_experts % mesh_config.ep != 0:
-                raise ValueError(
-                    f"ep={mesh_config.ep} must divide "
-                    f"num_experts={config.num_experts}"
-                )
         self.mesh = build_mesh(
             mesh_config, devices=jax.devices()[: mesh_config.size]
         )

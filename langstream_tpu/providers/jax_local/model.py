@@ -403,28 +403,23 @@ def decode_step(
     return {"k": k_cache, "v": v_cache}, logits
 
 
-def forward(
+def apply_layers(
     config: LlamaConfig,
-    params: Dict[str, jnp.ndarray],
-    tokens: jnp.ndarray,   # [B, T]
-    mask: Optional[jnp.ndarray] = None,  # [B, T] valid-token mask
-    freqs: Optional[jnp.ndarray] = None,
-    with_aux: bool = False,
+    layer_inputs,          # stacked layer params (from _stack_layer_params),
+                           # possibly a contiguous slice of the layers
+    x: jnp.ndarray,        # [B, T, H] activations
+    mask: Optional[jnp.ndarray],   # [B, T] valid-token mask or None
+    freqs: jnp.ndarray,
     dropless: bool = False,
-) -> jnp.ndarray:
-    """Cache-free full-sequence forward → logits [B, T, V] (training /
-    scoring path; serving uses :func:`prefill`/:func:`decode_step`).
-    With ``with_aux`` also returns the mean MoE load-balancing loss.
-    ``dropless=True`` selects the exact MoE regime (no token dropping) —
-    use it when scoring a dropless-trained checkpoint; training keeps the
-    capacity regime so the router feels the balance pressure."""
-    batch, seq = tokens.shape
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Scan the transformer layers over activations → (x, moe aux sum).
+
+    Factored out of :func:`forward` so pipeline parallelism
+    (``parallel.pipeline``) can run a *slice* of the layer stack as one
+    pipeline stage."""
+    batch, seq = x.shape[:2]
     hd = config.dims_per_head
-    if freqs is None:
-        freqs = rope_frequencies(hd, config.max_seq_len, config.rope_theta)
     positions = jnp.arange(seq)[None, :].repeat(batch, 0)
-    x = params["embedding"][tokens].astype(config.dtype)
-    layer_inputs = _stack_layer_params(params)
 
     def layer_fn(carry, layer):
         x, aux = carry
@@ -455,6 +450,31 @@ def forward(
     (x, aux), _ = jax.lax.scan(
         layer_fn, (x, jnp.zeros((), dtype=jnp.float32)), layer_inputs
     )
+    return x, aux
+
+
+def forward(
+    config: LlamaConfig,
+    params: Dict[str, jnp.ndarray],
+    tokens: jnp.ndarray,   # [B, T]
+    mask: Optional[jnp.ndarray] = None,  # [B, T] valid-token mask
+    freqs: Optional[jnp.ndarray] = None,
+    with_aux: bool = False,
+    dropless: bool = False,
+) -> jnp.ndarray:
+    """Cache-free full-sequence forward → logits [B, T, V] (training /
+    scoring path; serving uses :func:`prefill`/:func:`decode_step`).
+    With ``with_aux`` also returns the mean MoE load-balancing loss.
+    ``dropless=True`` selects the exact MoE regime (no token dropping) —
+    use it when scoring a dropless-trained checkpoint; training keeps the
+    capacity regime so the router feels the balance pressure."""
+    if freqs is None:
+        freqs = rope_frequencies(
+            config.dims_per_head, config.max_seq_len, config.rope_theta
+        )
+    x = params["embedding"][tokens].astype(config.dtype)
+    layer_inputs = _stack_layer_params(params)
+    x, aux = apply_layers(config, layer_inputs, x, mask, freqs, dropless)
     x = rms_norm(x, params["final_norm"], config.norm_eps)
     logits = _logits(config, params, x)
     if with_aux:

@@ -31,6 +31,7 @@ from langstream_tpu.parallel.mesh import (
     logical_to_physical,
     param_shardings,
     shard_params,
+    validate_mesh,
 )
 from langstream_tpu.providers.jax_local import model as model_lib
 
@@ -45,11 +46,16 @@ class TrainConfig:
     remat: bool = True
     # MoE load-balancing loss weight (ignored for dense models)
     moe_aux_weight: float = 0.01
+    # GPipe microbatches per step on pp>1 meshes (default: 2 per stage —
+    # bubble fraction (pp-1)/(M+pp-1) ≈ 1/3; raise for bigger batches)
+    num_microbatches: int = 0
 
 
 def loss_fn(config, params, tokens, mask, freqs, moe_aux_weight):
     """Causal next-token cross-entropy (mean over valid positions), plus
     the router load-balancing aux loss for MoE models."""
+    from langstream_tpu.ops.losses import causal_ce_loss
+
     aux = 0.0
     if config.num_experts:
         logits, aux = model_lib.forward(
@@ -58,15 +64,7 @@ def loss_fn(config, params, tokens, mask, freqs, moe_aux_weight):
         aux = moe_aux_weight * aux
     else:
         logits = model_lib.forward(config, params, tokens, mask=mask, freqs=freqs)
-    targets = tokens[:, 1:]
-    logits = logits[:, :-1]
-    valid = mask[:, 1:].astype(jnp.float32)
-    log_probs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    token_ll = jnp.take_along_axis(
-        log_probs, targets[..., None].astype(jnp.int32), axis=-1
-    )[..., 0]
-    total = jnp.maximum(valid.sum(), 1.0)
-    return -(token_ll * valid).sum() / total + aux
+    return causal_ce_loss(logits, tokens, mask) + aux
 
 
 class Trainer:
@@ -82,6 +80,15 @@ class Trainer:
 
         self.model_config = model_config
         self.train_config = train_config or TrainConfig()
+        validate_mesh(
+            mesh_config or MeshConfig(),
+            num_heads=model_config.num_heads,
+            num_kv_heads=model_config.num_kv_heads,
+            intermediate_size=model_config.intermediate_size,
+            num_experts=model_config.num_experts,
+            num_layers=model_config.num_layers,
+            allow_pp=True,
+        )
         self.mesh = build_mesh(
             mesh_config or MeshConfig(),
             devices=jax.devices()[: (mesh_config or MeshConfig()).size],
@@ -126,14 +133,30 @@ class Trainer:
 
         aux_w = self.train_config.moe_aux_weight
 
+        pp = self.mesh.shape.get("pp", 1)
+        if pp > 1:
+            from langstream_tpu.parallel.pipeline import pipelined_loss_fn
+
+            num_mb = self.train_config.num_microbatches or 2 * pp
+            mesh = self.mesh
+
+            def base_loss(p, t, m):
+                return pipelined_loss_fn(
+                    config, p, t, m, freqs, mesh, num_mb, moe_aux_weight=aux_w
+                )
+        else:
+
+            def base_loss(p, t, m):
+                return loss_fn(config, p, t, m, freqs, aux_w)
+
         def compute_loss(params, tokens, mask):
             if remat:
                 fn = jax.checkpoint(
-                    lambda p, t, m: loss_fn(config, p, t, m, freqs, aux_w),
+                    base_loss,
                     policy=jax.checkpoint_policies.nothing_saveable,
                 )
                 return fn(params, tokens, mask)
-            return loss_fn(config, params, tokens, mask, freqs, aux_w)
+            return base_loss(params, tokens, mask)
 
         @functools.partial(
             jax.jit,

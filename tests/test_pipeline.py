@@ -1,0 +1,159 @@
+"""Pipeline-parallel (pp) tests on the virtual 8-device CPU mesh."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from langstream_tpu.ops.rope import rope_frequencies
+from langstream_tpu.parallel.mesh import MeshConfig, build_mesh, shard_params
+from langstream_tpu.parallel.pipeline import (
+    pipelined_logits,
+    pipelined_loss_fn,
+)
+from langstream_tpu.providers.jax_local import model as model_lib
+
+
+@pytest.fixture(scope="module")
+def setup():
+    config = dataclasses.replace(model_lib.LlamaConfig.tiny(), num_layers=4)
+    params = model_lib.init_params(config, seed=0)
+    freqs = rope_frequencies(
+        config.dims_per_head, config.max_seq_len, config.rope_theta
+    )
+    tokens = jnp.arange(32, dtype=jnp.int32).reshape(4, 8) % config.vocab_size
+    mask = jnp.ones((4, 8), dtype=bool)
+    return config, params, freqs, tokens, mask
+
+
+def test_pipelined_forward_matches_plain(setup):
+    config, params, freqs, tokens, mask = setup
+    expected = model_lib.forward(config, params, tokens, mask=mask, freqs=freqs)
+    mesh = build_mesh(MeshConfig(pp=4), devices=jax.devices()[:4])
+    axes = model_lib.logical_axes(config)
+    with mesh:
+        sharded = shard_params(params, axes, mesh)
+        got = jax.jit(
+            lambda p, t, m: pipelined_logits(config, p, t, m, freqs, mesh, 2)
+        )(sharded, tokens, mask)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(expected), rtol=2e-3, atol=2e-3
+    )
+
+
+def test_pipelined_grads_match_plain(setup):
+    """The pipelined backward (AD through ppermute+scan) must equal the
+    plain single-device gradient."""
+    from langstream_tpu.training.trainer import loss_fn
+
+    config, params, freqs, tokens, mask = setup
+    mesh = build_mesh(MeshConfig(pp=4), devices=jax.devices()[:4])
+    axes = model_lib.logical_axes(config)
+    with mesh:
+        sharded = shard_params(params, axes, mesh)
+        grads_pp = jax.jit(
+            jax.grad(
+                lambda p: pipelined_loss_fn(config, p, tokens, mask, freqs, mesh, 2)
+            )
+        )(sharded)
+    grads_ref = jax.grad(
+        lambda p: loss_fn(config, p, tokens, mask, freqs, 0.0)
+    )(params)
+    for name in grads_ref:
+        np.testing.assert_allclose(
+            np.asarray(grads_pp[name]), np.asarray(grads_ref[name]),
+            rtol=5e-3, atol=5e-3, err_msg=name,
+        )
+
+
+def test_pipelined_rejects_bad_divisibility(setup):
+    config, params, freqs, tokens, mask = setup
+    mesh = build_mesh(MeshConfig(pp=8), devices=jax.devices()[:8])
+    with pytest.raises(ValueError, match="must divide num_layers"):
+        pipelined_logits(config, params, tokens, mask, freqs, mesh, 2)
+    mesh4 = build_mesh(MeshConfig(pp=4), devices=jax.devices()[:4])
+    with pytest.raises(ValueError, match="must divide batch"):
+        pipelined_logits(config, params, tokens, mask, freqs, mesh4, 3)
+
+
+def test_pipelined_dp_x_pp_matches_plain(setup):
+    """Combined dp×pp mesh: microbatches shard over dp, each dp group
+    runs its own pipeline — results must equal the plain forward."""
+    config, params, freqs, tokens, mask = setup
+    expected = model_lib.forward(config, params, tokens, mask=mask, freqs=freqs)
+    mesh = build_mesh(MeshConfig(dp=2, pp=4), devices=jax.devices()[:8])
+    axes = model_lib.logical_axes(config)
+    with mesh:
+        sharded = shard_params(params, axes, mesh)
+        got = jax.jit(
+            lambda p, t, m: pipelined_logits(config, p, t, m, freqs, mesh, 2)
+        )(sharded, tokens, mask)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(expected), rtol=2e-3, atol=2e-3
+    )
+
+
+def test_pipelined_moe_aux_threaded():
+    """MoE aux loss must flow through the pipeline (not silently drop)."""
+    config = model_lib.LlamaConfig.tiny_moe()
+    params = model_lib.init_params(config, seed=0)
+    freqs = rope_frequencies(
+        config.dims_per_head, config.max_seq_len, config.rope_theta
+    )
+    tokens = jnp.arange(32, dtype=jnp.int32).reshape(4, 8) % config.vocab_size
+    mask = jnp.ones((4, 8), dtype=bool)
+    mesh = build_mesh(MeshConfig(pp=2), devices=jax.devices()[:2])
+    axes = model_lib.logical_axes(config)
+    with mesh:
+        sharded = shard_params(params, axes, mesh)
+        logits, aux = jax.jit(
+            lambda p, t, m: pipelined_logits(
+                config, p, t, m, freqs, mesh, 2, with_aux=True
+            )
+        )(sharded, tokens, mask)
+    _, aux_ref = model_lib.forward(
+        config, params, tokens, mask=mask, freqs=freqs, with_aux=True
+    )
+    # aux is a per-group balance estimator, so microbatching shifts it a
+    # little (different routing-group boundaries and capacities) — check
+    # it flows through with the right magnitude, not bitwise parity
+    assert float(aux) > 0
+    np.testing.assert_allclose(float(aux), float(aux_ref), rtol=0.3)
+
+
+def test_trainer_rejects_pp_indivisible_layers(setup):
+    from langstream_tpu.training.trainer import Trainer
+
+    config, params, _, _, _ = setup  # 4 layers
+    with pytest.raises(ValueError, match="must divide num_layers"):
+        Trainer(config, params, mesh_config=MeshConfig(pp=8))
+
+
+def test_engine_rejects_pp_mesh():
+    from langstream_tpu.providers.jax_local.engine import DecodeEngine
+
+    config = model_lib.LlamaConfig.tiny()
+    params = model_lib.init_params(config)
+    with pytest.raises(ValueError, match="pipeline"):
+        DecodeEngine(config, params, mesh_config=MeshConfig(pp=2))
+
+
+def test_trainer_pp_converges(setup):
+    from langstream_tpu.training.trainer import TrainConfig, Trainer
+
+    config, params, _, _, _ = setup
+    trainer = Trainer(
+        config, params,
+        mesh_config=MeshConfig(pp=4),
+        train_config=TrainConfig(learning_rate=1e-3, num_microbatches=2),
+    )
+    tokens = np.random.default_rng(0).integers(
+        1, config.vocab_size, size=(4, 16)
+    ).astype(np.int32)
+    mask = np.ones((4, 16), dtype=bool)
+    losses = [trainer.train_step(tokens, mask) for _ in range(4)]
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]

@@ -1,0 +1,57 @@
+"""Ulysses (all-to-all head-sharded) sequence parallelism tests."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from langstream_tpu.ops.attention import prefill_attention
+from langstream_tpu.parallel.ulysses import ulysses_attention_sharded
+
+
+def _mesh(sp):
+    return Mesh(np.asarray(jax.devices()[:sp]).reshape(sp), ("sp",))
+
+
+@pytest.mark.parametrize("sp", [2, 4])
+def test_ulysses_matches_reference(sp):
+    key = jax.random.PRNGKey(0)
+    b, t, nh, nkv, d = 2, 8 * sp, 8, 4, 16
+    q = jax.random.normal(key, (b, t, nh, d), dtype=jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, t, nkv, d), dtype=jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, t, nkv, d), dtype=jnp.float32)
+    mesh = _mesh(sp)
+    got = jax.jit(
+        lambda q, k, v: ulysses_attention_sharded(q, k, v, mesh)
+    )(q, k, v)
+    ref = prefill_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+
+def test_ulysses_with_padding_mask():
+    sp = 4
+    b, t, nh, nkv, d = 1, 16, 4, 4, 8
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, t, nh, d), dtype=jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, t, nkv, d), dtype=jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, t, nkv, d), dtype=jnp.float32)
+    mask = (jnp.arange(t) < 10)[None, :]
+    mesh = _mesh(sp)
+    got = jax.jit(
+        lambda q, k, v, m: ulysses_attention_sharded(q, k, v, mesh, mask=m)
+    )(q, k, v, mask)
+    ref = prefill_attention(q, k, v, mask=mask)
+    np.testing.assert_allclose(
+        np.asarray(got)[:, :10], np.asarray(ref)[:, :10], rtol=1e-4, atol=1e-5
+    )
+
+
+def test_ulysses_rejects_indivisible_heads():
+    sp = 4
+    b, t, d = 1, 16, 8
+    q = jnp.ones((b, t, 6, d))  # 6 heads not divisible by sp=4
+    kv = jnp.ones((b, t, 2, d))
+    mesh = _mesh(sp)
+    with pytest.raises(ValueError, match="divisible"):
+        jax.jit(lambda q, k, v: ulysses_attention_sharded(q, k, v, mesh))(q, kv, kv)
