@@ -103,6 +103,13 @@ def validate_mesh(
             raise ValueError(
                 f"pp={config.pp} must divide num_layers={num_layers}"
             )
+        if config.fsdp > 1 or config.tp > 1 or config.sp > 1:
+            # the pipeline shard_map only uses the pp and dp axes; other
+            # axes would replicate params/activations and waste devices
+            raise ValueError(
+                f"pp={config.pp} composes only with dp for now "
+                f"(got fsdp={config.fsdp}, tp={config.tp}, sp={config.sp})"
+            )
 
 
 def build_mesh(

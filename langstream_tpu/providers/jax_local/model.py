@@ -111,6 +111,9 @@ class LlamaConfig:
     def from_dict(cls, config: Dict[str, Any]) -> "LlamaConfig":
         known = {f.name for f in dataclasses.fields(cls)}
         clean = {k.replace("-", "_"): v for k, v in config.items()}
+        if isinstance(clean.get("dtype"), str):
+            # checkpoints serialize the dtype by name ("bfloat16")
+            clean["dtype"] = jnp.dtype(clean["dtype"])
         presets = {
             "llama-3-8b": cls.llama3_8b, "llama-3-70b": cls.llama3_70b,
             "llama-3-1b": cls.llama3_1b, "tiny": cls.tiny,

@@ -53,9 +53,40 @@ class JaxCompletionsService(CompletionsService):
         from langstream_tpu.providers.jax_local.engine import DecodeEngine
         from langstream_tpu.providers.jax_local.tokenizer import get_tokenizer
 
+        import os
+
         model_config = model_lib.LlamaConfig.from_dict(config.get("model", {"preset": "tiny"}))
         checkpoint = config.get("checkpoint")
-        if checkpoint:
+        if checkpoint and any(
+            f.endswith(".safetensors") or f == "model.safetensors.index.json"
+            for f in (
+                os.listdir(checkpoint) if os.path.isdir(checkpoint) else []
+            )
+        ):
+            # direct safetensors load: one fp32 tensor transient at a time
+            from langstream_tpu.providers.jax_local.weights import (
+                load_safetensors_checkpoint,
+            )
+
+            model_config, params = load_safetensors_checkpoint(checkpoint)
+            logger.info(
+                "loaded safetensors %s (%d params)",
+                checkpoint, model_config.num_params(),
+            )
+        elif checkpoint and os.path.isdir(checkpoint) and any(
+            entry.isdigit() and os.path.isdir(os.path.join(checkpoint, entry))
+            for entry in os.listdir(checkpoint)
+        ):
+            # orbax checkpoint (save_model export or Trainer save dir —
+            # numeric step subdirs); load_model restores the latest step
+            from langstream_tpu.training.checkpoint import load_model
+
+            model_config, params = load_model(checkpoint)
+            logger.info(
+                "loaded orbax checkpoint %s (%d params)",
+                checkpoint, model_config.num_params(),
+            )
+        elif checkpoint:
             model_config, params = model_lib.load_hf_checkpoint(checkpoint)
             logger.info("loaded checkpoint %s (%d params)", checkpoint, model_config.num_params())
         else:

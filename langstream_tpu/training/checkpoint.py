@@ -1,0 +1,173 @@
+"""Checkpoint / resume for model weights and trainer state (orbax).
+
+The reference's checkpoint story is broker offsets + per-agent persistent
+volumes + agent-custom status files (SURVEY §5 "Checkpoint / resume" —
+e.g. the webcrawler's S3 status,
+langstream-agent-webcrawler/src/main/java/ai/langstream/agents/webcrawler/WebCrawlerSource.java:381-440).
+The TPU build adds the missing piece the reference never needed: *model
+state* — sharded parameter pytrees, optimizer state, and the training
+step — saved asynchronously with orbax so a preempted TPU job resumes
+from the last step. Serving engines load the same checkpoints (weights
+only) by path, giving one artifact format across train → serve.
+
+Layout: ``<dir>/<step>/{params,opt_state,meta}`` managed by
+``orbax.checkpoint.CheckpointManager`` (retention, atomicity, async
+commit). Sharded arrays restore with the *target* sharding provided by
+the caller, so a checkpoint written on one mesh reloads onto another
+(e.g. train on dp×fsdp, serve on tp).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+
+class CheckpointManager:
+    """Thin wrapper over orbax for (params, opt_state, step, config)."""
+
+    def __init__(
+        self,
+        directory: str,
+        *,
+        max_to_keep: int = 3,
+        save_interval_steps: int = 1,
+    ) -> None:
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._manager = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                save_interval_steps=save_interval_steps,
+                enable_async_checkpointing=True,
+            ),
+        )
+
+    def save(
+        self,
+        step: int,
+        params: Any,
+        opt_state: Any = None,
+        meta: Optional[Dict[str, Any]] = None,
+    ) -> bool:
+        """Queue an async save; returns whether a save was started."""
+        items = {"params": ocp.args.StandardSave(params)}
+        if opt_state is not None:
+            items["opt_state"] = ocp.args.StandardSave(opt_state)
+        if meta is not None:
+            items["meta"] = ocp.args.JsonSave(meta)
+        return self._manager.save(step, args=ocp.args.Composite(**items))
+
+    def restore(
+        self,
+        step: Optional[int] = None,
+        *,
+        params_target: Any = None,
+        opt_state_target: Any = None,
+    ) -> Dict[str, Any]:
+        """Restore a checkpoint (latest if ``step`` is None).
+
+        Targets are abstract pytrees (e.g. ``jax.eval_shape`` results or
+        arrays with the desired sharding); passing them restores each
+        array directly onto its target sharding/devices.
+        """
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint in {self.directory}")
+        items: Dict[str, Any] = {}
+        if params_target is not None:
+            items["params"] = ocp.args.StandardRestore(params_target)
+        else:
+            items["params"] = ocp.args.StandardRestore()
+        saved = self._manager.item_metadata(step)
+        if saved is not None and "opt_state" in saved:
+            if opt_state_target is not None:
+                items["opt_state"] = ocp.args.StandardRestore(opt_state_target)
+            else:
+                items["opt_state"] = ocp.args.StandardRestore()
+        if saved is not None and "meta" in saved:
+            items["meta"] = ocp.args.JsonRestore()
+        restored = self._manager.restore(step, args=ocp.args.Composite(**items))
+
+        def match_sharding(value, target):
+            # orbax can bring scalar leaves (e.g. optimizer step counts)
+            # back on a single device even when the target is replicated
+            # over a mesh — re-place leaves whose sharding diverges from
+            # the target's (no-op when orbax already honored it)
+            if target is None:
+                return value
+
+            def fix(restored_leaf, target_leaf):
+                want = getattr(target_leaf, "sharding", None)
+                if want is None or getattr(restored_leaf, "sharding", None) == want:
+                    return restored_leaf
+                return jax.device_put(restored_leaf, want)
+
+            return jax.tree.map(fix, value, target)
+
+        out = {
+            "step": step,
+            "params": match_sharding(restored["params"], params_target),
+        }
+        if "opt_state" in items:
+            out["opt_state"] = match_sharding(
+                restored.get("opt_state"), opt_state_target
+            )
+        if "meta" in items:
+            out["meta"] = restored.get("meta")
+        return out
+
+    def latest_step(self) -> Optional[int]:
+        return self._manager.latest_step()
+
+    def all_steps(self):
+        return list(self._manager.all_steps())
+
+    def wait(self) -> None:
+        """Block until queued async saves are committed."""
+        self._manager.wait_until_finished()
+
+    def close(self) -> None:
+        self._manager.wait_until_finished()
+        self._manager.close()
+
+
+def config_meta(config) -> Dict[str, Any]:
+    """JSON-safe dict of a model config dataclass (dtype by name)."""
+    out = {
+        k: v for k, v in dataclasses.asdict(config).items()
+        if isinstance(v, (int, float, str, bool))
+    }
+    dtype = getattr(config, "dtype", None)
+    if dtype is not None:
+        out["dtype"] = jax.numpy.dtype(dtype).name
+    return out
+
+
+def save_model(directory: str, config, params) -> None:
+    """One-shot weights-only export (serving artifact): step 0 with the
+    model config embedded as JSON meta."""
+    manager = CheckpointManager(directory, max_to_keep=1)
+    manager.save(0, params, meta={"model_config": config_meta(config)})
+    manager.close()
+
+
+def load_model(directory: str, config_cls=None):
+    """Load (config, params) from a weights export. ``config_cls``
+    defaults to the jax-local LlamaConfig."""
+    if config_cls is None:
+        from langstream_tpu.providers.jax_local.model import LlamaConfig
+
+        config_cls = LlamaConfig
+    manager = CheckpointManager(directory)
+    restored = manager.restore()
+    manager.close()
+    meta = restored.get("meta") or {}
+    config = config_cls.from_dict(meta.get("model_config", {}))
+    return config, restored["params"]

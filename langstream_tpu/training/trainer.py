@@ -183,3 +183,58 @@ class Trainer:
             )
         self.step += 1
         return float(loss)
+
+    # ------------------------------------------------------------------ #
+    # checkpoint / resume
+    # ------------------------------------------------------------------ #
+    def save_checkpoint(self, manager, *, wait: bool = False) -> None:
+        """Queue an async save of (params, opt_state, step) through a
+        ``training.checkpoint.CheckpointManager``."""
+        from langstream_tpu.training.checkpoint import config_meta
+
+        manager.save(
+            self.step, self.params, self.opt_state,
+            meta={"model_config": config_meta(self.model_config)},
+        )
+        if wait:
+            manager.wait()
+
+    def _opt_state_shardings(self):
+        """Target shardings for restored optimizer state: array leaves
+        (mu/nu) shard like the same-shaped parameter, scalars (step
+        counts) replicate over the mesh. Needed because freshly-init'd
+        opt_state leaves are *uncommitted* (jit may place them anywhere)
+        while orbax restores *committed* single-device arrays that would
+        otherwise conflict with the mesh-sharded params inside jit."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        shape_to_sharding = {}
+        for leaf in jax.tree.leaves(self.params):
+            shape_to_sharding.setdefault(leaf.shape, leaf.sharding)
+        replicated = NamedSharding(self.mesh, PartitionSpec())
+        return jax.tree.map(
+            lambda leaf: shape_to_sharding.get(leaf.shape, replicated),
+            self.opt_state,
+        )
+
+    def restore_checkpoint(self, manager, step=None) -> int:
+        """Restore params/opt_state/step in place (arrays land on this
+        trainer's shardings). Returns the restored step."""
+        # abstract targets with explicit shardings: orbax restores each
+        # leaf straight onto the mesh, no post-hoc copies
+        opt_target = jax.tree.map(
+            lambda leaf, sharding: jax.ShapeDtypeStruct(
+                leaf.shape, leaf.dtype, sharding=sharding
+            ),
+            self.opt_state, self._opt_state_shardings(),
+        )
+        restored = manager.restore(
+            step,
+            params_target=self.params,
+            opt_state_target=opt_target,
+        )
+        self.params = restored["params"]
+        if restored.get("opt_state") is not None:
+            self.opt_state = restored["opt_state"]
+        self.step = restored["step"]
+        return self.step

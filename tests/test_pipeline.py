@@ -132,6 +132,17 @@ def test_trainer_rejects_pp_indivisible_layers(setup):
         Trainer(config, params, mesh_config=MeshConfig(pp=8))
 
 
+def test_trainer_rejects_pp_with_fsdp():
+    from langstream_tpu.training.trainer import Trainer
+
+    config = model_lib.LlamaConfig.tiny()  # 2 layers
+    with pytest.raises(ValueError, match="composes only with dp"):
+        Trainer(
+            config, model_lib.init_params(config),
+            mesh_config=MeshConfig(pp=2, fsdp=2),
+        )
+
+
 def test_engine_rejects_pp_mesh():
     from langstream_tpu.providers.jax_local.engine import DecodeEngine
 

@@ -1,0 +1,153 @@
+"""Direct safetensors loader tests (synthetic HF checkpoint dirs)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from langstream_tpu.providers.jax_local import model as model_lib
+from langstream_tpu.providers.jax_local.weights import (
+    load_config,
+    load_safetensors_checkpoint,
+)
+
+
+def _to_hf_state(config, params):
+    """Inverse of the loader's mapping: our stacked params → HF names."""
+    state = {
+        "model.embed_tokens.weight": np.asarray(params["embedding"], np.float32),
+        "model.norm.weight": np.asarray(params["final_norm"], np.float32),
+    }
+    if not config.tie_embeddings:
+        state["lm_head.weight"] = np.ascontiguousarray(np.asarray(params["lm_head"], np.float32).T)
+    per_layer = {
+        "self_attn.q_proj": "wq", "self_attn.k_proj": "wk",
+        "self_attn.v_proj": "wv", "self_attn.o_proj": "wo",
+    }
+    if config.num_experts:
+        for i in range(config.num_layers):
+            state[f"model.layers.{i}.block_sparse_moe.gate.weight"] = (
+                np.ascontiguousarray(np.asarray(params["router"][i], np.float32).T)
+            )
+            for e in range(config.num_experts):
+                for hf_w, ours in (("w1", "w_gate"), ("w3", "w_up"), ("w2", "w_down")):
+                    state[
+                        f"model.layers.{i}.block_sparse_moe.experts.{e}.{hf_w}.weight"
+                    ] = np.ascontiguousarray(np.asarray(params[ours][i, e], np.float32).T)
+    else:
+        per_layer.update({
+            "mlp.gate_proj": "w_gate", "mlp.up_proj": "w_up",
+            "mlp.down_proj": "w_down",
+        })
+    for i in range(config.num_layers):
+        for hf_name, ours in per_layer.items():
+            state[f"model.layers.{i}.{hf_name}.weight"] = (
+                np.ascontiguousarray(np.asarray(params[ours][i], np.float32).T)
+            )
+        state[f"model.layers.{i}.input_layernorm.weight"] = np.asarray(
+            params["attn_norm"][i], np.float32
+        )
+        state[f"model.layers.{i}.post_attention_layernorm.weight"] = np.asarray(
+            params["mlp_norm"][i], np.float32
+        )
+    return state
+
+
+def _write_checkpoint(path, config, params, shards=1):
+    from safetensors.numpy import save_file
+
+    os.makedirs(path, exist_ok=True)
+    state = _to_hf_state(config, params)
+    names = sorted(state)
+    hf_config = {
+        "vocab_size": config.vocab_size,
+        "hidden_size": config.hidden_size,
+        "intermediate_size": config.intermediate_size,
+        "num_hidden_layers": config.num_layers,
+        "num_attention_heads": config.num_heads,
+        "num_key_value_heads": config.num_kv_heads,
+        "rope_theta": config.rope_theta,
+        "rms_norm_eps": config.norm_eps,
+        "max_position_embeddings": config.max_seq_len,
+        "tie_word_embeddings": config.tie_embeddings,
+    }
+    if config.num_experts:
+        hf_config["num_local_experts"] = config.num_experts
+        hf_config["num_experts_per_tok"] = config.num_experts_per_tok
+    with open(os.path.join(path, "config.json"), "w") as fh:
+        json.dump(hf_config, fh)
+    if shards == 1:
+        save_file(state, os.path.join(path, "model.safetensors"))
+    else:
+        weight_map = {}
+        per = (len(names) + shards - 1) // shards
+        for s in range(shards):
+            chunk = names[s * per:(s + 1) * per]
+            fname = f"model-{s+1:05d}-of-{shards:05d}.safetensors"
+            save_file({n: state[n] for n in chunk}, os.path.join(path, fname))
+            for n in chunk:
+                weight_map[n] = fname
+        with open(os.path.join(path, "model.safetensors.index.json"), "w") as fh:
+            json.dump({"weight_map": weight_map}, fh)
+
+
+@pytest.mark.parametrize("shards", [1, 3])
+def test_safetensors_roundtrip_dense(tmp_path, shards):
+    config = model_lib.LlamaConfig.tiny()
+    params = model_lib.init_params(config, seed=0)
+    path = str(tmp_path / "ckpt")
+    _write_checkpoint(path, config, params, shards=shards)
+
+    loaded_config, loaded = load_safetensors_checkpoint(path, dtype=jnp.float32)
+    assert loaded_config.num_layers == config.num_layers
+    assert loaded_config.num_kv_heads == config.num_kv_heads
+    for name, value in params.items():
+        np.testing.assert_allclose(
+            np.asarray(loaded[name], np.float32),
+            np.asarray(value, np.float32),
+            rtol=1e-6, err_msg=name,
+        )
+    # forward parity
+    tokens = jnp.arange(16, dtype=jnp.int32).reshape(2, 8) % config.vocab_size
+    np.testing.assert_allclose(
+        np.asarray(model_lib.forward(loaded_config, loaded, tokens)),
+        np.asarray(model_lib.forward(config, params, tokens)),
+        rtol=1e-5, atol=1e-5,
+    )
+
+
+def test_safetensors_roundtrip_moe(tmp_path):
+    config = model_lib.LlamaConfig.tiny_moe()
+    params = model_lib.init_params(config, seed=0)
+    path = str(tmp_path / "ckpt")
+    _write_checkpoint(path, config, params)
+
+    loaded_config, loaded = load_safetensors_checkpoint(path, dtype=jnp.float32)
+    assert loaded_config.num_experts == config.num_experts
+    for name, value in params.items():
+        np.testing.assert_allclose(
+            np.asarray(loaded[name], np.float32),
+            np.asarray(value, np.float32),
+            rtol=1e-6, err_msg=name,
+        )
+
+
+def test_load_config_only(tmp_path):
+    config = model_lib.LlamaConfig.tiny()
+    _write_checkpoint(
+        str(tmp_path / "c"), config, model_lib.init_params(config)
+    )
+    loaded = load_config(str(tmp_path / "c"))
+    assert loaded.hidden_size == config.hidden_size
+    assert loaded.rope_theta == config.rope_theta
+
+
+def test_missing_dir_raises(tmp_path):
+    os.makedirs(tmp_path / "empty", exist_ok=True)
+    with pytest.raises(FileNotFoundError):
+        from langstream_tpu.providers.jax_local.weights import SafetensorsDir
+
+        SafetensorsDir(str(tmp_path / "empty"))
