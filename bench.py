@@ -2,25 +2,33 @@
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 Runs on whatever accelerator JAX finds (the driver runs it on one real TPU
-chip). Model: Llama-3.2-1B-shaped random weights in bf16 (an 8B bf16 model
-does not fit one v5e chip's 16 GB HBM; int8 8B is future work), byte
-tokenizer, continuous batching with 16 slots.
+chip).
+
+Default model: **Llama-3-8B with weight-only int8** — the BASELINE.md
+headline config. int8 halves HBM bytes/step on the weights-bound decode
+path and is what lets 8B (+KV cache) fit one v5e chip's 16 GB; weights
+are random (byte-level tokens) since the bench measures engine+model
+throughput, not quality. Weights init directly in int8 on device — the
+bf16 tensors are never materialized.
+
+Override via env: BENCH_MODEL=llama-3-1b BENCH_QUANT= (empty = bf16).
 
 vs_baseline compares against the BASELINE.md north-star of 800 output
-tok/s/chip (defined for 8B; this 1B number overshoots it accordingly —
-the metric name carries the model so the judge can track both).
+tok/s/chip (defined for 8B end-to-end on v5e).
 """
 
 from __future__ import annotations
 
 import asyncio
 import json
+import os
 import sys
 import time
 
 
-MODEL_PRESET = "llama-3-1b"
-MAX_SLOTS = 32
+MODEL_PRESET = os.environ.get("BENCH_MODEL", "llama-3-8b")
+QUANT = os.environ.get("BENCH_QUANT", "int8") or None
+MAX_SLOTS = int(os.environ.get("BENCH_SLOTS", "32"))
 DECODE_CHUNK = 32
 PROMPT_LEN = 128
 NEW_TOKENS = 128
@@ -46,9 +54,19 @@ async def run_bench():
     import dataclasses
 
     config = dataclasses.replace(config, max_seq_len=PROMPT_LEN + NEW_TOKENS + 64)
-    log(f"model: {MODEL_PRESET}, {config.num_params() / 1e9:.2f}B params")
+    log(
+        f"model: {MODEL_PRESET}, {config.num_params() / 1e9:.2f}B params, "
+        f"quant={QUANT or 'bf16'}"
+    )
     t0 = time.perf_counter()
-    params = model_lib.init_params(config, seed=0)
+    if QUANT == "int8":
+        from langstream_tpu.providers.jax_local.quant import (
+            init_quantized_params,
+        )
+
+        params = init_quantized_params(config, seed=0)
+    else:
+        params = model_lib.init_params(config, seed=0)
     engine = DecodeEngine(
         config,
         params,
@@ -56,29 +74,35 @@ async def run_bench():
         max_seq_len=config.max_seq_len,
         prefill_buckets=[PROMPT_LEN],
         decode_chunk=DECODE_CHUNK,
+        quantize=QUANT,
     )
-    engine.start()
-    log(f"init: {time.perf_counter() - t0:.1f}s")
+    try:
+        engine.start()
+        log(f"init: {time.perf_counter() - t0:.1f}s")
 
-    def prompt(i: int):
-        return [(7 * i + j) % 250 + 1 for j in range(PROMPT_LEN)]
+        def prompt(i: int):
+            return [(7 * i + j) % 250 + 1 for j in range(PROMPT_LEN)]
 
-    sampling = SamplingParams(temperature=0.0, max_new_tokens=NEW_TOKENS)
+        sampling = SamplingParams(temperature=0.0, max_new_tokens=NEW_TOKENS)
 
-    # warmup with the SAME traffic shape so every (bucket, batch) prefill
-    # variant and the decode chunk are compiled before measurement
-    t0 = time.perf_counter()
-    await asyncio.gather(
-        *[engine.generate(prompt(i), sampling) for i in range(REQUESTS)]
-    )
-    log(f"warmup (compile): {time.perf_counter() - t0:.1f}s")
+        # warmup with the SAME traffic shape so every (bucket, batch)
+        # prefill variant and the decode chunk are compiled before
+        # measurement
+        t0 = time.perf_counter()
+        await asyncio.gather(
+            *[engine.generate(prompt(i), sampling) for i in range(REQUESTS)]
+        )
+        log(f"warmup (compile): {time.perf_counter() - t0:.1f}s")
 
-    t0 = time.perf_counter()
-    results = await asyncio.gather(
-        *[engine.generate(prompt(i + 1), sampling) for i in range(REQUESTS)]
-    )
-    elapsed = time.perf_counter() - t0
-    engine.stop()
+        t0 = time.perf_counter()
+        results = await asyncio.gather(
+            *[engine.generate(prompt(i + 1), sampling) for i in range(REQUESTS)]
+        )
+        elapsed = time.perf_counter() - t0
+    finally:
+        # release the engine thread + device buffers even on OOM so the
+        # fallback model starts from a clean chip
+        engine.stop()
 
     generated = sum(len(r.tokens) for r in results)
     tok_s = generated / elapsed
@@ -91,11 +115,24 @@ async def run_bench():
 
 
 def main():
-    tok_s = asyncio.run(run_bench())
+    global MODEL_PRESET, MAX_SLOTS
+    failed = None
+    try:
+        tok_s = asyncio.run(run_bench())
+    except Exception as error:  # noqa: BLE001 — e.g. OOM on a small chip
+        failed = repr(error)
+    if failed is not None:
+        # retry outside the except block: no live traceback pinning the
+        # failed attempt's frames (and its device arrays) during the rerun
+        log(f"{MODEL_PRESET} bench failed ({failed}); falling back to 1B")
+        MODEL_PRESET = "llama-3-1b"
+        MAX_SLOTS = 32
+        tok_s = asyncio.run(run_bench())
+    suffix = MODEL_PRESET.replace("-", "_") + (f"_{QUANT}" if QUANT else "")
     print(
         json.dumps(
             {
-                "metric": f"decode_output_tok_per_s_per_chip_{MODEL_PRESET.replace('-', '_')}",
+                "metric": f"decode_output_tok_per_s_per_chip_{suffix}",
                 "value": round(tok_s, 1),
                 "unit": "tok/s",
                 "vs_baseline": round(tok_s / BASELINE_TOK_S, 3),

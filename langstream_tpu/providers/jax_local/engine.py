@@ -119,6 +119,7 @@ class DecodeEngine:
         prefill_buckets: Optional[List[int]] = None,
         decode_chunk: int = 8,
         seed: int = 0,
+        quantize: Optional[str] = None,  # "int8" = weight-only int8
     ) -> None:
         self.config = config
         self.max_slots = max_slots
@@ -151,6 +152,21 @@ class DecodeEngine:
             mesh_config, devices=jax.devices()[: mesh_config.size]
         )
         axes = model_lib.logical_axes(config)
+        from langstream_tpu.providers.jax_local.quant import QTensor
+
+        pre_quantized = any(
+            isinstance(v, QTensor) for v in params.values()
+        )
+        if quantize or pre_quantized:
+            if quantize not in (None, "int8"):
+                raise ValueError(f"unknown quantization {quantize!r}")
+            from langstream_tpu.providers.jax_local.quant import (
+                quantize_logical_axes,
+                quantize_params,
+            )
+
+            params = quantize_params(params, config.num_experts)
+            axes = quantize_logical_axes(axes, params)
         with self.mesh:
             self.params = shard_params(params, axes, self.mesh)
         self.freqs = rope_frequencies(

@@ -29,6 +29,7 @@ from langstream_tpu.ops.flash_attention import flash_prefill_attention, use_flas
 from langstream_tpu.ops.norms import rms_norm
 from langstream_tpu.ops.rope import apply_rope, rope_frequencies
 from langstream_tpu.parallel.mesh import L
+from langstream_tpu.providers.jax_local.quant import dq
 
 
 @dataclasses.dataclass(frozen=True)
@@ -264,7 +265,7 @@ def _mlp_block(
             capacity_factor=None if dropless else config.capacity_factor,
             valid=valid,
         )
-    w_gate, w_up, w_down = mlp_weights
+    w_gate, w_up, w_down = (dq(w, normed.dtype) for w in mlp_weights)
     gate = jnp.einsum("...h,hf->...f", normed, w_gate)
     up = jnp.einsum("...h,hf->...f", normed, w_up)
     out = jnp.einsum("...f,fh->...h", jax.nn.silu(gate) * up, w_down)
@@ -272,8 +273,12 @@ def _mlp_block(
 
 
 def _logits(config: LlamaConfig, params, x):
-    head = params["embedding"].T if config.tie_embeddings else params["lm_head"]
-    return jnp.einsum("...h,hv->...v", x, head.astype(x.dtype)).astype(jnp.float32)
+    head = (
+        params["embedding"].T.astype(x.dtype)
+        if config.tie_embeddings
+        else dq(params["lm_head"], x.dtype)
+    )
+    return jnp.einsum("...h,hv->...v", x, head).astype(jnp.float32)
 
 
 def _prefill_attn(config, q, k, v, mask):
@@ -311,6 +316,7 @@ def prefill(
 
     def layer_fn(x, layer):
         attn_norm, wq, wk, wv, wo, mlp_norm, mlp_weights = layer
+        wq, wk, wv, wo = (dq(w, config.dtype) for w in (wq, wk, wv, wo))
         normed = rms_norm(x, attn_norm, config.norm_eps)
         q = jnp.einsum("bth,hd->btd", normed, wq).reshape(
             batch, seq, config.num_heads, hd
@@ -381,6 +387,7 @@ def decode_step(
     def layer_fn(carry, inputs):
         x = carry
         (attn_norm, wq, wk, wv, wo, mlp_norm, mlp_weights), kc, vc = inputs
+        wq, wk, wv, wo = (dq(w, config.dtype) for w in (wq, wk, wv, wo))
         normed = rms_norm(x, attn_norm, config.norm_eps)
         q = jnp.einsum("sh,hd->sd", normed, wq).reshape(slots, config.num_heads, hd)
         k = jnp.einsum("sh,hd->sd", normed, wk).reshape(slots, config.num_kv_heads, hd)
@@ -427,6 +434,7 @@ def apply_layers(
     def layer_fn(carry, layer):
         x, aux = carry
         attn_norm, wq, wk, wv, wo, mlp_norm, mlp_weights = layer
+        wq, wk, wv, wo = (dq(w, config.dtype) for w in (wq, wk, wv, wo))
         normed = rms_norm(x, attn_norm, config.norm_eps)
         q = jnp.einsum("bth,hd->btd", normed, wq).reshape(
             batch, seq, config.num_heads, hd

@@ -106,6 +106,7 @@ class JaxCompletionsService(CompletionsService):
             mesh_config=mesh_config,
             max_slots=int(engine_config.get("max-slots", 8)),
             max_seq_len=engine_config.get("max-seq-len"),
+            quantize=config.get("quantization"),
         )
         self.engine.start()
 

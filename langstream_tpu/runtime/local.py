@@ -48,8 +48,10 @@ class LocalApplicationRunner:
         *,
         topic_runtime: Optional[TopicConnectionsRuntime] = None,
         state_directory: Optional[str] = None,
+        tracer=None,
     ) -> None:
         self.plan = plan
+        self.tracer = tracer
         self.application = plan.application
         self.topic_runtime = topic_runtime or create_topic_runtime(
             plan.application.instance.streaming_cluster
@@ -164,6 +166,7 @@ class LocalApplicationRunner:
             errors=node.errors,
             context=context,
             metrics=context.metrics,
+            tracer=self.tracer,
         )
 
     # ------------------------------------------------------------------ #

@@ -215,7 +215,9 @@ class AgentRunner:
         metrics: Optional[MetricsReporter] = None,
         max_pending_records: int = 512,
         drain_timeout: float = 60.0,
+        tracer=None,
     ) -> None:
+        from langstream_tpu.runtime.tracing import NOOP
         self.agent_id = agent_id
         self.source = source
         self.processor = processor
@@ -225,6 +227,7 @@ class AgentRunner:
         self.metrics = metrics or MetricsReporter(prefix=f"agent_{agent_id}")
         self.max_pending_records = max_pending_records
         self.drain_timeout = drain_timeout
+        self.tracer = tracer or NOOP
 
         self.stats = RunnerStats()
         self._stop = asyncio.Event()
@@ -295,13 +298,18 @@ class AgentRunner:
                     await self._pending_low.wait()
                     continue
                 budget = self.max_pending_records - self._pending
-                batch = await self.source.read(max_records=budget)
+                with self.tracer.span("source.read", agent=self.agent_id):
+                    batch = await self.source.read(max_records=budget)
                 if not batch:
                     continue
                 self.stats.records_in += len(batch)
                 self.metrics.counter("records_in").count(len(batch))
                 self._pending += len(batch)
-                self.processor.process(batch, self._result_sink)
+                with self.tracer.span(
+                    "processor.dispatch", agent=self.agent_id,
+                    records=len(batch),
+                ):
+                    self.processor.process(batch, self._result_sink)
             await self._drain()
             if self._failure is not None:
                 raise self._failure
@@ -363,14 +371,19 @@ class AgentRunner:
                 await self._handle_record_error(result.source_record, result.error)
                 return
             try:
-                for record in result.result_records:
-                    await self.sink.write(record)
-                    self.stats.records_out += 1
-                    self.metrics.counter("records_out").count()
+                with self.tracer.span(
+                    "sink.write", agent=self.agent_id,
+                    records=len(result.result_records),
+                ):
+                    for record in result.result_records:
+                        await self.sink.write(record)
+                        self.stats.records_out += 1
+                        self.metrics.counter("records_out").count()
             except BaseException as error:  # noqa: BLE001
                 await self._handle_record_error(result.source_record, error)
                 return
-            await self.source.commit([result.source_record])
+            with self.tracer.span("source.commit", agent=self.agent_id):
+                await self.source.commit([result.source_record])
             self._record_done(result.source_record)
         except BaseException as error:  # noqa: BLE001 — fatal
             self._failure = error

@@ -1,0 +1,191 @@
+"""Step-level tracing + on-demand TPU profiling.
+
+The reference has NO tracing subsystem (SURVEY §5: "Tracing / profiling:
+ABSENT" — observability there is Prometheus counters + a periodic stats
+dump, AgentRunner.java:598-618). This is a net-new subsystem of the TPU
+build, in two layers:
+
+1. **Span tracing** (any platform): lightweight in-process spans with
+   wall-time + monotonic durations, parent links, and per-record
+   attributes, kept in a bounded ring buffer per :class:`Tracer` and
+   exportable as Chrome ``trace_event`` JSON (load in
+   ``chrome://tracing`` / Perfetto). The runner wraps each hot-loop
+   phase (read / process / write / commit) in spans when given a tracer.
+
+2. **XLA device profiling** (TPU/CPU): :func:`profile` wraps
+   ``jax.profiler.trace`` to capture an xplane trace of everything the
+   devices ran — the tool for MXU utilization and HBM stalls. Written
+   to a TensorBoard-compatible directory.
+
+Overhead when disabled: a single ``if`` per call site (module-level
+no-op tracer).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Deque, Dict, Iterator, List, Optional
+
+
+class Span:
+    __slots__ = (
+        "name", "trace_id", "span_id", "parent_id", "start_wall",
+        "start_ns", "duration_ns", "attributes",
+    )
+
+    def __init__(
+        self,
+        name: str,
+        trace_id: str,
+        span_id: int,
+        parent_id: Optional[int],
+        attributes: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.start_wall = time.time()
+        self.start_ns = time.perf_counter_ns()
+        self.duration_ns: Optional[int] = None
+        self.attributes: Dict[str, Any] = attributes or {}
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "start": self.start_wall,
+            "duration_ms": (
+                None if self.duration_ns is None else self.duration_ns / 1e6
+            ),
+            "attributes": self.attributes,
+        }
+
+
+class Tracer:
+    """Per-component span recorder with a bounded buffer."""
+
+    def __init__(self, component: str, max_spans: int = 4096) -> None:
+        self.component = component
+        self.enabled = True
+        self._spans: Deque[Span] = deque(maxlen=max_spans)
+        self._lock = threading.Lock()
+        self._counter = 0
+        # ContextVar, not threading.local: the runner opens spans around
+        # awaits in concurrent asyncio tasks on ONE event-loop thread —
+        # a thread-local "current span" would cross-link unrelated tasks
+        self._current: "contextvars.ContextVar[Optional[Span]]" = (
+            contextvars.ContextVar(f"span_{component}", default=None)
+        )
+
+    def _next_id(self) -> int:
+        with self._lock:
+            self._counter += 1
+            return self._counter
+
+    @contextlib.contextmanager
+    def span(
+        self,
+        name: str,
+        trace_id: str = "",
+        **attributes: Any,
+    ) -> Iterator[Span]:
+        """Record a span; nests under the current thread's open span."""
+        if not self.enabled:
+            yield _NOOP_SPAN
+            return
+        parent = self._current.get()
+        span = Span(
+            name=name,
+            trace_id=trace_id or (parent.trace_id if parent else ""),
+            span_id=self._next_id(),
+            parent_id=parent.span_id if parent else None,
+            attributes=attributes,
+        )
+        token = self._current.set(span)
+        try:
+            yield span
+        finally:
+            span.duration_ns = time.perf_counter_ns() - span.start_ns
+            self._current.reset(token)
+            with self._lock:
+                self._spans.append(span)
+
+    def spans(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [s.to_dict() for s in self._spans]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+    # ------------------------------------------------------------------ #
+    # export
+    # ------------------------------------------------------------------ #
+    def chrome_trace(self) -> List[Dict[str, Any]]:
+        """Chrome trace_event "X" (complete) events — open the JSON in
+        chrome://tracing or Perfetto."""
+        events = []
+        with self._lock:
+            spans = list(self._spans)
+        for span in spans:
+            if span.duration_ns is None:
+                continue
+            events.append({
+                "name": span.name,
+                "cat": self.component,
+                "ph": "X",
+                "ts": span.start_wall * 1e6,
+                "dur": span.duration_ns / 1e3,
+                "pid": 0,
+                "tid": span.parent_id or span.span_id,
+                "args": {"trace_id": span.trace_id, **span.attributes},
+            })
+        return events
+
+    def dump(self, path: str) -> None:
+        with open(path, "w") as fh:
+            json.dump({"traceEvents": self.chrome_trace()}, fh)
+
+
+class _NoopSpan:
+    __slots__ = ()
+    attributes: Dict[str, Any] = {}
+
+    def __setattr__(self, *_a) -> None:  # pragma: no cover
+        pass
+
+
+_NOOP_SPAN = _NoopSpan()
+
+
+class NoopTracer(Tracer):
+    """Shared do-nothing tracer (the default when tracing is off)."""
+
+    def __init__(self) -> None:
+        super().__init__("noop", max_spans=1)
+        self.enabled = False
+
+
+NOOP = NoopTracer()
+
+
+@contextlib.contextmanager
+def profile(log_dir: str, *, host_tracer_level: int = 2) -> Iterator[None]:
+    """Capture an XLA device profile (xplane) under ``log_dir`` —
+    TensorBoard's profile plugin or xprof reads it. Wraps
+    ``jax.profiler.trace``; everything the devices execute inside the
+    block is captured (MXU utilization, HBM traffic, fusion names)."""
+    import jax
+
+    os.makedirs(log_dir, exist_ok=True)
+    with jax.profiler.trace(log_dir):
+        yield

@@ -1,0 +1,133 @@
+"""Tracing subsystem tests: spans, nesting, chrome export, runner
+integration, and the jax profiler wrapper."""
+
+import asyncio
+import json
+import os
+
+import pytest
+
+from langstream_tpu.runtime.tracing import NOOP, Tracer, profile
+
+
+def test_span_records_duration_and_attributes():
+    tracer = Tracer("test")
+    with tracer.span("work", trace_id="t1", records=3) as span:
+        pass
+    spans = tracer.spans()
+    assert len(spans) == 1
+    assert spans[0]["name"] == "work"
+    assert spans[0]["trace_id"] == "t1"
+    assert spans[0]["attributes"] == {"records": 3}
+    assert spans[0]["duration_ms"] >= 0
+
+
+def test_span_nesting_links_parent():
+    tracer = Tracer("test")
+    with tracer.span("outer", trace_id="t1"):
+        with tracer.span("inner"):
+            pass
+    spans = {s["name"]: s for s in tracer.spans()}
+    assert spans["inner"]["parent_id"] == spans["outer"]["span_id"]
+    # trace id propagates to children
+    assert spans["inner"]["trace_id"] == "t1"
+
+
+def test_bounded_buffer():
+    tracer = Tracer("test", max_spans=10)
+    for i in range(25):
+        with tracer.span(f"s{i}"):
+            pass
+    assert len(tracer.spans()) == 10
+    assert tracer.spans()[-1]["name"] == "s24"
+
+
+def test_noop_tracer_records_nothing():
+    with NOOP.span("anything") as span:
+        pass
+    assert NOOP.spans() == []
+
+
+def test_chrome_trace_export(tmp_path):
+    tracer = Tracer("agent")
+    with tracer.span("read"):
+        pass
+    path = str(tmp_path / "trace.json")
+    tracer.dump(path)
+    with open(path) as fh:
+        data = json.load(fh)
+    events = data["traceEvents"]
+    assert events and events[0]["ph"] == "X"
+    assert events[0]["cat"] == "agent"
+
+
+def test_runner_emits_spans():
+    from langstream_tpu.api.agent import (
+        AgentSink,
+        AgentSource,
+        SingleRecordProcessor,
+    )
+    from langstream_tpu.api.records import SimpleRecord
+    from langstream_tpu.runtime.runner import AgentRunner
+
+    class ListSource(AgentSource):
+        def __init__(self, records):
+            self.records = list(records)
+            self.committed = []
+
+        async def read(self, max_records=128):
+            if not self.records:
+                await asyncio.sleep(0.01)
+                return []
+            out, self.records = self.records, []
+            return out
+
+        async def commit(self, records):
+            self.committed.extend(records)
+
+    class Echo(SingleRecordProcessor):
+        async def process_record(self, record):
+            return [record]
+
+    class ListSink(AgentSink):
+        def __init__(self):
+            self.written = []
+
+        async def write(self, record):
+            self.written.append(record)
+
+    tracer = Tracer("runner")
+    source = ListSource([SimpleRecord(value=b"a"), SimpleRecord(value=b"b")])
+    sink = ListSink()
+    runner = AgentRunner(
+        agent_id="t", source=source, processor=Echo(), sink=sink,
+        tracer=tracer,
+    )
+
+    async def go():
+        task = asyncio.get_running_loop().create_task(runner.run())
+        for _ in range(200):
+            if len(sink.written) == 2:
+                break
+            await asyncio.sleep(0.01)
+        runner.stop()
+        await task
+
+    asyncio.run(go())
+    names = {s["name"] for s in tracer.spans()}
+    assert {"source.read", "processor.dispatch", "sink.write",
+            "source.commit"} <= names
+
+
+def test_jax_profile_writes_trace(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    log_dir = str(tmp_path / "prof")
+    with profile(log_dir):
+        jax.jit(lambda x: x * 2)(jnp.ones((8, 8))).block_until_ready()
+    # xplane artifacts land under plugins/profile/<run>/
+    found = []
+    for root, _dirs, files in os.walk(log_dir):
+        found.extend(files)
+    assert found, "profiler wrote no files"
