@@ -58,6 +58,12 @@ _BUILTIN = {
     "file-source": ("langstream_tpu.agents.storage", "FileSource"),
     "azure-blob-storage-source": ("langstream_tpu.agents.storage", "AzureBlobStorageSource"),
     "http-request": ("langstream_tpu.agents.http_request", "HttpRequestAgent"),
+    "langserve-invoke": ("langstream_tpu.agents.http_request", "LangServeInvokeAgent"),
+    # iterative retrieval control
+    "flare-controller": ("langstream_tpu.agents.flare", "FlareControllerAgent"),
+    # generic connector escape hatch (reference role: Camel / Kafka Connect)
+    "exec-source": ("langstream_tpu.agents.connector", "ExecSource"),
+    "exec-sink": ("langstream_tpu.agents.connector", "ExecSink"),
 }
 
 

@@ -1,0 +1,118 @@
+"""Asset manager SPI: provision tables/collections/indexes at setup.
+
+Equivalent of the reference's AssetManager SPI
+(``langstream-api/src/main/java/ai/langstream/api/runner/assets/AssetManager.java``
+with providers in ``langstream-core/.../impl/assets/`` — Cassandra, JDBC,
+Milvus, OpenSearch, Solr) and its registry
+(``AssetManagerRegistry.java``). Assets declare what infrastructure a
+pipeline needs; the setup phase creates them according to
+``creation-mode`` and tears them down per ``deletion-mode``.
+
+Built-in managers cover the TPU build's local datasources (SQL tables
+via the sqlite/jdbc datasource, vector collections via the in-process
+vector store); external systems (Cassandra/Milvus/...) plug in through
+:func:`register_asset_manager`.
+"""
+
+from __future__ import annotations
+
+import abc
+import logging
+from typing import Any, Callable, Dict, Optional
+
+from langstream_tpu.model.application import AssetDefinition
+
+logger = logging.getLogger(__name__)
+
+
+class AssetManager(abc.ABC):
+    """Lifecycle of one asset instance
+    (reference: ``AssetManager.java`` — init/assetExists/deployAsset/
+    deleteAssetIfExists/close)."""
+
+    async def init(
+        self, asset: AssetDefinition, resources: Dict[str, Any]
+    ) -> None:
+        self.asset = asset
+        self.resources = resources
+
+    @abc.abstractmethod
+    async def asset_exists(self) -> bool: ...
+
+    @abc.abstractmethod
+    async def deploy_asset(self) -> None: ...
+
+    async def delete_asset(self) -> bool:
+        return False
+
+    async def close(self) -> None:
+        pass
+
+
+_MANAGERS: Dict[str, Callable[[], AssetManager]] = {}
+
+
+def register_asset_manager(
+    asset_type: str, factory: Callable[[], AssetManager]
+) -> None:
+    _MANAGERS[asset_type] = factory
+
+
+def asset_manager_types() -> list:
+    _ensure_builtin()
+    return sorted(_MANAGERS)
+
+
+def create_asset_manager(asset_type: str) -> AssetManager:
+    _ensure_builtin()
+    factory = _MANAGERS.get(asset_type)
+    if factory is None:
+        raise ValueError(
+            f"no asset manager for type {asset_type!r} "
+            f"(available: {sorted(_MANAGERS)})"
+        )
+    return factory()
+
+
+_builtin = False
+
+
+def _ensure_builtin() -> None:
+    global _builtin
+    if _builtin:
+        return
+    _builtin = True
+    from langstream_tpu.runtime import assets as _impl  # noqa: F401
+
+
+async def deploy_assets(
+    assets, resources: Dict[str, Any]
+) -> None:
+    """Setup-phase provisioning (reference:
+    ``ApplicationSetupRunner`` asset deployment)."""
+    for asset in assets:
+        if asset.creation_mode != "create-if-not-exists":
+            continue
+        manager = create_asset_manager(asset.asset_type)
+        await manager.init(asset, resources)
+        try:
+            if await manager.asset_exists():
+                logger.info("asset %s already exists", asset.name)
+                continue
+            await manager.deploy_asset()
+            logger.info("created asset %s (%s)", asset.name, asset.asset_type)
+        finally:
+            await manager.close()
+
+
+async def cleanup_assets(assets, resources: Dict[str, Any]) -> None:
+    for asset in assets:
+        if asset.deletion_mode != "delete":
+            continue
+        manager = create_asset_manager(asset.asset_type)
+        await manager.init(asset, resources)
+        try:
+            if await manager.delete_asset():
+                logger.info("deleted asset %s", asset.name)
+        finally:
+            await manager.close()

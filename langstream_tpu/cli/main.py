@@ -182,17 +182,38 @@ async def _broker_serve(args) -> None:
 # docs
 # ---------------------------------------------------------------------- #
 def _docs(args) -> None:
-    from langstream_tpu.compiler.planner import GENAI_STEP_TYPES, _KIND
-    from langstream_tpu.runtime.registry import agent_types, _ensure_builtin_loaded
+    import json as _json
+
+    from langstream_tpu.model.docs import all_docs, generate_docs_model, get_doc
+    from langstream_tpu.runtime.registry import _ensure_builtin_loaded
 
     _ensure_builtin_loaded()
-    print("agent types:")
-    for agent_type in agent_types():
-        kind = _KIND.get(agent_type)
-        print(f"  {agent_type:28s} {kind.value if kind else ''}")
-    print("declarative GenAI steps (compile to the ai-tools executor):")
-    for step in sorted(GENAI_STEP_TYPES):
-        print(f"  {step}")
+    agent_type = getattr(args, "agent_type", None)
+    as_json = getattr(args, "json", False)
+    if agent_type:
+        doc = get_doc(agent_type)
+        if doc is None:
+            raise SystemExit(f"no documentation for agent type {agent_type!r}")
+        if as_json:
+            print(_json.dumps(doc.to_dict(), indent=2))
+            return
+        print(f"{doc.agent_type} ({doc.category})")
+        print(f"  {doc.description}")
+        for prop in doc.properties:
+            req = " (required)" if prop.required else ""
+            default = f" [default: {prop.default}]" if prop.default is not None else ""
+            print(f"  - {prop.name}: {prop.type}{req}{default}")
+            if prop.description:
+                print(f"      {prop.description}")
+            if prop.choices:
+                print(f"      choices: {', '.join(prop.choices)}")
+        return
+    if as_json:
+        print(_json.dumps(generate_docs_model(), indent=2))
+        return
+    print("agent types (docs <type> for details):")
+    for name, doc in sorted(all_docs().items()):
+        print(f"  {name:28s} {doc.category:10s} {doc.description}")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -233,7 +254,9 @@ def build_parser() -> argparse.ArgumentParser:
     broker.add_argument("--host", default="127.0.0.1")
     broker.add_argument("--port", type=int, default=4551)
 
-    sub.add_parser("docs", help="list agent types")
+    docs = sub.add_parser("docs", help="agent-type documentation")
+    docs.add_argument("agent_type", nargs="?", help="show one agent's docs")
+    docs.add_argument("--json", action="store_true", help="emit the JSON doc model")
     return parser
 
 

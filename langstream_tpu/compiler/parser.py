@@ -29,6 +29,7 @@ from langstream_tpu.model.application import (
     DEFAULT_MODULE,
     AgentConfiguration,
     Application,
+    AssetDefinition,
     Gateway,
     Instance,
     Module,
@@ -69,6 +70,9 @@ def parse_pipeline_file(
     for topic_config in content.get("topics", []) or []:
         topic = TopicDefinition.from_config(topic_config)
         module.topics[topic.name] = topic
+    for asset_config in content.get("assets", []) or []:
+        asset = AssetDefinition.from_config(asset_config)
+        module.assets[asset.id] = asset
     used_ids = set()
     for index, agent_config in enumerate(content.get("pipeline", []) or []):
         agent = AgentConfiguration.from_config(agent_config)

@@ -75,8 +75,10 @@ _KIND: Dict[str, ComponentType] = {
     "s3-source": ComponentType.SOURCE,
     "file-source": ComponentType.SOURCE,
     "azure-blob-storage-source": ComponentType.SOURCE,
+    "exec-source": ComponentType.SOURCE,
     "python-sink": ComponentType.SINK,
     "vector-db-sink": ComponentType.SINK,
+    "exec-sink": ComponentType.SINK,
     "python-service": ComponentType.SERVICE,
 }
 
@@ -137,12 +139,13 @@ class AgentNode:
 
 @dataclasses.dataclass
 class ExecutionPlan:
-    """Topics + agent nodes (+ assets later)
-    (``langstream-api/.../runtime/ExecutionPlan.java:32``)."""
+    """Topics + assets + agent nodes
+    (``langstream-api/.../runtime/ExecutionPlan.java:32``, maps 18-20)."""
 
     application: Application
     topics: Dict[str, TopicSpec] = dataclasses.field(default_factory=dict)
     agents: List[AgentNode] = dataclasses.field(default_factory=list)
+    assets: List[Any] = dataclasses.field(default_factory=list)
 
     def agent(self, node_id: str) -> AgentNode:
         for node in self.agents:
@@ -321,14 +324,35 @@ def build_execution_plan(application: Application) -> ExecutionPlan:
     """``ComputeClusterRuntime.buildExecutionPlan`` equivalent
     (``langstream-api/.../runtime/ComputeClusterRuntime.java:32``)."""
     plan = ExecutionPlan(application=application)
+    _validate_agent_configs(application)
     # declared topics first (even if no agent references them: gateways may)
     for module in application.modules.values():
         for topic in module.topics.values():
             plan.topics.setdefault(topic.name, _topic_spec(topic))
+        plan.assets.extend(module.assets.values())
         for pipeline in module.pipelines.values():
             _build_pipeline_nodes(plan, pipeline, application)
     _validate(plan)
     return plan
+
+
+def _validate_agent_configs(application: Application) -> None:
+    """Typed config validation against the doc model BEFORE any planner
+    transforms (reference: ``ClassConfigValidator.java:60`` runs on the
+    raw agent configuration)."""
+    from langstream_tpu.model.docs import validate_agent_config
+
+    errors = []
+    for module in application.modules.values():
+        for pipeline in module.pipelines.values():
+            for agent in pipeline.agents:
+                errors.extend(
+                    validate_agent_config(agent.type, agent.configuration)
+                )
+    if errors:
+        raise ValueError(
+            "invalid agent configuration:\n  " + "\n  ".join(errors)
+        )
 
 
 def _validate(plan: ExecutionPlan) -> None:

@@ -107,10 +107,36 @@ class Pipeline:
 
 
 @dataclasses.dataclass
+class AssetDefinition:
+    """Infrastructure an app needs provisioned before it runs — tables,
+    collections, indexes (``langstream-api/.../model/AssetDefinition``;
+    managers under ``langstream-core/.../impl/assets/``)."""
+
+    id: str
+    name: str
+    asset_type: str
+    creation_mode: str = "none"        # none | create-if-not-exists
+    deletion_mode: str = "none"        # none | delete
+    config: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @classmethod
+    def from_config(cls, config: Dict[str, Any]) -> "AssetDefinition":
+        return cls(
+            id=config.get("id") or config.get("name"),
+            name=config.get("name") or config.get("id"),
+            asset_type=config.get("asset-type") or config.get("type"),
+            creation_mode=config.get("creation-mode", "none"),
+            deletion_mode=config.get("deletion-mode", "none"),
+            config=config.get("config", {}) or {},
+        )
+
+
+@dataclasses.dataclass
 class Module:
     id: str = DEFAULT_MODULE
     pipelines: Dict[str, Pipeline] = dataclasses.field(default_factory=dict)
     topics: Dict[str, TopicDefinition] = dataclasses.field(default_factory=dict)
+    assets: Dict[str, AssetDefinition] = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass

@@ -80,6 +80,10 @@ class LocalApplicationRunner:
             if spec.creation_mode == "create-if-not-exists":
                 await admin.create_topic(spec)
         await admin.close()
+        if self.plan.assets:
+            from langstream_tpu.api.assets import deploy_assets
+
+            await deploy_assets(self.plan.assets, self.application.resources)
 
     def _make_context(self, node: AgentNode, replica: int) -> AgentContext:
         state_dir = os.path.join(self.state_directory, node.id, str(replica))

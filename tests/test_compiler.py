@@ -33,6 +33,9 @@ pipeline:
     configuration:
       model: "${secrets.open-ai.model}"
       completion-field: "value.answer"
+      messages:
+        - role: user
+          content: "{{ value.question }}"
 """
 
 

@@ -1,0 +1,119 @@
+"""Config doc model + validation tests."""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from langstream_tpu.model.docs import (
+    all_docs,
+    generate_docs_model,
+    get_doc,
+    validate_agent_config,
+)
+
+
+def test_docs_cover_all_registered_and_genai_types():
+    from langstream_tpu.compiler.planner import GENAI_STEP_TYPES
+    from langstream_tpu.runtime.registry import (
+        _ensure_builtin_loaded,
+        agent_types,
+    )
+
+    _ensure_builtin_loaded()
+    documented = set(all_docs())
+    missing = (set(agent_types()) | GENAI_STEP_TYPES) - documented
+    assert not missing, f"undocumented agent types: {sorted(missing)}"
+
+
+def test_validate_ok_and_unknown_property():
+    assert validate_agent_config("drop-fields", {"fields": ["a"]}) == []
+    errors = validate_agent_config("drop-fields", {"fields": ["a"], "oops": 1})
+    assert errors and "unknown property 'oops'" in errors[0]
+
+
+def test_validate_missing_required_and_bad_type():
+    errors = validate_agent_config("compute", {})
+    assert any("missing required property 'fields'" in e for e in errors)
+    errors = validate_agent_config("text-splitter", {"chunk_size": "big"})
+    assert any("expects integer" in e for e in errors)
+
+
+def test_validate_choices():
+    errors = validate_agent_config("cast", {"schema-type": "string", "part": "header"})
+    assert any("must be one of" in e for e in errors)
+
+
+def test_unknown_agent_type_passes():
+    assert validate_agent_config("my-custom-agent", {"whatever": 1}) == []
+
+
+def test_allow_unknown_types_accept_extra_keys():
+    assert validate_agent_config(
+        "python-processor", {"className": "x.Y", "custom-knob": 3}
+    ) == []
+
+
+def test_planner_rejects_bad_config(tmp_path):
+    import textwrap
+
+    from langstream_tpu.compiler import build_application, build_execution_plan
+
+    app_dir = tmp_path / "app"
+    app_dir.mkdir()
+    (app_dir / "pipeline.yaml").write_text(textwrap.dedent("""
+        topics:
+          - name: "in"
+          - name: "out"
+        pipeline:
+          - name: "bad"
+            type: "compute"
+            input: "in"
+            output: "out"
+            configuration:
+              fieldz: []
+    """))
+    (app_dir / "instance.yaml").write_text(textwrap.dedent("""
+        instance:
+          streamingCluster: {type: memory}
+          computeCluster: {type: local}
+    """))
+    app = build_application(str(app_dir))
+    with pytest.raises(ValueError, match="unknown property 'fieldz'"):
+        build_execution_plan(app)
+
+
+def test_docs_match_implementation_keys():
+    """Regression: doc entries must accept the keys the implementations
+    actually read (strict validation would otherwise reject working
+    pipelines)."""
+    assert validate_agent_config("re-rank", {"vector-field": "v"}) == []
+    assert validate_agent_config("re-rank", {}) == []  # all defaults
+    assert validate_agent_config("log-event", {"message": "hi"}) == []
+    assert validate_agent_config("file-source", {
+        "path": "/tmp", "delete-objects": True,
+    }) == []
+
+
+def test_docs_model_json_serializable():
+    model = generate_docs_model()
+    assert "ai-chat-completions" in model
+    encoded = json.loads(json.dumps(model))
+    props = {p["name"] for p in encoded["ai-chat-completions"]["properties"]}
+    assert {"messages", "stream-to-topic", "session-field"} <= props
+
+
+def test_cli_docs_command():
+    out = subprocess.run(
+        [sys.executable, "-m", "langstream_tpu", "docs", "re-rank"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "MMR" in out.stdout
+    out = subprocess.run(
+        [sys.executable, "-m", "langstream_tpu", "docs", "--json"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    assert json.loads(out.stdout)["cast"]["properties"]

@@ -1,0 +1,335 @@
+"""Tests: FLARE controller, exec connector agents, langserve-invoke,
+and the assets subsystem."""
+
+import asyncio
+import sys
+import textwrap
+
+import pytest
+
+from langstream_tpu.api.records import SimpleRecord
+
+
+# ---------------------------------------------------------------------- #
+# FLARE
+# ---------------------------------------------------------------------- #
+def test_low_confidence_spans():
+    import math
+
+    from langstream_tpu.agents.flare import low_confidence_spans
+
+    tokens = list("abcdefghij")
+    lp = [0.0] * 10          # prob 1.0 — confident
+    low = math.log(0.01)     # prob 0.01 — low confidence
+    lp[2] = low
+    lp[3] = low
+    spans = low_confidence_spans(tokens, lp, num_pad_tokens=1)
+    assert spans == ["cde"]  # merged c,d + 1 pad
+    assert low_confidence_spans(tokens, [0.0] * 10) == []
+    # distant low tokens form separate spans
+    lp2 = [0.0] * 10
+    lp2[0] = low
+    lp2[8] = low
+    assert low_confidence_spans(tokens, lp2, min_token_gap=5, num_pad_tokens=0) == ["a", "i"]
+
+
+class _CapturingRuntime:
+    def __init__(self):
+        self.written = []
+
+    def create_producer(self, agent_id, config):
+        runtime = self
+
+        class P:
+            async def start(self):
+                pass
+
+            async def close(self):
+                pass
+
+            async def write(self, record):
+                runtime.written.append((config["topic"], record))
+
+        return P()
+
+
+def test_flare_controller_routes_low_confidence():
+    import math
+
+    from langstream_tpu.agents.flare import FlareControllerAgent
+    from langstream_tpu.api.agent import AgentContext
+
+    async def go():
+        agent = FlareControllerAgent()
+        agent.agent_id = "flare"
+        await agent.init({"loop-topic": "loop"})
+        runtime = _CapturingRuntime()
+        await agent.set_context(
+            AgentContext(agent_id="flare", topic_connections=runtime)
+        )
+        # confident record passes through
+        good = SimpleRecord(value={
+            "tokens": ["a", "b"], "logprobs": [0.0, 0.0],
+        })
+        out = await agent.process_record(good)
+        assert out == [good]
+        # low-confidence record goes to the loop topic with spans
+        low = math.log(0.01)
+        bad = SimpleRecord(value={
+            "tokens": ["x", "y", "z"], "logprobs": [low, low, low],
+        })
+        out = await agent.process_record(bad)
+        assert out == []
+        assert len(runtime.written) == 1
+        topic, looped = runtime.written[0]
+        assert topic == "loop"
+        assert looped.value["documents_to_retrieve"]
+        assert looped.value["flare_iterations"] == 1
+        # a record over the iteration budget passes through untouched
+        tired = SimpleRecord(value={
+            "tokens": ["x"], "logprobs": [low], "flare_iterations": 99,
+        })
+        out = await agent.process_record(tired)
+        assert out == [tired]
+        await agent.close()
+
+        # max-iterations: 0 = never loop, even with low-confidence spans
+        agent0 = FlareControllerAgent()
+        agent0.agent_id = "flare0"
+        await agent0.init({"loop-topic": "loop", "max-iterations": 0})
+        runtime0 = _CapturingRuntime()
+        await agent0.set_context(
+            AgentContext(agent_id="flare0", topic_connections=runtime0)
+        )
+        out = await agent0.process_record(SimpleRecord(value={
+            "tokens": ["x"], "logprobs": [low],
+        }))
+        assert len(out) == 1 and not runtime0.written
+        await agent0.close()
+
+    asyncio.run(go())
+
+
+# ---------------------------------------------------------------------- #
+# exec connector
+# ---------------------------------------------------------------------- #
+def test_exec_source_reads_json_lines():
+    from langstream_tpu.agents.connector import ExecSource
+
+    async def go():
+        agent = ExecSource()
+        await agent.init({
+            "command": f'{sys.executable} -c "print(\'{{\\"n\\": 1}}\')"',
+            "max-restarts": 1,
+        })
+        await agent.start()
+        records = []
+        for _ in range(50):
+            records.extend(await agent.read())
+            if records:
+                break
+        await agent.close()
+        assert records and records[0].value == {"n": 1}
+
+    asyncio.run(go())
+
+
+def test_exec_sink_writes_stdin(tmp_path):
+    from langstream_tpu.agents.connector import ExecSink
+
+    out_file = tmp_path / "sink.out"
+    script = tmp_path / "sink.py"
+    script.write_text(textwrap.dedent(f"""
+        import sys
+        with open({str(out_file)!r}, "w") as fh:
+            for line in sys.stdin:
+                fh.write(line)
+    """))
+
+    async def go():
+        agent = ExecSink()
+        await agent.init({"command": f"{sys.executable} {script}"})
+        await agent.start()
+        await agent.write(SimpleRecord(value={"msg": "hello"}))
+        await agent.write(SimpleRecord(value={"msg": "world"}))
+        await agent.close()
+
+    asyncio.run(go())
+    lines = out_file.read_text().strip().splitlines()
+    assert lines == ['{"msg": "hello"}', '{"msg": "world"}']
+
+
+# ---------------------------------------------------------------------- #
+# langserve-invoke
+# ---------------------------------------------------------------------- #
+def test_langserve_invoke_and_stream():
+    import json
+
+    from aiohttp import web
+
+    from langstream_tpu.agents.http_request import LangServeInvokeAgent
+    from langstream_tpu.api.agent import AgentContext
+
+    async def go():
+        async def invoke(request):
+            body = await request.json()
+            return web.json_response(
+                {"output": f"echo:{body['input']['question']}"}
+            )
+
+        async def stream(request):
+            response = web.StreamResponse()
+            response.headers["Content-Type"] = "text/event-stream"
+            await response.prepare(request)
+            for part in ("Hello", " ", "world"):
+                await response.write(
+                    b"event: data\ndata: " + json.dumps(part).encode() + b"\n\n"
+                )
+            await response.write(b"event: end\ndata: [DONE]\n\n")
+            return response
+
+        app = web.Application()
+        app.router.add_post("/invoke", invoke)
+        app.router.add_post("/stream", stream)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+
+        try:
+            agent = LangServeInvokeAgent()
+            agent.agent_id = "ls"
+            await agent.init({
+                "url": f"http://127.0.0.1:{port}/invoke",
+                "fields": [{"name": "question", "expression": "value.q"}],
+                "output-field": "value.answer",
+            })
+            await agent.start()
+            out = await agent.process_record(SimpleRecord(value={"q": "hi"}))
+            assert out[0].value["answer"] == "echo:hi"
+            await agent.close()
+
+            runtime = _CapturingRuntime()
+            agent = LangServeInvokeAgent()
+            agent.agent_id = "ls"
+            await agent.init({
+                "url": f"http://127.0.0.1:{port}/stream",
+                "fields": [{"name": "question", "expression": "value.q"}],
+                "output-field": "value.answer",
+                "content-field": "value.chunk",
+                "stream-to-topic": "chunks",
+            })
+            await agent.set_context(
+                AgentContext(agent_id="ls", topic_connections=runtime)
+            )
+            await agent.start()
+            out = await agent.process_record(SimpleRecord(value={"q": "hi"}))
+            assert out[0].value["answer"] == "Hello world"
+            assert runtime.written
+            total = "".join(r.value["chunk"] for _, r in runtime.written)
+            assert total == "Hello world"
+            last_headers = dict(runtime.written[-1][1].headers)
+            assert last_headers["stream-last-message"] == "true"
+            await agent.close()
+        finally:
+            await runner.cleanup()
+
+    asyncio.run(go())
+
+
+# ---------------------------------------------------------------------- #
+# assets
+# ---------------------------------------------------------------------- #
+def test_jdbc_table_asset_roundtrip(tmp_path):
+    from langstream_tpu.api.assets import (
+        cleanup_assets,
+        create_asset_manager,
+        deploy_assets,
+    )
+    from langstream_tpu.model.application import AssetDefinition
+
+    db = str(tmp_path / "db.sqlite")
+    resources = {
+        "my-db": {"configuration": {"service": "sqlite", "path": db}},
+    }
+    asset = AssetDefinition(
+        id="t1", name="docs", asset_type="jdbc-table",
+        creation_mode="create-if-not-exists", deletion_mode="delete",
+        config={
+            "datasource": "my-db",
+            "table-name": "docs",
+            "create-statements": [
+                "CREATE TABLE docs (id INTEGER PRIMARY KEY, text TEXT)",
+            ],
+        },
+    )
+
+    async def go():
+        await deploy_assets([asset], resources)
+        manager = create_asset_manager("jdbc-table")
+        await manager.init(asset, resources)
+        assert await manager.asset_exists()
+        # idempotent: second deploy is a no-op
+        await deploy_assets([asset], resources)
+        await cleanup_assets([asset], resources)
+        manager2 = create_asset_manager("jdbc-table")
+        await manager2.init(asset, resources)
+        assert not await manager2.asset_exists()
+
+    asyncio.run(go())
+
+
+def test_vector_collection_asset():
+    from langstream_tpu.api.assets import deploy_assets
+    from langstream_tpu.agents.vectorstore import _SHARED_STORES
+    from langstream_tpu.model.application import AssetDefinition
+
+    asset = AssetDefinition(
+        id="v", name="corpus-test-asset", asset_type="vector-collection",
+        creation_mode="create-if-not-exists",
+        config={"dimensions": 8},
+    )
+
+    async def go():
+        await deploy_assets([asset], {})
+        assert "corpus-test-asset" in _SHARED_STORES
+        _SHARED_STORES.pop("corpus-test-asset", None)
+
+    asyncio.run(go())
+
+
+def test_assets_parse_and_plan(tmp_path):
+    import textwrap as tw
+
+    from langstream_tpu.compiler import build_application, build_execution_plan
+
+    app_dir = tmp_path / "app"
+    app_dir.mkdir()
+    (app_dir / "pipeline.yaml").write_text(tw.dedent("""
+        assets:
+          - name: "docs-table"
+            asset-type: "jdbc-table"
+            creation-mode: create-if-not-exists
+            config:
+              datasource: "my-db"
+              table-name: "docs"
+              create-statements:
+                - "CREATE TABLE docs (id INTEGER PRIMARY KEY)"
+        topics:
+          - name: "in"
+        pipeline:
+          - name: "noop"
+            type: "identity"
+            input: "in"
+    """))
+    (app_dir / "instance.yaml").write_text(tw.dedent("""
+        instance:
+          streamingCluster: {type: memory}
+          computeCluster: {type: local}
+    """))
+    app = build_application(str(app_dir))
+    plan = build_execution_plan(app)
+    assert len(plan.assets) == 1
+    assert plan.assets[0].asset_type == "jdbc-table"
+    assert plan.assets[0].creation_mode == "create-if-not-exists"
