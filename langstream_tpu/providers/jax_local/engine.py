@@ -243,16 +243,18 @@ class DecodeEngine:
                     cache, logits = model_lib.decode_step(
                         config, params, cache, tokens, lengths, freqs, write_mask
                     )
-                    sampled = _sample(logits, temperature, top_k, key, top_p)
+                    sampled, lp = _sample_with_logprob(
+                        logits, temperature, top_k, key, top_p
+                    )
                     sampled = jnp.where(active, sampled, 0)
                     lengths = jnp.where(active, lengths + 1, lengths)
-                    return (cache, sampled, lengths), sampled
+                    return (cache, sampled, lengths), (sampled, lp)
 
                 keys = jax.random.split(rng, steps)
-                (cache, _, _), out = jax.lax.scan(
+                (cache, _, _), (out, lps) = jax.lax.scan(
                     body, (cache, tokens, lengths), keys
                 )
-                return cache, out.T  # [S, K]
+                return cache, out.T, lps.T  # [S, K] each
 
             fn = run
             self._decode_fns[steps] = fn
@@ -571,12 +573,13 @@ class DecodeEngine:
                     steps = 1
         run = self._get_decode(steps)
         self._rng, step_key = jax.random.split(self._rng)
-        self.cache, out_tokens = run(
+        self.cache, out_tokens, out_lps = run(
             self.params, self.cache, jnp.asarray(tokens), jnp.asarray(lengths),
             jnp.asarray(active), jnp.asarray(active), jnp.asarray(temperature),
             jnp.asarray(top_k), jnp.asarray(top_p), step_key,
         )
         out_host = np.asarray(out_tokens)  # [S, steps]
+        lps_host = np.asarray(out_lps)
         self.stats["decode_steps"] += steps
         for i, slot in enumerate(self.slots):
             if not active[i]:
@@ -588,7 +591,7 @@ class DecodeEngine:
                     # garbage cache rows beyond it are dead
                     break
                 slot.length += 1
-                self._emit_token(i, int(out_host[i, j]))
+                self._emit_token(i, int(out_host[i, j]), float(lps_host[i, j]))
 
     def _emit_token(self, index: int, token: int) -> None:
         """Record a newly generated token for a slot; finish if stopping."""
@@ -711,3 +714,19 @@ def _sample(
     scaled = masked / jnp.maximum(temperature, 1e-6)[:, None]
     sampled = jax.random.categorical(rng, scaled, axis=-1)
     return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)
+
+
+def _sample_with_logprob(
+    logits: jnp.ndarray,
+    temperature: jnp.ndarray,
+    top_k: jnp.ndarray,
+    rng: jnp.ndarray,
+    top_p: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Sample and also return each sampled token's log-probability under
+    the UNTRUNCATED distribution (the model's own confidence — what the
+    FLARE controller consumes; reference: OpenAI-style logprobs)."""
+    token = _sample(logits, temperature, top_k, rng, top_p)
+    log_probs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    lp = jnp.take_along_axis(log_probs, token[:, None], axis=-1)[:, 0]
+    return token, lp
