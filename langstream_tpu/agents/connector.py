@@ -71,22 +71,37 @@ class ExecSource(AgentSource):
             await self._spawn()
             process = self._process
         assert process is not None and process.stdout is not None
+        # await (with timeout) only the FIRST line; then drain whatever is
+        # already buffered up to max_records, so high-volume subprocess
+        # streams are not capped at one record per runner-loop iteration
         try:
             line = await asyncio.wait_for(process.stdout.readline(), timeout=0.5)
         except asyncio.TimeoutError:
             return []
         if not line:
             return []  # EOF; next read() restarts
-        text = line.decode("utf-8", "replace").rstrip("\n")
-        if not text:
-            return []
-        value: Any = text
-        if self.parse_json:
+        records: List[Record] = []
+        while True:
+            text = line.decode("utf-8", "replace").rstrip("\n")
+            if text:
+                value: Any = text
+                if self.parse_json:
+                    try:
+                        value = json.loads(text)
+                    except ValueError:
+                        pass
+                records.append(SimpleRecord(value=value, timestamp=now_millis()))
+            if len(records) >= max_records:
+                break
             try:
-                value = json.loads(text)
-            except ValueError:
-                pass
-        return [SimpleRecord(value=value, timestamp=now_millis())]
+                line = await asyncio.wait_for(
+                    process.stdout.readline(), timeout=0.0005
+                )
+            except asyncio.TimeoutError:
+                break
+            if not line:
+                break  # EOF; next read() restarts
+        return records
 
     async def commit(self, records: List[Record]) -> None:
         pass  # the subprocess stream has no replay; at-most-once by nature

@@ -312,6 +312,9 @@ class ChatCompletionsStep(Step):
         self.stream_to_topic = config.get("stream-to-topic")
         self.stream_response_field = config.get("stream-response-completion-field")
         self.min_chunks = int(config.get("min-chunks-per-message", 20))
+        self.want_logprobs = bool(config.get("logprobs"))
+        self.logprobs_field = config.get("logprobs-field", "value.logprobs")
+        self.tokens_field = config.get("tokens-field", "value.tokens")
         self.messages = config.get("messages", [])
         self.prompt = config.get("prompt", [])
         self._service = None
@@ -367,6 +370,8 @@ class ChatCompletionsStep(Step):
 
         options = dict(self._options)
         options["min-chunks-per-message"] = self.min_chunks
+        if self.want_logprobs:
+            options["logprobs"] = True
         # session affinity for KV-cache reuse (BASELINE config #5): the
         # gateway's session header, else the record key (broker partitioning
         # by key then gives replica affinity too)
@@ -381,6 +386,11 @@ class ChatCompletionsStep(Step):
         for task in stream_tasks:
             await task
         ctx.set_field(self.completion_field, result.content)
+        if self.want_logprobs and result.logprobs is not None:
+            # OpenAI-style logprobs surface: the flare-controller's
+            # tokens-field/logprobs-field defaults resolve against these
+            ctx.set_field(self.tokens_field, list(result.tokens or []))
+            ctx.set_field(self.logprobs_field, list(result.logprobs))
         if self.log_field:
             ctx.set_field(
                 self.log_field,

@@ -53,6 +53,12 @@ class ChatCompletionResult:
     finish_reason: str = "stop"
     prompt_tokens: int = 0
     completion_tokens: int = 0
+    # per-token text pieces + log-probabilities (OpenAI-style logprobs;
+    # filled by providers that expose them — notably jax-local, whose
+    # engine samples them in-jit). Consumed by the flare-controller
+    # (reference: FlareControllerAgent.java tokens/logprobs fields).
+    tokens: Optional[List[str]] = None
+    logprobs: Optional[List[float]] = None
 
 
 class StreamingChunksConsumer(abc.ABC):

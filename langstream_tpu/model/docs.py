@@ -201,6 +201,9 @@ for doc in [
         _P("top-k", "integer", "top-k sampling"),
         _P("session-field", "string", "expression for KV-cache session affinity"),
         _P("ai-service", "string", "resource name of the AI service"),
+        _P("logprobs", "boolean", "emit per-token text + logprobs", default=False),
+        _P("logprobs-field", "string", "field for token logprobs", default="value.logprobs"),
+        _P("tokens-field", "string", "field for token text pieces", default="value.tokens"),
         _WHEN,
     )),
     AgentDoc("ai-text-completions", "Raw text completion via the configured model", (
@@ -214,6 +217,9 @@ for doc in [
         _P("temperature", "number", "sampling temperature"),
         _P("max-tokens", "integer", "max new tokens"),
         _P("ai-service", "string", "resource name of the AI service"),
+        _P("logprobs", "boolean", "emit per-token text + logprobs", default=False),
+        _P("logprobs-field", "string", "field for token logprobs", default="value.logprobs"),
+        _P("tokens-field", "string", "field for token text pieces", default="value.tokens"),
         _WHEN,
     )),
     # --- text processing (reference: langstream-agents-text-processing)

@@ -83,6 +83,42 @@ def decode_attention(
     return out.reshape(batch, heads, dim)
 
 
+def chunk_attention(
+    q: jnp.ndarray,
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    starts: jnp.ndarray,
+    lengths: jnp.ndarray,
+) -> jnp.ndarray:
+    """Chunked prefill-at-offset attention against the cache.
+
+    q: [B, T, H, D] — T new tokens per row whose global positions are
+    ``starts[b] + t``; k/v_cache: [B, S, KVH, D] with the new tokens' KV
+    already written at ``starts[b]..starts[b]+n-1``; lengths: [B] total
+    valid cache entries (starts + suffix length). Query t attends
+    causally to cache positions ``<= starts[b] + t``. Returns
+    [B, T, H, D]. This is what makes a warm-session follow-up one
+    bucketed dispatch instead of one decode dispatch per suffix token.
+    """
+    batch, seq, heads, dim = q.shape
+    max_len = k_cache.shape[1]
+    kv_heads = k_cache.shape[2]
+    scale = dim ** -0.5
+    qg = _group_query(q, kv_heads)  # [B, Tq, KVH, G, D]
+    scores = jnp.einsum(
+        "bqkgd,bskd->bkgqs", qg.astype(jnp.float32), k_cache.astype(jnp.float32)
+    ) * scale  # [B, KVH, G, Tq, S]
+    pos_q = starts[:, None] + jnp.arange(seq)[None, :]       # [B, Tq]
+    pos_s = jnp.arange(max_len)[None, None, :]               # [1, 1, S]
+    allowed = (pos_s <= pos_q[:, :, None]) & (
+        pos_s < lengths[:, None, None]
+    )  # [B, Tq, S]
+    scores = jnp.where(allowed[:, None, None, :, :], scores, -1e30)
+    weights = _softmax(scores)
+    out = jnp.einsum("bkgqs,bskd->bqkgd", weights.astype(v_cache.dtype), v_cache)
+    return out.reshape(batch, seq, heads, dim)
+
+
 def _softmax(scores: jnp.ndarray) -> jnp.ndarray:
     scores = scores - jnp.max(scores, axis=-1, keepdims=True)
     exp = jnp.exp(scores)

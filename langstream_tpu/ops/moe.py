@@ -120,7 +120,9 @@ def moe_routing(
     else:
         denom = jnp.maximum(valid.sum().astype(jnp.float32), 1.0)
         probs_masked = probs * valid[:, None].astype(jnp.float32)
-    frac_routed = onehot[:, 0].sum(axis=0) / denom  # first-choice share
+    # fraction over ALL top-k selections, normalized by k (Switch/Mixtral
+    # formulation): second-choice load gets balancing pressure too
+    frac_routed = onehot.sum(axis=(0, 1)) / (num_selected * denom)
     mean_prob = probs_masked.sum(axis=0) / denom
     aux_loss = num_experts * jnp.sum(frac_routed * mean_prob)
     return dispatch, combine, aux_loss

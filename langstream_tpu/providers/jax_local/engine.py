@@ -83,6 +83,10 @@ class GenerationResult:
     finish_reason: str = "stop"
     prefill_time: float = 0.0
     decode_time: float = 0.0
+    # per-token log-probability under the untruncated distribution,
+    # aligned 1:1 with ``tokens`` (consumed by the FLARE controller;
+    # reference: FlareControllerAgent.java logprobs field)
+    logprobs: List[float] = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass
@@ -90,6 +94,7 @@ class _Slot:
     request: Optional[GenerationRequest] = None
     length: int = 0                 # valid cache length
     generated: Optional[List[int]] = None
+    logprobs: Optional[List[float]] = None  # parallel to ``generated``
     history: Optional[List[int]] = None  # full token history in cache
     session_id: Optional[str] = None     # pinned session (slot free but warm)
 
@@ -187,12 +192,15 @@ class DecodeEngine:
         self._pending: List[GenerationRequest] = []
         self._thread: Optional[threading.Thread] = None
         self._running = False
+        self._crashed: Optional[BaseException] = None
         self._compiled_prefill: Dict[int, Any] = {}
+        self._prefill_offset_fns: Dict[int, Any] = {}
         self._decode_fns: Dict[int, Any] = {}
         self.stats = {
             "tokens_generated": 0,
             "requests": 0,
             "prefill_calls": 0,
+            "warm_prefill_calls": 0,
             "decode_steps": 0,
             "session_hits": 0,
         }
@@ -222,6 +230,22 @@ class DecodeEngine:
 
             fn = run
             self._compiled_prefill[bucket] = fn
+        return fn
+
+    def _get_prefill_offset(self, bucket: int):
+        fn = self._prefill_offset_fns.get(bucket)
+        if fn is None:
+            config, freqs = self.config, self.freqs
+
+            @functools.partial(jax.jit, donate_argnums=(1,))
+            def run(params, cache, tokens, lengths, offsets, slot_ids):
+                return model_lib.prefill_at_offset(
+                    config, params, cache, tokens, lengths, offsets,
+                    slot_ids, freqs,
+                )
+
+            fn = run
+            self._prefill_offset_fns[bucket] = fn
         return fn
 
     def _get_decode(self, steps: int = 1):
@@ -264,6 +288,8 @@ class DecodeEngine:
     # public API (thread-safe)
     # ------------------------------------------------------------------ #
     def start(self) -> None:
+        if self._crashed is not None:
+            raise RuntimeError("decode engine crashed") from self._crashed
         if self._thread is not None:
             return
         self._running = True
@@ -280,6 +306,8 @@ class DecodeEngine:
             self._thread = None
 
     def submit(self, request: GenerationRequest) -> None:
+        if self._crashed is not None:
+            raise RuntimeError("decode engine crashed") from self._crashed
         limit = min(self.max_seq_len - 1, self.prefill_buckets[-1])
         if len(request.prompt_tokens) > limit:
             raise ValueError(
@@ -288,6 +316,10 @@ class DecodeEngine:
                 f"largest prefill bucket {self.prefill_buckets[-1]})"
             )
         self._queue.put(request)
+        if self._crashed is not None:
+            # crashed between the check above and the put: the loop will
+            # never drain the queue again, so fail the stragglers here
+            self._fail_all_pending()
 
     async def generate(
         self,
@@ -337,8 +369,12 @@ class DecodeEngine:
                     self._admit()
                     if self._any_active():
                         self._decode_once()
-        except BaseException:  # noqa: BLE001
+        except BaseException as exc:  # noqa: BLE001
             logger.exception("engine loop crashed")
+            # flip the crash flag BEFORE failing waiters so a racing
+            # submit() either lands in the drained queue below or raises
+            self._crashed = exc
+            self._running = False
             self._fail_all_pending()
             raise
 
@@ -379,29 +415,29 @@ class DecodeEngine:
                 return i
         return None
 
-    # a warm suffix longer than this re-prefills cold instead: the forcing
-    # path is one full decode dispatch per token, so past this point the
-    # batched bucketed prefill wins (proper chunked prefill-at-offset is
-    # future work)
-    MAX_WARM_SUFFIX = 48
-
     def _session_warm(self, index: int, request: GenerationRequest) -> bool:
         slot = self.slots[index]
         prompt = request.prompt_tokens
-        return (
+        if not (
             request.session_id is not None
             and slot.session_id == request.session_id
             and slot.history is not None
             and len(slot.history) < len(prompt)
-            and len(prompt) - len(slot.history) <= self.MAX_WARM_SUFFIX
             and prompt[: len(slot.history)] == slot.history
-        )
+        ):
+            return False
+        # the suffix's bucket window must fit past the cached prefix —
+        # prefill_at_offset writes a full bucket-sized window at the
+        # offset, and a clamped write would clobber live prefix rows
+        suffix = len(prompt) - len(slot.history)
+        bucket = _bucket(suffix, self.prefill_buckets)
+        return len(slot.history) + bucket <= self.max_seq_len
 
     def _admit(self) -> None:
         """Move pending requests into slots. Cold requests sharing a prompt
         bucket are prefilled in ONE batched device call (batch padded to a
         power of two so compilations stay bounded); warm-session requests
-        take the teacher-forcing path individually."""
+        take one chunked prefill-at-offset dispatch each."""
         while self._pending:
             cold: List[Tuple[int, GenerationRequest]] = []
             cold_bucket: Optional[int] = None
@@ -460,6 +496,7 @@ class DecodeEngine:
                 slot_ids[row] = index
                 slot = self.slots[index]
                 slot.generated = []
+                slot.logprobs = []
                 slot.history = list(prompt)
                 slot.session_id = None
                 slot.length = len(prompt)
@@ -473,82 +510,53 @@ class DecodeEngine:
             )
             self.stats["prefill_calls"] += 1
             for row, (index, request) in enumerate(group):
-                first = self._sample_host(logits[row], request.sampling)
-                self._emit_token(index, int(first))
+                first, lp = self._sample_host(logits[row], request.sampling)
+                self._emit_token(index, int(first), lp)
                 request._prefill_time = time.perf_counter() - started  # type: ignore[attr-defined]
 
     def _prefill_warm(self, index: int, request: GenerationRequest) -> None:
         """Warm-session admission: the cache already holds the shared
-        prefix; teacher-force only the new suffix."""
+        prefix; prefill the new suffix AT OFFSET in one bucketed,
+        jitted dispatch (chunked prefill — no per-token forcing)."""
         slot = self.slots[index]
         prompt = request.prompt_tokens
         started = time.perf_counter()
         reused = len(slot.history)
+        suffix = prompt[reused:]
+        bucket = _bucket(len(suffix), self.prefill_buckets)
         self.stats["session_hits"] += 1
         slot.request = request
         slot.generated = []
+        slot.logprobs = []
         slot.history = list(prompt)
         slot.session_id = None
-        slot.length = reused
-        for token in prompt[reused:]:
-            self._force_token(index, int(token))
-        first = self._decode_single_for_logits(index, request.sampling)
-        self._emit_token(index, int(first))
+        slot.length = len(prompt)
+        tokens = np.zeros((1, bucket), dtype=np.int32)
+        tokens[0, : len(suffix)] = suffix
+        run = self._get_prefill_offset(bucket)
+        self.cache, logits = run(
+            self.params,
+            self.cache,
+            jnp.asarray(tokens),
+            jnp.asarray([len(suffix)], dtype=jnp.int32),
+            jnp.asarray([reused], dtype=jnp.int32),
+            jnp.asarray([index], dtype=jnp.int32),
+        )
+        self.stats["warm_prefill_calls"] += 1
+        first, lp = self._sample_host(logits[0], request.sampling)
+        self._emit_token(index, int(first), lp)
         request._prefill_time = time.perf_counter() - started  # type: ignore[attr-defined]
 
-    def _force_token(self, index: int, token: int) -> None:
-        """Advance one slot by a known token (no sampling)."""
-        slot = self.slots[index]
-        tokens = np.zeros((self.max_slots,), dtype=np.int32)
-        lengths = np.array([s.length for s in self.slots], dtype=np.int32)
-        tokens[index] = token
-        lengths[index] = slot.length + 1
-        write_mask = np.zeros((self.max_slots,), dtype=bool)
-        write_mask[index] = True
-        run = self._get_decode(1)
-        self._rng, step_key = jax.random.split(self._rng)
-        self.cache, _ = run(
-            self.params, self.cache, jnp.asarray(tokens), jnp.asarray(lengths),
-            jnp.zeros((self.max_slots,), dtype=bool),
-            jnp.asarray(write_mask),
-            jnp.zeros((self.max_slots,), dtype=jnp.float32),
-            jnp.zeros((self.max_slots,), dtype=jnp.int32),
-            jnp.zeros((self.max_slots,), dtype=jnp.float32),
-            step_key,
-        )
-        slot.length += 1
-
-    def _decode_single_for_logits(self, index: int, sampling: SamplingParams) -> int:
-        """After forcing a suffix, the next sampled token needs the last
-        token's logits; re-run the last position as a 1-token prefill of
-        length slot.length (positions already cached — we recompute the
-        last token's logits via a masked decode where we re-feed the last
-        history token WITHOUT advancing the slot length)."""
-        slot = self.slots[index]
-        last_token = slot.history[-1] if slot.history else 0
-        tokens = np.zeros((self.max_slots,), dtype=np.int32)
-        lengths = np.array([s.length for s in self.slots], dtype=np.int32)
-        tokens[index] = last_token
-        # re-write at the same position: length stays
-        config, freqs = self.config, self.freqs
-        cache, logits = model_lib.decode_step(
-            config, self.params, self.cache, jnp.asarray(tokens),
-            jnp.asarray(lengths), freqs,
-            write_mask=jnp.zeros((self.max_slots,), dtype=bool),
-        )
-        self.cache = cache
-        return self._sample_host(logits[index], sampling)
-
-    def _sample_host(self, logits, sampling: SamplingParams) -> int:
+    def _sample_host(self, logits, sampling: SamplingParams) -> Tuple[int, float]:
         self._rng, key = jax.random.split(self._rng)
-        token = _sample(
+        token, lp = _sample_with_logprob(
             logits[None],
             jnp.asarray([sampling.temperature], dtype=jnp.float32),
             jnp.asarray([sampling.top_k], dtype=jnp.int32),
             key,
             jnp.asarray([sampling.top_p], dtype=jnp.float32),
         )
-        return int(np.asarray(token)[0])
+        return int(np.asarray(token)[0]), float(np.asarray(lp)[0])
 
     def _decode_once(self) -> None:
         tokens = np.zeros((self.max_slots,), dtype=np.int32)
@@ -593,11 +601,12 @@ class DecodeEngine:
                 slot.length += 1
                 self._emit_token(i, int(out_host[i, j]), float(lps_host[i, j]))
 
-    def _emit_token(self, index: int, token: int) -> None:
+    def _emit_token(self, index: int, token: int, logprob: float = 0.0) -> None:
         """Record a newly generated token for a slot; finish if stopping."""
         slot = self.slots[index]
         request = slot.request
         slot.generated.append(token)
+        slot.logprobs.append(logprob)
         hit_stop = token in request.stop_tokens
         if not hit_stop:
             # stop tokens stay out of the history so a session follow-up
@@ -619,18 +628,22 @@ class DecodeEngine:
         slot = self.slots[index]
         request = slot.request
         generated = list(slot.generated)
+        logprobs = list(slot.logprobs)
         if generated and generated[-1] in request.stop_tokens:
             generated = generated[:-1]
+            logprobs = logprobs[:-1]
         result = GenerationResult(
             tokens=generated,
             prompt_tokens=len(request.prompt_tokens),
             finish_reason=reason,
             prefill_time=getattr(request, "_prefill_time", 0.0),
+            logprobs=logprobs,
         )
         self.stats["requests"] += 1
         # pin the slot for session reuse; otherwise free it fully
         slot.request = None
         slot.generated = None
+        slot.logprobs = None
         if request.session_id is not None:
             slot.session_id = request.session_id
             # keep only the history that is actually IN the cache (the
@@ -660,6 +673,10 @@ class DecodeEngine:
             request.future.set_result(result)
 
     def _fail_all_pending(self) -> None:
+        """Fail EVERY waiter promptly: queued, pending, and in-flight.
+        A crashed engine must never leave a caller hanging (the future is
+        the contract streaming callers await on — see
+        JaxCompletionsService.get_chat_completions)."""
         error = RuntimeError("decode engine crashed; see logs")
 
         def fail(request: GenerationRequest) -> None:
@@ -671,15 +688,30 @@ class DecodeEngine:
                     request.future.set_exception(error)
 
             if request.loop is not None:
-                request.loop.call_soon_threadsafe(resolve)
+                try:
+                    request.loop.call_soon_threadsafe(resolve)
+                except RuntimeError:
+                    # waiter's loop already closed (caller gave up) —
+                    # must not abort failing the REMAINING waiters
+                    pass
             else:
                 resolve()
 
+        # drain anything submitted but not yet picked up by the loop
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if item is not None:
+                self._pending.append(item)
         for request in self._pending:
             fail(request)
+        self._pending = []
         for slot in self.slots:
             if slot.active:
                 fail(slot.request)
+                slot.request = None
 
 
 def _sample(

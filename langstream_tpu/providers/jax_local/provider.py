@@ -171,11 +171,19 @@ class JaxCompletionsService(CompletionsService):
                 ChatChunk(content=tail, index=index_box[0]),
                 last=True,
             )
+        want_logprobs = bool(options.get("logprobs"))
         return ChatCompletionResult(
             content=text,
             finish_reason=result.finish_reason,
             prompt_tokens=result.prompt_tokens,
             completion_tokens=len(result.tokens),
+            # per-token decode only when the caller asked for logprobs —
+            # N tokenizer round-trips are pure waste on the common path
+            tokens=(
+                [self.tokenizer.decode([t]) for t in result.tokens]
+                if want_logprobs else None
+            ),
+            logprobs=list(result.logprobs) if want_logprobs else None,
         )
 
     async def close(self) -> None:

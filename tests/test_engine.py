@@ -221,3 +221,94 @@ def test_engine_tp_rejects_indivisible_heads():
 
     with pytest.raises(ValueError, match="must divide"):
         DecodeEngine(config, params, mesh_config=MeshConfig(tp=8))
+
+
+def test_logprobs_surfaced(engine):
+    """Every generated token carries a real logprob (≤ 0, aligned 1:1)."""
+
+    async def main():
+        r = await engine.generate([2, 4, 6], SamplingParams(max_new_tokens=5))
+        assert len(r.logprobs) == len(r.tokens)
+        assert all(isinstance(lp, float) and lp <= 0.0 for lp in r.logprobs)
+        # greedy tokens should be the argmax => logprob is the max one,
+        # which for a softmax over V classes is > -log(V) only when the
+        # distribution is peaked; just sanity-check finiteness here
+        assert all(np.isfinite(lp) for lp in r.logprobs)
+
+    asyncio.run(main())
+
+
+def test_warm_followup_single_dispatch():
+    """A warm-session follow-up with a LONG suffix must cost exactly one
+    chunked prefill-at-offset dispatch (no per-token forcing), and match
+    the cold path token-for-token."""
+    config = LlamaConfig.tiny(max_seq_len=256)
+    engine = DecodeEngine(
+        config, init_params(config), max_slots=2, max_seq_len=256,
+        prefill_buckets=[16, 64, 128],
+    )
+    engine.start()
+
+    async def main():
+        prompt1 = [1, 2, 3, 4]
+        r1 = await engine.generate(
+            prompt1, SamplingParams(max_new_tokens=4), session_id="s"
+        )
+        warm_before = engine.stats["warm_prefill_calls"]
+        prefills_before = engine.stats["prefill_calls"]
+        decode_before = engine.stats["decode_steps"]
+        suffix = [(i % 50) + 1 for i in range(60)]  # long suffix
+        prompt2 = prompt1 + r1.tokens + suffix
+        r2 = await engine.generate(
+            prompt2, SamplingParams(max_new_tokens=4), session_id="s"
+        )
+        assert engine.stats["warm_prefill_calls"] == warm_before + 1
+        assert engine.stats["prefill_calls"] == prefills_before
+        # decode steps only for the 4 new tokens (chunked), NOT ~60 forcing
+        assert engine.stats["decode_steps"] - decode_before <= 8
+        cold = await engine.generate(prompt2, SamplingParams(max_new_tokens=4))
+        assert cold.tokens == r2.tokens
+
+    asyncio.run(main())
+    engine.stop()
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)
+def test_crash_fails_all_waiters_fast():
+    """A crashed engine must fail every caller promptly — queued, pending,
+    in-flight, and future submissions — never hang them."""
+    import concurrent.futures
+
+    config = LlamaConfig.tiny(max_seq_len=64)
+    engine = DecodeEngine(
+        config, init_params(config), max_slots=2, max_seq_len=64,
+        prefill_buckets=[16],
+    )
+    # sabotage the device path: every prefill raises inside the loop
+    def boom(*a, **k):
+        raise RuntimeError("injected device failure")
+
+    engine._get_prefill = boom  # type: ignore[method-assign]
+
+    async def main():
+        with pytest.raises(RuntimeError):
+            await asyncio.wait_for(
+                engine.generate([1, 2, 3], SamplingParams(max_new_tokens=4)),
+                timeout=30,
+            )
+
+    asyncio.run(main())
+    # engine is now crashed: direct submission must raise immediately
+    from langstream_tpu.providers.jax_local.engine import GenerationRequest
+
+    with pytest.raises(RuntimeError, match="crashed"):
+        engine.submit(
+            GenerationRequest(
+                prompt_tokens=[1], sampling=SamplingParams(max_new_tokens=1),
+                future=concurrent.futures.Future(),
+            )
+        )
+    with pytest.raises(RuntimeError, match="crashed"):
+        engine.start()
