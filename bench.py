@@ -89,6 +89,13 @@ def probe_backend() -> None:
             # JAX_PLATFORMS=cpu; the driver's TPU run doesn't set it)
             if os.environ.get("JAX_PLATFORMS"):
                 jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+            # persistent compile cache: the 8B decode/prefill jits cost
+            # ~90 s to compile; cache them across bench runs
+            cache_dir = os.environ.get(
+                "JAX_COMPILATION_CACHE_DIR", "/tmp/jax_compile_cache"
+            )
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
             result["devices"] = [str(d) for d in jax.devices()]
         except BaseException as error:  # noqa: BLE001
             result["error"] = repr(error)
@@ -159,11 +166,14 @@ async def run_bench():
         )
         log(f"warmup (compile): {time.perf_counter() - t0:.1f}s")
 
+        engine.reset_stats()
         t0 = time.perf_counter()
         results = await asyncio.gather(
             *[engine.generate(prompt(i + 1), sampling) for i in range(REQUESTS)]
         )
         elapsed = time.perf_counter() - t0
+        stats = dict(engine.stats)
+        chunks = list(engine.chunk_log)
     finally:
         # release the engine thread + device buffers even on OOM so the
         # fallback model starts from a clean chip
@@ -171,10 +181,25 @@ async def run_bench():
 
     generated = sum(len(r.tokens) for r in results)
     tok_s = generated / elapsed
+    # evidence breakdown: where each second went and how full the waves
+    # were (VERDICT r2 weak #1: "451 tok/s and nobody knows why")
+    steps = max(stats["decode_steps"], 1)
+    occupancy = stats["active_slot_steps"] / (steps * MAX_SLOTS)
+    per_step_ms = [w / s * 1e3 for s, _, w in chunks] or [0.0]
+    per_step_ms.sort()
+    p50 = per_step_ms[len(per_step_ms) // 2]
+    p95 = per_step_ms[min(len(per_step_ms) - 1, int(len(per_step_ms) * 0.95))]
     log(
-        f"{generated} tokens in {elapsed:.2f}s -> {tok_s:.1f} tok/s "
-        f"(decode steps: {engine.stats['decode_steps']}, "
-        f"prefills: {engine.stats['prefill_calls']})"
+        f"{generated} tokens in {elapsed:.2f}s -> {tok_s:.1f} tok/s\n"
+        f"  decode: {stats['decode_steps']} steps in "
+        f"{stats['decode_chunks']} chunks, {stats['decode_time']:.2f}s "
+        f"({stats['decode_time'] / steps * 1e3:.2f} ms/step avg, "
+        f"p50 {p50:.2f} / p95 {p95:.2f} ms/step per chunk)\n"
+        f"  occupancy: {occupancy * 100:.1f}% of {MAX_SLOTS} slots\n"
+        f"  prefill: {stats['prefill_calls']} calls, "
+        f"{stats['prefill_time']:.2f}s\n"
+        f"  unaccounted (host/admission): "
+        f"{elapsed - stats['decode_time'] - stats['prefill_time']:.2f}s"
     )
     return tok_s
 

@@ -257,6 +257,33 @@ def build_parser() -> argparse.ArgumentParser:
     docs = sub.add_parser("docs", help="agent-type documentation")
     docs.add_argument("agent_type", nargs="?", help="show one agent's docs")
     docs.add_argument("--json", action="store_true", help="emit the JSON doc model")
+
+    # pod entry points (invoked by the deployer's generated manifests;
+    # reference: AgentRunnerStarter.java:39, RuntimeDeployer.java:40,
+    # ApplicationSetupRunner.java:40)
+    runner = sub.add_parser(
+        "agent-runner", help="run one plan node from a mounted pod config"
+    )
+    runner.add_argument("--config", required=True,
+                        help="path to pod-configuration.json")
+    runner.add_argument("--http-port", type=int, default=8080,
+                        help="/info + /metrics port (0 = kernel-assigned)")
+
+    download = sub.add_parser(
+        "code-download", help="fetch the app code archive (init container)"
+    )
+    download.add_argument("--config", required=True)
+    download.add_argument("--target", required=True)
+
+    setup = sub.add_parser(
+        "application-setup", help="create topics + assets (setup Job)"
+    )
+    setup.add_argument("--delete", action="store_true")
+
+    deployer = sub.add_parser(
+        "deployer", help="build the plan and write Agent CRs (deployer Job)"
+    )
+    deployer.add_argument("--delete", action="store_true")
     return parser
 
 
@@ -276,6 +303,24 @@ def main(argv: Optional[List[str]] = None) -> None:
         asyncio.run(_broker_serve(args))
     elif args.command == "docs":
         _docs(args)
+    elif args.command == "agent-runner":
+        from langstream_tpu.runtime.pod import agent_runner_main
+
+        asyncio.run(
+            agent_runner_main(args.config, http_port=args.http_port)
+        )
+    elif args.command == "code-download":
+        from langstream_tpu.runtime.pod import code_download_main
+
+        code_download_main(args.config, args.target)
+    elif args.command == "application-setup":
+        from langstream_tpu.runtime.pod import application_setup_main
+
+        asyncio.run(application_setup_main(delete=args.delete))
+    elif args.command == "deployer":
+        from langstream_tpu.runtime.pod import deployer_main
+
+        asyncio.run(deployer_main(delete=args.delete))
 
 
 if __name__ == "__main__":

@@ -78,6 +78,9 @@ class AgentCustomResource:
     application_id: str
     agent_node: Dict[str, Any]       # serialized AgentNode (runner config)
     streaming_cluster: Dict[str, Any]
+    # the application's AI-provider/datasource resource configs — agents
+    # resolve providers from these at runtime, so the pod needs them
+    resources: Dict[str, Any] = dataclasses.field(default_factory=dict)
     parallelism: int = 1
     size: int = 1                    # compute units → TPU chips per replica
     disk: Optional[Dict[str, Any]] = None
@@ -103,6 +106,7 @@ class AgentCustomResource:
                 "applicationId": self.application_id,
                 "agentNode": json.dumps(self.agent_node),
                 "streamingCluster": json.dumps(self.streaming_cluster),
+                "resources": json.dumps(self.resources),
                 "parallelism": self.parallelism,
                 "size": self.size,
                 "disk": self.disk,
@@ -121,6 +125,7 @@ class AgentCustomResource:
             application_id=spec.get("applicationId", ""),
             agent_node=json.loads(spec.get("agentNode") or "{}"),
             streaming_cluster=json.loads(spec.get("streamingCluster") or "{}"),
+            resources=json.loads(spec.get("resources") or "{}"),
             parallelism=int(spec.get("parallelism", 1)),
             size=int(spec.get("size", 1)),
             disk=spec.get("disk"),
@@ -183,6 +188,7 @@ def agent_crd_schema() -> Dict[str, Any]:
         "applicationId": {"type": "string"},
         "agentNode": {"type": "string"},
         "streamingCluster": {"type": "string"},
+        "resources": {"type": "string"},
         "parallelism": {"type": "integer"},
         "size": {"type": "integer"},
         "disk": {

@@ -1,0 +1,255 @@
+"""Real Kubernetes API client (same verbs as :class:`MockKubeApi`).
+
+Reference: ``langstream-k8s-common/src/main/java/ai/langstream/impl/k8s/
+KubernetesClientFactory.java`` (fabric8 client, in-cluster or kubeconfig).
+This client is dependency-free (stdlib ``urllib``): the operator and
+deployer only need apply/get/list/delete/patch_status over a handful of
+well-known kinds, so a full client library isn't warranted.
+
+Configuration resolution order (:func:`create_kube_api`):
+
+1. ``LANGSTREAM_KUBE_URL`` (+ optional ``LANGSTREAM_KUBE_TOKEN``) — used
+   by tests and non-standard clusters; plain HTTP allowed.
+2. In-cluster service account (``KUBERNETES_SERVICE_HOST`` + the mounted
+   token/CA under ``/var/run/secrets/kubernetes.io/serviceaccount``).
+3. ``LANGSTREAM_KUBE=mock`` → the in-memory :class:`MockKubeApi`
+   (single-process stacks and unit tests).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import ssl
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from langstream_tpu.deployer.crds import (
+    AGENTS_PLURAL,
+    API_GROUP,
+    APPLICATIONS_PLURAL,
+)
+
+Manifest = Dict[str, Any]
+
+SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+# kind → (api prefix, plural). CRs use the langstream API group.
+_KIND_ROUTES: Dict[str, Any] = {
+    "Secret": ("/api/v1", "secrets"),
+    "Service": ("/api/v1", "services"),
+    "ConfigMap": ("/api/v1", "configmaps"),
+    "Pod": ("/api/v1", "pods"),
+    "Namespace": ("/api/v1", "namespaces"),
+    "StatefulSet": ("/apis/apps/v1", "statefulsets"),
+    "Deployment": ("/apis/apps/v1", "deployments"),
+    "Job": ("/apis/batch/v1", "jobs"),
+    "Application": (f"/apis/{API_GROUP}/v1", APPLICATIONS_PLURAL),
+    "Agent": (f"/apis/{API_GROUP}/v1", AGENTS_PLURAL),
+    "CustomResourceDefinition": (
+        "/apis/apiextensions.k8s.io/v1", "customresourcedefinitions"
+    ),
+}
+
+_CLUSTER_SCOPED = {"Namespace", "CustomResourceDefinition"}
+
+
+class KubeApiError(RuntimeError):
+    def __init__(self, status: int, body: str, url: str) -> None:
+        super().__init__(f"kube API {status} for {url}: {body[:500]}")
+        self.status = status
+        self.body = body
+
+
+class RealKubeApi:
+    """apply/get/list/delete/patch_status over the Kubernetes REST API."""
+
+    def __init__(
+        self,
+        base_url: str,
+        *,
+        token: Optional[str] = None,
+        ca_file: Optional[str] = None,
+        insecure: bool = False,
+        timeout: float = 30.0,
+    ) -> None:
+        self.base_url = base_url.rstrip("/")
+        self.token = token
+        self.timeout = timeout
+        if self.base_url.startswith("https"):
+            if insecure:
+                context = ssl.create_default_context()
+                context.check_hostname = False
+                context.verify_mode = ssl.CERT_NONE
+            else:
+                context = ssl.create_default_context(cafile=ca_file)
+            self._context: Optional[ssl.SSLContext] = context
+        else:
+            self._context = None
+
+    # -- plumbing ------------------------------------------------------ #
+    def _url(self, kind: str, namespace: Optional[str], name: Optional[str],
+             *, subresource: str = "", query: str = "") -> str:
+        try:
+            prefix, plural = _KIND_ROUTES[kind]
+        except KeyError:
+            raise ValueError(f"unsupported kind {kind!r}") from None
+        if kind in _CLUSTER_SCOPED:
+            path = f"{prefix}/{plural}"
+        else:
+            path = f"{prefix}/namespaces/{namespace or 'default'}/{plural}"
+        if name:
+            path += f"/{name}"
+        if subresource:
+            path += f"/{subresource}"
+        if query:
+            path += f"?{query}"
+        return self.base_url + path
+
+    def _request(
+        self, method: str, url: str, body: Optional[Dict[str, Any]] = None,
+        content_type: str = "application/json",
+    ) -> Dict[str, Any]:
+        data = json.dumps(body).encode() if body is not None else None
+        request = urllib.request.Request(url, data=data, method=method)
+        request.add_header("Accept", "application/json")
+        if data is not None:
+            request.add_header("Content-Type", content_type)
+        if self.token:
+            request.add_header("Authorization", f"Bearer {self.token}")
+        try:
+            with urllib.request.urlopen(
+                request, timeout=self.timeout, context=self._context
+            ) as response:
+                payload = response.read()
+        except urllib.error.HTTPError as error:
+            raise KubeApiError(
+                error.code, error.read().decode(errors="replace"), url
+            ) from None
+        return json.loads(payload) if payload else {}
+
+    # -- verbs (MockKubeApi-compatible) -------------------------------- #
+    def apply(self, doc: Manifest) -> Manifest:
+        kind = doc.get("kind", "")
+        meta = doc.get("metadata", {})
+        namespace, name = meta.get("namespace", "default"), meta["name"]
+        # create-or-replace: POST, then on conflict GET the live object's
+        # resourceVersion and PUT (the fabric8 createOrReplace pattern)
+        try:
+            return self._request(
+                "POST", self._url(kind, namespace, None), doc
+            )
+        except KubeApiError as error:
+            if error.status != 409:
+                raise
+        live = self.get(kind, namespace, name)
+        if live is None:  # deleted between POST and GET — retry create
+            return self._request(
+                "POST", self._url(kind, namespace, None), doc
+            )
+        replacement = dict(doc)
+        replacement["metadata"] = dict(meta)
+        replacement["metadata"]["resourceVersion"] = (
+            live.get("metadata", {}).get("resourceVersion")
+        )
+        # status is only written through patch_status
+        replacement.pop("status", None)
+        return self._request(
+            "PUT", self._url(kind, namespace, name), replacement
+        )
+
+    def get(self, kind: str, namespace: str, name: str) -> Optional[Manifest]:
+        try:
+            return self._request("GET", self._url(kind, namespace, name))
+        except KubeApiError as error:
+            if error.status == 404:
+                return None
+            raise
+
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+    ) -> List[Manifest]:
+        query = ""
+        if label_selector:
+            selector = ",".join(f"{k}={v}" for k, v in label_selector.items())
+            query = "labelSelector=" + urllib.parse.quote(selector)
+        if namespace is None and kind not in _CLUSTER_SCOPED:
+            # all-namespaces listing
+            prefix, plural = _KIND_ROUTES[kind]
+            url = f"{self.base_url}{prefix}/{plural}"
+            if query:
+                url += f"?{query}"
+        else:
+            url = self._url(kind, namespace, None, query=query)
+        result = self._request("GET", url)
+        items = result.get("items", []) or []
+        for item in items:
+            # list items omit kind/apiVersion; restore for manifest_key use
+            item.setdefault("kind", kind)
+        return items
+
+    def delete(self, kind: str, namespace: str, name: str) -> bool:
+        try:
+            self._request("DELETE", self._url(kind, namespace, name))
+            return True
+        except KubeApiError as error:
+            if error.status == 404:
+                return False
+            raise
+
+    def patch_status(
+        self, kind: str, namespace: str, name: str, status: Dict[str, Any]
+    ) -> Optional[Manifest]:
+        try:
+            return self._request(
+                "PATCH",
+                self._url(kind, namespace, name, subresource="status"),
+                {"status": status},
+                content_type="application/merge-patch+json",
+            )
+        except KubeApiError as error:
+            if error.status == 404:
+                return None
+            raise
+
+
+def in_cluster_available() -> bool:
+    return bool(os.environ.get("KUBERNETES_SERVICE_HOST")) and os.path.exists(
+        os.path.join(SERVICE_ACCOUNT_DIR, "token")
+    )
+
+
+def create_kube_api():
+    """Resolve a kube API client from the environment (see module doc)."""
+    explicit = os.environ.get("LANGSTREAM_KUBE_URL")
+    if explicit:
+        return RealKubeApi(
+            explicit,
+            token=os.environ.get("LANGSTREAM_KUBE_TOKEN"),
+            ca_file=os.environ.get("LANGSTREAM_KUBE_CA"),
+            insecure=os.environ.get("LANGSTREAM_KUBE_INSECURE") == "true",
+        )
+    if in_cluster_available():
+        host = os.environ["KUBERNETES_SERVICE_HOST"]
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        with open(os.path.join(SERVICE_ACCOUNT_DIR, "token")) as handle:
+            token = handle.read().strip()
+        ca = os.path.join(SERVICE_ACCOUNT_DIR, "ca.crt")
+        return RealKubeApi(
+            f"https://{host}:{port}",
+            token=token,
+            ca_file=ca if os.path.exists(ca) else None,
+        )
+    if os.environ.get("LANGSTREAM_KUBE", "").lower() in ("mock", "memory"):
+        from langstream_tpu.deployer.kube import MockKubeApi
+
+        return MockKubeApi()
+    raise RuntimeError(
+        "no Kubernetes API configured: set LANGSTREAM_KUBE_URL, run "
+        "in-cluster with a service account, or set LANGSTREAM_KUBE=mock"
+    )

@@ -71,6 +71,7 @@ class Operator:
                 application_id=app.name,
                 agent_node=_node_document(node),
                 streaming_cluster=application.instance.streaming_cluster,
+                resources=application.resources,
                 parallelism=node.resources.parallelism,
                 size=node.resources.size,
                 disk=node.resources.disk,

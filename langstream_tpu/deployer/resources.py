@@ -59,6 +59,7 @@ def _runtime_pod_configuration(agent: AgentCustomResource) -> Dict[str, Any]:
     return {
         "agentNode": agent.agent_node,
         "streamingCluster": agent.streaming_cluster,
+        "resources": agent.resources,
         "applicationId": agent.application_id,
         "codeArchiveId": agent.code_archive_id,
         "tenant": agent.namespace,

@@ -196,14 +196,30 @@ class DecodeEngine:
         self._compiled_prefill: Dict[int, Any] = {}
         self._prefill_offset_fns: Dict[int, Any] = {}
         self._decode_fns: Dict[int, Any] = {}
-        self.stats = {
+        self.stats = self._fresh_stats()
+        # per-chunk dispatch log: (steps, active_slots, wall_seconds) —
+        # the occupancy/step-time evidence the bench prints (bounded)
+        self.chunk_log: List[Tuple[int, int, float]] = []
+
+    @staticmethod
+    def _fresh_stats() -> Dict[str, Any]:
+        return {
             "tokens_generated": 0,
             "requests": 0,
             "prefill_calls": 0,
             "warm_prefill_calls": 0,
             "decode_steps": 0,
             "session_hits": 0,
+            "decode_chunks": 0,
+            "decode_time": 0.0,      # wall secs inside decode dispatches
+            "prefill_time": 0.0,     # wall secs inside prefill dispatches
+            "active_slot_steps": 0,  # sum of active slots over decode steps
         }
+
+    def reset_stats(self) -> None:
+        """Zero the counters (e.g. after warmup, before measurement)."""
+        self.stats = self._fresh_stats()
+        self.chunk_log = []
 
     def _default_buckets(self) -> List[int]:
         buckets, size = [], 64
@@ -485,6 +501,7 @@ class DecodeEngine:
             groups.append(remaining[:size])
             remaining = remaining[size:]
         for group in groups:
+            group_started = time.perf_counter()
             size = len(group)
             tokens = np.zeros((size, bucket), dtype=np.int32)
             lengths = np.zeros((size,), dtype=np.int32)
@@ -509,6 +526,8 @@ class DecodeEngine:
                 jnp.asarray(slot_ids),
             )
             self.stats["prefill_calls"] += 1
+            jax.block_until_ready(logits)
+            self.stats["prefill_time"] += time.perf_counter() - group_started
             for row, (index, request) in enumerate(group):
                 first, lp = self._sample_host(logits[row], request.sampling)
                 self._emit_token(index, int(first), lp)
@@ -543,6 +562,8 @@ class DecodeEngine:
             jnp.asarray([index], dtype=jnp.int32),
         )
         self.stats["warm_prefill_calls"] += 1
+        jax.block_until_ready(logits)
+        self.stats["prefill_time"] += time.perf_counter() - started
         first, lp = self._sample_host(logits[0], request.sampling)
         self._emit_token(index, int(first), lp)
         request._prefill_time = time.perf_counter() - started  # type: ignore[attr-defined]
@@ -559,6 +580,7 @@ class DecodeEngine:
         return int(np.asarray(token)[0]), float(np.asarray(lp)[0])
 
     def _decode_once(self) -> None:
+        started = time.perf_counter()
         tokens = np.zeros((self.max_slots,), dtype=np.int32)
         lengths = np.zeros((self.max_slots,), dtype=np.int32)
         active = np.zeros((self.max_slots,), dtype=bool)
@@ -588,7 +610,14 @@ class DecodeEngine:
         )
         out_host = np.asarray(out_tokens)  # [S, steps]
         lps_host = np.asarray(out_lps)
+        wall = time.perf_counter() - started
+        n_active = int(active.sum())
         self.stats["decode_steps"] += steps
+        self.stats["decode_chunks"] += 1
+        self.stats["decode_time"] += wall
+        self.stats["active_slot_steps"] += n_active * steps
+        if len(self.chunk_log) < 65536:
+            self.chunk_log.append((steps, n_active, wall))
         for i, slot in enumerate(self.slots):
             if not active[i]:
                 continue

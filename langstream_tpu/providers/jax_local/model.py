@@ -33,7 +33,7 @@ from langstream_tpu.ops.flash_attention import flash_prefill_attention, use_flas
 from langstream_tpu.ops.norms import rms_norm
 from langstream_tpu.ops.rope import apply_rope, rope_frequencies
 from langstream_tpu.parallel.mesh import L
-from langstream_tpu.providers.jax_local.quant import dq
+from langstream_tpu.providers.jax_local.quant import qeinsum
 
 
 @dataclasses.dataclass(frozen=True)
@@ -269,20 +269,18 @@ def _mlp_block(
             capacity_factor=None if dropless else config.capacity_factor,
             valid=valid,
         )
-    w_gate, w_up, w_down = (dq(w, normed.dtype) for w in mlp_weights)
-    gate = jnp.einsum("...h,hf->...f", normed, w_gate)
-    up = jnp.einsum("...h,hf->...f", normed, w_up)
-    out = jnp.einsum("...f,fh->...h", jax.nn.silu(gate) * up, w_down)
+    w_gate, w_up, w_down = mlp_weights
+    gate = qeinsum("...h,hf->...f", normed, w_gate)
+    up = qeinsum("...h,hf->...f", normed, w_up)
+    out = qeinsum("...f,fh->...h", jax.nn.silu(gate) * up, w_down)
     return out, jnp.zeros((), dtype=jnp.float32)
 
 
 def _logits(config: LlamaConfig, params, x):
-    head = (
-        params["embedding"].T.astype(x.dtype)
-        if config.tie_embeddings
-        else dq(params["lm_head"], x.dtype)
-    )
-    return jnp.einsum("...h,hv->...v", x, head).astype(jnp.float32)
+    if config.tie_embeddings:
+        head = params["embedding"].T.astype(x.dtype)
+        return jnp.einsum("...h,hv->...v", x, head).astype(jnp.float32)
+    return qeinsum("...h,hv->...v", x, params["lm_head"]).astype(jnp.float32)
 
 
 def _prefill_attn(config, q, k, v, mask):
@@ -320,21 +318,20 @@ def prefill(
 
     def layer_fn(x, layer):
         attn_norm, wq, wk, wv, wo, mlp_norm, mlp_weights = layer
-        wq, wk, wv, wo = (dq(w, config.dtype) for w in (wq, wk, wv, wo))
         normed = rms_norm(x, attn_norm, config.norm_eps)
-        q = jnp.einsum("bth,hd->btd", normed, wq).reshape(
+        q = qeinsum("bth,hd->btd", normed, wq).reshape(
             batch, seq, config.num_heads, hd
         )
-        k = jnp.einsum("bth,hd->btd", normed, wk).reshape(
+        k = qeinsum("bth,hd->btd", normed, wk).reshape(
             batch, seq, config.num_kv_heads, hd
         )
-        v = jnp.einsum("bth,hd->btd", normed, wv).reshape(
+        v = qeinsum("bth,hd->btd", normed, wv).reshape(
             batch, seq, config.num_kv_heads, hd
         )
         q = apply_rope(q, freqs, positions)
         k = apply_rope(k, freqs, positions)
         attn = _prefill_attn(config, q, k, v, mask)
-        attn = jnp.einsum(
+        attn = qeinsum(
             "btd,dh->bth", attn.reshape(batch, seq, config.num_heads * hd), wo
         )
         x = x + attn
@@ -411,15 +408,14 @@ def prefill_at_offset(
     def layer_fn(carry, inputs):
         x = carry
         (attn_norm, wq, wk, wv, wo, mlp_norm, mlp_weights), kc, vc = inputs
-        wq, wk, wv, wo = (dq(w, config.dtype) for w in (wq, wk, wv, wo))
         normed = rms_norm(x, attn_norm, config.norm_eps)
-        q = jnp.einsum("bth,hd->btd", normed, wq).reshape(
+        q = qeinsum("bth,hd->btd", normed, wq).reshape(
             batch, seq, config.num_heads, hd
         )
-        k = jnp.einsum("bth,hd->btd", normed, wk).reshape(
+        k = qeinsum("bth,hd->btd", normed, wk).reshape(
             batch, seq, config.num_kv_heads, hd
         )
-        v = jnp.einsum("bth,hd->btd", normed, wv).reshape(
+        v = qeinsum("bth,hd->btd", normed, wv).reshape(
             batch, seq, config.num_kv_heads, hd
         )
         q = apply_rope(q, freqs, positions)
@@ -427,7 +423,7 @@ def prefill_at_offset(
         kc = write_rows(kc, k, offsets)
         vc = write_rows(vc, v, offsets)
         attn = chunk_attention(q, kc[slot_ids], vc[slot_ids], offsets, totals)
-        x = x + jnp.einsum(
+        x = x + qeinsum(
             "btd,dh->bth", attn.reshape(batch, seq, config.num_heads * hd), wo
         )
         normed = rms_norm(x, mlp_norm, config.norm_eps)
@@ -475,17 +471,16 @@ def decode_step(
     def layer_fn(carry, inputs):
         x = carry
         (attn_norm, wq, wk, wv, wo, mlp_norm, mlp_weights), kc, vc = inputs
-        wq, wk, wv, wo = (dq(w, config.dtype) for w in (wq, wk, wv, wo))
         normed = rms_norm(x, attn_norm, config.norm_eps)
-        q = jnp.einsum("sh,hd->sd", normed, wq).reshape(slots, config.num_heads, hd)
-        k = jnp.einsum("sh,hd->sd", normed, wk).reshape(slots, config.num_kv_heads, hd)
-        v = jnp.einsum("sh,hd->sd", normed, wv).reshape(slots, config.num_kv_heads, hd)
+        q = qeinsum("sh,hd->sd", normed, wq).reshape(slots, config.num_heads, hd)
+        k = qeinsum("sh,hd->sd", normed, wk).reshape(slots, config.num_kv_heads, hd)
+        v = qeinsum("sh,hd->sd", normed, wv).reshape(slots, config.num_kv_heads, hd)
         q = apply_rope(q[:, None], freqs, positions[:, None])[:, 0]
         k = apply_rope(k[:, None], freqs, positions[:, None])[:, 0]
         kc = jax.vmap(write)(kc, positions, k, write_mask)
         vc = jax.vmap(write)(vc, positions, v, write_mask)
         attn = decode_attention(q, kc, vc, lengths)
-        x = x + jnp.einsum("sd,dh->sh", attn.reshape(slots, config.num_heads * hd), wo)
+        x = x + qeinsum("sd,dh->sh", attn.reshape(slots, config.num_heads * hd), wo)
         normed = rms_norm(x, mlp_norm, config.norm_eps)
         # decode groups are tiny (S = slots) so dropless capacity is cheap;
         # inactive slots can't evict anyone, so no valid mask is needed
@@ -522,21 +517,20 @@ def apply_layers(
     def layer_fn(carry, layer):
         x, aux = carry
         attn_norm, wq, wk, wv, wo, mlp_norm, mlp_weights = layer
-        wq, wk, wv, wo = (dq(w, config.dtype) for w in (wq, wk, wv, wo))
         normed = rms_norm(x, attn_norm, config.norm_eps)
-        q = jnp.einsum("bth,hd->btd", normed, wq).reshape(
+        q = qeinsum("bth,hd->btd", normed, wq).reshape(
             batch, seq, config.num_heads, hd
         )
-        k = jnp.einsum("bth,hd->btd", normed, wk).reshape(
+        k = qeinsum("bth,hd->btd", normed, wk).reshape(
             batch, seq, config.num_kv_heads, hd
         )
-        v = jnp.einsum("bth,hd->btd", normed, wv).reshape(
+        v = qeinsum("bth,hd->btd", normed, wv).reshape(
             batch, seq, config.num_kv_heads, hd
         )
         q = apply_rope(q, freqs, positions)
         k = apply_rope(k, freqs, positions)
         attn = prefill_attention(q, k, v, mask=mask)
-        x = x + jnp.einsum(
+        x = x + qeinsum(
             "btd,dh->bth", attn.reshape(batch, seq, config.num_heads * hd), wo
         )
         normed = rms_norm(x, mlp_norm, config.norm_eps)

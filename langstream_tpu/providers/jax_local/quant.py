@@ -61,6 +61,29 @@ def dq(w: Any, dtype: Any) -> jnp.ndarray:
     return w.astype(dtype) if w.dtype != dtype else w
 
 
+def qeinsum(spec: str, x: jnp.ndarray, w: Any) -> jnp.ndarray:
+    """Einsum against an optionally-quantized weight, scale applied to
+    the OUTPUT.
+
+    The decode path is weights-bound, so what matters is that int8 is the
+    only thing crossing HBM. ``dq()``'s operand-side expression
+    ``convert(int8)*broadcast(scale)`` is not reliably fused into the dot
+    by XLA:TPU — when it isn't, every step materializes the bf16 weight
+    (3× the traffic int8 was meant to save). Per-output-channel scales
+    commute with the contraction, so we contract against the bare
+    ``convert(int8)`` (which XLA does fuse into the MXU operand stream)
+    and multiply the [*, out] result by the scale — an elementwise op on
+    activations, not weights.
+
+    Requires ``spec`` to contract the weight's second-to-last axis and
+    end with its last axis (true of every matmul in the model).
+    """
+    if isinstance(w, QTensor):
+        out = jnp.einsum(spec, x, w.q.astype(x.dtype))
+        return out * w.scale.astype(x.dtype)
+    return jnp.einsum(spec, x, w.astype(x.dtype) if w.dtype != x.dtype else w)
+
+
 # parameter names quantized for the dense Llama family; MoE expert
 # weights keep bf16 for now (expert matmuls are already batched small)
 QUANTIZED_PARAMS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "lm_head")

@@ -1,0 +1,122 @@
+"""Kubernetes REST facade over :class:`MockKubeApi` for integration tests.
+
+The reference tests its k8s path against a fabric8 mock API server
+(``KubeTestServer.java:46``); this is the same idea: the real HTTP client
+(``deployer/kubeclient.py``) exercises create/replace/list/delete/patch
+semantics against an in-memory object store.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from aiohttp import web
+
+from langstream_tpu.deployer.kube import MockKubeApi
+from langstream_tpu.deployer.kubeclient import _KIND_ROUTES
+
+_PLURAL_TO_KIND = {
+    plural: kind for kind, (_prefix, plural) in _KIND_ROUTES.items()
+}
+
+
+class MockKubeRestServer:
+    """Serves the subset of the Kubernetes REST API the client uses."""
+
+    def __init__(self, kube: Optional[MockKubeApi] = None) -> None:
+        self.kube = kube or MockKubeApi()
+        self._runner = None
+        self.port: Optional[int] = None
+
+    async def start(self) -> int:
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", self._dispatch)
+        runner = web.AppRunner(app, access_log=None)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        self._runner = runner
+        self.port = site._server.sockets[0].getsockname()[1]  # noqa: SLF001
+        return self.port
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    async def _dispatch(self, request: web.Request) -> web.Response:
+        # path shapes:
+        #   /api/v1/namespaces/{ns}/{plural}[/{name}[/status]]
+        #   /apis/{group}/{version}/namespaces/{ns}/{plural}[/{name}[/status]]
+        #   /apis/{group}/{version}/{plural}  (cluster-scoped / all-ns list)
+        parts = [p for p in request.path.split("/") if p]
+        namespace = None
+        if "namespaces" in parts:
+            idx = parts.index("namespaces")
+            namespace = parts[idx + 1]
+            rest = parts[idx + 2:]
+        elif parts[0] == "api":
+            rest = parts[2:]
+        else:  # apis/{group}/{version}/...
+            rest = parts[3:]
+        if not rest:
+            return web.json_response({"message": "bad path"}, status=400)
+        plural = rest[0]
+        name = rest[1] if len(rest) > 1 else None
+        subresource = rest[2] if len(rest) > 2 else None
+        kind = _PLURAL_TO_KIND.get(plural)
+        if kind is None:
+            return web.json_response(
+                {"message": f"unknown resource {plural}"}, status=404
+            )
+        ns = namespace or "default"
+
+        if request.method == "GET" and name:
+            doc = self.kube.get(kind, ns, name)
+            if doc is None:
+                return web.json_response({"message": "not found"}, status=404)
+            return web.json_response(doc)
+        if request.method == "GET":
+            selector = request.query.get("labelSelector")
+            labels = None
+            if selector:
+                labels = dict(
+                    pair.split("=", 1) for pair in selector.split(",")
+                )
+            items = self.kube.list(
+                kind, namespace if namespace else None, labels
+            )
+            return web.json_response({"items": items})
+        if request.method == "POST":
+            doc = json.loads(await request.read())
+            key_name = doc.get("metadata", {}).get("name")
+            if self.kube.get(kind, ns, key_name) is not None:
+                return web.json_response(
+                    {"message": "already exists", "reason": "AlreadyExists"},
+                    status=409,
+                )
+            doc.setdefault("metadata", {}).setdefault("namespace", ns)
+            doc.setdefault("kind", kind)
+            return web.json_response(self.kube.apply(doc), status=201)
+        if request.method == "PUT" and name:
+            doc = json.loads(await request.read())
+            doc.setdefault("metadata", {}).setdefault("namespace", ns)
+            doc.setdefault("kind", kind)
+            return web.json_response(self.kube.apply(doc))
+        if request.method == "PATCH" and name and subresource == "status":
+            body = json.loads(await request.read())
+            doc = self.kube.patch_status(
+                kind, ns, name, body.get("status", {})
+            )
+            if doc is None:
+                return web.json_response({"message": "not found"}, status=404)
+            return web.json_response(doc)
+        if request.method == "DELETE" and name:
+            if not self.kube.delete(kind, ns, name):
+                return web.json_response({"message": "not found"}, status=404)
+            return web.json_response({"status": "Success"})
+        return web.json_response({"message": "unsupported"}, status=405)
