@@ -1,0 +1,3 @@
+from langstream_tpu.admin.client import AdminClient, AdminClientError
+
+__all__ = ["AdminClient", "AdminClientError"]

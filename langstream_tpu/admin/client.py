@@ -1,0 +1,231 @@
+"""Programmatic control-plane client.
+
+Reference: ``langstream-admin-client/src/main/java/ai/langstream/admin/
+client/AdminClient.java:42`` (HTTP client the CLI and operators embed:
+applications().deploy/update/get/delete/logs, tenants()). Speaks to
+``controlplane/webservice.py``'s REST surface; multipart deploy matches
+the webservice's ``app``/``instance``/``secrets`` fields.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+import aiohttp
+
+
+class AdminClientError(RuntimeError):
+    def __init__(self, status: int, message: str) -> None:
+        super().__init__(f"HTTP {status}: {message}")
+        self.status = status
+
+
+class AdminClient:
+    def __init__(
+        self,
+        base_url: str,
+        *,
+        tenant: str = "default",
+        token: Optional[str] = None,
+        timeout: float = 60.0,
+    ) -> None:
+        self.base_url = base_url.rstrip("/")
+        self.tenant = tenant
+        self.token = token
+        self.timeout = aiohttp.ClientTimeout(total=timeout)
+
+    def _headers(self) -> Dict[str, str]:
+        if self.token:
+            return {"Authorization": f"Bearer {self.token}"}
+        return {}
+
+    async def _request(
+        self,
+        method: str,
+        path: str,
+        *,
+        data: Any = None,
+        json_body: Any = None,
+        expect_bytes: bool = False,
+        expect_text: bool = False,
+        params: Optional[Dict[str, str]] = None,
+    ) -> Any:
+        url = f"{self.base_url}{path}"
+        async with aiohttp.ClientSession(timeout=self.timeout) as session:
+            async with session.request(
+                method, url, data=data, json=json_body,
+                headers=self._headers(), params=params,
+            ) as response:
+                if response.status >= 400:
+                    body = await response.text()
+                    raise AdminClientError(response.status, body)
+                if expect_bytes:
+                    return await response.read()
+                if expect_text:
+                    return await response.text()
+                return await response.json()
+
+    # -- applications (reference: AdminClient.applications()) ----------- #
+    async def deploy_application(
+        self,
+        application_id: str,
+        archive: bytes,
+        *,
+        instance_yaml: Optional[str] = None,
+        secrets_yaml: Optional[str] = None,
+        update: bool = False,
+        dry_run: bool = False,
+    ) -> Dict[str, Any]:
+        form = aiohttp.FormData()
+        form.add_field("app", archive, filename="app.zip",
+                       content_type="application/zip")
+        if instance_yaml is not None:
+            form.add_field("instance", instance_yaml)
+        if secrets_yaml is not None:
+            form.add_field("secrets", secrets_yaml)
+        params = {"dry-run": "true"} if dry_run else None
+        return await self._request(
+            "PUT" if update else "POST",
+            f"/api/applications/{self.tenant}/{application_id}",
+            data=form, params=params,
+        )
+
+    async def deploy_application_directory(
+        self, application_id: str, app_dir: str, **kwargs: Any
+    ) -> Dict[str, Any]:
+        """Zip an application directory client-side and deploy it; the
+        sibling ``instance.yaml``/``secrets.yaml`` conventions match the
+        reference CLI's ``apps deploy -app dir -i instance -s secrets``."""
+        from langstream_tpu.controlplane.service import zip_directory
+
+        archive = zip_directory(app_dir)
+        return await self.deploy_application(
+            application_id, archive, **kwargs
+        )
+
+    async def list_applications(self) -> List[Dict[str, Any]]:
+        return await self._request("GET", f"/api/applications/{self.tenant}")
+
+    async def get_application(self, application_id: str) -> Dict[str, Any]:
+        return await self._request(
+            "GET", f"/api/applications/{self.tenant}/{application_id}"
+        )
+
+    async def delete_application(self, application_id: str) -> Dict[str, Any]:
+        return await self._request(
+            "DELETE", f"/api/applications/{self.tenant}/{application_id}"
+        )
+
+    async def get_logs(self, application_id: str) -> str:
+        return await self._request(
+            "GET", f"/api/applications/{self.tenant}/{application_id}/logs",
+            expect_text=True,
+        )
+
+    async def download_code(self, application_id: str) -> bytes:
+        return await self._request(
+            "GET", f"/api/applications/{self.tenant}/{application_id}/code",
+            expect_bytes=True,
+        )
+
+    # -- tenants (reference: AdminClient.tenants()) --------------------- #
+    async def list_tenants(self) -> Dict[str, Any]:
+        return await self._request("GET", "/api/tenants")
+
+    async def get_tenant(self, name: str) -> Dict[str, Any]:
+        return await self._request("GET", f"/api/tenants/{name}")
+
+    async def put_tenant(
+        self, name: str, config: Optional[Dict[str, Any]] = None
+    ) -> Dict[str, Any]:
+        return await self._request(
+            "PUT", f"/api/tenants/{name}", json_body=config or {}
+        )
+
+    async def delete_tenant(self, name: str) -> Dict[str, Any]:
+        return await self._request("DELETE", f"/api/tenants/{name}")
+
+    # -- archetypes ----------------------------------------------------- #
+    async def list_archetypes(self) -> List[Dict[str, Any]]:
+        return await self._request("GET", f"/api/archetypes/{self.tenant}")
+
+    async def deploy_from_archetype(
+        self, archetype_id: str, application_id: str,
+        parameters: Optional[Dict[str, Any]] = None,
+    ) -> Dict[str, Any]:
+        return await self._request(
+            "POST",
+            f"/api/archetypes/{self.tenant}/{archetype_id}"
+            f"/applications/{application_id}",
+            json_body=parameters or {},
+        )
+
+
+# ---------------------------------------------------------------------- #
+# CLI profiles (reference: langstream-cli profiles + ~/.langstream/config)
+# ---------------------------------------------------------------------- #
+DEFAULT_CONFIG_PATH = os.path.expanduser("~/.langstream-tpu/config.json")
+
+
+def load_profiles(path: Optional[str] = None) -> Dict[str, Any]:
+    import json
+
+    path = path or os.environ.get(
+        "LANGSTREAM_CLI_CONFIG", DEFAULT_CONFIG_PATH
+    )
+    if not os.path.exists(path):
+        return {"profiles": {}, "current": None}
+    with open(path, "r", encoding="utf-8") as handle:
+        return json.load(handle)
+
+
+def save_profiles(config: Dict[str, Any], path: Optional[str] = None) -> None:
+    import json
+
+    path = path or os.environ.get(
+        "LANGSTREAM_CLI_CONFIG", DEFAULT_CONFIG_PATH
+    )
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w", encoding="utf-8") as handle:
+        json.dump(config, handle, indent=2)
+
+
+def resolve_profile(
+    profile: Optional[str] = None, path: Optional[str] = None
+) -> Dict[str, Any]:
+    """Pick the named (or current) profile; env vars win over the file
+    (LANGSTREAM_API_URL / LANGSTREAM_TENANT / LANGSTREAM_TOKEN)."""
+    config = load_profiles(path)
+    name = profile or config.get("current")
+    settings: Dict[str, Any] = {}
+    if name and name in config.get("profiles", {}):
+        settings = dict(config["profiles"][name])
+    if os.environ.get("LANGSTREAM_API_URL"):
+        settings["webServiceUrl"] = os.environ["LANGSTREAM_API_URL"]
+    if os.environ.get("LANGSTREAM_TENANT"):
+        settings["tenant"] = os.environ["LANGSTREAM_TENANT"]
+    if os.environ.get("LANGSTREAM_TOKEN"):
+        settings["token"] = os.environ["LANGSTREAM_TOKEN"]
+    return settings
+
+
+def client_from_profile(
+    profile: Optional[str] = None,
+    *,
+    url: Optional[str] = None,
+    tenant: Optional[str] = None,
+    token: Optional[str] = None,
+) -> AdminClient:
+    settings = resolve_profile(profile)
+    base_url = url or settings.get("webServiceUrl")
+    if not base_url:
+        raise SystemExit(
+            "no control plane configured: pass --api-url, set "
+            "LANGSTREAM_API_URL, or create a profile"
+        )
+    return AdminClient(
+        base_url,
+        tenant=tenant or settings.get("tenant", "default"),
+        token=token or settings.get("token"),
+    )

@@ -165,6 +165,115 @@ async def _gateway_consume(args) -> None:
 
 
 # ---------------------------------------------------------------------- #
+# control-plane commands (reference: RootCmd.java:38 apps/tenants/profiles)
+# ---------------------------------------------------------------------- #
+def _admin(args):
+    from langstream_tpu.admin.client import client_from_profile
+
+    return client_from_profile(
+        getattr(args, "profile", None),
+        url=getattr(args, "api_url", None),
+        tenant=getattr(args, "cp_tenant", None),
+        token=getattr(args, "token", None),
+    )
+
+
+def _print_json(doc) -> None:
+    print(json.dumps(doc, indent=2))
+
+
+async def _apps_deploy(args, update: bool) -> None:
+    client = _admin(args)
+    instance_yaml = secrets_yaml = None
+    if args.instance:
+        with open(args.instance) as handle:
+            instance_yaml = handle.read()
+    if args.secrets:
+        with open(args.secrets) as handle:
+            secrets_yaml = handle.read()
+    result = await client.deploy_application_directory(
+        args.app_id, args.app_dir,
+        instance_yaml=instance_yaml, secrets_yaml=secrets_yaml,
+        update=update, dry_run=args.dry_run,
+    )
+    _print_json(result)
+
+
+async def _apps_get(args) -> None:
+    _print_json(await _admin(args).get_application(args.app_id))
+
+
+async def _apps_list(args) -> None:
+    _print_json(await _admin(args).list_applications())
+
+
+async def _apps_delete(args) -> None:
+    _print_json(await _admin(args).delete_application(args.app_id))
+
+
+async def _apps_logs(args) -> None:
+    print(await _admin(args).get_logs(args.app_id), end="")
+
+
+async def _apps_download(args) -> None:
+    data = await _admin(args).download_code(args.app_id)
+    target = args.output or f"{args.app_id}.zip"
+    with open(target, "wb") as handle:
+        handle.write(data)
+    print(f"wrote {len(data)} bytes to {target}")
+
+
+async def _tenants_cmd(args) -> None:
+    client = _admin(args)
+    if args.tenants_command == "list":
+        _print_json(await client.list_tenants())
+    elif args.tenants_command == "get":
+        _print_json(await client.get_tenant(args.name))
+    elif args.tenants_command in ("put", "create"):
+        _print_json(await client.put_tenant(args.name))
+    elif args.tenants_command == "delete":
+        _print_json(await client.delete_tenant(args.name))
+
+
+def _profiles_cmd(args) -> None:
+    from langstream_tpu.admin.client import load_profiles, save_profiles
+
+    config = load_profiles()
+    if args.profiles_command == "list":
+        _print_json({
+            "current": config.get("current"),
+            "profiles": config.get("profiles", {}),
+        })
+    elif args.profiles_command == "create" or args.profiles_command == "update":
+        config.setdefault("profiles", {})[args.name] = {
+            "webServiceUrl": args.api_url,
+            "tenant": args.cp_tenant or "default",
+            **({"token": args.token} if args.token else {}),
+        }
+        if args.set_current or config.get("current") is None:
+            config["current"] = args.name
+        save_profiles(config)
+        print(f"profile {args.name} saved")
+    elif args.profiles_command == "get":
+        profile = config.get("profiles", {}).get(args.name)
+        if profile is None:
+            raise SystemExit(f"unknown profile {args.name!r}")
+        _print_json({args.name: profile})
+    elif args.profiles_command == "delete":
+        config.get("profiles", {}).pop(args.name, None)
+        if config.get("current") == args.name:
+            config["current"] = None
+        save_profiles(config)
+        print(f"profile {args.name} deleted")
+    elif args.profiles_command == "set-current":
+        if args.name not in config.get("profiles", {}):
+            raise SystemExit(f"unknown profile {args.name!r}")
+        config["current"] = args.name
+        save_profiles(config)
+        print(f"current profile: {args.name}")
+
+
+# ---------------------------------------------------------------------- #
 # broker
 # ---------------------------------------------------------------------- #
 async def _broker_serve(args) -> None:
@@ -220,6 +329,14 @@ def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(prog="langstream-tpu")
     sub = parser.add_subparsers(dest="command", required=True)
 
+    def add_admin_flags(cmd) -> None:
+        cmd.add_argument("--api-url", default=None,
+                         help="control-plane URL (or LANGSTREAM_API_URL)")
+        cmd.add_argument("--cp-tenant", default=None,
+                         help="control-plane tenant (default from profile)")
+        cmd.add_argument("--token", default=None)
+        cmd.add_argument("--profile", default=None)
+
     apps = sub.add_parser("apps", help="application commands")
     apps_sub = apps.add_subparsers(dest="apps_command", required=True)
     for name in ("run", "plan"):
@@ -230,6 +347,49 @@ def build_parser() -> argparse.ArgumentParser:
         if name == "run":
             cmd.add_argument("--gateway-port", type=int, default=8091)
             cmd.add_argument("--tenant", default="default")
+    # control-plane application commands (reference: apps deploy/update/...)
+    for name in ("deploy", "update"):
+        cmd = apps_sub.add_parser(name, help=f"{name} via the control plane")
+        cmd.add_argument("app_id")
+        cmd.add_argument("app_dir")
+        cmd.add_argument("-i", "--instance", default=None)
+        cmd.add_argument("-s", "--secrets", default=None)
+        cmd.add_argument("--dry-run", action="store_true")
+        add_admin_flags(cmd)
+    for name in ("get", "delete", "logs"):
+        cmd = apps_sub.add_parser(name)
+        cmd.add_argument("app_id")
+        add_admin_flags(cmd)
+    cmd = apps_sub.add_parser("list")
+    add_admin_flags(cmd)
+    cmd = apps_sub.add_parser("download", help="download the app's code zip")
+    cmd.add_argument("app_id")
+    cmd.add_argument("-o", "--output", default=None)
+    add_admin_flags(cmd)
+
+    tenants = sub.add_parser("tenants", help="tenant administration")
+    tenants_sub = tenants.add_subparsers(dest="tenants_command", required=True)
+    for name in ("list", "get", "put", "create", "delete"):
+        cmd = tenants_sub.add_parser(name)
+        if name != "list":
+            cmd.add_argument("name")
+        add_admin_flags(cmd)
+
+    profiles = sub.add_parser("profiles", help="control-plane profiles")
+    profiles_sub = profiles.add_subparsers(
+        dest="profiles_command", required=True
+    )
+    for name in ("create", "update"):
+        cmd = profiles_sub.add_parser(name)
+        cmd.add_argument("name")
+        cmd.add_argument("--api-url", required=True)
+        cmd.add_argument("--cp-tenant", default=None)
+        cmd.add_argument("--token", default=None)
+        cmd.add_argument("--set-current", action="store_true")
+    for name in ("get", "delete", "set-current"):
+        cmd = profiles_sub.add_parser(name)
+        cmd.add_argument("name")
+    profiles_sub.add_parser("list")
 
     gateway = sub.add_parser("gateway", help="gateway client commands")
     gateway_sub = gateway.add_subparsers(dest="gateway_command", required=True)
@@ -293,6 +453,22 @@ def main(argv: Optional[List[str]] = None) -> None:
         asyncio.run(_apps_run(args))
     elif args.command == "apps" and args.apps_command == "plan":
         _apps_plan(args)
+    elif args.command == "apps" and args.apps_command in ("deploy", "update"):
+        asyncio.run(_apps_deploy(args, update=args.apps_command == "update"))
+    elif args.command == "apps" and args.apps_command == "get":
+        asyncio.run(_apps_get(args))
+    elif args.command == "apps" and args.apps_command == "list":
+        asyncio.run(_apps_list(args))
+    elif args.command == "apps" and args.apps_command == "delete":
+        asyncio.run(_apps_delete(args))
+    elif args.command == "apps" and args.apps_command == "logs":
+        asyncio.run(_apps_logs(args))
+    elif args.command == "apps" and args.apps_command == "download":
+        asyncio.run(_apps_download(args))
+    elif args.command == "tenants":
+        asyncio.run(_tenants_cmd(args))
+    elif args.command == "profiles":
+        _profiles_cmd(args)
     elif args.command == "gateway" and args.gateway_command == "chat":
         asyncio.run(_gateway_chat(args))
     elif args.command == "gateway" and args.gateway_command == "produce":

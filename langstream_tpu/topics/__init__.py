@@ -85,11 +85,20 @@ def _make_tpulog(configuration: Dict[str, Any]) -> TopicConnectionsRuntime:
     return LogTopicConnectionsRuntime(root=str(directory))
 
 
+def _make_kafka(configuration: Dict[str, Any]) -> TopicConnectionsRuntime:
+    from langstream_tpu.topics.kafka.runtime import (
+        KafkaTopicConnectionsRuntime,
+    )
+
+    return KafkaTopicConnectionsRuntime(configuration)
+
+
 def _register_builtin() -> None:
     from langstream_tpu.topics.memory import MemoryTopicConnectionsRuntime
 
     register_topic_runtime("memory", lambda configuration=None: MemoryTopicConnectionsRuntime())
     register_topic_runtime("tpulog", _make_tpulog)
+    register_topic_runtime("kafka", _make_kafka)
 
 
 _register_builtin()

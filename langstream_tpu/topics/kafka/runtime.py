@@ -1,0 +1,555 @@
+"""Kafka implementation of the Topic SPI.
+
+Reference: ``KafkaTopicConnectionsRuntime.java:53`` (producer/consumer/
+reader/admin factories) and ``KafkaConsumerWrapper.java:52-230`` — the
+out-of-order ack bookkeeping is reproduced here: every delivered offset
+is tracked, acks land in a per-partition set, and the *committed* offset
+only advances across the contiguous prefix, so a crash never skips an
+in-flight record (at-least-once).
+
+Serialization: values/keys/headers use a typed envelope in one Kafka
+header (``ls-meta``) so Python payloads (str/bytes/dict/...) round-trip;
+foreign records (no envelope) decode as UTF-8 text, falling back to raw
+bytes — the same contract the reference gets from configurable Kafka
+serializers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import uuid
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+from langstream_tpu.api.records import Record, now_millis
+from langstream_tpu.api.topics import (
+    OffsetPosition,
+    TopicAdmin,
+    TopicConnectionsRuntime,
+    TopicConsumer,
+    TopicProducer,
+    TopicReader,
+    TopicSpec,
+)
+from langstream_tpu.topics.kafka import protocol as proto
+from langstream_tpu.topics.kafka.client import KafkaClient
+
+logger = logging.getLogger(__name__)
+
+EARLIEST, LATEST = -2, -1
+
+
+# ---------------------------------------------------------------------- #
+# record (de)serialization
+# ---------------------------------------------------------------------- #
+def _encode_payload(value: Any) -> Tuple[Optional[bytes], str]:
+    if value is None:
+        return None, "n"
+    if isinstance(value, bytes):
+        return value, "b"
+    if isinstance(value, str):
+        return value.encode("utf-8"), "s"
+    return json.dumps(value).encode("utf-8"), "j"
+
+
+def _decode_payload(data: Optional[bytes], kind: Optional[str]) -> Any:
+    if data is None or kind == "n":
+        return None
+    if kind == "b":
+        return data
+    if kind == "j":
+        return json.loads(data.decode("utf-8"))
+    if kind == "s":
+        return data.decode("utf-8")
+    try:  # foreign record: no envelope
+        return data.decode("utf-8")
+    except UnicodeDecodeError:
+        return data
+
+
+def encode_record(record: Record) -> Tuple[
+    Optional[bytes], Optional[bytes], List[Tuple[str, Optional[bytes]]]
+]:
+    key, key_kind = _encode_payload(record.key)
+    value, value_kind = _encode_payload(record.value)
+    headers: List[Tuple[str, Optional[bytes]]] = []
+    header_kinds: Dict[str, str] = {}
+    for name, hvalue in record.headers:
+        data, kind = _encode_payload(hvalue)
+        headers.append((name, data))
+        header_kinds[name] = kind
+    meta = json.dumps({"v": value_kind, "k": key_kind, "h": header_kinds})
+    headers.append(("ls-meta", meta.encode("utf-8")))
+    return key, value, headers
+
+
+def decode_record(
+    kafka_record: proto.KafkaRecord, topic: str
+) -> "KafkaRecordView":
+    kinds: Dict[str, Any] = {}
+    headers: List[Tuple[str, Any]] = []
+    raw_headers = []
+    for name, data in kafka_record.headers:
+        if name == "ls-meta" and data is not None:
+            try:
+                kinds = json.loads(data.decode("utf-8"))
+            except (ValueError, UnicodeDecodeError):
+                kinds = {}
+        else:
+            raw_headers.append((name, data))
+    header_kinds = kinds.get("h", {})
+    for name, data in raw_headers:
+        headers.append((name, _decode_payload(data, header_kinds.get(name))))
+    return KafkaRecordView(
+        value=_decode_payload(kafka_record.value, kinds.get("v")),
+        key=_decode_payload(kafka_record.key, kinds.get("k")),
+        origin=topic,
+        timestamp=kafka_record.timestamp,
+        headers=tuple(headers),
+        partition=-1,  # caller fills in
+        offset=kafka_record.offset,
+    )
+
+
+import dataclasses as _dataclasses
+
+
+@_dataclasses.dataclass(frozen=True)
+class KafkaRecordView(Record):
+    """A Record plus its Kafka coordinates (what commit() needs)."""
+
+    partition: int = -1
+    offset: int = -1
+
+
+# ---------------------------------------------------------------------- #
+# producer
+# ---------------------------------------------------------------------- #
+class KafkaTopicProducer(TopicProducer):
+    def __init__(self, client: KafkaClient, topic: str) -> None:
+        self._client = client
+        self._topic = topic
+        self._written = 0
+        self._round_robin = 0
+
+    @property
+    def topic(self) -> str:
+        return self._topic
+
+    async def start(self) -> None:
+        await self._client.partitions_for(self._topic)
+
+    async def write(self, record: Record) -> None:
+        partitions = await self._client.partitions_for(self._topic)
+        if not partitions:
+            raise proto.KafkaProtocolError(
+                proto.UNKNOWN_TOPIC_OR_PARTITION, self._topic
+            )
+        key, value, headers = encode_record(record)
+        if record.key is not None:
+            # stable key → partition affinity (session/KV locality rides
+            # partitioning, like the reference's keyed producer). crc32 is
+            # process-stable — Python's hash() is salted per interpreter
+            index = zlib.crc32(str(record.key).encode("utf-8")) % len(
+                partitions
+            )
+        else:
+            index = self._round_robin % len(partitions)
+            self._round_robin += 1
+        partition = partitions[index]
+        timestamp = record.timestamp or now_millis()
+        batch = proto.encode_record_batch([(key, value, headers, timestamp)])
+        await self._client.produce(self._topic, partition, batch)
+        self._written += 1
+
+    def total_in(self) -> int:
+        return self._written
+
+
+# ---------------------------------------------------------------------- #
+# consumer (group member, contiguous-watermark commit)
+# ---------------------------------------------------------------------- #
+class KafkaTopicConsumer(TopicConsumer):
+    def __init__(
+        self,
+        client: KafkaClient,
+        topic: str,
+        group: str,
+        *,
+        session_timeout_ms: int = 10000,
+        heartbeat_interval: float = 3.0,
+        auto_offset_reset: int = EARLIEST,
+    ) -> None:
+        self._client = client
+        self._topic = topic
+        self._group = group
+        self._session_timeout_ms = session_timeout_ms
+        self._heartbeat_interval = heartbeat_interval
+        self._auto_offset_reset = auto_offset_reset
+
+        self._coordinator: int = -1
+        self._member_id = ""
+        self._generation = -1
+        self._assignment: List[int] = []         # partitions of _topic
+        self._fetch_pos: Dict[int, int] = {}     # next offset to fetch
+        self._committed: Dict[int, int] = {}     # durable commit watermark
+        # delivered-but-unacked offsets per partition, plus the offset
+        # just past the last delivered record: the watermark is
+        # min(outstanding) or, with nothing outstanding, next-after-
+        # delivered. Using *delivered* offsets (not offset arithmetic)
+        # keeps gaps — compaction, transaction markers — from stalling it
+        self._outstanding: Dict[int, set] = {}
+        self._next_after_delivered: Dict[int, int] = {}
+        self._rejoin_needed = False
+        self._coord_conn = None  # dedicated coordinator channel
+        self._heartbeat_task: Optional[asyncio.Task] = None
+        self._fetch_cursor = 0
+        self._delivered = 0
+        self._started = False
+
+    # -- membership ----------------------------------------------------- #
+    async def start(self) -> None:
+        if self._started:
+            return
+        self._started = True
+        await self._join()
+        self._heartbeat_task = asyncio.get_running_loop().create_task(
+            self._heartbeat_loop()
+        )
+
+    async def _reconnect_coordinator(self) -> None:
+        if self._coord_conn is not None:
+            await self._coord_conn.close()
+        self._coordinator = await self._client.find_coordinator(self._group)
+        self._coord_conn = self._client.dedicated_connection(self._coordinator)
+
+    async def _join(self) -> None:
+        await self._reconnect_coordinator()
+        for attempt in range(10):
+            try:
+                joined = await self._client.join_group(
+                    self._coordinator, self._group, self._member_id,
+                    [self._topic],
+                    session_timeout_ms=self._session_timeout_ms,
+                    conn=self._coord_conn,
+                )
+            except proto.KafkaProtocolError as error:
+                if error.code == proto.MEMBER_ID_REQUIRED:
+                    # KIP-394: adopt the broker-assigned id for the retry
+                    self._member_id = getattr(error, "member_id", "") or ""
+                    continue
+                if error.code == proto.REBALANCE_IN_PROGRESS:
+                    await asyncio.sleep(0.1)
+                    continue
+                if error.code in (
+                    proto.NOT_COORDINATOR, proto.COORDINATOR_NOT_AVAILABLE,
+                ):
+                    await asyncio.sleep(0.2)
+                    await self._reconnect_coordinator()
+                    continue
+                if error.code == proto.UNKNOWN_MEMBER_ID:
+                    self._member_id = ""
+                    continue
+                raise
+            self._member_id = joined["member_id"]
+            self._generation = joined["generation"]
+            assignments = None
+            if joined["leader"] == self._member_id:
+                partitions_by_topic: Dict[str, int] = {}
+                for _mid, topics in joined["members"]:
+                    for topic in topics:
+                        partitions_by_topic[topic] = len(
+                            await self._client.partitions_for(topic)
+                        )
+                assignments = proto.range_assign(
+                    joined["members"], partitions_by_topic
+                )
+            try:
+                my_assignment = await self._client.sync_group(
+                    self._coordinator, self._group, self._generation,
+                    self._member_id, assignments, conn=self._coord_conn,
+                )
+            except proto.KafkaProtocolError as error:
+                if error.code in (
+                    proto.REBALANCE_IN_PROGRESS, proto.ILLEGAL_GENERATION,
+                ):
+                    continue
+                raise
+            self._assignment = sorted(my_assignment.get(self._topic, []))
+            await self._reset_positions()
+            self._rejoin_needed = False
+            logger.info(
+                "kafka consumer %s joined %s gen %d: partitions %s",
+                self._member_id, self._group, self._generation,
+                self._assignment,
+            )
+            return
+        raise proto.KafkaProtocolError(
+            proto.REBALANCE_IN_PROGRESS, f"join retries exhausted {self._group}"
+        )
+
+    async def _reset_positions(self) -> None:
+        """Start every assigned partition at the group's committed offset
+        (or auto reset); uncommitted in-flight work from before a
+        rebalance is redelivered — at-least-once."""
+        self._fetch_pos.clear()
+        self._committed.clear()
+        self._outstanding = {p: set() for p in self._assignment}
+        self._next_after_delivered = {}
+        if not self._assignment:
+            return
+        committed = await self._client.offset_fetch(
+            self._coordinator, self._group,
+            [(self._topic, p) for p in self._assignment],
+            conn=self._coord_conn,
+        )
+        for partition in self._assignment:
+            offset = committed.get((self._topic, partition), -1)
+            if offset < 0:
+                offset = await self._client.list_offset(
+                    self._topic, partition, self._auto_offset_reset
+                )
+            self._fetch_pos[partition] = offset
+            self._committed[partition] = offset
+            self._next_after_delivered[partition] = offset
+
+    async def _heartbeat_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self._heartbeat_interval)
+            try:
+                code = await self._client.heartbeat(
+                    self._coordinator, self._group, self._generation,
+                    self._member_id, conn=self._coord_conn,
+                )
+            except Exception:  # noqa: BLE001 — transient; retry next beat
+                continue
+            if code in (
+                proto.REBALANCE_IN_PROGRESS, proto.ILLEGAL_GENERATION,
+                proto.UNKNOWN_MEMBER_ID, proto.NOT_COORDINATOR,
+            ):
+                self._rejoin_needed = True
+
+    # -- data ------------------------------------------------------------ #
+    async def read(
+        self, max_records: int = 100, timeout: float = 0.1
+    ) -> List[Record]:
+        if not self._started:
+            await self.start()
+        if self._rejoin_needed:
+            if self._member_id:
+                self._generation = -1
+            await self._join()
+        if not self._assignment:
+            await asyncio.sleep(timeout)
+            return []
+        out: List[Record] = []
+        # round-robin over assigned partitions for fairness
+        for i in range(len(self._assignment)):
+            partition = self._assignment[
+                (self._fetch_cursor + i) % len(self._assignment)
+            ]
+            records, _hw = await self._client.fetch(
+                self._topic, partition, self._fetch_pos[partition],
+                max_wait_ms=int(timeout * 1000),
+            )
+            for kafka_record in records:
+                if kafka_record.offset < self._fetch_pos[partition]:
+                    continue  # batch replay below requested offset
+                view = decode_record(kafka_record, self._topic)
+                view = _dataclasses.replace(view, partition=partition)
+                out.append(view)
+                self._fetch_pos[partition] = kafka_record.offset + 1
+                self._outstanding.setdefault(partition, set()).add(
+                    kafka_record.offset
+                )
+                self._next_after_delivered[partition] = (
+                    kafka_record.offset + 1
+                )
+                if len(out) >= max_records:
+                    break
+            if out:
+                self._fetch_cursor = (
+                    self._fetch_cursor + i + 1
+                ) % len(self._assignment)
+                break
+        self._delivered += len(out)
+        return out
+
+    async def commit(self, records: List[Record]) -> None:
+        """Out-of-order acks allowed; durable offset = contiguous prefix
+        (KafkaConsumerWrapper.java:52-230 semantics)."""
+        to_commit: Dict[Tuple[str, int], int] = {}
+        for record in records:
+            if not isinstance(record, KafkaRecordView):
+                raise ValueError(
+                    f"cannot commit a non-kafka record: {record!r}"
+                )
+            if record.partition not in self._outstanding:
+                # partition reassigned away mid-flight: the new owner's
+                # watermark is authoritative; committing here would
+                # regress the group offset
+                logger.info(
+                    "dropping stale ack for %s/%d (not assigned)",
+                    self._topic, record.partition,
+                )
+                continue
+            outstanding = self._outstanding[record.partition]
+            outstanding.discard(record.offset)
+            watermark = (
+                min(outstanding)
+                if outstanding
+                else self._next_after_delivered.get(record.partition, 0)
+            )
+            if watermark > self._committed.get(record.partition, -1):
+                self._committed[record.partition] = watermark
+                to_commit[(self._topic, record.partition)] = watermark
+        if to_commit and self._generation >= 0:
+            await self._client.offset_commit(
+                self._coordinator, self._group, self._generation,
+                self._member_id, to_commit, conn=self._coord_conn,
+            )
+
+    def committed_offsets(self) -> Dict[int, int]:
+        return dict(self._committed)
+
+    async def close(self) -> None:
+        if self._heartbeat_task is not None:
+            self._heartbeat_task.cancel()
+            try:
+                await self._heartbeat_task
+            except asyncio.CancelledError:
+                pass
+            self._heartbeat_task = None
+        if self._member_id and self._coordinator >= 0:
+            await self._client.leave_group(
+                self._coordinator, self._group, self._member_id,
+                conn=self._coord_conn,
+            )
+        if self._coord_conn is not None:
+            await self._coord_conn.close()
+            self._coord_conn = None
+        self._started = False
+
+    def total_out(self) -> int:
+        return self._delivered
+
+
+# ---------------------------------------------------------------------- #
+# reader (group-less tail)
+# ---------------------------------------------------------------------- #
+class KafkaTopicReader(TopicReader):
+    def __init__(
+        self, client: KafkaClient, topic: str, position: OffsetPosition
+    ) -> None:
+        self._client = client
+        self._topic = topic
+        self._position = position
+        self._offsets: Dict[int, int] = {}
+
+    async def start(self) -> None:
+        timestamp = (
+            EARLIEST if self._position == OffsetPosition.EARLIEST else LATEST
+        )
+        for partition in await self._client.partitions_for(self._topic):
+            self._offsets[partition] = await self._client.list_offset(
+                self._topic, partition, timestamp
+            )
+
+    async def read(
+        self, max_records: int = 100, timeout: float = 0.1
+    ) -> List[Record]:
+        if not self._offsets:
+            await self.start()
+        out: List[Record] = []
+        for partition, offset in list(self._offsets.items()):
+            records, _hw = await self._client.fetch(
+                self._topic, partition, offset,
+                max_wait_ms=int(timeout * 1000),
+            )
+            for kafka_record in records:
+                if kafka_record.offset < self._offsets[partition]:
+                    continue
+                view = decode_record(kafka_record, self._topic)
+                out.append(_dataclasses.replace(view, partition=partition))
+                self._offsets[partition] = kafka_record.offset + 1
+                if len(out) >= max_records:
+                    return out
+        return out
+
+
+# ---------------------------------------------------------------------- #
+# admin + runtime
+# ---------------------------------------------------------------------- #
+class KafkaTopicAdmin(TopicAdmin):
+    def __init__(self, client: KafkaClient, replication: int = 1) -> None:
+        self._client = client
+        self._replication = replication
+
+    async def create_topic(self, spec: TopicSpec) -> None:
+        await self._client.create_topic(
+            spec.name, max(1, spec.partitions), self._replication
+        )
+
+    async def delete_topic(self, name: str) -> None:
+        await self._client.delete_topic(name)
+
+
+class KafkaTopicConnectionsRuntime(TopicConnectionsRuntime):
+    """``streamingCluster: {type: kafka, configuration: {bootstrapServers:
+    host:port, ...}}`` (the reference accepts ``admin.bootstrap.servers``
+    too — both spellings are honored here)."""
+
+    def __init__(self, configuration: Optional[Dict[str, Any]] = None) -> None:
+        configuration = configuration or {}
+        admin = configuration.get("admin") or {}
+        bootstrap = (
+            configuration.get("bootstrapServers")
+            or configuration.get("bootstrap_servers")
+            or configuration.get("bootstrap.servers")
+            or admin.get("bootstrap.servers")
+            or admin.get("bootstrapServers")
+            or "127.0.0.1:9092"
+        )
+        self.configuration = configuration
+        self._client = KafkaClient(
+            bootstrap,
+            client_id=configuration.get("clientId", "langstream-tpu"),
+        )
+        self._replication = int(configuration.get("replicationFactor", 1))
+
+    def create_consumer(
+        self, agent_id: str, config: Dict[str, Any]
+    ) -> TopicConsumer:
+        return KafkaTopicConsumer(
+            self._client,
+            config["topic"],
+            config.get("group") or f"langstream-{agent_id}",
+            session_timeout_ms=int(
+                self.configuration.get("sessionTimeoutMs", 10000)
+            ),
+            auto_offset_reset=(
+                LATEST
+                if self.configuration.get("autoOffsetReset") == "latest"
+                else EARLIEST
+            ),
+        )
+
+    def create_producer(
+        self, agent_id: str, config: Dict[str, Any]
+    ) -> TopicProducer:
+        return KafkaTopicProducer(self._client, config["topic"])
+
+    def create_reader(
+        self,
+        config: Dict[str, Any],
+        initial_position: OffsetPosition = OffsetPosition.LATEST,
+    ) -> TopicReader:
+        return KafkaTopicReader(self._client, config["topic"], initial_position)
+
+    def create_admin(self) -> TopicAdmin:
+        return KafkaTopicAdmin(self._client, self._replication)
+
+    async def close(self) -> None:
+        await self._client.close()

@@ -1,0 +1,161 @@
+"""CLI ⇄ control-plane round trip: ``apps deploy`` through the admin
+client and webservice reaches the operator and produces pod manifests;
+``apps get/list/logs/delete`` and ``tenants``/``profiles`` complete the
+reference CLI surface (``RootCmd.java:38``, ``AdminClient.java:42``)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+
+import pytest
+
+from langstream_tpu.cli.main import main as cli_main
+from langstream_tpu.controlplane import (
+    ApplicationService,
+    GlobalMetadataStore,
+    InMemoryApplicationStore,
+    TenantService,
+)
+from langstream_tpu.controlplane.codestorage import InMemoryCodeStorage
+from langstream_tpu.controlplane.webservice import ControlPlaneWebService
+from langstream_tpu.deployer.kube import MockKubeApi
+from langstream_tpu.deployer.operator import KubernetesExecutor, Operator
+
+PIPELINE = """
+topics:
+  - name: input-topic
+    creation-mode: create-if-not-exists
+  - name: output-topic
+    creation-mode: create-if-not-exists
+pipeline:
+  - name: "upper"
+    id: "upper"
+    type: compute
+    input: input-topic
+    output: output-topic
+    configuration:
+      fields:
+        - name: value.text
+          expression: "fn:uppercase(value.text)"
+"""
+
+INSTANCE = """
+instance:
+  streamingCluster:
+    type: memory
+  computeCluster:
+    type: kubernetes
+"""
+
+
+@pytest.fixture()
+def control_plane():
+    kube = MockKubeApi()
+    operator = Operator(kube)
+    executor = KubernetesExecutor(kube, operator)
+    tenants = TenantService(GlobalMetadataStore())
+    tenants.create("default")
+    service = ApplicationService(
+        InMemoryApplicationStore(), InMemoryCodeStorage(), tenants,
+        executor=executor,
+    )
+    loop = asyncio.new_event_loop()
+    thread = threading.Thread(target=loop.run_forever, daemon=True)
+    thread.start()
+    ws = ControlPlaneWebService(service)
+    port = asyncio.run_coroutine_threadsafe(
+        ws.start("127.0.0.1", 0), loop
+    ).result(timeout=10)
+    try:
+        yield f"http://127.0.0.1:{port}", kube
+    finally:
+        asyncio.run_coroutine_threadsafe(ws.stop(), loop).result(timeout=10)
+        loop.call_soon_threadsafe(loop.stop)
+        thread.join(timeout=10)
+
+
+def _write_app(tmp_path):
+    app_dir = tmp_path / "app"
+    app_dir.mkdir()
+    (app_dir / "pipeline.yaml").write_text(PIPELINE)
+    instance = tmp_path / "instance.yaml"
+    instance.write_text(INSTANCE)
+    return str(app_dir), str(instance)
+
+
+def test_cli_deploy_roundtrip(tmp_path, capsys, monkeypatch, control_plane):
+    url, kube = control_plane
+    monkeypatch.setenv("LANGSTREAM_CLI_CONFIG", str(tmp_path / "cli.json"))
+    app_dir, instance = _write_app(tmp_path)
+
+    cli_main(["profiles", "create", "local", "--api-url", url,
+              "--set-current"])
+    cli_main(["profiles", "list"])
+    assert "local" in capsys.readouterr().out
+
+    cli_main(["apps", "deploy", "cliapp", app_dir, "-i", instance])
+    deployed = json.loads(capsys.readouterr().out)
+    assert deployed["application-id"] == "cliapp"
+
+    # the operator turned the CR into pod manifests (mock kube)
+    statefulsets = kube.list("StatefulSet", "default")
+    assert statefulsets, "operator produced no StatefulSet"
+    assert statefulsets[0]["metadata"]["name"].startswith("cliapp-")
+
+    cli_main(["apps", "list"])
+    listed = json.loads(capsys.readouterr().out)
+    assert [app["application-id"] for app in listed] == ["cliapp"]
+
+    cli_main(["apps", "get", "cliapp"])
+    got = json.loads(capsys.readouterr().out)
+    assert got["application-id"] == "cliapp"
+
+    cli_main(["apps", "logs", "cliapp"])
+    logs = capsys.readouterr().out
+    assert "cliapp" in logs
+
+    cli_main(["apps", "download", "cliapp",
+              "-o", str(tmp_path / "code.zip")])
+    assert (tmp_path / "code.zip").stat().st_size > 0
+
+    cli_main(["apps", "delete", "cliapp"])
+    capsys.readouterr()
+    assert kube.list("StatefulSet", "default") == []
+
+    cli_main(["apps", "list"])
+    assert json.loads(capsys.readouterr().out) == []
+
+
+def test_cli_tenants(tmp_path, capsys, monkeypatch, control_plane):
+    url, _kube = control_plane
+    monkeypatch.setenv("LANGSTREAM_CLI_CONFIG", str(tmp_path / "cli.json"))
+    monkeypatch.setenv("LANGSTREAM_API_URL", url)
+
+    cli_main(["tenants", "put", "team-a"])
+    capsys.readouterr()
+    cli_main(["tenants", "list"])
+    tenants = json.loads(capsys.readouterr().out)
+    assert "team-a" in tenants and "default" in tenants
+    cli_main(["tenants", "delete", "team-a"])
+    capsys.readouterr()
+    cli_main(["tenants", "list"])
+    assert "team-a" not in json.loads(capsys.readouterr().out)
+
+
+def test_profile_env_overrides(tmp_path, monkeypatch):
+    from langstream_tpu.admin.client import resolve_profile, save_profiles
+
+    path = str(tmp_path / "cli.json")
+    monkeypatch.setenv("LANGSTREAM_CLI_CONFIG", path)
+    save_profiles({
+        "profiles": {"p": {"webServiceUrl": "http://file", "tenant": "t1"}},
+        "current": "p",
+    })
+    assert resolve_profile()["webServiceUrl"] == "http://file"
+    monkeypatch.setenv("LANGSTREAM_API_URL", "http://env")
+    monkeypatch.setenv("LANGSTREAM_TENANT", "t2")
+    settings = resolve_profile()
+    assert settings["webServiceUrl"] == "http://env"
+    assert settings["tenant"] == "t2"

@@ -1,0 +1,340 @@
+"""Kafka runtime tests: protocol codecs, client⇄broker contract over real
+TCP (the in-process Kafka-protocol facade by default, a real cluster when
+``KAFKA_BOOTSTRAP`` is set), and an unchanged YAML app running with
+``streamingCluster: kafka``.
+
+Reference test model: ``AbstractApplicationRunner`` boots an embedded
+Kafka; here the facade (``topics/kafka/server.py``) plays that role.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import os
+import textwrap
+
+import pytest
+
+from langstream_tpu.api.records import Record
+from langstream_tpu.api.topics import OffsetPosition, TopicSpec
+from langstream_tpu.topics.kafka import protocol as proto
+from langstream_tpu.topics.kafka.runtime import (
+    KafkaRecordView,
+    KafkaTopicConnectionsRuntime,
+)
+from langstream_tpu.topics.kafka.server import serve_kafka_facade
+
+EXTERNAL = os.environ.get("KAFKA_BOOTSTRAP")
+
+
+# --------------------------------------------------------------------- #
+# protocol unit tests
+# --------------------------------------------------------------------- #
+def test_crc32c_standard_vector():
+    # the canonical CRC-32C check value (RFC 3720 appendix B / every
+    # published implementation)
+    assert proto.crc32c(b"123456789") == 0xE3069283
+    assert proto.crc32c(b"") == 0
+
+
+def test_varint_zigzag_roundtrip():
+    for value in (0, 1, -1, 63, -64, 300, -300, 2**31 - 1, -(2**31)):
+        data = proto.Writer().varint(value).build()
+        assert proto.Reader(data).varint() == value
+    for value in (0, -1, 2**62, -(2**62)):
+        data = proto.Writer().varlong(value).build()
+        assert proto.Reader(data).varlong() == value
+
+
+def test_record_batch_roundtrip():
+    records = [
+        (b"k1", b"v1", [("h", b"x")], 1000),
+        (None, b"v2", [], 1005),
+        (b"k3", None, [("a", None), ("b", b"bb")], 1010),
+    ]
+    batch = proto.encode_record_batch(records, base_offset=42)
+    decoded = proto.decode_record_batches(batch)
+    assert [r.offset for r in decoded] == [42, 43, 44]
+    assert [r.timestamp for r in decoded] == [1000, 1005, 1010]
+    assert decoded[0].key == b"k1" and decoded[0].value == b"v1"
+    assert decoded[1].key is None
+    assert decoded[2].value is None
+    assert decoded[2].headers == [("a", None), ("b", b"bb")]
+    # truncated tail batch is skipped, not an error (Fetch semantics)
+    assert len(proto.decode_record_batches(batch[:-5])) == 0
+
+
+def test_range_assignor():
+    members = [("m2", ["t"]), ("m1", ["t"])]
+    out = proto.range_assign(members, {"t": 5})
+    assert out["m1"]["t"] == [0, 1, 2]
+    assert out["m2"]["t"] == [3, 4]
+
+
+# --------------------------------------------------------------------- #
+# broker-backed contract tests
+# --------------------------------------------------------------------- #
+@contextlib.asynccontextmanager
+async def kafka_runtime(n_partitions: int = 1, topic: str = "t1"):
+    facade = None
+    if EXTERNAL:
+        bootstrap = EXTERNAL
+    else:
+        facade = await serve_kafka_facade()
+        bootstrap = facade.bootstrap
+    runtime = KafkaTopicConnectionsRuntime({"bootstrapServers": bootstrap})
+    admin = runtime.create_admin()
+    await admin.create_topic(TopicSpec(name=topic, partitions=n_partitions))
+    try:
+        yield runtime
+    finally:
+        await runtime.close()
+        if facade is not None:
+            await facade.close()
+
+
+def test_produce_fetch_roundtrip():
+    async def main():
+        async with kafka_runtime() as runtime:
+            producer = runtime.create_producer("p", {"topic": "t1"})
+            await producer.start()
+            await producer.write(Record(value="hello", key="k"))
+            await producer.write(Record(
+                value={"a": 1}, headers=(("h", "x"), ("raw", b"\x00\x01")),
+            ))
+            reader = runtime.create_reader(
+                {"topic": "t1"}, OffsetPosition.EARLIEST
+            )
+            await reader.start()
+            out = []
+            for _ in range(50):
+                out.extend(await reader.read(timeout=0.2))
+                if len(out) >= 2:
+                    break
+            assert out[0].value == "hello" and out[0].key == "k"
+            assert out[1].value == {"a": 1}
+            assert out[1].header("h") == "x"
+            assert out[1].header("raw") == b"\x00\x01"
+            assert producer.total_in() == 2
+
+    asyncio.run(main())
+
+
+def test_reader_latest_skips_history():
+    async def main():
+        async with kafka_runtime() as runtime:
+            producer = runtime.create_producer("p", {"topic": "t1"})
+            await producer.write(Record(value="old"))
+            reader = runtime.create_reader(
+                {"topic": "t1"}, OffsetPosition.LATEST
+            )
+            await reader.start()
+            assert await reader.read(timeout=0.1) == []
+            await producer.write(Record(value="new"))
+            out = []
+            for _ in range(50):
+                out.extend(await reader.read(timeout=0.2))
+                if out:
+                    break
+            assert [r.value for r in out] == ["new"]
+
+    asyncio.run(main())
+
+
+def test_consumer_contiguous_watermark_commit():
+    """Out-of-order acks must not move the committed offset past an
+    unacked record (KafkaConsumerWrapper.java:52-230 semantics)."""
+
+    async def main():
+        async with kafka_runtime() as runtime:
+            producer = runtime.create_producer("p", {"topic": "t1"})
+            for i in range(4):
+                await producer.write(Record(value=f"r{i}"))
+            consumer = runtime.create_consumer(
+                "a", {"topic": "t1", "group": "g1"}
+            )
+            await consumer.start()
+            got = []
+            for _ in range(100):
+                got.extend(await consumer.read(timeout=0.2))
+                if len(got) >= 4:
+                    break
+            assert [r.value for r in got] == ["r0", "r1", "r2", "r3"]
+            # ack 1,2,3 but NOT 0: watermark must stay at 0
+            await consumer.commit([got[1], got[2], got[3]])
+            assert consumer.committed_offsets()[got[0].partition] == 0
+            # acking 0 releases the whole contiguous prefix
+            await consumer.commit([got[0]])
+            assert consumer.committed_offsets()[got[0].partition] == 4
+            await consumer.close()
+
+            # a new member of the same group resumes from the watermark
+            consumer2 = runtime.create_consumer(
+                "a", {"topic": "t1", "group": "g1"}
+            )
+            await consumer2.start()
+            await producer.write(Record(value="r4"))
+            got2 = []
+            for _ in range(100):
+                got2.extend(await consumer2.read(timeout=0.2))
+                if got2:
+                    break
+            assert [r.value for r in got2] == ["r4"]
+            await consumer2.close()
+
+    asyncio.run(main())
+
+
+def test_uncommitted_records_redelivered_to_new_member():
+    async def main():
+        async with kafka_runtime() as runtime:
+            producer = runtime.create_producer("p", {"topic": "t1"})
+            for i in range(3):
+                await producer.write(Record(value=f"r{i}"))
+            consumer = runtime.create_consumer(
+                "a", {"topic": "t1", "group": "g1"}
+            )
+            await consumer.start()
+            got = []
+            for _ in range(100):
+                got.extend(await consumer.read(timeout=0.2))
+                if len(got) >= 3:
+                    break
+            await consumer.commit([got[0]])  # r1, r2 stay in flight
+            await consumer.close()
+
+            consumer2 = runtime.create_consumer(
+                "a", {"topic": "t1", "group": "g1"}
+            )
+            await consumer2.start()
+            got2 = []
+            for _ in range(100):
+                got2.extend(await consumer2.read(timeout=0.2))
+                if len(got2) >= 2:
+                    break
+            assert [r.value for r in got2] == ["r1", "r2"]
+            await consumer2.close()
+
+    asyncio.run(main())
+
+
+@pytest.mark.slow
+def test_two_members_split_partitions():
+    async def main():
+        async with kafka_runtime(n_partitions=2, topic="t2") as runtime:
+            consumer_a = runtime.create_consumer(
+                "a", {"topic": "t2", "group": "g2"}
+            )
+            consumer_b = runtime.create_consumer(
+                "b", {"topic": "t2", "group": "g2"}
+            )
+            # concurrent joins land in one rebalance generation
+            await asyncio.gather(consumer_a.start(), consumer_b.start())
+            for _ in range(200):
+                if (
+                    len(consumer_a._assignment) == 1
+                    and len(consumer_b._assignment) == 1
+                ):
+                    break
+                await asyncio.gather(
+                    consumer_a.read(timeout=0.05),
+                    consumer_b.read(timeout=0.05),
+                )
+            assert sorted(
+                consumer_a._assignment + consumer_b._assignment
+            ) == [0, 1]
+
+            producer = runtime.create_producer("p", {"topic": "t2"})
+            for i in range(8):
+                await producer.write(Record(value=f"r{i}", key=f"k{i}"))
+            got = []
+            for _ in range(200):
+                batches = await asyncio.gather(
+                    consumer_a.read(timeout=0.1),
+                    consumer_b.read(timeout=0.1),
+                )
+                got.extend(batches[0])
+                got.extend(batches[1])
+                if len(got) >= 8:
+                    break
+            assert sorted(r.value for r in got) == [
+                f"r{i}" for i in range(8)
+            ]
+            await consumer_a.close()
+            await consumer_b.close()
+
+    asyncio.run(main())
+
+
+# --------------------------------------------------------------------- #
+# the YAML app, unchanged, on streamingCluster kafka
+# --------------------------------------------------------------------- #
+PIPELINE = """
+    topics:
+      - name: "in"
+        creation-mode: create-if-not-exists
+      - name: "out"
+        creation-mode: create-if-not-exists
+    pipeline:
+      - id: "shout"
+        type: "python-processor"
+        input: "in"
+        output: "out"
+        configuration:
+          className: "shout_agent.Shout"
+"""
+
+AGENT = """
+    class Shout:
+        def process(self, record):
+            return [record.value.upper() + "!"]
+"""
+
+
+@pytest.mark.slow
+def test_app_runs_unchanged_on_kafka(tmp_path):
+    from langstream_tpu.runtime.local import run_application
+
+    app_dir = tmp_path / "app"
+    (app_dir / "python").mkdir(parents=True)
+    (app_dir / "pipeline.yaml").write_text(textwrap.dedent(PIPELINE))
+    (app_dir / "python" / "shout_agent.py").write_text(
+        textwrap.dedent(AGENT)
+    )
+
+    async def main():
+        facade = None
+        if EXTERNAL:
+            bootstrap = EXTERNAL
+        else:
+            facade = await serve_kafka_facade()
+            bootstrap = facade.bootstrap
+        (tmp_path / "instance.yaml").write_text(textwrap.dedent(f"""
+            instance:
+              streamingCluster:
+                type: kafka
+                configuration:
+                  bootstrapServers: "{bootstrap}"
+        """))
+        runner = await run_application(
+            str(app_dir), instance_file=str(tmp_path / "instance.yaml")
+        )
+        try:
+            producer = runner.producer("in")
+            await producer.start()
+            await producer.write(Record(value="hello"))
+            reader = runner.reader("out")
+            await reader.start()
+            out = []
+            for _ in range(150):
+                out.extend(await reader.read(timeout=0.2))
+                if out:
+                    break
+            assert out and out[0].value == "HELLO!"
+        finally:
+            await runner.stop()
+            if facade is not None:
+                await facade.close()
+
+    asyncio.run(main())
