@@ -245,11 +245,18 @@ def _profiles_cmd(args) -> None:
             "profiles": config.get("profiles", {}),
         })
     elif args.profiles_command == "create" or args.profiles_command == "update":
-        config.setdefault("profiles", {})[args.name] = {
-            "webServiceUrl": args.api_url,
-            "tenant": args.cp_tenant or "default",
-            **({"token": args.token} if args.token else {}),
-        }
+        # update merges: omitted flags keep their stored values
+        existing = config.get("profiles", {}).get(args.name, {})
+        profile = dict(existing) if args.profiles_command == "update" else {}
+        if args.api_url:
+            profile["webServiceUrl"] = args.api_url
+        if args.cp_tenant:
+            profile["tenant"] = args.cp_tenant
+        elif "tenant" not in profile:
+            profile["tenant"] = "default"
+        if args.token:
+            profile["token"] = args.token
+        config.setdefault("profiles", {})[args.name] = profile
         if args.set_current or config.get("current") is None:
             config["current"] = args.name
         save_profiles(config)
@@ -382,7 +389,7 @@ def build_parser() -> argparse.ArgumentParser:
     for name in ("create", "update"):
         cmd = profiles_sub.add_parser(name)
         cmd.add_argument("name")
-        cmd.add_argument("--api-url", required=True)
+        cmd.add_argument("--api-url", required=name == "create")
         cmd.add_argument("--cp-tenant", default=None)
         cmd.add_argument("--token", default=None)
         cmd.add_argument("--set-current", action="store_true")

@@ -17,7 +17,9 @@ import os
 import re
 from typing import Any, Dict
 
-_PLACEHOLDER = re.compile(r"\$\{\s*([a-zA-Z0-9_.\-]+)\s*\}")
+_PLACEHOLDER = re.compile(
+    r"\$\{\s*([a-zA-Z0-9_.\-]+)\s*(?::-([^}]*))?\}"
+)
 _ENV = re.compile(r"\$\{(?P<name>[A-Za-z_][A-Za-z0-9_]*)(?::-(?P<default>[^}]*))?\}")
 
 
@@ -41,12 +43,19 @@ def resolve_env(value: str) -> str:
     return _ENV.sub(sub, value)
 
 
-def _lookup(context: Dict[str, Any], dotted: str) -> Any:
+def _lookup(
+    context: Dict[str, Any], dotted: str, default: Any = None,
+    has_default: bool = False,
+) -> Any:
     node: Any = context
     for part in dotted.split("."):
         if isinstance(node, dict) and part in node:
             node = node[part]
         else:
+            if has_default:
+                # ``${globals.key:-fallback}`` — same shell-style default
+                # spelling secrets values already support (resolve_env)
+                return default
             raise PlaceholderError(f"unresolved placeholder: ${{{dotted}}}")
     return node
 
@@ -56,10 +65,16 @@ def resolve_value(value: Any, context: Dict[str, Any]) -> Any:
         # whole-string placeholder keeps the native type of the target
         whole = _PLACEHOLDER.fullmatch(value.strip())
         if whole:
-            return _lookup(context, whole.group(1))
+            return _lookup(
+                context, whole.group(1), whole.group(2),
+                has_default=whole.group(2) is not None,
+            )
 
         def sub(match: "re.Match[str]") -> str:
-            return str(_lookup(context, match.group(1)))
+            return str(_lookup(
+                context, match.group(1), match.group(2),
+                has_default=match.group(2) is not None,
+            ))
 
         return _PLACEHOLDER.sub(sub, value)
     if isinstance(value, dict):

@@ -73,7 +73,13 @@ class KafkaConnection:
                 payload = await asyncio.wait_for(
                     self._reader.readexactly(size), timeout
                 )
-            except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            except (
+                asyncio.IncompleteReadError, asyncio.TimeoutError,
+                ConnectionError, OSError,
+            ):
+                # a timed-out request leaves its response in flight; the
+                # connection is desynced — drop it so the next call
+                # reconnects instead of reading the stale frame
                 await self.close()
                 raise
             reader = Reader(payload)
@@ -103,8 +109,12 @@ class KafkaClient:
     ) -> None:
         self.bootstrap: List[Tuple[str, int]] = []
         for part in bootstrap_servers.split(","):
-            host, _, port = part.strip().rpartition(":")
-            self.bootstrap.append((host or "127.0.0.1", int(port)))
+            part = part.strip()
+            if ":" in part:
+                host, _, port = part.rpartition(":")
+                self.bootstrap.append((host or "127.0.0.1", int(port)))
+            else:
+                self.bootstrap.append((part, 9092))  # Kafka default port
         self.client_id = client_id
         self.brokers: Dict[int, BrokerInfo] = {}
         self.controller_id: int = -1
@@ -242,52 +252,80 @@ class KafkaClient:
         max_wait_ms: int = 100, min_bytes: int = 1,
         max_bytes: int = 4 * 1024 * 1024,
     ) -> Tuple[List[proto.KafkaRecord], int]:
-        """Returns (records, high_watermark)."""
-        leader = await self.leader_for(topic, partition)
-        body = (
-            Writer()
-            .int32(-1)           # replica id
-            .int32(max_wait_ms)
-            .int32(min_bytes)
-            .int32(max_bytes)
-            .int8(0)             # isolation level: read uncommitted
-            .array([None], lambda w, _: (
-                w.string(topic),
-                w.array([None], lambda w2, _2: (
-                    w2.int32(partition),
-                    w2.int64(offset),
-                    w2.int32(max_bytes),
-                )),
-            ))
-            .build()
+        """Single-partition fetch → (records, high_watermark)."""
+        result = await self.fetch_multi(
+            topic, {partition: offset}, max_wait_ms=max_wait_ms,
+            min_bytes=min_bytes, max_bytes=max_bytes,
         )
-        reader = await self.node_connection(leader).call(
-            proto.FETCH, 4, body, timeout=max(30.0, max_wait_ms / 1000 + 30)
-        )
-        reader.int32()  # throttle
-        records: List[proto.KafkaRecord] = []
-        high_watermark = -1
-        for _ in range(reader.int32()):
-            reader.string()
-            for _p in range(reader.int32()):
-                reader.int32()
-                error = reader.int16()
-                high_watermark = reader.int64()
-                reader.int64()  # last stable offset
-                aborted = reader.int32()
-                for _a in range(max(0, aborted)):
-                    reader.int64()
-                    reader.int64()
-                record_set = reader.bytes_()
-                if error == proto.NONE and record_set:
-                    records.extend(proto.decode_record_batches(record_set))
-                elif error in proto.RETRIABLE:
-                    await self.refresh_metadata([topic])
-                elif error != proto.NONE:
-                    raise KafkaProtocolError(
-                        error, f"fetch {topic}/{partition}"
-                    )
-        return records, high_watermark
+        return result.get(partition, ([], -1))
+
+    async def fetch_multi(
+        self, topic: str, offsets: Dict[int, int],
+        max_wait_ms: int = 100, min_bytes: int = 1,
+        max_bytes: int = 4 * 1024 * 1024,
+    ) -> Dict[int, Tuple[List[proto.KafkaRecord], int]]:
+        """Fetch MANY partitions in one request per leader (idle-partition
+        long-polls overlap instead of serializing — a consumer assigned P
+        partitions pays one wait, not P). Returns
+        {partition: (records, high_watermark)}."""
+        by_leader: Dict[int, List[int]] = {}
+        for partition in offsets:
+            leader = await self.leader_for(topic, partition)
+            by_leader.setdefault(leader, []).append(partition)
+
+        out: Dict[int, Tuple[List[proto.KafkaRecord], int]] = {}
+
+        async def fetch_from(leader: int, partitions: List[int]) -> None:
+            body = (
+                Writer()
+                .int32(-1)           # replica id
+                .int32(max_wait_ms)
+                .int32(min_bytes)
+                .int32(max_bytes)
+                .int8(0)             # isolation level: read uncommitted
+                .array([None], lambda w, _: (
+                    w.string(topic),
+                    w.array(partitions, lambda w2, p: (
+                        w2.int32(p),
+                        w2.int64(offsets[p]),
+                        w2.int32(max_bytes),
+                    )),
+                ))
+                .build()
+            )
+            reader = await self.node_connection(leader).call(
+                proto.FETCH, 4, body,
+                timeout=max(30.0, max_wait_ms / 1000 + 30),
+            )
+            reader.int32()  # throttle
+            for _ in range(reader.int32()):
+                reader.string()
+                for _p in range(reader.int32()):
+                    partition = reader.int32()
+                    error = reader.int16()
+                    high_watermark = reader.int64()
+                    reader.int64()  # last stable offset
+                    aborted = reader.int32()
+                    for _a in range(max(0, aborted)):
+                        reader.int64()
+                        reader.int64()
+                    record_set = reader.bytes_()
+                    if error == proto.NONE:
+                        out[partition] = (
+                            proto.decode_record_batches(record_set or b""),
+                            high_watermark,
+                        )
+                    elif error in proto.RETRIABLE:
+                        await self.refresh_metadata([topic])
+                        out[partition] = ([], high_watermark)
+                    else:
+                        raise KafkaProtocolError(
+                            error, f"fetch {topic}/{partition}"
+                        )
+
+        for leader, partitions in by_leader.items():
+            await fetch_from(leader, partitions)
+        return out
 
     # -- list offsets (v1) -------------------------------------------------- #
     async def list_offset(

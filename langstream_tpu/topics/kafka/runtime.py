@@ -128,11 +128,25 @@ class KafkaRecordView(Record):
 # producer
 # ---------------------------------------------------------------------- #
 class KafkaTopicProducer(TopicProducer):
-    def __init__(self, client: KafkaClient, topic: str) -> None:
+    """Micro-batching producer: concurrent ``write()``s within the linger
+    window coalesce into one record batch per partition (the reference
+    relies on the Kafka client's linger.ms/batch.size for the same);
+    every ``write()`` still awaits its own batch's broker ack, so the
+    durability contract (await = acked) is unchanged."""
+
+    def __init__(
+        self, client: KafkaClient, topic: str,
+        *, linger: float = 0.002, batch_max: int = 256,
+    ) -> None:
         self._client = client
         self._topic = topic
+        self._linger = linger
+        self._batch_max = batch_max
         self._written = 0
         self._round_robin = 0
+        # partition -> [((key, value, headers, ts), future)]
+        self._buffers: Dict[int, List] = {}
+        self._flush_tasks: Dict[int, asyncio.Task] = {}
 
     @property
     def topic(self) -> str:
@@ -160,9 +174,52 @@ class KafkaTopicProducer(TopicProducer):
             self._round_robin += 1
         partition = partitions[index]
         timestamp = record.timestamp or now_millis()
-        batch = proto.encode_record_batch([(key, value, headers, timestamp)])
-        await self._client.produce(self._topic, partition, batch)
+        future = asyncio.get_running_loop().create_future()
+        rows = self._buffers.setdefault(partition, [])
+        rows.append(((key, value, headers, timestamp), future))
+        if len(rows) >= self._batch_max:
+            await self._flush(partition)
+        elif partition not in self._flush_tasks:
+            self._flush_tasks[partition] = (
+                asyncio.get_running_loop().create_task(
+                    self._flush_later(partition)
+                )
+            )
+        await future
         self._written += 1
+
+    async def _flush_later(self, partition: int) -> None:
+        await asyncio.sleep(self._linger)
+        await self._flush(partition)
+
+    async def _flush(self, partition: int) -> None:
+        task = self._flush_tasks.pop(partition, None)
+        if task is not None and task is not asyncio.current_task():
+            task.cancel()
+        rows = self._buffers.pop(partition, [])
+        if not rows:
+            return
+        batch = proto.encode_record_batch([payload for payload, _ in rows])
+        try:
+            await self._client.produce(self._topic, partition, batch)
+        except BaseException as error:  # noqa: BLE001 — fail every waiter
+            # the error travels via the futures (every write() awaits one);
+            # not re-raised here so a timer-triggered flush doesn't also
+            # log an unretrieved task exception
+            for _, future in rows:
+                if not future.done():
+                    future.set_exception(error)
+            return
+        for _, future in rows:
+            if not future.done():
+                future.set_result(None)
+
+    async def close(self) -> None:
+        for partition in list(self._buffers):
+            await self._flush(partition)
+        for task in self._flush_tasks.values():
+            task.cancel()
+        self._flush_tasks.clear()
 
     def total_in(self) -> int:
         return self._written
@@ -345,18 +402,27 @@ class KafkaTopicConsumer(TopicConsumer):
             await asyncio.sleep(timeout)
             return []
         out: List[Record] = []
-        # round-robin over assigned partitions for fairness
-        for i in range(len(self._assignment)):
-            partition = self._assignment[
-                (self._fetch_cursor + i) % len(self._assignment)
-            ]
-            records, _hw = await self._client.fetch(
-                self._topic, partition, self._fetch_pos[partition],
-                max_wait_ms=int(timeout * 1000),
-            )
+        # ONE fetch covering every assigned partition: idle partitions
+        # share a single long-poll instead of serializing P timeouts
+        results = await self._client.fetch_multi(
+            self._topic,
+            {p: self._fetch_pos[p] for p in self._assignment},
+            max_wait_ms=int(timeout * 1000),
+        )
+        # rotate the partition order so no partition starves when
+        # max_records truncates the batch
+        order = (
+            self._assignment[self._fetch_cursor:]
+            + self._assignment[:self._fetch_cursor]
+        )
+        self._fetch_cursor = (self._fetch_cursor + 1) % len(self._assignment)
+        for partition in order:
+            records, _hw = results.get(partition, ([], -1))
             for kafka_record in records:
                 if kafka_record.offset < self._fetch_pos[partition]:
                     continue  # batch replay below requested offset
+                if len(out) >= max_records:
+                    break
                 view = decode_record(kafka_record, self._topic)
                 view = _dataclasses.replace(view, partition=partition)
                 out.append(view)
@@ -367,13 +433,6 @@ class KafkaTopicConsumer(TopicConsumer):
                 self._next_after_delivered[partition] = (
                     kafka_record.offset + 1
                 )
-                if len(out) >= max_records:
-                    break
-            if out:
-                self._fetch_cursor = (
-                    self._fetch_cursor + i + 1
-                ) % len(self._assignment)
-                break
         self._delivered += len(out)
         return out
 
@@ -463,19 +522,19 @@ class KafkaTopicReader(TopicReader):
         if not self._offsets:
             await self.start()
         out: List[Record] = []
-        for partition, offset in list(self._offsets.items()):
-            records, _hw = await self._client.fetch(
-                self._topic, partition, offset,
-                max_wait_ms=int(timeout * 1000),
-            )
+        results = await self._client.fetch_multi(
+            self._topic, dict(self._offsets),
+            max_wait_ms=int(timeout * 1000),
+        )
+        for partition, (records, _hw) in results.items():
             for kafka_record in records:
                 if kafka_record.offset < self._offsets[partition]:
                     continue
+                if len(out) >= max_records:
+                    return out
                 view = decode_record(kafka_record, self._topic)
                 out.append(_dataclasses.replace(view, partition=partition))
                 self._offsets[partition] = kafka_record.offset + 1
-                if len(out) >= max_records:
-                    return out
         return out
 
 

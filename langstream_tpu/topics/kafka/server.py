@@ -55,6 +55,9 @@ class _Group:
         self.join_barrier: Optional[asyncio.Event] = None
         self.sync_barrier: Optional[asyncio.Event] = None
         self.pending: Dict[str, bytes] = {}
+        # strong ref: the loop only weakly references tasks, and a
+        # GC'd close_window would strand every joiner on the barrier
+        self.window_task: Optional[asyncio.Task] = None
 
 
 class KafkaFacadeBroker:
@@ -410,7 +413,7 @@ class KafkaFacadeBroker:
                 g.leader = sorted(g.members)[0] if g.members else None
                 g.join_barrier.set()
 
-            asyncio.get_running_loop().create_task(
+            group.window_task = asyncio.get_running_loop().create_task(
                 close_window(group, set(group.members))
             )
         group.pending[member_id] = subscription
