@@ -109,11 +109,102 @@ class InMemoryCodeStorage:
         return sorted(self._archives.get(tenant, {}))
 
 
+class S3CodeStorage:
+    """S3-backed archives at ``<prefix>/<tenant>/<code_id>.zip``
+    (reference: ``langstream-k8s-storage/.../codestorage/S3CodeStorage.java``
+    — bucket + endpoint + keys config shape kept compatible).
+
+    Sync facade over the async SigV4 client from ``agents/storage.py``:
+    a dedicated event-loop thread serves all calls, so the store works
+    from both sync CLI paths (code-download) and inside async webservice
+    handlers (where ``asyncio.run`` would be illegal).
+    """
+
+    def __init__(
+        self,
+        *,
+        bucket: str,
+        endpoint: str,
+        access_key: str = "",
+        secret_key: str = "",
+        region: str = "us-east-1",
+        prefix: str = "code",
+    ) -> None:
+        import threading
+
+        from langstream_tpu.agents.storage import S3Client
+
+        self.bucket = bucket
+        self.prefix = prefix.strip("/")
+        self._client = S3Client(
+            endpoint=endpoint, access_key=access_key,
+            secret_key=secret_key, region=region,
+        )
+        import asyncio
+
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(
+            target=self._loop.run_forever, name="s3-codestorage", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self, coro):
+        import asyncio
+
+        return asyncio.run_coroutine_threadsafe(coro, self._loop).result(120)
+
+    def _key(self, tenant: str, code_id: str) -> str:
+        if "/" in code_id or "/" in tenant or ".." in (tenant, code_id):
+            raise ValueError(f"invalid tenant/code id {tenant!r}/{code_id!r}")
+        return f"{self.prefix}/{tenant}/{code_id}.zip"
+
+    def store(self, tenant: str, application_id: str, archive: bytes) -> str:
+        code_id = f"{application_id}-{uuid.uuid4().hex[:12]}"
+        self._run(self._client.put_object(
+            self.bucket, self._key(tenant, code_id), archive
+        ))
+        return code_id
+
+    def download(self, tenant: str, code_id: str) -> bytes:
+        try:
+            return self._run(self._client.get_object(
+                self.bucket, self._key(tenant, code_id)
+            ))
+        except IOError as error:
+            if "404" in str(error):
+                raise CodeArchiveNotFound(f"{tenant}/{code_id}") from None
+            raise
+
+    def delete(self, tenant: str, code_id: str) -> None:
+        self._run(self._client.delete_object(
+            self.bucket, self._key(tenant, code_id)
+        ))
+
+    def delete_tenant(self, tenant: str) -> None:
+        for code_id in self.list(tenant):
+            self.delete(tenant, code_id)
+
+    def list(self, tenant: str) -> List[str]:
+        objects = self._run(self._client.list_objects(
+            self.bucket, prefix=f"{self.prefix}/{tenant}/"
+        ))
+        out = []
+        for obj in objects:
+            name = obj["key"].rsplit("/", 1)[-1]
+            if name.endswith(".zip"):
+                out.append(name[:-4])
+        return sorted(out)
+
+    def close(self) -> None:
+        self._run(self._client.close())
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=10)
+
+
 def create_code_storage(config: Optional[Dict[str, Any]] = None) -> CodeStorage:
-    """Factory keyed on ``type``: ``local-disk`` (default), ``memory``;
-    ``s3``/``azure`` are declared but gated (no object-store clients in
-    this image — the reference's S3CodeStorage contract is the shape to
-    fill in when one is available)."""
+    """Factory keyed on ``type``: ``local-disk`` (default), ``memory``,
+    ``s3`` (native SigV4 client); ``azure`` stays gated (no Azure SDK in
+    this image)."""
     config = config or {}
     kind = config.get("type", "local-disk")
     if kind in ("local-disk", "local"):
@@ -123,9 +214,22 @@ def create_code_storage(config: Optional[Dict[str, Any]] = None) -> CodeStorage:
         return LocalDiskCodeStorage(root)
     if kind in ("memory", "in-memory"):
         return InMemoryCodeStorage()
-    if kind in ("s3", "azure", "azure-blob-storage"):
+    if kind == "s3":
+        bucket = config.get("bucket-name") or config.get("bucket")
+        endpoint = config.get("endpoint")
+        if not bucket or not endpoint:
+            raise ValueError("s3 code storage needs 'bucket-name' and 'endpoint'")
+        return S3CodeStorage(
+            bucket=bucket,
+            endpoint=endpoint,
+            access_key=config.get("access-key", ""),
+            secret_key=config.get("secret-key", ""),
+            region=config.get("region", "us-east-1"),
+            prefix=config.get("prefix", "code"),
+        )
+    if kind in ("azure", "azure-blob-storage"):
         raise NotImplementedError(
-            f"code storage type {kind!r} requires an object-store client "
-            "not present in this environment; use 'local-disk'"
+            f"code storage type {kind!r} requires the Azure SDK, which is "
+            "not present in this environment; use 's3' or 'local-disk'"
         )
     raise ValueError(f"unknown code storage type {kind!r}")

@@ -1,0 +1,98 @@
+"""The shipped example applications must actually run (BASELINE configs
+#3 RAG and #4 DP fan-out; #2/#5 are covered by bench.py and the
+engine tp tests)."""
+
+from __future__ import annotations
+
+import asyncio
+import os
+
+import pytest
+
+from langstream_tpu.api.records import Record
+from langstream_tpu.runtime.local import run_application
+
+EXAMPLES = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples"
+)
+
+
+async def _read_until(reader, predicate, timeout=30.0):
+    out = []
+    deadline = asyncio.get_event_loop().time() + timeout
+    while True:
+        if asyncio.get_event_loop().time() > deadline:
+            raise TimeoutError(f"only got {out}")
+        for record in await reader.read(timeout=0.2):
+            out.append(record)
+            if predicate(out):
+                return out
+
+
+@pytest.mark.slow
+def test_rag_pipeline_example(tmp_path):
+    import langstream_tpu.agents.vectorstore as vs
+
+    vs._SHARED_STORES.clear()
+
+    async def main():
+        runner = await run_application(
+            os.path.join(EXAMPLES, "applications", "rag-pipeline"),
+            instance_file=os.path.join(
+                EXAMPLES, "instances", "local-rag-tiny.yaml"
+            ),
+        )
+        try:
+            docs = runner.producer("docs-topic")
+            await docs.start()
+            await docs.write(Record(
+                value="JAX programs are traced and compiled by XLA. "
+                      "Pallas writes TPU kernels."
+            ))
+            # ingest lands in the vector store (polled: async pipeline)
+            for _ in range(150):
+                store = vs._SHARED_STORES.get("rag-corpus")
+                if store is not None and len(store) > 0:
+                    break
+                await asyncio.sleep(0.2)
+            else:
+                raise TimeoutError("document never reached the vector store")
+
+            questions = runner.producer("questions-topic")
+            await questions.start()
+            await questions.write(Record(value="What compiles JAX programs?"))
+            reader = runner.reader("answers-topic")
+            (answer,) = await _read_until(reader, lambda out: len(out) >= 1)
+            assert "answer" in answer.value
+            assert isinstance(answer.value["context"], list)
+            assert answer.value["context"], "no retrieved context"
+        finally:
+            await runner.stop()
+
+    asyncio.run(main())
+
+
+@pytest.mark.slow
+def test_dp_embeddings_example(tmp_path):
+    async def main():
+        runner = await run_application(
+            os.path.join(EXAMPLES, "applications", "dp-embeddings"),
+            instance_file=os.path.join(
+                EXAMPLES, "instances", "local-tiny.yaml"
+            ),
+        )
+        try:
+            # DP by replication: 4 replicas in one consumer group
+            assert len(runner.runners) == 4
+            producer = runner.producer("text-topic")
+            await producer.start()
+            for i in range(8):
+                await producer.write(Record(value=f"text number {i}", key=f"k{i}"))
+            reader = runner.reader("embeddings-topic")
+            out = await _read_until(reader, lambda o: len(o) >= 8)
+            for record in out:
+                assert len(record.value["embeddings"]) == 32
+        finally:
+            await runner.stop()
+
+    asyncio.run(main())

@@ -1,0 +1,111 @@
+"""S3 code storage round trip against an in-process S3-compatible HTTP
+server (the SigV4 client's request shape is accepted as-is; auth headers
+are present but not validated — signature correctness is a server-side
+concern this mock does not re-implement)."""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+
+import pytest
+from aiohttp import web
+
+from langstream_tpu.controlplane.codestorage import (
+    CodeArchiveNotFound,
+    create_code_storage,
+)
+
+
+class MockS3Server:
+    def __init__(self) -> None:
+        self.objects: dict = {}
+        self.port: int | None = None
+        self._runner = None
+
+    async def start(self) -> int:
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", self._dispatch)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]  # noqa: SLF001
+        return self.port
+
+    async def stop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+
+    async def _dispatch(self, request: web.Request) -> web.Response:
+        parts = request.path.lstrip("/").split("/", 1)
+        bucket = parts[0]
+        key = parts[1] if len(parts) > 1 else ""
+        store = self.objects.setdefault(bucket, {})
+        if request.method == "PUT":
+            store[key] = await request.read()
+            return web.Response()
+        if request.method == "GET" and key:
+            if key not in store:
+                return web.Response(status=404)
+            return web.Response(body=store[key])
+        if request.method == "GET":  # list-objects v2
+            prefix = request.query.get("prefix", "")
+            keys = sorted(k for k in store if k.startswith(prefix))
+            contents = "".join(
+                f"<Contents><Key>{k}</Key><Size>{len(store[k])}</Size>"
+                f"<ETag>\"x\"</ETag></Contents>"
+                for k in keys
+            )
+            xml = (
+                "<?xml version=\"1.0\"?><ListBucketResult>"
+                f"{contents}<IsTruncated>false</IsTruncated>"
+                "</ListBucketResult>"
+            )
+            return web.Response(text=xml, content_type="application/xml")
+        if request.method == "DELETE":
+            store.pop(key, None)
+            return web.Response(status=204)
+        return web.Response(status=405)
+
+
+@pytest.fixture()
+def s3_server():
+    server = MockS3Server()
+    loop = asyncio.new_event_loop()
+    thread = threading.Thread(target=loop.run_forever, daemon=True)
+    thread.start()
+    asyncio.run_coroutine_threadsafe(server.start(), loop).result(10)
+    try:
+        yield server
+    finally:
+        asyncio.run_coroutine_threadsafe(server.stop(), loop).result(10)
+        loop.call_soon_threadsafe(loop.stop)
+        thread.join(timeout=10)
+
+
+def test_s3_codestorage_roundtrip(s3_server):
+    storage = create_code_storage({
+        "type": "s3",
+        "bucket-name": "langstream",
+        "endpoint": f"http://127.0.0.1:{s3_server.port}",
+        "access-key": "test",
+        "secret-key": "test",
+    })
+    try:
+        code_id = storage.store("tenant-a", "myapp", b"zip-bytes")
+        assert code_id.startswith("myapp-")
+        assert storage.download("tenant-a", code_id) == b"zip-bytes"
+        assert storage.list("tenant-a") == [code_id]
+        assert storage.list("other") == []
+
+        with pytest.raises(CodeArchiveNotFound):
+            storage.download("tenant-a", "nope")
+
+        storage.delete("tenant-a", code_id)
+        assert storage.list("tenant-a") == []
+        # tenant isolation keys: path traversal refused
+        with pytest.raises(ValueError):
+            storage.download("..", "x")
+    finally:
+        storage.close()
