@@ -1,0 +1,31 @@
+# Runtime image for langstream-tpu pods (reference:
+# langstream-runtime/langstream-runtime-base-docker-image/src/main/docker/
+# Dockerfile:12-22 — here a single Python image serves runner, deployer,
+# setup, and code-download; the TPU runtime libs come from the base).
+#
+# Build:   docker build -t langstream-tpu/runtime:latest .
+# On GKE TPU node pools use a base image with libtpu, e.g.
+#   --build-arg BASE=python:3.12-slim          (CPU agents)
+#   --build-arg BASE=<jax-tpu base image>      (TPU agents)
+ARG BASE=python:3.12-slim
+FROM ${BASE}
+
+WORKDIR /app
+
+COPY pyproject.toml README.md /app/
+COPY langstream_tpu /app/langstream_tpu
+COPY examples /app/examples
+
+RUN pip install --no-cache-dir /app "jax[tpu]" || pip install --no-cache-dir /app
+
+# the deployer's manifests invoke:
+#   python -m langstream_tpu {agent-runner,code-download,application-setup,deployer}
+# /app/config and /app/code are volume mounts (Secret + emptyDir)
+ENV LANGSTREAM_CODE_DIR=/app/code \
+    LANGSTREAM_STATE_DIR=/app/state \
+    PYTHONUNBUFFERED=1
+
+EXPOSE 8080 8000
+
+ENTRYPOINT ["python", "-m", "langstream_tpu"]
+CMD ["--help"]

@@ -88,6 +88,29 @@ def _watchdog() -> None:
     os._exit(3)
 
 
+def _relay_diagnosis() -> str:
+    """Distinguish a wedged TPU tunnel from a code problem: the axon
+    relay rides 127.0.0.1:2024; 'accepts then closes' means the relay is
+    up but its upstream pool connection is gone (infra, not this repo)."""
+    import socket
+
+    try:
+        with socket.create_connection(("127.0.0.1", 2024), timeout=3) as s:
+            s.settimeout(2)
+            try:
+                data = s.recv(1)
+            except socket.timeout:
+                return "relay :2024 accepts, no data within 2s"
+            if data == b"":
+                return (
+                    "relay :2024 accepts then immediately closes — "
+                    "upstream TPU pool connection is down (infra)"
+                )
+            return "relay :2024 is responsive"
+    except OSError as error:
+        return f"relay :2024 unreachable: {error}"
+
+
 def probe_backend() -> None:
     """Initialize the JAX backend in a side thread with a hard bound, so
     a wedged device plugin can't eat the whole driver timeout."""
@@ -119,6 +142,7 @@ def probe_backend() -> None:
     if thread.is_alive():
         raise TimeoutError(
             f"JAX backend init exceeded {INIT_TIMEOUT_S:.0f}s"
+            f" ({_relay_diagnosis()})"
         )
     if "error" in result:
         raise RuntimeError(f"JAX backend init failed: {result['error']}")

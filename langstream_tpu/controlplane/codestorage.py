@@ -24,6 +24,17 @@ class CodeArchiveNotFound(KeyError):
     pass
 
 
+def _validate_ids(tenant: str, code_id: str) -> None:
+    """Refuse path/key traversal: no separators, no '..' anywhere (a
+    SUBSTRING check — filesystem-backed stores join these into paths)."""
+    if (
+        "/" in tenant or "/" in code_id
+        or "\\" in tenant or "\\" in code_id
+        or ".." in tenant or ".." in code_id
+    ):
+        raise ValueError(f"invalid tenant/code id {tenant!r}/{code_id!r}")
+
+
 class CodeStorage(Protocol):
     def store(self, tenant: str, application_id: str, archive: bytes) -> str:
         """Store an archive, return its unique code-archive id."""
@@ -48,8 +59,7 @@ class LocalDiskCodeStorage:
         self.root.mkdir(parents=True, exist_ok=True)
 
     def _path(self, tenant: str, code_id: str) -> pathlib.Path:
-        if "/" in code_id or "/" in tenant or ".." in (tenant, code_id):
-            raise ValueError(f"invalid tenant/code id {tenant!r}/{code_id!r}")
+        _validate_ids(tenant, code_id)
         return self.root / tenant / f"{code_id}.zip"
 
     def store(self, tenant: str, application_id: str, archive: bytes) -> str:
@@ -154,8 +164,7 @@ class S3CodeStorage:
         return asyncio.run_coroutine_threadsafe(coro, self._loop).result(120)
 
     def _key(self, tenant: str, code_id: str) -> str:
-        if "/" in code_id or "/" in tenant or ".." in (tenant, code_id):
-            raise ValueError(f"invalid tenant/code id {tenant!r}/{code_id!r}")
+        _validate_ids(tenant, code_id)
         return f"{self.prefix}/{tenant}/{code_id}.zip"
 
     def store(self, tenant: str, application_id: str, archive: bytes) -> str:

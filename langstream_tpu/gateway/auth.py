@@ -10,10 +10,10 @@ Providers here:
   path); principal = the credential string.
 - ``http``      — POST the credential to a configured endpoint; 2xx = ok,
   JSON body becomes the principal attributes.
-- ``jwt``       — HS256 verification with a shared secret, implemented on
-  stdlib hmac (no external JWT lib); claims become principal attributes.
-  RS256/JWKS (the reference's Kubernetes JWKS path) is gated until a
-  crypto dependency is available.
+- ``jwt``       — HS256 (shared secret, stdlib hmac) and RS256 with either
+  a configured PEM public key or a JWKS URI with kid-keyed key cache
+  (reference: ``langstream-auth-jwt`` + ``JwksUriSigningKeyResolver.java``);
+  claims become principal attributes.
 - ``google`` / ``github`` — gated: they need outbound calls to the identity
   provider; configs validate but authentication fails with a clear error.
 """
@@ -98,16 +98,94 @@ def _b64url_decode(data: str) -> bytes:
     return base64.urlsafe_b64decode(data + padding)
 
 
-class JwtHS256AuthProvider(GatewayAuthProvider):
-    """HS256 JWT validation on stdlib hmac (``langstream-auth-jwt``
-    analogue for shared-secret deployments)."""
+class JwtAuthProvider(GatewayAuthProvider):
+    """JWT validation (``langstream-auth-jwt`` analogue).
+
+    - ``secret-key``  — HS256 shared secret (stdlib hmac).
+    - ``public-key``  — PEM RSA public key for RS256.
+    - ``jwks-uri``    — RS256 keys resolved by ``kid`` from a JWKS
+      endpoint, cached; an unknown kid triggers one refetch (the
+      reference's ``JwksUriSigningKeyResolver.java`` rotation behavior).
+    """
 
     def __init__(self, config: Dict[str, Any]) -> None:
         self.secret = config.get("secret-key", config.get("secret", ""))
-        if not self.secret:
-            raise ValueError("jwt auth requires 'secret-key'")
+        self.public_key_pem = config.get("public-key")
+        self.jwks_uri = config.get("jwks-uri") or config.get("jwks-hosts") \
+            or config.get("jwksUri")
+        if not (self.secret or self.public_key_pem or self.jwks_uri):
+            raise ValueError(
+                "jwt auth requires 'secret-key' (HS256), 'public-key' "
+                "(RS256 PEM), or 'jwks-uri' (RS256 JWKS)"
+            )
         self.audience = config.get("audience")
         self.verify_expiry = bool(config.get("verify-expiry", True))
+        self._jwks_keys: Dict[str, Any] = {}  # kid -> public key object
+        # rotation: the cache expires so rotated-OUT keys stop being
+        # trusted; unknown-kid refetches are throttled so unauthenticated
+        # garbage tokens can't amplify into JWKS traffic
+        self.jwks_refresh = float(config.get("jwks-refresh-seconds", 300))
+        self._jwks_fetched_at = 0.0
+        self._jwks_min_fetch_interval = 30.0
+
+    # -- RS256 key material --------------------------------------------- #
+    def _pem_key(self):
+        from cryptography.hazmat.primitives.serialization import (
+            load_pem_public_key,
+        )
+
+        return load_pem_public_key(self.public_key_pem.encode())
+
+    async def _jwks_key(self, kid: Optional[str]):
+        now = time.time()
+        fresh = now - self._jwks_fetched_at < self.jwks_refresh
+        if kid in self._jwks_keys and fresh:
+            return self._jwks_keys[kid]
+        throttled = (
+            now - self._jwks_fetched_at < self._jwks_min_fetch_interval
+        )
+        if throttled:
+            # recently refetched: trust the current document only
+            if kid in self._jwks_keys:
+                return self._jwks_keys[kid]
+            raise AuthenticationFailed(f"no JWKS key for kid {kid!r}")
+        import aiohttp
+        from cryptography.hazmat.primitives.asymmetric import rsa
+
+        async with aiohttp.ClientSession() as session:
+            async with session.get(self.jwks_uri) as response:
+                if response.status >= 300:
+                    raise AuthenticationFailed(
+                        f"JWKS fetch HTTP {response.status}"
+                    )
+                document = await response.json(content_type=None)
+        # REPLACE the cache: rotated-out keys must stop being trusted
+        keys: Dict[str, Any] = {}
+        for jwk in document.get("keys", []):
+            if jwk.get("kty") != "RSA":
+                continue
+            n = int.from_bytes(_b64url_decode(jwk["n"]), "big")
+            e = int.from_bytes(_b64url_decode(jwk["e"]), "big")
+            keys[jwk.get("kid")] = rsa.RSAPublicNumbers(e, n).public_key()
+        self._jwks_keys = keys
+        self._jwks_fetched_at = now
+        if kid not in self._jwks_keys:
+            if None in self._jwks_keys and kid is None:
+                return self._jwks_keys[None]
+            raise AuthenticationFailed(f"no JWKS key for kid {kid!r}")
+        return self._jwks_keys[kid]
+
+    def _verify_rs256(self, key, signing_input: bytes, signature: bytes):
+        from cryptography.exceptions import InvalidSignature
+        from cryptography.hazmat.primitives import hashes
+        from cryptography.hazmat.primitives.asymmetric import padding
+
+        try:
+            key.verify(
+                signature, signing_input, padding.PKCS1v15(), hashes.SHA256()
+            )
+        except InvalidSignature as error:
+            raise AuthenticationFailed("bad JWT signature") from error
 
     async def authenticate(self, credentials: str) -> Principal:
         try:
@@ -115,24 +193,39 @@ class JwtHS256AuthProvider(GatewayAuthProvider):
         except ValueError as error:
             raise AuthenticationFailed("malformed JWT") from error
         header = json.loads(_b64url_decode(header_b64))
-        if header.get("alg") != "HS256":
-            raise AuthenticationFailed(
-                f"unsupported JWT alg {header.get('alg')!r} (only HS256 in "
-                "this build; RS256/JWKS requires a crypto dependency)"
-            )
-        expected = hmac.new(
-            self.secret.encode(),
-            f"{header_b64}.{payload_b64}".encode(),
-            hashlib.sha256,
-        ).digest()
-        if not hmac.compare_digest(expected, _b64url_decode(signature_b64)):
-            raise AuthenticationFailed("bad JWT signature")
+        alg = header.get("alg")
+        signing_input = f"{header_b64}.{payload_b64}".encode()
+        signature = _b64url_decode(signature_b64)
+        if alg == "HS256":
+            if not self.secret:
+                raise AuthenticationFailed("HS256 token but no secret-key")
+            expected = hmac.new(
+                self.secret.encode(), signing_input, hashlib.sha256
+            ).digest()
+            if not hmac.compare_digest(expected, signature):
+                raise AuthenticationFailed("bad JWT signature")
+        elif alg == "RS256":
+            if self.public_key_pem:
+                key = self._pem_key()
+            elif self.jwks_uri:
+                key = await self._jwks_key(header.get("kid"))
+            else:
+                raise AuthenticationFailed(
+                    "RS256 token but no public-key/jwks-uri configured"
+                )
+            self._verify_rs256(key, signing_input, signature)
+        else:
+            raise AuthenticationFailed(f"unsupported JWT alg {alg!r}")
         claims = json.loads(_b64url_decode(payload_b64))
         if self.verify_expiry and "exp" in claims and claims["exp"] < time.time():
             raise AuthenticationFailed("JWT expired")
         if self.audience and claims.get("aud") != self.audience:
             raise AuthenticationFailed("JWT audience mismatch")
         return Principal(subject=str(claims.get("sub", "user")), attributes=claims)
+
+
+# backward-compatible alias (pre-RS256 name)
+JwtHS256AuthProvider = JwtAuthProvider
 
 
 class GatedAuthProvider(GatewayAuthProvider):
@@ -154,7 +247,7 @@ def create_auth_provider(config: Dict[str, Any]) -> GatewayAuthProvider:
     if provider == "http":
         return HttpAuthProvider(configuration)
     if provider == "jwt":
-        return JwtHS256AuthProvider(configuration)
+        return JwtAuthProvider(configuration)
     if provider in ("google", "github"):
         return GatedAuthProvider(provider)
     raise ValueError(f"unknown auth provider {provider!r}")

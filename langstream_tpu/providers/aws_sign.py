@@ -1,0 +1,93 @@
+"""AWS SigV4 request signing (shared by the S3 client and Bedrock).
+
+Stdlib-only; the same canonical-request flow the S3 source uses
+(``agents/storage.py``), generalized over the service name so
+``bedrock-runtime`` requests sign identically.
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import hmac
+import urllib.parse
+from typing import Dict, Optional
+
+
+def sign_request(
+    *,
+    method: str,
+    url: str,
+    region: str,
+    service: str,
+    access_key: str,
+    secret_key: str,
+    body: bytes = b"",
+    headers: Optional[Dict[str, str]] = None,
+    session_token: Optional[str] = None,
+) -> Dict[str, str]:
+    """Return the full header set (including Authorization) for ``url``."""
+    parsed = urllib.parse.urlparse(url)
+    host = parsed.netloc
+    raw_path = parsed.path or "/"
+    if service == "s3":
+        # S3 is the one service whose canonical URI is the path as-is
+        # (no re-encoding); everything else URI-encodes each segment —
+        # e.g. Bedrock model ids contain ':' which must sign as %3A
+        path = raw_path
+    else:
+        path = "/".join(
+            urllib.parse.quote(segment, safe="-._~")
+            for segment in raw_path.split("/")
+        ) or "/"
+    # canonical query: keys and values URI-encoded, sorted
+    pairs = urllib.parse.parse_qsl(parsed.query, keep_blank_values=True)
+    query = "&".join(
+        f"{urllib.parse.quote(k, safe='-._~')}="
+        f"{urllib.parse.quote(v, safe='-._~')}"
+        for k, v in sorted(pairs)
+    )
+    now = datetime.datetime.now(datetime.timezone.utc)
+    amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+    date_stamp = now.strftime("%Y%m%d")
+    payload_hash = hashlib.sha256(body).hexdigest()
+
+    all_headers = {k.lower(): v for k, v in (headers or {}).items()}
+    all_headers.update({
+        "host": host,
+        "x-amz-date": amz_date,
+        "x-amz-content-sha256": payload_hash,
+    })
+    if session_token:
+        all_headers["x-amz-security-token"] = session_token
+
+    signed_names = ";".join(sorted(all_headers))
+    canonical_headers = "".join(
+        f"{name}:{all_headers[name].strip()}\n" for name in sorted(all_headers)
+    )
+    canonical_request = "\n".join(
+        [method, path, query, canonical_headers, signed_names, payload_hash]
+    )
+    scope = f"{date_stamp}/{region}/{service}/aws4_request"
+    string_to_sign = "\n".join([
+        "AWS4-HMAC-SHA256",
+        amz_date,
+        scope,
+        hashlib.sha256(canonical_request.encode()).hexdigest(),
+    ])
+
+    def _hmac(key: bytes, message: str) -> bytes:
+        return hmac.new(key, message.encode(), hashlib.sha256).digest()
+
+    key = _hmac(f"AWS4{secret_key}".encode(), date_stamp)
+    key = _hmac(key, region)
+    key = _hmac(key, service)
+    key = _hmac(key, "aws4_request")
+    signature = hmac.new(
+        key, string_to_sign.encode(), hashlib.sha256
+    ).hexdigest()
+    all_headers["Authorization"] = (
+        f"AWS4-HMAC-SHA256 Credential={access_key}/{scope}, "
+        f"SignedHeaders={signed_names}, Signature={signature}"
+    )
+    return all_headers

@@ -1,0 +1,208 @@
+"""AWS Bedrock provider (SigV4 REST, no boto3).
+
+Reference: ``langstream-agents/langstream-ai-agents/src/main/java/ai/
+langstream/ai/agents/services/impl/BedrockServiceProvider.java:47`` —
+resources of type ``bedrock-configuration`` with ``access-key`` /
+``secret-key`` / ``region``; completions call InvokeModel with
+model-family-specific request parameters and read the completion out of
+the response with a configurable expression. The TPU build signs the
+request natively (``aws_sign.py``) instead of pulling in an SDK.
+
+Config keys:
+
+- ``access-key`` / ``secret-key`` / ``region`` (+ optional
+  ``session-token``)
+- ``endpoint-override`` — full base URL (tests; VPC endpoints)
+
+Completion options (per step configuration):
+
+- ``model``                — Bedrock modelId (used in the URL)
+- ``request-parameters``   — dict merged into the request body
+- ``response-completions-path`` — dotted path to the completion text;
+  when unset, common fields are tried (``completion``, ``generation``,
+  ``outputs[0].text``, ``content[0].text``, ``results[0].outputText``).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+from langstream_tpu.api.service import (
+    ChatChunk,
+    ChatCompletionResult,
+    ChatMessage,
+    CompletionsService,
+    EmbeddingsService,
+    ServiceProvider,
+    StreamingChunksConsumer,
+)
+from langstream_tpu.providers.aws_sign import sign_request
+
+
+def _dig(payload: Any, path: str) -> Any:
+    """Dotted-path lookup with [n] indexing: ``outputs[0].text``."""
+    node = payload
+    for raw in path.split("."):
+        part = raw
+        while part:
+            if "[" in part:
+                name, _, rest = part.partition("[")
+                index, _, part = rest.partition("]")
+                if name:
+                    node = node[name]
+                node = node[int(index)]
+            else:
+                node = node[part]
+                part = ""
+    return node
+
+
+_DEFAULT_PATHS = (
+    "completion",                  # anthropic (legacy)
+    "content[0].text",             # anthropic messages
+    "generation",                  # meta llama
+    "outputs[0].text",             # mistral
+    "results[0].outputText",       # amazon titan
+)
+
+
+class BedrockCompletionsService(CompletionsService):
+    def __init__(self, config: Dict[str, Any]) -> None:
+        self.region = config.get("region", "us-east-1")
+        self.access_key = config.get("access-key", "")
+        self.secret_key = config.get("secret-key", "")
+        self.session_token = config.get("session-token")
+        self.endpoint = (
+            config.get("endpoint-override")
+            or f"https://bedrock-runtime.{self.region}.amazonaws.com"
+        ).rstrip("/")
+        self._session = None
+
+    async def _get_session(self):
+        if self._session is None:
+            import aiohttp
+
+            self._session = aiohttp.ClientSession()
+        return self._session
+
+    async def _invoke(self, model: str, body: Dict[str, Any]) -> Dict[str, Any]:
+        payload = json.dumps(body).encode()
+        url = f"{self.endpoint}/model/{model}/invoke"
+        headers = sign_request(
+            method="POST", url=url, region=self.region,
+            service="bedrock", access_key=self.access_key,
+            secret_key=self.secret_key, body=payload,
+            headers={"content-type": "application/json"},
+            session_token=self.session_token,
+        )
+        session = await self._get_session()
+        async with session.post(url, data=payload, headers=headers) as resp:
+            text = await resp.text()
+            if resp.status >= 300:
+                raise IOError(f"bedrock invoke HTTP {resp.status}: {text[:500]}")
+            return json.loads(text)
+
+    @staticmethod
+    def _render_prompt(messages: List[ChatMessage]) -> str:
+        return "\n".join(
+            f"{m.role}: {m.content}" if m.role else m.content
+            for m in messages
+        )
+
+    async def get_chat_completions(
+        self,
+        messages: List[ChatMessage],
+        options: Dict[str, Any],
+        stream_consumer: Optional[StreamingChunksConsumer] = None,
+    ) -> ChatCompletionResult:
+        model = options.get("model")
+        if not model:
+            raise ValueError("bedrock completions require a 'model' id")
+        body = dict(options.get("request-parameters") or {})
+        if "messages" in body:
+            body["messages"] = [
+                {"role": m.role, "content": m.content} for m in messages
+            ]
+        else:
+            body.setdefault("prompt", self._render_prompt(messages))
+        if options.get("max-tokens") and "max_tokens" not in body:
+            body["max_tokens"] = options["max-tokens"]
+        payload = await self._invoke(model, body)
+        path = options.get("response-completions-path")
+        if path:
+            content = str(_dig(payload, path))
+        else:
+            content = None
+            for candidate in _DEFAULT_PATHS:
+                try:
+                    content = str(_dig(payload, candidate))
+                    break
+                except (KeyError, IndexError, TypeError):
+                    continue
+            if content is None:
+                raise ValueError(
+                    "could not locate the completion in the Bedrock "
+                    f"response (keys: {sorted(payload)}); set "
+                    "'response-completions-path'"
+                )
+        if stream_consumer is not None:
+            # Bedrock invoke is non-streaming here: emit one final chunk
+            stream_consumer.consume_chunk(
+                "bedrock", 0, ChatChunk(content=content, index=0), last=True
+            )
+        return ChatCompletionResult(
+            content=content,
+            finish_reason="stop",
+            prompt_tokens=0,
+            completion_tokens=0,
+        )
+
+    async def close(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+
+
+class BedrockEmbeddingsService(EmbeddingsService):
+    def __init__(self, completions: BedrockCompletionsService, model: str):
+        self._svc = completions
+        self.model = model or "amazon.titan-embed-text-v1"
+
+    async def compute_embeddings(self, texts: List[str]) -> List[List[float]]:
+        out: List[List[float]] = []
+        for text in texts:
+            payload = await self._svc._invoke(  # noqa: SLF001 — same client
+                self.model, {"inputText": text}
+            )
+            out.append(payload.get("embedding") or payload["embeddings"][0])
+        return out
+
+    async def close(self) -> None:
+        await self._svc.close()
+
+
+class BedrockServiceProvider(ServiceProvider):
+    name = "bedrock"
+
+    def supports(self, resource_config: Dict[str, Any]) -> bool:
+        return (
+            resource_config.get("type") == "bedrock-configuration"
+            or "bedrock" in resource_config
+        )
+
+    def get_completions_service(
+        self, resource_config: Dict[str, Any]
+    ) -> CompletionsService:
+        return BedrockCompletionsService(
+            resource_config.get("configuration", resource_config)
+        )
+
+    def get_embeddings_service(
+        self, resource_config: Dict[str, Any], model: Optional[str] = None
+    ) -> EmbeddingsService:
+        return BedrockEmbeddingsService(
+            BedrockCompletionsService(
+                resource_config.get("configuration", resource_config)
+            ),
+            model,
+        )

@@ -54,6 +54,48 @@ from langstream_tpu.providers.jax_local import model as model_lib
 
 logger = logging.getLogger(__name__)
 
+# live engines, for /metrics exposure (weak: a stopped engine's buffers
+# must not be pinned by the metrics path)
+import weakref
+
+_LIVE_ENGINES: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def engines_snapshot() -> Dict[str, float]:
+    """Prometheus-gauge view over every live engine in this process:
+    decode-step latency, slot occupancy, token/prefill counters
+    (reference: AgentRunner.java:99-113 exposes runtime internals the
+    same way; here the runtime internal is the TPU engine)."""
+    out: Dict[str, float] = {}
+    tokens = steps = chunks = 0
+    decode_time = prefill_time = 0.0
+    active_slot_steps = total_slot_steps = 0
+    for engine in list(_LIVE_ENGINES):
+        stats = engine.stats
+        tokens += stats["tokens_generated"]
+        steps += stats["decode_steps"]
+        chunks += stats["decode_chunks"]
+        decode_time += stats["decode_time"]
+        prefill_time += stats["prefill_time"]
+        active_slot_steps += stats["active_slot_steps"]
+        total_slot_steps += stats["decode_steps"] * engine.max_slots
+    if not (tokens or steps):
+        return out
+    out["jax_engine_tokens_generated"] = float(tokens)
+    out["jax_engine_decode_steps"] = float(steps)
+    out["jax_engine_decode_chunks"] = float(chunks)
+    out["jax_engine_decode_time_seconds"] = round(decode_time, 6)
+    out["jax_engine_prefill_time_seconds"] = round(prefill_time, 6)
+    if steps:
+        out["jax_engine_decode_ms_per_step"] = round(
+            decode_time / steps * 1e3, 4
+        )
+    if total_slot_steps:
+        out["jax_engine_slot_occupancy"] = round(
+            active_slot_steps / total_slot_steps, 4
+        )
+    return out
+
 
 @dataclasses.dataclass
 class SamplingParams:
@@ -97,6 +139,7 @@ class _Slot:
     logprobs: Optional[List[float]] = None  # parallel to ``generated``
     history: Optional[List[int]] = None  # full token history in cache
     session_id: Optional[str] = None     # pinned session (slot free but warm)
+    last_used: float = 0.0               # monotonic; drives LRU eviction
 
     @property
     def active(self) -> bool:
@@ -195,6 +238,7 @@ class DecodeEngine:
         # per-chunk dispatch log: (steps, active_slots, wall_seconds) —
         # the occupancy/step-time evidence the bench prints (bounded)
         self.chunk_log: List[Tuple[int, int, float]] = []
+        _LIVE_ENGINES.add(self)
 
     @staticmethod
     def _fresh_stats() -> Dict[str, Any]:
@@ -424,11 +468,16 @@ class DecodeEngine:
         for i, slot in enumerate(self.slots):
             if not slot.active and slot.session_id is None:
                 return i
-        # evict the least-recently pinned session slot
+        # evict the least-recently USED pinned session (a hot session's
+        # warm cache survives slot pressure; the stalest one pays)
+        victim: Optional[int] = None
         for i, slot in enumerate(self.slots):
-            if not slot.active:
-                return i
-        return None
+            if not slot.active and (
+                victim is None
+                or slot.last_used < self.slots[victim].last_used
+            ):
+                victim = i
+        return victim
 
     def _session_warm(self, index: int, request: GenerationRequest) -> bool:
         slot = self.slots[index]
@@ -516,6 +565,7 @@ class DecodeEngine:
                 slot.history = list(prompt)
                 slot.session_id = None
                 slot.length = len(prompt)
+                slot.last_used = time.monotonic()
             run = self._get_prefill(bucket)
             self.cache, logits = run(
                 self.params,
@@ -549,6 +599,7 @@ class DecodeEngine:
         slot.history = list(prompt)
         slot.session_id = None
         slot.length = len(prompt)
+        slot.last_used = time.monotonic()
         tokens = np.zeros((1, bucket), dtype=np.int32)
         tokens[0, : len(suffix)] = suffix
         run = self._get_prefill_offset(bucket)
@@ -674,6 +725,7 @@ class DecodeEngine:
         slot.logprobs = None
         if request.session_id is not None:
             slot.session_id = request.session_id
+            slot.last_used = time.monotonic()
             # keep only the history that is actually IN the cache (the
             # final sampled token is never written before finish)
             slot.history = slot.history[: slot.length]

@@ -39,6 +39,8 @@ register_provider(_lazy("langstream_tpu.providers.mock", "MockServiceProvider"))
 register_provider(_lazy("langstream_tpu.providers.jax_local.provider", "JaxLocalServiceProvider"))
 register_provider(_lazy("langstream_tpu.providers.openai_compat", "OpenAICompatServiceProvider"))
 register_provider(_lazy("langstream_tpu.providers.huggingface", "HuggingFaceServiceProvider"))
+register_provider(_lazy("langstream_tpu.providers.bedrock", "BedrockServiceProvider"))
+register_provider(_lazy("langstream_tpu.providers.vertex", "VertexServiceProvider"))
 
 
 class ServiceProviderRegistry:

@@ -1,0 +1,224 @@
+"""Google Vertex AI provider (REST + service-account OAuth, no SDK).
+
+Reference: ``langstream-agents/langstream-ai-agents/src/main/java/ai/
+langstream/ai/agents/services/impl/VertexAIProvider.java:58`` — resources
+of type ``vertex-configuration`` with ``url``, ``region``, ``project``,
+and either a static ``token`` or ``serviceAccountJson``. Chat/completions
+and embeddings go through the ``:predict`` endpoints; the OAuth2 access
+token is minted from the service account with an RS256 JWT grant
+(the same flow google-auth performs, implemented on ``cryptography``).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import time
+from typing import Any, Dict, List, Optional
+
+from langstream_tpu.api.service import (
+    ChatChunk,
+    ChatCompletionResult,
+    ChatMessage,
+    CompletionsService,
+    EmbeddingsService,
+    ServiceProvider,
+    StreamingChunksConsumer,
+)
+
+_OAUTH_TOKEN_URL = "https://oauth2.googleapis.com/token"
+_SCOPE = "https://www.googleapis.com/auth/cloud-platform"
+
+
+def _b64url(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+class _TokenSource:
+    """Static token, or service-account JWT-grant tokens with caching."""
+
+    def __init__(self, config: Dict[str, Any]) -> None:
+        self.static_token = config.get("token")
+        raw = config.get("serviceAccountJson") or config.get(
+            "service-account-json"
+        )
+        self.service_account = (
+            json.loads(raw) if isinstance(raw, str) else raw
+        )
+        self.token_url = config.get("token-url", _OAUTH_TOKEN_URL)
+        self._cached: Optional[str] = None
+        self._expiry = 0.0
+        if not self.static_token and not self.service_account:
+            raise ValueError(
+                "vertex configuration needs 'token' or 'serviceAccountJson'"
+            )
+
+    def _assertion(self) -> str:
+        from cryptography.hazmat.primitives import hashes, serialization
+        from cryptography.hazmat.primitives.asymmetric import padding
+
+        now = int(time.time())
+        header = {"alg": "RS256", "typ": "JWT"}
+        claims = {
+            "iss": self.service_account["client_email"],
+            "scope": _SCOPE,
+            "aud": self.token_url,
+            "iat": now,
+            "exp": now + 3600,
+        }
+        signing_input = (
+            f"{_b64url(json.dumps(header).encode())}."
+            f"{_b64url(json.dumps(claims).encode())}"
+        )
+        key = serialization.load_pem_private_key(
+            self.service_account["private_key"].encode(), password=None
+        )
+        signature = key.sign(
+            signing_input.encode(), padding.PKCS1v15(), hashes.SHA256()
+        )
+        return f"{signing_input}.{_b64url(signature)}"
+
+    async def token(self, session) -> str:
+        if self.static_token:
+            return self.static_token
+        if self._cached and time.time() < self._expiry - 120:
+            return self._cached
+        async with session.post(
+            self.token_url,
+            data={
+                "grant_type": "urn:ietf:params:oauth:grant-type:jwt-bearer",
+                "assertion": self._assertion(),
+            },
+        ) as response:
+            payload = await response.json(content_type=None)
+            if response.status >= 300 or "access_token" not in payload:
+                raise IOError(f"vertex token exchange failed: {payload}")
+        self._cached = payload["access_token"]
+        self._expiry = time.time() + float(payload.get("expires_in", 3600))
+        return self._cached
+
+
+class VertexCompletionsService(CompletionsService):
+    def __init__(self, config: Dict[str, Any]) -> None:
+        self.url = (config.get("url")
+                    or "https://us-central1-aiplatform.googleapis.com"
+                    ).rstrip("/")
+        self.project = config.get("project")
+        self.region = config.get("region", "us-central1")
+        self.tokens = _TokenSource(config)
+        self._session = None
+
+    async def _get_session(self):
+        if self._session is None:
+            import aiohttp
+
+            self._session = aiohttp.ClientSession()
+        return self._session
+
+    def _endpoint(self, model: str) -> str:
+        return (
+            f"{self.url}/v1/projects/{self.project}/locations/{self.region}"
+            f"/publishers/google/models/{model}:predict"
+        )
+
+    async def _predict(self, model: str, body: Dict[str, Any]) -> Dict[str, Any]:
+        session = await self._get_session()
+        token = await self.tokens.token(session)
+        async with session.post(
+            self._endpoint(model), json=body,
+            headers={"Authorization": f"Bearer {token}"},
+        ) as response:
+            payload = await response.json(content_type=None)
+            if response.status >= 300:
+                raise IOError(
+                    f"vertex predict HTTP {response.status}: "
+                    f"{str(payload)[:500]}"
+                )
+            return payload
+
+    async def get_chat_completions(
+        self,
+        messages: List[ChatMessage],
+        options: Dict[str, Any],
+        stream_consumer: Optional[StreamingChunksConsumer] = None,
+    ) -> ChatCompletionResult:
+        model = options.get("model") or "chat-bison"
+        parameters = {}
+        for src, dst in (
+            ("temperature", "temperature"), ("max-tokens", "maxOutputTokens"),
+            ("top-p", "topP"), ("top-k", "topK"),
+        ):
+            if options.get(src) is not None:
+                parameters[dst] = options[src]
+        body = {
+            "instances": [{
+                "messages": [
+                    {"author": m.role or "user", "content": m.content}
+                    for m in messages
+                ],
+            }],
+            "parameters": parameters,
+        }
+        payload = await self._predict(model, body)
+        prediction = payload["predictions"][0]
+        candidates = prediction.get("candidates") or []
+        content = (
+            candidates[0].get("content", "")
+            if candidates else prediction.get("content", "")
+        )
+        if stream_consumer is not None:
+            stream_consumer.consume_chunk(
+                "vertex", 0, ChatChunk(content=content, index=0), last=True
+            )
+        return ChatCompletionResult(
+            content=content, finish_reason="stop",
+            prompt_tokens=0, completion_tokens=0,
+        )
+
+    async def close(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+
+
+class VertexEmbeddingsService(EmbeddingsService):
+    def __init__(self, completions: VertexCompletionsService, model: str):
+        self._svc = completions
+        self.model = model or "textembedding-gecko"
+
+    async def compute_embeddings(self, texts: List[str]) -> List[List[float]]:
+        payload = await self._svc._predict(  # noqa: SLF001 — same client
+            self.model, {"instances": [{"content": t} for t in texts]}
+        )
+        return [
+            p["embeddings"]["values"] for p in payload["predictions"]
+        ]
+
+    async def close(self) -> None:
+        await self._svc.close()
+
+
+class VertexServiceProvider(ServiceProvider):
+    name = "vertex"
+
+    def supports(self, resource_config: Dict[str, Any]) -> bool:
+        return (
+            resource_config.get("type") == "vertex-configuration"
+            or "vertex" in resource_config
+        )
+
+    def get_completions_service(
+        self, resource_config: Dict[str, Any]
+    ) -> CompletionsService:
+        return VertexCompletionsService(
+            resource_config.get("configuration", resource_config)
+        )
+
+    def get_embeddings_service(
+        self, resource_config: Dict[str, Any], model: Optional[str] = None
+    ) -> EmbeddingsService:
+        return VertexEmbeddingsService(
+            VertexCompletionsService(
+                resource_config.get("configuration", resource_config)
+            ),
+            model,
+        )

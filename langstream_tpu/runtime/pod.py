@@ -233,8 +233,16 @@ async def agent_runner_main(
         os.makedirs(state_dir, exist_ok=True)
     runner = LocalApplicationRunner(plan, state_directory=state_dir or None)
 
+    def gauges() -> Dict[str, float]:
+        # TPU engine internals, when this pod hosts a jax-local engine
+        import sys
+
+        module = sys.modules.get("langstream_tpu.providers.jax_local.engine")
+        return module.engines_snapshot() if module else {}
+
     http = AgentHttpServer(
-        info=runner.info, metrics=runner.metrics, port=http_port
+        info=runner.info, metrics=runner.metrics, gauges=gauges,
+        port=http_port,
     )
     await http.start()
     logger.info(

@@ -350,3 +350,56 @@ def test_engine_tp4_flash_matches_single_device():
         assert r1.tokens == r2.tokens
 
     asyncio.run(main())
+
+
+def test_session_lru_eviction_under_pressure():
+    """Slot pressure must evict the least-recently USED pinned session,
+    not an arbitrary one (VERDICT r2 weak #5): a freshly-touched session
+    survives a cold admission; the stale one pays."""
+
+    async def main():
+        config = LlamaConfig.tiny(max_seq_len=64)
+        params = init_params(config)
+        engine = DecodeEngine(
+            config, params, max_slots=3, max_seq_len=64, prefill_buckets=[16]
+        )
+        engine.start()
+        try:
+            sampling = SamplingParams(max_new_tokens=2)
+            r = {}
+            for name, prompt in (("A", [1, 2]), ("B", [3, 4]), ("C", [5, 6])):
+                r[name] = await engine.generate(
+                    prompt, sampling, session_id=name
+                )
+            # touch A: warm follow-up — A becomes most recently used
+            hits = engine.stats["session_hits"]
+            await engine.generate(
+                [1, 2] + r["A"].tokens + [9], sampling, session_id="A"
+            )
+            assert engine.stats["session_hits"] == hits + 1
+
+            # cold admission with all slots pinned: B (stalest) is evicted
+            await engine.generate([7, 8], sampling)
+            sessions = {s.session_id for s in engine.slots}
+            assert "A" in sessions and "C" in sessions
+            assert "B" not in sessions
+
+            # A is still warm: another follow-up is a session hit...
+            hits = engine.stats["session_hits"]
+            a_history = next(
+                s.history for s in engine.slots if s.session_id == "A"
+            )
+            await engine.generate(
+                list(a_history) + [10], sampling, session_id="A"
+            )
+            assert engine.stats["session_hits"] == hits + 1
+            # ...while B went cold: its follow-up re-prefills
+            prefills = engine.stats["prefill_calls"]
+            await engine.generate(
+                [3, 4] + r["B"].tokens + [11], sampling, session_id="B"
+            )
+            assert engine.stats["prefill_calls"] == prefills + 1
+        finally:
+            engine.stop()
+
+    asyncio.run(main())

@@ -1,0 +1,191 @@
+"""Bedrock (SigV4 REST) and Vertex (service-account OAuth) providers
+against in-process mock endpoints (reference:
+BedrockServiceProvider.java:47, VertexAIProvider.java:58)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+
+import pytest
+from aiohttp import web
+from cryptography.hazmat.primitives import serialization
+from cryptography.hazmat.primitives.asymmetric import rsa
+
+from langstream_tpu.api.service import ChatMessage
+from langstream_tpu.providers.registry import ServiceProviderRegistry
+
+
+class _Server:
+    def __init__(self, routes):
+        self.routes = routes
+        self.requests: list = []
+        self.port = None
+        self._runner = None
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(
+            target=self._loop.run_forever, daemon=True
+        )
+        self._thread.start()
+
+    def start(self) -> int:
+        async def go():
+            app = web.Application()
+            for method, path, handler in self.routes:
+                app.router.add_route(method, path, handler)
+            self._runner = web.AppRunner(app, access_log=None)
+            await self._runner.setup()
+            site = web.TCPSite(self._runner, "127.0.0.1", 0)
+            await site.start()
+            return site._server.sockets[0].getsockname()[1]  # noqa: SLF001
+
+        self.port = asyncio.run_coroutine_threadsafe(
+            go(), self._loop
+        ).result(10)
+        return self.port
+
+    def stop(self):
+        asyncio.run_coroutine_threadsafe(
+            self._runner.cleanup(), self._loop
+        ).result(10)
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=10)
+
+
+def test_bedrock_completions_signed():
+    seen = {}
+
+    async def invoke(request: web.Request):
+        seen["auth"] = request.headers.get("Authorization", "")
+        seen["model"] = request.match_info["model"]
+        seen["body"] = json.loads(await request.read())
+        return web.json_response({"generation": "the llama answers"})
+
+    server = _Server([("POST", "/model/{model}/invoke", invoke)])
+    port = server.start()
+    try:
+        registry = ServiceProviderRegistry({
+            "aws": {
+                "type": "bedrock-configuration",
+                "configuration": {
+                    "access-key": "AK", "secret-key": "SK",
+                    "region": "eu-west-1",
+                    "endpoint-override": f"http://127.0.0.1:{port}",
+                },
+            }
+        })
+        service = registry.completions("aws")
+        result = asyncio.run(service.get_chat_completions(
+            [ChatMessage(role="user", content="hello?")],
+            {"model": "meta.llama3-8b-instruct-v1:0",
+             "request-parameters": {"temperature": 0.2},
+             "max-tokens": 64},
+        ))
+        assert result.content == "the llama answers"
+        assert seen["model"] == "meta.llama3-8b-instruct-v1:0"
+        assert seen["auth"].startswith("AWS4-HMAC-SHA256 Credential=AK/")
+        assert "/eu-west-1/bedrock/aws4_request" in seen["auth"]
+        assert seen["body"]["temperature"] == 0.2
+        assert "user: hello?" in seen["body"]["prompt"]
+        asyncio.run(service.close())
+    finally:
+        server.stop()
+
+
+def test_bedrock_response_path_override():
+    async def invoke(request: web.Request):
+        return web.json_response({"odd": {"nest": [{"txt": "deep"}]}})
+
+    server = _Server([("POST", "/model/{model}/invoke", invoke)])
+    port = server.start()
+    try:
+        from langstream_tpu.providers.bedrock import (
+            BedrockCompletionsService,
+        )
+
+        service = BedrockCompletionsService({
+            "access-key": "a", "secret-key": "s",
+            "endpoint-override": f"http://127.0.0.1:{port}",
+        })
+        result = asyncio.run(service.get_chat_completions(
+            [ChatMessage(role="user", content="q")],
+            {"model": "m", "response-completions-path": "odd.nest[0].txt"},
+        ))
+        assert result.content == "deep"
+        asyncio.run(service.close())
+    finally:
+        server.stop()
+
+
+def test_vertex_service_account_oauth_and_predict():
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    pem = key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption(),
+    ).decode()
+    seen = {}
+
+    async def token(request: web.Request):
+        form = await request.post()
+        seen["grant"] = form["grant_type"]
+        seen["assertion_parts"] = form["assertion"].count(".")
+        return web.json_response(
+            {"access_token": "tok-123", "expires_in": 3600}
+        )
+
+    async def predict(request: web.Request):
+        seen["bearer"] = request.headers.get("Authorization")
+        seen["path"] = request.path
+        body = json.loads(await request.read())
+        if "messages" in body["instances"][0]:
+            return web.json_response({
+                "predictions": [
+                    {"candidates": [{"content": "vertex says hi"}]}
+                ]
+            })
+        return web.json_response({
+            "predictions": [
+                {"embeddings": {"values": [0.1, 0.2]}}
+                for _ in body["instances"]
+            ]
+        })
+
+    server = _Server([
+        ("POST", "/token", token),
+        ("POST", "/v1/projects/{p}/locations/{r}/publishers/google/models/{m:.+}", predict),
+    ])
+    port = server.start()
+    try:
+        registry = ServiceProviderRegistry({
+            "gcp": {
+                "type": "vertex-configuration",
+                "configuration": {
+                    "url": f"http://127.0.0.1:{port}",
+                    "project": "proj", "region": "us-central1",
+                    "token-url": f"http://127.0.0.1:{port}/token",
+                    "serviceAccountJson": json.dumps({
+                        "client_email": "sa@proj.iam.gserviceaccount.com",
+                        "private_key": pem,
+                    }),
+                },
+            }
+        })
+        service = registry.completions("gcp")
+        result = asyncio.run(service.get_chat_completions(
+            [ChatMessage(role="user", content="hello")],
+            {"model": "chat-bison", "max-tokens": 32},
+        ))
+        assert result.content == "vertex says hi"
+        assert seen["grant"] == "urn:ietf:params:oauth:grant-type:jwt-bearer"
+        assert seen["assertion_parts"] == 2  # header.claims.signature
+        assert seen["bearer"] == "Bearer tok-123"
+        assert "chat-bison:predict" in seen["path"]
+
+        embeddings = registry.embeddings("gcp", model="textembedding-gecko")
+        vectors = asyncio.run(embeddings.compute_embeddings(["a", "b"]))
+        assert vectors == [[0.1, 0.2], [0.1, 0.2]]
+        asyncio.run(service.close())
+    finally:
+        server.stop()
