@@ -14,10 +14,11 @@ Built-ins:
 - ``service: vector``  — the TPU-native vector store
   (``langstream_tpu.agents.vectorstore``), queried with JSON specs.
 
-External engines from the reference (Cassandra/Astra, Milvus, Pinecone,
-OpenSearch, Solr) are declared-but-gated: their client libraries are not in
-this image, so their configs validate and fail at `start` with an explicit
-message rather than at plan time.
+External engines: OpenSearch/Elasticsearch, Pinecone, and Solr are
+implemented natively over their REST APIs (``external_stores.py``);
+Cassandra/Astra and Milvus (binary protocols needing client libraries
+not in this image) are declared-but-gated — configs validate and fail at
+``start`` with an explicit message rather than at plan time.
 """
 
 from __future__ import annotations
@@ -27,9 +28,10 @@ import json
 import re
 from typing import Any, Dict, List, Optional
 
-_GATED_SERVICES = {
-    "astra", "cassandra", "milvus", "pinecone", "opensearch", "solr", "jdbc",
-}
+# engines whose client protocol needs a library not in this image
+# (CQL / Milvus gRPC); REST-based engines are implemented natively in
+# ``external_stores.py``
+_GATED_SERVICES = {"astra", "cassandra", "milvus", "jdbc"}
 
 
 class DataSource:
@@ -159,6 +161,22 @@ class DataSourceRegistry:
             from langstream_tpu.agents.vectorstore import VectorStoreDataSource
 
             source = VectorStoreDataSource(config)
+        elif service in ("opensearch", "elasticsearch"):
+            from langstream_tpu.agents.external_stores import (
+                OpenSearchDataSource,
+            )
+
+            source = OpenSearchDataSource(config)
+        elif service == "pinecone":
+            from langstream_tpu.agents.external_stores import (
+                PineconeDataSource,
+            )
+
+            source = PineconeDataSource(config)
+        elif service == "solr":
+            from langstream_tpu.agents.external_stores import SolrDataSource
+
+            source = SolrDataSource(config)
         elif service in _GATED_SERVICES:
             raise ValueError(
                 f"datasource service {service!r} requires a client library "

@@ -1,0 +1,276 @@
+"""External vector-store datasources over their REST APIs.
+
+Reference: ``langstream-vector-agents/src/main/java/ai/langstream/agents/
+vector/{opensearch,pinecone,solr}/`` — the same stores, driven through
+their HTTP APIs with aiohttp instead of vendor SDKs (none are bundled in
+this image; all three expose full-featured REST surfaces).
+
+Each implements the datasource JSON-spec contract the vector agents use
+(``{"action": "search"|"upsert"|"delete", ...}`` with ``?`` params), so
+``vector-db-sink`` / ``query-vector-db`` pipelines move between the
+native TPU store and these engines by swapping the resource entry only.
+Results are normalized to ``{"id", "similarity", **metadata}`` rows.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+from langstream_tpu.agents.datasource import DataSource, _substitute
+
+
+def _fill(query: str, params: List[Any]) -> Dict[str, Any]:
+    return json.loads(_substitute(query, params))
+
+
+class _RestDataSource(DataSource):
+    def __init__(self) -> None:
+        self._session = None
+
+    async def _get_session(self, headers: Optional[Dict[str, str]] = None):
+        if self._session is None:
+            import aiohttp
+
+            auth = self._basic_auth()
+            self._session = aiohttp.ClientSession(
+                headers=headers or self._headers(), auth=auth
+            )
+        return self._session
+
+    def _headers(self) -> Dict[str, str]:
+        return {}
+
+    def _basic_auth(self):
+        return None
+
+    async def _call(self, method: str, url: str, body: Any = None) -> Any:
+        session = await self._get_session()
+        async with session.request(method, url, json=body) as response:
+            text = await response.text()
+            if response.status >= 300:
+                raise IOError(
+                    f"{type(self).__name__} {method} {url}: "
+                    f"HTTP {response.status}: {text[:400]}"
+                )
+            return json.loads(text) if text else {}
+
+    async def close(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+
+class OpenSearchDataSource(_RestDataSource):
+    """OpenSearch/Elasticsearch kNN index (reference:
+    ``vector/opensearch/OpenSearchDataSource.java``).
+
+    Config: ``endpoint`` (or ``hosts``), ``index-name``, optional
+    ``username``/``password``, ``vector-field`` (default ``embeddings``).
+    """
+
+    def __init__(self, config: Dict[str, Any]) -> None:
+        super().__init__()
+        endpoint = config.get("endpoint") or config.get("hosts")
+        if isinstance(endpoint, list):
+            endpoint = endpoint[0]
+        if not endpoint:
+            raise ValueError("opensearch datasource needs 'endpoint'")
+        self.endpoint = str(endpoint).rstrip("/")
+        self.index = config.get("index-name", config.get("index", "langstream"))
+        self.vector_field = config.get("vector-field", "embeddings")
+        self.username = config.get("username")
+        self.password = config.get("password")
+
+    def _basic_auth(self):
+        if self.username:
+            import aiohttp
+
+            return aiohttp.BasicAuth(self.username, self.password or "")
+        return None
+
+    async def query(self, query: str, params: List[Any]) -> List[Dict[str, Any]]:
+        spec = _fill(query, params)
+        if "body" in spec:  # raw passthrough for power users
+            body = spec["body"]
+        else:
+            k = int(spec.get("top-k", 10))
+            body = {
+                "size": k,
+                "query": {
+                    "knn": {
+                        self.vector_field: {"vector": spec["vector"], "k": k}
+                    }
+                },
+            }
+        payload = await self._call(
+            "POST", f"{self.endpoint}/{self.index}/_search", body
+        )
+        out = []
+        for hit in payload.get("hits", {}).get("hits", []):
+            source = dict(hit.get("_source", {}))
+            source.pop(self.vector_field, None)
+            out.append({
+                "id": hit.get("_id"),
+                "similarity": hit.get("_score", 0.0),
+                **source,
+            })
+        return out
+
+    async def execute(self, statement: str, params: List[Any]) -> Dict[str, Any]:
+        spec = _fill(statement, params)
+        action = spec.get("action")
+        if action == "upsert":
+            document = {
+                self.vector_field: spec["vector"],
+                **(spec.get("metadata") or {}),
+            }
+            await self._call(
+                "PUT",
+                f"{self.endpoint}/{self.index}/_doc/{spec['id']}"
+                "?refresh=true",
+                document,
+            )
+            return {"rowcount": 1}
+        if action == "delete":
+            await self._call(
+                "DELETE",
+                f"{self.endpoint}/{self.index}/_doc/{spec['id']}"
+                "?refresh=true",
+            )
+            return {"rowcount": 1}
+        raise ValueError(f"unsupported opensearch action {action!r}")
+
+
+class PineconeDataSource(_RestDataSource):
+    """Pinecone index over its data-plane REST API (reference:
+    ``vector/pinecone/PineconeDataSource.java``).
+
+    Config: ``endpoint`` (index host, e.g. ``https://idx-xxx.svc...``),
+    ``api-key``, optional ``namespace``.
+    """
+
+    def __init__(self, config: Dict[str, Any]) -> None:
+        super().__init__()
+        endpoint = config.get("endpoint")
+        if not endpoint:
+            raise ValueError("pinecone datasource needs 'endpoint'")
+        self.endpoint = endpoint.rstrip("/")
+        self.api_key = config.get("api-key", "")
+        self.namespace = config.get("namespace")
+
+    def _headers(self) -> Dict[str, str]:
+        return {"Api-Key": self.api_key}
+
+    async def query(self, query: str, params: List[Any]) -> List[Dict[str, Any]]:
+        spec = _fill(query, params)
+        body: Dict[str, Any] = {
+            "vector": spec["vector"],
+            "topK": int(spec.get("top-k", 10)),
+            "includeMetadata": True,
+        }
+        if self.namespace:
+            body["namespace"] = self.namespace
+        if spec.get("filter"):
+            body["filter"] = spec["filter"]
+        payload = await self._call("POST", f"{self.endpoint}/query", body)
+        return [
+            {
+                "id": match.get("id"),
+                "similarity": match.get("score", 0.0),
+                **(match.get("metadata") or {}),
+            }
+            for match in payload.get("matches", [])
+        ]
+
+    async def execute(self, statement: str, params: List[Any]) -> Dict[str, Any]:
+        spec = _fill(statement, params)
+        action = spec.get("action")
+        if action == "upsert":
+            body: Dict[str, Any] = {"vectors": [{
+                "id": str(spec["id"]),
+                "values": spec["vector"],
+                "metadata": spec.get("metadata") or {},
+            }]}
+            if self.namespace:
+                body["namespace"] = self.namespace
+            payload = await self._call(
+                "POST", f"{self.endpoint}/vectors/upsert", body
+            )
+            return {"rowcount": int(payload.get("upsertedCount", 1))}
+        if action == "delete":
+            body = {"ids": [str(spec["id"])]}
+            if self.namespace:
+                body["namespace"] = self.namespace
+            await self._call(
+                "POST", f"{self.endpoint}/vectors/delete", body
+            )
+            return {"rowcount": 1}
+        raise ValueError(f"unsupported pinecone action {action!r}")
+
+
+class SolrDataSource(_RestDataSource):
+    """Solr collection with dense-vector kNN (reference:
+    ``vector/solr/SolrDataSource.java``).
+
+    Config: ``endpoint`` (e.g. ``http://host:8983/solr``),
+    ``collection-name``, ``vector-field`` (default ``embeddings``).
+    """
+
+    def __init__(self, config: Dict[str, Any]) -> None:
+        super().__init__()
+        endpoint = config.get("endpoint") or config.get("hosts")
+        if not endpoint:
+            raise ValueError("solr datasource needs 'endpoint'")
+        self.endpoint = str(endpoint).rstrip("/")
+        self.collection = config.get(
+            "collection-name", config.get("collection", "langstream")
+        )
+        self.vector_field = config.get("vector-field", "embeddings")
+
+    async def query(self, query: str, params: List[Any]) -> List[Dict[str, Any]]:
+        spec = _fill(query, params)
+        k = int(spec.get("top-k", 10))
+        vector = "[" + ",".join(str(float(x)) for x in spec["vector"]) + "]"
+        body = {
+            "query": f"{{!knn f={self.vector_field} topK={k}}}{vector}",
+            "limit": k,
+            "fields": "*,score",
+        }
+        payload = await self._call(
+            "POST", f"{self.endpoint}/{self.collection}/select", body
+        )
+        out = []
+        for doc in payload.get("response", {}).get("docs", []):
+            doc = dict(doc)
+            doc.pop(self.vector_field, None)
+            out.append({
+                "id": doc.pop("id", None),
+                "similarity": doc.pop("score", 0.0),
+                **doc,
+            })
+        return out
+
+    async def execute(self, statement: str, params: List[Any]) -> Dict[str, Any]:
+        spec = _fill(statement, params)
+        action = spec.get("action")
+        if action == "upsert":
+            document = {
+                "id": str(spec["id"]),
+                self.vector_field: spec["vector"],
+                **(spec.get("metadata") or {}),
+            }
+            await self._call(
+                "POST",
+                f"{self.endpoint}/{self.collection}/update?commit=true",
+                [document],
+            )
+            return {"rowcount": 1}
+        if action == "delete":
+            await self._call(
+                "POST",
+                f"{self.endpoint}/{self.collection}/update?commit=true",
+                {"delete": [str(spec["id"])]},
+            )
+            return {"rowcount": 1}
+        raise ValueError(f"unsupported solr action {action!r}")

@@ -257,6 +257,11 @@ async def run_application(
     path, ``langstream-cli/.../docker/LocalRunApplicationCmd.java:56``)."""
     from langstream_tpu.compiler import build_application, build_execution_plan
 
+    plugins_dir = os.environ.get("LANGSTREAM_PLUGINS_DIR")
+    if plugins_dir:
+        from langstream_tpu.runtime.plugins import load_plugins
+
+        load_plugins(plugins_dir)
     application = build_application(
         app_dir, instance_file=instance_file, secrets_file=secrets_file
     )

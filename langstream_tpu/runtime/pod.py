@@ -219,6 +219,11 @@ async def agent_runner_main(
     # pods can override the port via env without changing the manifest
     # command line (tests use this to avoid :8080 collisions)
     http_port = int(os.environ.get("LANGSTREAM_HTTP_PORT", http_port))
+    plugins_dir = os.environ.get("LANGSTREAM_PLUGINS_DIR")
+    if plugins_dir:
+        from langstream_tpu.runtime.plugins import load_plugins
+
+        load_plugins(plugins_dir)
     config = load_pod_configuration(config_path)
     node = node_from_document(config["agentNode"])
     # one pod = one replica; data parallelism is the StatefulSet's

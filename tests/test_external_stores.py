@@ -1,0 +1,198 @@
+"""OpenSearch / Pinecone / Solr datasources through the vector agents
+(sink + query), against in-process mock REST endpoints that remember the
+exact requests (reference: langstream-vector-agents/.../vector/*)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+
+import pytest
+from aiohttp import web
+
+from langstream_tpu.api.agent import AgentContext
+from langstream_tpu.api.records import Record
+from langstream_tpu.runtime.registry import create_agent
+from langstream_tpu.runtime.runner import process_and_collect
+
+
+class _Server:
+    def __init__(self, handler):
+        self.handler = handler
+        self.requests: list = []
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(
+            target=self._loop.run_forever, daemon=True
+        )
+        self._thread.start()
+        self._runner = None
+        self.port = None
+
+    def __enter__(self):
+        async def go():
+            app = web.Application()
+            app.router.add_route("*", "/{tail:.*}", self._dispatch)
+            self._runner = web.AppRunner(app, access_log=None)
+            await self._runner.setup()
+            site = web.TCPSite(self._runner, "127.0.0.1", 0)
+            await site.start()
+            return site._server.sockets[0].getsockname()[1]  # noqa: SLF001
+
+        self.port = asyncio.run_coroutine_threadsafe(
+            go(), self._loop
+        ).result(10)
+        return self
+
+    def __exit__(self, *exc):
+        asyncio.run_coroutine_threadsafe(
+            self._runner.cleanup(), self._loop
+        ).result(10)
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=10)
+
+    async def _dispatch(self, request: web.Request):
+        body = await request.read()
+        record = {
+            "method": request.method,
+            "path": request.path,
+            "query": dict(request.query),
+            "json": json.loads(body) if body else None,
+            "headers": dict(request.headers),
+        }
+        self.requests.append(record)
+        return self.handler(record)
+
+
+async def _sink_and_query(resources, sink_config, query_config, records):
+    context = AgentContext(agent_id="t", resources=resources)
+    sink = create_agent("vector-db-sink")
+    await sink.init(sink_config)
+    await sink.set_context(context)
+    await sink.start()
+    for record in records:
+        await sink.write(record)
+    await sink.close()
+
+    query = create_agent("query-vector-db")
+    await query.init(query_config)
+    await query.set_context(context)
+    await query.start()
+    results = await process_and_collect(
+        query, [Record(value={"qv": [0.1, 0.2]})]
+    )
+    await query.close()
+    (result,) = results
+    if result.error:
+        raise result.error
+    return result.result_records[0]
+
+
+def test_opensearch_through_vector_agents():
+    def handler(request):
+        if request["path"].endswith("/_search"):
+            return web.json_response({"hits": {"hits": [{
+                "_id": "d1", "_score": 0.93,
+                "_source": {"text": "hello os", "embeddings": [0, 0]},
+            }]}})
+        return web.json_response({"result": "ok"})
+
+    with _Server(handler) as server:
+        resources = {"os": {"type": "datasource", "configuration": {
+            "service": "opensearch",
+            "endpoint": f"http://127.0.0.1:{server.port}",
+            "index-name": "docs",
+            "username": "admin", "password": "pw",
+        }}}
+        out = asyncio.run(_sink_and_query(
+            resources,
+            {"datasource": "os", "vector.id": "value.id",
+             "vector.vector": "value.vec", "vector.text": "value.text"},
+            {"datasource": "os",
+             "query": json.dumps({"action": "search", "vector": "?", "top-k": 3}),
+             "fields": ["value.qv"], "output-field": "value.hits"},
+            [Record(value={"id": "d1", "vec": [0.1, 0.2], "text": "hello os"})],
+        ))
+        hits = out.value["hits"]
+        assert hits[0]["id"] == "d1" and hits[0]["text"] == "hello os"
+        assert "embeddings" not in hits[0]
+        upserts = [r for r in _requests(server) if r["method"] == "PUT"]
+        assert upserts[0]["path"] == "/docs/_doc/d1"
+        assert upserts[0]["json"]["embeddings"] == [0.1, 0.2]
+        searches = [r for r in _requests(server) if r["path"].endswith("/_search")]
+        assert searches[0]["json"]["query"]["knn"]["embeddings"]["k"] == 3
+
+
+def _requests(server):
+    return server.requests
+
+
+def test_pinecone_through_vector_agents():
+    def handler(request):
+        if request["path"] == "/query":
+            return web.json_response({"matches": [
+                {"id": "p1", "score": 0.88, "metadata": {"text": "pine"}},
+            ]})
+        return web.json_response({"upsertedCount": 1})
+
+    with _Server(handler) as server:
+        resources = {"pc": {"type": "datasource", "configuration": {
+            "service": "pinecone",
+            "endpoint": f"http://127.0.0.1:{server.port}",
+            "api-key": "pk-123", "namespace": "ns1",
+        }}}
+        out = asyncio.run(_sink_and_query(
+            resources,
+            {"datasource": "pc", "vector.id": "value.id",
+             "vector.vector": "value.vec", "vector.text": "value.text"},
+            {"datasource": "pc",
+             "query": json.dumps({"action": "search", "vector": "?", "top-k": 2}),
+             "fields": ["value.qv"], "output-field": "value.hits"},
+            [Record(value={"id": "p1", "vec": [0.1, 0.2], "text": "pine"})],
+        ))
+        assert out.value["hits"][0] == {
+            "id": "p1", "similarity": 0.88, "text": "pine",
+        }
+        upsert = next(r for r in server.requests if r["path"] == "/vectors/upsert")
+        assert upsert["headers"]["Api-Key"] == "pk-123"
+        assert upsert["json"]["namespace"] == "ns1"
+        assert upsert["json"]["vectors"][0]["values"] == [0.1, 0.2]
+        query = next(r for r in server.requests if r["path"] == "/query")
+        assert query["json"]["topK"] == 2
+
+
+def test_solr_through_vector_agents():
+    def handler(request):
+        if request["path"].endswith("/select"):
+            return web.json_response({"response": {"docs": [
+                {"id": "s1", "score": 0.7, "text": "solr doc",
+                 "embeddings": [0, 0]},
+            ]}})
+        return web.json_response({"responseHeader": {"status": 0}})
+
+    with _Server(handler) as server:
+        resources = {"solr": {"type": "datasource", "configuration": {
+            "service": "solr",
+            "endpoint": f"http://127.0.0.1:{server.port}/solr",
+            "collection-name": "docs",
+        }}}
+        out = asyncio.run(_sink_and_query(
+            resources,
+            {"datasource": "solr", "vector.id": "value.id",
+             "vector.vector": "value.vec", "vector.text": "value.text"},
+            {"datasource": "solr",
+             "query": json.dumps({"action": "search", "vector": "?", "top-k": 5}),
+             "fields": ["value.qv"], "output-field": "value.hits"},
+            [Record(value={"id": "s1", "vec": [0.1, 0.2], "text": "solr doc"})],
+        ))
+        assert out.value["hits"][0]["id"] == "s1"
+        assert out.value["hits"][0]["text"] == "solr doc"
+        update = next(
+            r for r in server.requests if "/update" in r["path"]
+        )
+        assert update["query"].get("commit") == "true"
+        assert update["json"][0]["embeddings"] == [0.1, 0.2]
+        select = next(
+            r for r in server.requests if r["path"].endswith("/select")
+        )
+        assert "{!knn f=embeddings topK=5}" in select["json"]["query"]

@@ -1,0 +1,126 @@
+"""Plugin packages (NAR equivalent, NarFileHandler.java:44): two plugins
+shipping the SAME module name must not collide (per-plugin namespace =
+the classloader-isolation property), zips load like directories, and a
+plugin agent runs inside a YAML app."""
+
+from __future__ import annotations
+
+import asyncio
+import textwrap
+import zipfile
+
+import pytest
+
+from langstream_tpu.runtime.plugins import load_plugin, load_plugins
+from langstream_tpu.runtime.registry import create_agent
+
+PLUGIN_A = """
+    from langstream_tpu.api.agent import SingleRecordProcessor
+
+    MARK = "A"
+
+    class Upper(SingleRecordProcessor):
+        async def process_record(self, record):
+            return [record.with_value(str(record.value).upper() + MARK)]
+"""
+
+PLUGIN_B = """
+    from langstream_tpu.api.agent import SingleRecordProcessor
+
+    MARK = "B"
+
+    class Lower(SingleRecordProcessor):
+        async def process_record(self, record):
+            return [record.with_value(str(record.value).lower() + MARK)]
+"""
+
+
+def _write_plugin(root, name, agents_yaml, module_source):
+    plugin = root / name
+    (plugin / "python").mkdir(parents=True)
+    (plugin / "plugin.yaml").write_text(
+        f"name: {name}\nagents:\n{agents_yaml}"
+    )
+    # both plugins use the SAME module name on purpose
+    (plugin / "python" / "impl.py").write_text(textwrap.dedent(module_source))
+    return plugin
+
+
+def test_same_module_name_does_not_collide(tmp_path):
+    _write_plugin(tmp_path, "plug-a", "  upper-agent: impl.Upper\n", PLUGIN_A)
+    _write_plugin(tmp_path, "plug-b", "  lower-agent: impl.Lower\n", PLUGIN_B)
+    loaded = load_plugins(str(tmp_path))
+    assert loaded == {
+        "plug-a": ["upper-agent"], "plug-b": ["lower-agent"],
+    }
+
+    from langstream_tpu.api.records import Record
+    from langstream_tpu.runtime.runner import process_and_collect
+
+    async def main():
+        upper = create_agent("upper-agent")
+        lower = create_agent("lower-agent")
+        await upper.init({})
+        await lower.init({})
+        (r1,) = await process_and_collect(upper, [Record(value="hi")])
+        (r2,) = await process_and_collect(lower, [Record(value="HI")])
+        assert r1.result_records[0].value == "HIA"   # plug-a's impl.MARK
+        assert r2.result_records[0].value == "hiB"   # plug-b's impl.MARK
+
+    asyncio.run(main())
+
+
+def test_zip_plugin(tmp_path):
+    source = _write_plugin(
+        tmp_path / "src", "zipped", "  zip-agent: impl.Upper\n", PLUGIN_A
+    )
+    archive = tmp_path / "zipped.zip"
+    with zipfile.ZipFile(archive, "w") as zf:
+        zf.write(source / "plugin.yaml", "plugin.yaml")
+        zf.write(source / "python" / "impl.py", "python/impl.py")
+    assert load_plugin(str(archive)) == ["zip-agent"]
+    agent = create_agent("zip-agent")
+    assert agent is not None
+
+
+def test_plugin_agent_in_yaml_app(tmp_path, monkeypatch):
+    from langstream_tpu.api.records import Record
+    from langstream_tpu.runtime.local import run_application
+
+    _write_plugin(
+        tmp_path / "plugins", "app-plug",
+        "  shout-plugin: impl.Upper\n", PLUGIN_A,
+    )
+    app_dir = tmp_path / "app"
+    app_dir.mkdir()
+    (app_dir / "pipeline.yaml").write_text(textwrap.dedent("""
+        topics:
+          - name: "in"
+            creation-mode: create-if-not-exists
+          - name: "out"
+            creation-mode: create-if-not-exists
+        pipeline:
+          - id: "shout"
+            type: "shout-plugin"
+            input: "in"
+            output: "out"
+    """))
+    monkeypatch.setenv("LANGSTREAM_PLUGINS_DIR", str(tmp_path / "plugins"))
+
+    async def main():
+        runner = await run_application(str(app_dir))
+        try:
+            producer = runner.producer("in")
+            await producer.write(Record(value="plug"))
+            reader = runner.reader("out")
+            out = []
+            deadline = asyncio.get_event_loop().time() + 15
+            while not out:
+                if asyncio.get_event_loop().time() > deadline:
+                    raise TimeoutError
+                out.extend(await reader.read(timeout=0.2))
+            assert out[0].value == "PLUGA"
+        finally:
+            await runner.stop()
+
+    asyncio.run(main())
