@@ -93,12 +93,19 @@ def _make_kafka(configuration: Dict[str, Any]) -> TopicConnectionsRuntime:
     return KafkaTopicConnectionsRuntime(configuration)
 
 
+def _make_pulsar(configuration: Dict[str, Any]) -> TopicConnectionsRuntime:
+    from langstream_tpu.topics.pulsar import PulsarTopicConnectionsRuntime
+
+    return PulsarTopicConnectionsRuntime(configuration)
+
+
 def _register_builtin() -> None:
     from langstream_tpu.topics.memory import MemoryTopicConnectionsRuntime
 
     register_topic_runtime("memory", lambda configuration=None: MemoryTopicConnectionsRuntime())
     register_topic_runtime("tpulog", _make_tpulog)
     register_topic_runtime("kafka", _make_kafka)
+    register_topic_runtime("pulsar", _make_pulsar)
 
 
 _register_builtin()

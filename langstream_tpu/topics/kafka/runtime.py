@@ -42,31 +42,12 @@ EARLIEST, LATEST = -2, -1
 
 
 # ---------------------------------------------------------------------- #
-# record (de)serialization
+# record (de)serialization (shared envelope: topics/serde.py)
 # ---------------------------------------------------------------------- #
-def _encode_payload(value: Any) -> Tuple[Optional[bytes], str]:
-    if value is None:
-        return None, "n"
-    if isinstance(value, bytes):
-        return value, "b"
-    if isinstance(value, str):
-        return value.encode("utf-8"), "s"
-    return json.dumps(value).encode("utf-8"), "j"
-
-
-def _decode_payload(data: Optional[bytes], kind: Optional[str]) -> Any:
-    if data is None or kind == "n":
-        return None
-    if kind == "b":
-        return data
-    if kind == "j":
-        return json.loads(data.decode("utf-8"))
-    if kind == "s":
-        return data.decode("utf-8")
-    try:  # foreign record: no envelope
-        return data.decode("utf-8")
-    except UnicodeDecodeError:
-        return data
+from langstream_tpu.topics.serde import (  # noqa: E402
+    decode_payload as _decode_payload,
+    encode_payload as _encode_payload,
+)
 
 
 def encode_record(record: Record) -> Tuple[

@@ -1,0 +1,158 @@
+"""In-process mock of Pulsar's WebSocket proxy + admin REST (the subset
+the pulsar topic runtime uses). Shared-subscription semantics: per-
+(topic, subscription) ack set; unacked messages are redelivered to the
+next consumer connection — enough to exercise the runtime's produce /
+consume / ack / reader flows over real WebSockets."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import Any, Dict, List, Set, Tuple
+
+from aiohttp import WSMsgType, web
+
+
+class MockPulsar:
+    def __init__(self) -> None:
+        self.topics: Dict[str, List[Dict[str, Any]]] = {}
+        self.acked: Dict[Tuple[str, str], Set[str]] = {}
+        self.port: int | None = None
+        self._runner = None
+
+    async def start(self) -> "MockPulsar":
+        app = web.Application()
+        app.router.add_get(
+            "/ws/v2/producer/persistent/{tenant}/{ns}/{topic}",
+            self._producer,
+        )
+        app.router.add_get(
+            "/ws/v2/consumer/persistent/{tenant}/{ns}/{topic}/{sub}",
+            self._consumer,
+        )
+        app.router.add_get(
+            "/ws/v2/reader/persistent/{tenant}/{ns}/{topic}",
+            self._reader,
+        )
+        app.router.add_put(
+            "/admin/v2/persistent/{tenant}/{ns}/{topic}", self._create
+        )
+        app.router.add_put(
+            "/admin/v2/persistent/{tenant}/{ns}/{topic}/partitions",
+            self._create,
+        )
+        app.router.add_delete(
+            "/admin/v2/persistent/{tenant}/{ns}/{topic}", self._delete
+        )
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]  # noqa: SLF001
+        return self
+
+    async def close(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def _key(self, request) -> str:
+        info = request.match_info
+        return f"{info['tenant']}/{info['ns']}/{info['topic']}"
+
+    # -- admin ---------------------------------------------------------- #
+    async def _create(self, request):
+        topic = self._key(request)
+        if topic in self.topics:
+            return web.Response(status=409)
+        self.topics[topic] = []
+        return web.Response(status=204)
+
+    async def _delete(self, request):
+        if self.topics.pop(self._key(request), None) is None:
+            return web.Response(status=404)
+        return web.Response(status=204)
+
+    # -- websocket endpoints -------------------------------------------- #
+    async def _producer(self, request):
+        ws = web.WebSocketResponse()
+        await ws.prepare(request)
+        topic = self._key(request)
+        messages = self.topics.setdefault(topic, [])
+        async for frame in ws:
+            if frame.type != WSMsgType.TEXT:
+                break
+            body = json.loads(frame.data)
+            message_id = f"{len(messages)}:0:-1"
+            messages.append({
+                "messageId": message_id,
+                "payload": body.get("payload", ""),
+                "properties": body.get("properties", {}),
+                "key": body.get("key"),
+                "publishTime": int(time.time() * 1000),
+            })
+            await ws.send_json({
+                "result": "ok", "messageId": message_id,
+                "context": body.get("context"),
+            })
+        return ws
+
+    async def _consumer(self, request):
+        ws = web.WebSocketResponse()
+        await ws.prepare(request)
+        topic = self._key(request)
+        subscription = request.match_info["sub"]
+        acked = self.acked.setdefault((topic, subscription), set())
+        delivered: Set[str] = set()
+
+        async def sender():
+            while not ws.closed:
+                for message in list(self.topics.get(topic, [])):
+                    mid = message["messageId"]
+                    if mid in acked or mid in delivered:
+                        continue
+                    delivered.add(mid)
+                    await ws.send_json(message)
+                await asyncio.sleep(0.02)
+
+        task = asyncio.get_running_loop().create_task(sender())
+        try:
+            async for frame in ws:
+                if frame.type != WSMsgType.TEXT:
+                    break
+                ack = json.loads(frame.data)
+                if "messageId" in ack:
+                    acked.add(ack["messageId"])
+        finally:
+            task.cancel()
+        return ws
+
+    async def _reader(self, request):
+        ws = web.WebSocketResponse()
+        await ws.prepare(request)
+        topic = self._key(request)
+        start = request.query.get("messageId", "latest")
+        position = 0 if start == "earliest" else len(self.topics.get(topic, []))
+
+        async def sender():
+            nonlocal position
+            while not ws.closed:
+                messages = self.topics.get(topic, [])
+                while position < len(messages):
+                    await ws.send_json(messages[position])
+                    position += 1
+                await asyncio.sleep(0.02)
+
+        task = asyncio.get_running_loop().create_task(sender())
+        try:
+            async for frame in ws:
+                if frame.type != WSMsgType.TEXT:
+                    break
+                # reader acks advance the proxy cursor; nothing to store
+        finally:
+            task.cancel()
+        return ws

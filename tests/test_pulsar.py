@@ -1,0 +1,178 @@
+"""Pulsar topic runtime against the mock WebSocket proxy (real PULSAR
+clusters work the same way via their built-in WS proxy; set
+PULSAR_WEB_URL to run these against one)."""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import os
+import textwrap
+
+import pytest
+
+from langstream_tpu.api.records import Record
+from langstream_tpu.api.topics import OffsetPosition, TopicSpec
+from langstream_tpu.topics.pulsar import PulsarTopicConnectionsRuntime
+
+EXTERNAL = os.environ.get("PULSAR_WEB_URL")
+
+
+@contextlib.asynccontextmanager
+async def pulsar_runtime(topic="t1"):
+    mock = None
+    if EXTERNAL:
+        web_url = EXTERNAL
+    else:
+        from tests.pulsar_mock import MockPulsar
+
+        mock = await MockPulsar().start()
+        web_url = mock.url
+    runtime = PulsarTopicConnectionsRuntime({"webServiceUrl": web_url})
+    admin = runtime.create_admin()
+    await admin.create_topic(TopicSpec(name=topic))
+    try:
+        yield runtime
+    finally:
+        await runtime.close()
+        if mock is not None:
+            await mock.close()
+
+
+def test_produce_consume_ack_roundtrip():
+    async def main():
+        async with pulsar_runtime() as runtime:
+            producer = runtime.create_producer("p", {"topic": "t1"})
+            await producer.start()
+            await producer.write(Record(
+                value={"n": 1}, key="k1", headers=(("h", b"\x01"),),
+            ))
+            await producer.write(Record(value="plain"))
+
+            consumer = runtime.create_consumer(
+                "a", {"topic": "t1", "group": "g1"}
+            )
+            await consumer.start()
+            got = []
+            for _ in range(100):
+                got.extend(await consumer.read(timeout=0.2))
+                if len(got) >= 2:
+                    break
+            assert got[0].value == {"n": 1} and got[0].key == "k1"
+            assert got[0].header("h") == b"\x01"
+            assert got[1].value == "plain"
+            # ack only the SECOND record; the first must be redelivered
+            # to a new consumer on the same subscription
+            await consumer.commit([got[1]])
+            await consumer.close()
+
+            consumer2 = runtime.create_consumer(
+                "a", {"topic": "t1", "group": "g1"}
+            )
+            await consumer2.start()
+            redelivered = []
+            for _ in range(100):
+                redelivered.extend(await consumer2.read(timeout=0.2))
+                if redelivered:
+                    break
+            assert [r.value for r in redelivered] == [{"n": 1}]
+            await consumer2.commit(redelivered)
+            await consumer2.close()
+
+    asyncio.run(main())
+
+
+def test_reader_positions():
+    async def main():
+        async with pulsar_runtime(topic="t2") as runtime:
+            producer = runtime.create_producer("p", {"topic": "t2"})
+            await producer.write(Record(value="old"))
+            latest = runtime.create_reader(
+                {"topic": "t2"}, OffsetPosition.LATEST
+            )
+            await latest.start()
+            assert await latest.read(timeout=0.15) == []
+            await producer.write(Record(value="new"))
+            got = []
+            for _ in range(50):
+                got.extend(await latest.read(timeout=0.2))
+                if got:
+                    break
+            assert [r.value for r in got] == ["new"]
+
+            earliest = runtime.create_reader(
+                {"topic": "t2"}, OffsetPosition.EARLIEST
+            )
+            all_records = []
+            for _ in range(50):
+                all_records.extend(await earliest.read(timeout=0.2))
+                if len(all_records) >= 2:
+                    break
+            assert [r.value for r in all_records] == ["old", "new"]
+
+    asyncio.run(main())
+
+
+@pytest.mark.slow
+def test_app_runs_unchanged_on_pulsar(tmp_path):
+    from langstream_tpu.runtime.local import run_application
+
+    app_dir = tmp_path / "app"
+    (app_dir / "python").mkdir(parents=True)
+    (app_dir / "pipeline.yaml").write_text(textwrap.dedent("""
+        topics:
+          - name: "in"
+            creation-mode: create-if-not-exists
+          - name: "out"
+            creation-mode: create-if-not-exists
+        pipeline:
+          - id: "shout"
+            type: "python-processor"
+            input: "in"
+            output: "out"
+            configuration:
+              className: "shout_agent.Shout"
+    """))
+    (app_dir / "python" / "shout_agent.py").write_text(textwrap.dedent("""
+        class Shout:
+            def process(self, record):
+                return [record.value.upper() + "!"]
+    """))
+
+    async def main():
+        mock = None
+        if EXTERNAL:
+            web_url = EXTERNAL
+        else:
+            from tests.pulsar_mock import MockPulsar
+
+            mock = await MockPulsar().start()
+            web_url = mock.url
+        (tmp_path / "instance.yaml").write_text(textwrap.dedent(f"""
+            instance:
+              streamingCluster:
+                type: pulsar
+                configuration:
+                  webServiceUrl: "{web_url}"
+        """))
+        runner = await run_application(
+            str(app_dir), instance_file=str(tmp_path / "instance.yaml")
+        )
+        try:
+            producer = runner.producer("in")
+            await producer.start()
+            await producer.write(Record(value="hello"))
+            reader = runner.reader("out")
+            await reader.start()
+            out = []
+            for _ in range(150):
+                out.extend(await reader.read(timeout=0.2))
+                if out:
+                    break
+            assert out and out[0].value == "HELLO!"
+        finally:
+            await runner.stop()
+            if mock is not None:
+                await mock.close()
+
+    asyncio.run(main())
