@@ -451,6 +451,31 @@ def build_parser() -> argparse.ArgumentParser:
         "deployer", help="build the plan and write Agent CRs (deployer Job)"
     )
     deployer.add_argument("--delete", action="store_true")
+
+    # long-running services (what the helm chart's Deployments invoke)
+    cp = sub.add_parser("controlplane", help="run the REST control plane")
+    cp.add_argument("--host", default="0.0.0.0")
+    cp.add_argument("--port", type=int, default=8090)
+    cp.add_argument("--storage-path", default="/var/lib/langstream")
+    cp.add_argument("--code-storage", default=None,
+                    help="code storage config JSON (default: local-disk)")
+    cp.add_argument("--executor", choices=["kubernetes", "local", "none"],
+                    default="kubernetes")
+    cp.add_argument("--reconcile", action="store_true",
+                    help="also run the operator loop in-process")
+    cp.add_argument("--image", default="langstream-tpu/runtime:latest")
+    cp.add_argument("--auth-token", default=None)
+    cp.add_argument("--archetypes", default=None)
+
+    op = sub.add_parser("operator", help="run the reconcile loop")
+    op.add_argument("--interval", type=float, default=2.0)
+    op.add_argument("--image", default="langstream-tpu/runtime:latest")
+    op.add_argument("--code-storage", default=None)
+
+    gws = sub.add_parser("gateway-server", help="serve application gateways")
+    gws.add_argument("--host", default="0.0.0.0")
+    gws.add_argument("--port", type=int, default=8091)
+    gws.add_argument("--sync-interval", type=float, default=5.0)
     return parser
 
 
@@ -504,6 +529,18 @@ def main(argv: Optional[List[str]] = None) -> None:
         from langstream_tpu.runtime.pod import deployer_main
 
         asyncio.run(deployer_main(delete=args.delete))
+    elif args.command == "controlplane":
+        from langstream_tpu.cli.services import controlplane_main
+
+        asyncio.run(controlplane_main(args))
+    elif args.command == "operator":
+        from langstream_tpu.cli.services import operator_main
+
+        asyncio.run(operator_main(args))
+    elif args.command == "gateway-server":
+        from langstream_tpu.cli.services import gateway_server_main
+
+        asyncio.run(gateway_server_main(args))
 
 
 if __name__ == "__main__":

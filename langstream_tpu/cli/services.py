@@ -1,0 +1,191 @@
+"""Long-running service entry points: control plane, operator, gateway.
+
+The reference deploys these as separate images (langstream-webservice,
+langstream-k8s-deployer operator, langstream-api-gateway); here they are
+subcommands of the one runtime image, which is what the helm chart's
+Deployments invoke:
+
+- ``controlplane`` — REST webservice + (optionally) the reconcile loop,
+  file-backed stores under ``--storage-path``.
+- ``operator``     — standalone reconcile loop against the cluster's API
+  server (Application/Agent CRs → StatefulSets).
+- ``gateway-server`` — serves every deployed application's gateways,
+  discovering apps from Application CRs and connecting to each app's
+  own ``streamingCluster``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import signal
+from typing import Any, Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+
+def _install_stop(loop, stop: asyncio.Event) -> None:
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except (NotImplementedError, RuntimeError):
+            pass
+
+
+async def controlplane_main(args) -> None:
+    from langstream_tpu.controlplane import (
+        ApplicationService,
+        FileSystemApplicationStore,
+        GlobalMetadataStore,
+        TenantService,
+    )
+    from langstream_tpu.controlplane.codestorage import create_code_storage
+    from langstream_tpu.controlplane.webservice import ControlPlaneWebService
+
+    storage = args.storage_path
+    os.makedirs(storage, exist_ok=True)
+    store = FileSystemApplicationStore(os.path.join(storage, "apps"))
+    metadata = GlobalMetadataStore(os.path.join(storage, "metadata.json"))
+    tenants = TenantService(metadata)
+    if "default" not in {t.name for t in tenants.list()}:
+        tenants.create("default")
+    code_config = json.loads(args.code_storage) if args.code_storage else {
+        "type": "local-disk", "path": os.path.join(storage, "code"),
+    }
+    code = create_code_storage(code_config)
+
+    executor = None
+    operator = None
+    if args.executor == "kubernetes":
+        from langstream_tpu.deployer.kubeclient import create_kube_api
+        from langstream_tpu.deployer.operator import (
+            KubernetesExecutor,
+            Operator,
+        )
+
+        kube = create_kube_api()
+        operator = Operator(
+            kube, image=args.image, code_storage_config=code_config
+        )
+        executor = KubernetesExecutor(
+            kube, operator if args.reconcile else None
+        )
+    elif args.executor == "local":
+        from langstream_tpu.controlplane.service import LocalExecutor
+
+        executor = LocalExecutor()
+
+    service = ApplicationService(store, code, tenants, executor=executor)
+    webservice = ControlPlaneWebService(
+        service,
+        auth_token=args.auth_token or os.environ.get("LANGSTREAM_AUTH_TOKEN"),
+        archetypes_path=args.archetypes,
+    )
+    port = await webservice.start(args.host, args.port)
+    logger.info("control plane on %s:%d (storage %s)", args.host, port, storage)
+    print(f"control plane listening on http://{args.host}:{port}", flush=True)
+
+    stop = asyncio.Event()
+    _install_stop(asyncio.get_running_loop(), stop)
+    tasks = []
+    if operator is not None and args.reconcile:
+        tasks.append(asyncio.get_running_loop().create_task(
+            operator.run(stop=stop)
+        ))
+    try:
+        await stop.wait()
+    finally:
+        for task in tasks:
+            task.cancel()
+        await webservice.stop()
+
+
+async def operator_main(args) -> None:
+    from langstream_tpu.deployer.kubeclient import create_kube_api
+    from langstream_tpu.deployer.operator import Operator
+
+    code_config = (
+        json.loads(args.code_storage) if args.code_storage else {}
+    )
+    operator = Operator(
+        create_kube_api(), image=args.image, code_storage_config=code_config
+    )
+    stop = asyncio.Event()
+    _install_stop(asyncio.get_running_loop(), stop)
+    logger.info("operator reconcile loop started (interval %ss)", args.interval)
+    print("operator running", flush=True)
+    await operator.run(interval=args.interval, stop=stop)
+
+
+class GatewayAppWatcher:
+    """Polls Application CRs and (de)registers them with the gateway,
+    each with a topic runtime for its own streamingCluster (reference:
+    the api-gateway reads apps through the k8s application store)."""
+
+    def __init__(self, gateway, kube) -> None:
+        self.gateway = gateway
+        self.kube = kube
+        self._registered: Dict[tuple, Any] = {}
+
+    async def sync(self) -> None:
+        from langstream_tpu.deployer.crds import ApplicationCustomResource
+        from langstream_tpu.model.application import Application
+        from langstream_tpu.topics import create_topic_runtime
+
+        seen = set()
+        for doc in self.kube.list("Application"):
+            cr = ApplicationCustomResource.from_manifest(doc)
+            key = (cr.namespace, cr.name)
+            seen.add(key)
+            if key in self._registered:
+                continue
+            try:
+                application = Application.from_document(
+                    cr.application, cr.instance
+                )
+                application.application_id = cr.name
+                application.tenant = cr.namespace
+                runtime = create_topic_runtime(
+                    application.instance.streaming_cluster
+                )
+            except Exception:  # noqa: BLE001 — one bad app can't stop sync
+                logger.exception("cannot register app %s", key)
+                continue
+            self.gateway.register(cr.namespace, application, runtime)
+            self._registered[key] = runtime
+            logger.info("gateway registered %s/%s", *key)
+        for key in list(self._registered):
+            if key not in seen:
+                runtime = self._registered.pop(key)
+                self.gateway._apps.pop(key, None)  # noqa: SLF001
+                await runtime.close()
+                logger.info("gateway unregistered %s/%s", *key)
+
+    async def run(self, stop: asyncio.Event, interval: float = 5.0) -> None:
+        while not stop.is_set():
+            try:
+                await self.sync()
+            except Exception:  # noqa: BLE001
+                logger.exception("gateway app sync failed")
+            try:
+                await asyncio.wait_for(stop.wait(), timeout=interval)
+            except asyncio.TimeoutError:
+                pass
+
+
+async def gateway_server_main(args) -> None:
+    from langstream_tpu.deployer.kubeclient import create_kube_api
+    from langstream_tpu.gateway import GatewayServer
+
+    gateway = GatewayServer(host=args.host, port=args.port)
+    await gateway.start()
+    print(f"gateway listening on ws://{args.host}:{args.port}", flush=True)
+    stop = asyncio.Event()
+    _install_stop(asyncio.get_running_loop(), stop)
+    watcher = GatewayAppWatcher(gateway, create_kube_api())
+    try:
+        await watcher.run(stop, interval=args.sync_interval)
+    finally:
+        await gateway.stop()

@@ -1,0 +1,106 @@
+"""The long-running service subcommands (controlplane / operator /
+gateway-server) boot as real processes — what the helm chart Deployments
+invoke."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import signal
+import socket
+import urllib.request
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as sock:
+        sock.bind(("127.0.0.1", 0))
+        return sock.getsockname()[1]
+
+
+async def _wait_http(url: str, process, timeout=30.0) -> str:
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        if process.returncode is not None:
+            raise AssertionError(
+                (await process.stdout.read()).decode(errors="replace")
+            )
+        try:
+            with urllib.request.urlopen(url, timeout=1.0) as response:
+                return response.read().decode()
+        except Exception:  # noqa: BLE001
+            await asyncio.sleep(0.2)
+    raise TimeoutError(url)
+
+
+async def _spawn(args, env_extra, tmp):
+    env = {
+        "PATH": os.environ.get("PATH", ""),
+        "PYTHONPATH": REPO_ROOT,
+        "JAX_PLATFORMS": "cpu",
+        "HOME": str(tmp),
+        **env_extra,
+    }
+    return await asyncio.create_subprocess_exec(
+        "python", "-m", "langstream_tpu", *args,
+        env=env, cwd=REPO_ROOT,
+        stdout=asyncio.subprocess.PIPE,
+        stderr=asyncio.subprocess.STDOUT,
+    )
+
+
+async def _stop(process):
+    if process.returncode is None:
+        process.send_signal(signal.SIGTERM)
+        try:
+            await asyncio.wait_for(process.communicate(), timeout=15)
+        except asyncio.TimeoutError:
+            process.kill()
+            await process.communicate()
+
+
+@pytest.mark.slow
+def test_controlplane_command_boots(tmp_path):
+    async def main():
+        port = _free_port()
+        process = await _spawn(
+            ["controlplane", "--host", "127.0.0.1", "--port", str(port),
+             "--storage-path", str(tmp_path / "cp"), "--executor", "none"],
+            {}, tmp_path,
+        )
+        try:
+            health = await _wait_http(
+                f"http://127.0.0.1:{port}/healthz", process
+            )
+            assert json.loads(health)["status"] == "ok"
+            tenants = await _wait_http(
+                f"http://127.0.0.1:{port}/api/tenants", process
+            )
+            assert "default" in json.loads(tenants)
+        finally:
+            await _stop(process)
+
+    asyncio.run(main())
+
+
+@pytest.mark.slow
+def test_gateway_server_command_boots(tmp_path):
+    async def main():
+        port = _free_port()
+        process = await _spawn(
+            ["gateway-server", "--host", "127.0.0.1", "--port", str(port)],
+            {"LANGSTREAM_KUBE": "mock"}, tmp_path,
+        )
+        try:
+            health = await _wait_http(
+                f"http://127.0.0.1:{port}/healthz", process
+            )
+            assert json.loads(health)["status"].lower() == "ok"
+        finally:
+            await _stop(process)
+
+    asyncio.run(main())
