@@ -1,0 +1,64 @@
+"""Every shipped example application must at least parse and plan
+(`apps plan` succeeding is the contract that the YAML matches the agent
+docs and planner rules; the heavier run-through tests live in
+test_example_apps.py and bench.py)."""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+import yaml
+
+from langstream_tpu.compiler import build_application, build_execution_plan
+
+EXAMPLES = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples"
+)
+APPS = sorted(os.listdir(os.path.join(EXAMPLES, "applications")))
+
+# instance globals generous enough for every app's placeholders
+INSTANCE = {
+    "instance": {
+        "streamingCluster": {"type": "memory"},
+        "computeCluster": {"type": "local"},
+        "globals": {
+            "model": "tiny",
+            "tp": 1,
+            "max-slots": 4,
+            "max-seq-len": 256,
+            "max-tokens": 16,
+            "embedding-dimensions": 32,
+        },
+    }
+}
+
+SECRETS = {"secrets": [
+    {"id": "open-ai", "data": {"url": "http://localhost", "access-key": "k"}},
+]}
+
+
+@pytest.mark.parametrize("app", APPS)
+def test_example_app_plans(app, tmp_path):
+    instance_file = tmp_path / "instance.yaml"
+    instance_file.write_text(yaml.safe_dump(INSTANCE))
+    secrets_file = tmp_path / "secrets.yaml"
+    secrets_file.write_text(yaml.safe_dump(SECRETS))
+    application = build_application(
+        os.path.join(EXAMPLES, "applications", app),
+        instance_file=str(instance_file),
+        secrets_file=str(secrets_file),
+    )
+    plan = build_execution_plan(application)
+    assert plan.agents, f"{app}: empty plan"
+    for node in plan.agents:
+        for spec in [node.source, *node.processors, node.sink, node.service]:
+            assert spec is None or spec.agent_type
+
+
+def test_instances_parse():
+    for name in sorted(os.listdir(os.path.join(EXAMPLES, "instances"))):
+        with open(os.path.join(EXAMPLES, "instances", name)) as handle:
+            doc = yaml.safe_load(handle)
+        assert "instance" in doc, name
+        assert "streamingCluster" in doc["instance"], name
