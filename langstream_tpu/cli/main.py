@@ -476,6 +476,15 @@ def build_parser() -> argparse.ArgumentParser:
     gws.add_argument("--host", default="0.0.0.0")
     gws.add_argument("--port", type=int, default=8091)
     gws.add_argument("--sync-interval", type=float, default=5.0)
+
+    plugins = sub.add_parser("plugins", help="agent plugin packaging")
+    plugins_sub = plugins.add_subparsers(dest="plugins_command", required=True)
+    pkg = plugins_sub.add_parser(
+        "package", help="zip a plugin dir (the NAR-build equivalent)"
+    )
+    pkg.add_argument("plugin_dir")
+    pkg.add_argument("-o", "--output", default=None)
+    plugins_sub.add_parser("list", help="show loaded plugins")
     return parser
 
 
@@ -541,6 +550,28 @@ def main(argv: Optional[List[str]] = None) -> None:
         from langstream_tpu.cli.services import gateway_server_main
 
         asyncio.run(gateway_server_main(args))
+    elif args.command == "plugins" and args.plugins_command == "package":
+        import os
+        import zipfile
+
+        from langstream_tpu.runtime.plugins import load_plugin
+
+        plugin_dir = args.plugin_dir.rstrip("/")
+        # validate before packaging: a bad manifest fails at build time
+        load_plugin(plugin_dir)
+        output = args.output or f"{os.path.basename(plugin_dir)}.zip"
+        with zipfile.ZipFile(output, "w", zipfile.ZIP_DEFLATED) as zf:
+            for root, _dirs, files in os.walk(plugin_dir):
+                for name in files:
+                    if name.endswith(".pyc"):
+                        continue
+                    full = os.path.join(root, name)
+                    zf.write(full, os.path.relpath(full, plugin_dir))
+        print(f"packaged {plugin_dir} -> {output}")
+    elif args.command == "plugins" and args.plugins_command == "list":
+        from langstream_tpu.runtime.plugins import loaded_plugins
+
+        _print_json(loaded_plugins())
 
 
 if __name__ == "__main__":

@@ -24,6 +24,7 @@ from langstream_tpu.controlplane.codestorage import (  # noqa: F401
 from langstream_tpu.controlplane.stores import (  # noqa: F401
     ApplicationStore,
     FileSystemApplicationStore,
+    KubernetesApplicationStore,
     GlobalMetadataStore,
     InMemoryApplicationStore,
     StoredApplication,

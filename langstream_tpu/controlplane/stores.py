@@ -216,3 +216,122 @@ class GlobalMetadataStore:
         tmp = self._path.with_suffix(".tmp")
         tmp.write_text(json.dumps(self._data))
         tmp.replace(self._path)
+
+
+class KubernetesApplicationStore:
+    """Applications stored AS Application custom resources, secrets in a
+    sibling k8s Secret (reference: ``langstream-k8s-storage/.../apps/
+    KubernetesApplicationStore.java:66`` — the cluster is the database,
+    so every control-plane replica sees the same state and the operator
+    reconciles straight from what the store wrote).
+
+    Tenants map to namespaces; ``kube`` is any client with the
+    apply/get/list/delete verb interface (real REST client in-cluster,
+    the in-memory mock in tests).
+    """
+
+    _SECRET_PREFIX = "langstream-app-"
+
+    def __init__(self, kube) -> None:
+        self.kube = kube
+
+    # -- mapping -------------------------------------------------------- #
+    def _to_manifests(self, app: StoredApplication):
+        import base64
+
+        from langstream_tpu.deployer.crds import ApplicationCustomResource
+
+        cr = ApplicationCustomResource(
+            name=app.application_id,
+            namespace=app.tenant,
+            application=app.definition,
+            instance=app.instance,
+            code_archive_id=app.code_archive_id,
+            checksum=app.checksum,
+        )
+        manifest = cr.to_manifest()
+        manifest["metadata"].setdefault("annotations", {}).update({
+            "langstream.tpu/created-at": str(app.created_at),
+            "langstream.tpu/updated-at": str(app.updated_at),
+        })
+        secret = {
+            "apiVersion": "v1",
+            "kind": "Secret",
+            "metadata": {
+                "name": f"{self._SECRET_PREFIX}{app.application_id}",
+                "namespace": app.tenant,
+            },
+            "data": {
+                "secrets.json": base64.b64encode(
+                    json.dumps(app.secrets or {}).encode()
+                ).decode()
+            },
+        }
+        return manifest, secret
+
+    def _from_manifests(self, doc, secret) -> StoredApplication:
+        import base64
+
+        from langstream_tpu.deployer.crds import ApplicationCustomResource
+
+        cr = ApplicationCustomResource.from_manifest(doc)
+        secrets: Dict[str, Any] = {}
+        if secret:
+            raw = (secret.get("data") or {}).get("secrets.json")
+            if raw:
+                secrets = json.loads(base64.b64decode(raw))
+        annotations = doc.get("metadata", {}).get("annotations", {}) or {}
+        status = doc.get("status", {}) or {}
+        return StoredApplication(
+            application_id=cr.name,
+            tenant=cr.namespace,
+            definition=cr.application,
+            instance=cr.instance,
+            secrets=secrets,
+            code_archive_id=cr.code_archive_id,
+            checksum=cr.checksum,
+            status=status.get("phase", "CREATED"),
+            status_detail=status.get("detail", ""),
+            created_at=float(annotations.get(
+                "langstream.tpu/created-at", 0.0
+            ) or 0.0),
+            updated_at=float(annotations.get(
+                "langstream.tpu/updated-at", 0.0
+            ) or 0.0),
+        )
+
+    # -- verbs ---------------------------------------------------------- #
+    def put(self, app: StoredApplication) -> None:
+        app.updated_at = time.time()
+        manifest, secret = self._to_manifests(app)
+        self.kube.apply(secret)
+        self.kube.apply(manifest)
+
+    def get(self, tenant: str, application_id: str) -> Optional[StoredApplication]:
+        doc = self.kube.get("Application", tenant, application_id)
+        if doc is None:
+            return None
+        secret = self.kube.get(
+            "Secret", tenant, f"{self._SECRET_PREFIX}{application_id}"
+        )
+        return self._from_manifests(doc, secret)
+
+    def delete(self, tenant: str, application_id: str) -> None:
+        self.kube.delete("Application", tenant, application_id)
+        self.kube.delete(
+            "Secret", tenant, f"{self._SECRET_PREFIX}{application_id}"
+        )
+
+    def list(self, tenant: str) -> List[StoredApplication]:
+        out = []
+        for doc in self.kube.list("Application", tenant):
+            name = doc["metadata"]["name"]
+            secret = self.kube.get(
+                "Secret", tenant, f"{self._SECRET_PREFIX}{name}"
+            )
+            out.append(self._from_manifests(doc, secret))
+        return sorted(out, key=lambda app: app.application_id)
+
+    def on_tenant_deleted(self, tenant: str) -> None:
+        for doc in self.kube.list("Application", tenant):
+            self.delete(tenant, doc["metadata"]["name"])

@@ -14,8 +14,9 @@ Providers here:
   a configured PEM public key or a JWKS URI with kid-keyed key cache
   (reference: ``langstream-auth-jwt`` + ``JwksUriSigningKeyResolver.java``);
   claims become principal attributes.
-- ``google`` / ``github`` — gated: they need outbound calls to the identity
-  provider; configs validate but authentication fails with a clear error.
+- ``google``    — ID-token validation via the tokeninfo endpoint with an
+  audience check.
+- ``github``    — access-token validation via the user API.
 """
 
 from __future__ import annotations
@@ -228,15 +229,70 @@ class JwtAuthProvider(GatewayAuthProvider):
 JwtHS256AuthProvider = JwtAuthProvider
 
 
-class GatedAuthProvider(GatewayAuthProvider):
-    def __init__(self, name: str) -> None:
-        self.name = name
+class GoogleAuthProvider(GatewayAuthProvider):
+    """Google ID-token validation via the tokeninfo endpoint (reference:
+    ``langstream-api-gateway-auth/.../GoogleAuthenticationProvider``).
+    Config: ``clientId`` (audience check); ``tokeninfo-url`` override for
+    tests/self-hosted validators."""
+
+    def __init__(self, config: Dict[str, Any]) -> None:
+        self.client_id = config.get("clientId") or config.get("client-id")
+        self.tokeninfo_url = config.get(
+            "tokeninfo-url", "https://oauth2.googleapis.com/tokeninfo"
+        )
 
     async def authenticate(self, credentials: str) -> Principal:
-        raise AuthenticationFailed(
-            f"auth provider {self.name!r} requires outbound identity-provider "
-            "access not available in this build; use 'jwt' or 'http'"
-        )
+        import aiohttp
+
+        async with aiohttp.ClientSession() as session:
+            async with session.get(
+                self.tokeninfo_url, params={"id_token": credentials}
+            ) as response:
+                payload = await response.json(content_type=None)
+                if response.status >= 300:
+                    raise AuthenticationFailed(
+                        f"google tokeninfo HTTP {response.status}"
+                    )
+        if self.client_id and payload.get("aud") != self.client_id:
+            raise AuthenticationFailed("google token audience mismatch")
+        if "exp" in payload and float(payload["exp"]) < time.time():
+            raise AuthenticationFailed("google token expired")
+        subject = payload.get("email") or payload.get("sub")
+        if not subject:
+            raise AuthenticationFailed("google token has no subject")
+        return Principal(subject=str(subject), attributes=payload)
+
+
+class GithubAuthProvider(GatewayAuthProvider):
+    """GitHub access-token validation via the user API (reference:
+    ``GitHubAuthenticationProvider``). Config: ``api-url`` override for
+    tests/GHE."""
+
+    def __init__(self, config: Dict[str, Any]) -> None:
+        self.api_url = config.get(
+            "api-url", "https://api.github.com"
+        ).rstrip("/")
+
+    async def authenticate(self, credentials: str) -> Principal:
+        import aiohttp
+
+        async with aiohttp.ClientSession() as session:
+            async with session.get(
+                f"{self.api_url}/user",
+                headers={
+                    "Authorization": f"Bearer {credentials}",
+                    "Accept": "application/vnd.github+json",
+                },
+            ) as response:
+                if response.status >= 300:
+                    raise AuthenticationFailed(
+                        f"github user API HTTP {response.status}"
+                    )
+                payload = await response.json(content_type=None)
+        login = payload.get("login")
+        if not login:
+            raise AuthenticationFailed("github token has no login")
+        return Principal(subject=str(login), attributes=payload)
 
 
 def create_auth_provider(config: Dict[str, Any]) -> GatewayAuthProvider:
@@ -248,6 +304,8 @@ def create_auth_provider(config: Dict[str, Any]) -> GatewayAuthProvider:
         return HttpAuthProvider(configuration)
     if provider == "jwt":
         return JwtAuthProvider(configuration)
-    if provider in ("google", "github"):
-        return GatedAuthProvider(provider)
+    if provider == "google":
+        return GoogleAuthProvider(configuration)
+    if provider == "github":
+        return GithubAuthProvider(configuration)
     raise ValueError(f"unknown auth provider {provider!r}")

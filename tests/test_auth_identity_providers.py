@@ -1,0 +1,100 @@
+"""google / github gateway auth against mock identity endpoints
+(reference: langstream-api-gateway-auth providers)."""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+
+import pytest
+from aiohttp import web
+
+from langstream_tpu.gateway.auth import (
+    AuthenticationFailed,
+    create_auth_provider,
+)
+
+
+class _IdP:
+    def __init__(self, routes):
+        self.routes = routes
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(
+            target=self._loop.run_forever, daemon=True
+        )
+        self._thread.start()
+        self._runner = None
+        self.port = None
+
+    def __enter__(self):
+        async def go():
+            app = web.Application()
+            for method, path, handler in self.routes:
+                app.router.add_route(method, path, handler)
+            self._runner = web.AppRunner(app, access_log=None)
+            await self._runner.setup()
+            site = web.TCPSite(self._runner, "127.0.0.1", 0)
+            await site.start()
+            return site._server.sockets[0].getsockname()[1]  # noqa: SLF001
+
+        self.port = asyncio.run_coroutine_threadsafe(go(), self._loop).result(10)
+        return self
+
+    def __exit__(self, *exc):
+        asyncio.run_coroutine_threadsafe(
+            self._runner.cleanup(), self._loop
+        ).result(10)
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=10)
+
+
+def test_google_tokeninfo():
+    async def tokeninfo(request: web.Request):
+        token = request.query.get("id_token")
+        if token != "good":
+            return web.json_response({"error": "invalid"}, status=400)
+        return web.json_response({
+            "aud": "my-client", "sub": "1234",
+            "email": "user@example.com", "exp": str(time.time() + 300),
+        })
+
+    with _IdP([("GET", "/tokeninfo", tokeninfo)]) as idp:
+        provider = create_auth_provider({
+            "provider": "google",
+            "configuration": {
+                "clientId": "my-client",
+                "tokeninfo-url": f"http://127.0.0.1:{idp.port}/tokeninfo",
+            },
+        })
+        principal = asyncio.run(provider.authenticate("good"))
+        assert principal.subject == "user@example.com"
+        with pytest.raises(AuthenticationFailed):
+            asyncio.run(provider.authenticate("bad"))
+
+        wrong_audience = create_auth_provider({
+            "provider": "google",
+            "configuration": {
+                "clientId": "another-client",
+                "tokeninfo-url": f"http://127.0.0.1:{idp.port}/tokeninfo",
+            },
+        })
+        with pytest.raises(AuthenticationFailed, match="audience"):
+            asyncio.run(wrong_audience.authenticate("good"))
+
+
+def test_github_user_api():
+    async def user(request: web.Request):
+        if request.headers.get("Authorization") != "Bearer gho_valid":
+            return web.json_response({"message": "Bad credentials"}, status=401)
+        return web.json_response({"login": "octocat", "id": 1})
+
+    with _IdP([("GET", "/user", user)]) as idp:
+        provider = create_auth_provider({
+            "provider": "github",
+            "configuration": {"api-url": f"http://127.0.0.1:{idp.port}"},
+        })
+        principal = asyncio.run(provider.authenticate("gho_valid"))
+        assert principal.subject == "octocat"
+        with pytest.raises(AuthenticationFailed, match="401"):
+            asyncio.run(provider.authenticate("gho_stolen"))
