@@ -1,0 +1,136 @@
+"""AOT-compile the engine's real decode/prefill jits for a v5e topology
+(no TPU hardware needed — libtpu compiles against a topology descriptor)
+and print XLA's own memory/cost analysis.
+
+This is the blind-perf-debugging tool for when the chip is unreachable:
+temp memory ≈ materialized intermediates (a dequantized bf16 weight copy
+would show up as ~14 GB of temp for an 8B model); bytes-accessed versus
+the int8 weight footprint shows whether decode is at its weights-bound
+roofline.
+
+Usage: python tools/aot_probe.py [preset] [slots] [chunk] [seq]
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_platforms", "cpu")
+
+from jax.experimental import topologies  # noqa: E402
+from jax.sharding import Mesh, NamedSharding, PartitionSpec  # noqa: E402
+
+from langstream_tpu.ops.rope import rope_frequencies  # noqa: E402
+from langstream_tpu.providers.jax_local import model as model_lib  # noqa: E402
+from langstream_tpu.providers.jax_local.engine import (  # noqa: E402
+    _sample_with_logprob,
+)
+from langstream_tpu.providers.jax_local.quant import (  # noqa: E402
+    init_quantized_params,
+)
+
+
+def main() -> None:
+    preset = sys.argv[1] if len(sys.argv) > 1 else "llama-3-8b"
+    slots = int(sys.argv[2]) if len(sys.argv) > 2 else 32
+    chunk = int(sys.argv[3]) if len(sys.argv) > 3 else 32
+    seq = int(sys.argv[4]) if len(sys.argv) > 4 else 320
+
+    config = model_lib.LlamaConfig.from_dict({"preset": preset})
+    import dataclasses
+
+    config = dataclasses.replace(config, max_seq_len=seq)
+    topo = topologies.get_topology_desc("v5e:2x2", "tpu")
+    mesh = Mesh(topo.devices[:1], ("d",))
+    sharding = NamedSharding(mesh, PartitionSpec())
+
+    def shapes_of(tree):
+        return jax.tree_util.tree_map(
+            lambda leaf: jax.ShapeDtypeStruct(
+                leaf.shape, leaf.dtype, sharding=sharding
+            ),
+            tree,
+        )
+
+    params = shapes_of(
+        jax.eval_shape(lambda: init_quantized_params(config, seed=0))
+    )
+    cache = shapes_of(
+        jax.eval_shape(lambda: model_lib.init_cache(config, slots, seq))
+    )
+    freqs = rope_frequencies(
+        config.dims_per_head, config.max_seq_len, config.rope_theta
+    )
+
+    def arg(shape, dtype):
+        return jax.ShapeDtypeStruct(shape, dtype, sharding=sharding)
+
+    # -- the engine's decode chunk (engine._get_decode) ----------------- #
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def decode_run(params, cache, tokens, lengths, active, write_mask,
+                   temperature, top_k, top_p, rng):
+        def body(carry, key):
+            cache, tokens, lengths = carry
+            cache, logits = model_lib.decode_step(
+                config, params, cache, tokens, lengths, freqs, write_mask
+            )
+            sampled, lp = _sample_with_logprob(
+                logits, temperature, top_k, key, top_p
+            )
+            sampled = jnp.where(active, sampled, 0)
+            lengths = jnp.where(active, lengths + 1, lengths)
+            return (cache, sampled, lengths), (sampled, lp)
+
+        keys = jax.random.split(rng, chunk)
+        (cache, _, _), (out, lps) = jax.lax.scan(
+            body, (cache, tokens, lengths), keys
+        )
+        return cache, out.T, lps.T
+
+    lowered = decode_run.lower(
+        params, cache,
+        arg((slots,), jnp.int32), arg((slots,), jnp.int32),
+        arg((slots,), jnp.bool_), arg((slots,), jnp.bool_),
+        arg((slots,), jnp.float32), arg((slots,), jnp.int32),
+        arg((slots,), jnp.float32),
+        arg((2,), jnp.uint32),
+    )
+    compiled = lowered.compile()
+    mem = compiled.memory_analysis()
+    cost = compiled.cost_analysis()
+    if isinstance(cost, list):
+        cost = cost[0]
+    gb = 2 ** 30
+    weight_bytes = sum(
+        leaf.size * leaf.dtype.itemsize
+        for leaf in jax.tree_util.tree_leaves(params)
+    )
+    cache_bytes = sum(
+        leaf.size * leaf.dtype.itemsize
+        for leaf in jax.tree_util.tree_leaves(cache)
+    )
+    print(f"== decode chunk ({preset}, {slots} slots x {chunk} steps, seq {seq}) ==")
+    print(f"weights: {weight_bytes / gb:.2f} GB  kv cache: {cache_bytes / gb:.2f} GB")
+    print(f"temp:    {mem.temp_size_in_bytes / gb:.3f} GB")
+    print(f"args:    {mem.argument_size_in_bytes / gb:.2f} GB  "
+          f"output: {mem.output_size_in_bytes / gb:.2f} GB  "
+          f"(donation aliases the cache)")
+    if cost:
+        bytes_accessed = cost.get("bytes accessed", 0.0)
+        flops = cost.get("flops", 0.0)
+        per_step = bytes_accessed / chunk
+        ideal = weight_bytes + cache_bytes
+        print(f"bytes accessed: {bytes_accessed / gb:.1f} GB total, "
+              f"{per_step / gb:.2f} GB/step "
+              f"(weights+cache roofline {ideal / gb:.2f} GB/step, "
+              f"ratio {per_step / ideal:.2f}x)")
+        print(f"flops: {flops / 1e12:.2f} TF total")
+        print(f"roofline step time at 819 GB/s: {per_step / (819 * 2**30) * 1e3:.1f} ms")
+
+
+if __name__ == "__main__":
+    main()
