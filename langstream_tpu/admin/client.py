@@ -29,11 +29,13 @@ class AdminClient:
         tenant: str = "default",
         token: Optional[str] = None,
         timeout: float = 60.0,
+        retries: int = 3,
     ) -> None:
         self.base_url = base_url.rstrip("/")
         self.tenant = tenant
         self.token = token
         self.timeout = aiohttp.ClientTimeout(total=timeout)
+        self.retries = max(1, retries)
 
     def _headers(self) -> Dict[str, str]:
         if self.token:
@@ -51,20 +53,46 @@ class AdminClient:
         expect_text: bool = False,
         params: Optional[Dict[str, str]] = None,
     ) -> Any:
+        import asyncio
+
         url = f"{self.base_url}{path}"
-        async with aiohttp.ClientSession(timeout=self.timeout) as session:
-            async with session.request(
-                method, url, data=data, json=json_body,
-                headers=self._headers(), params=params,
-            ) as response:
-                if response.status >= 400:
-                    body = await response.text()
-                    raise AdminClientError(response.status, body)
-                if expect_bytes:
-                    return await response.read()
-                if expect_text:
-                    return await response.text()
-                return await response.json()
+        # exponential retry for transient failures (reference:
+        # admin-client ExponentialRetryPolicy): connection errors always
+        # retry; HTTP 5xx retries only for idempotent reads — a deploy
+        # that half-landed must not silently re-run
+        idempotent = method in ("GET", "HEAD")
+        last_error: Optional[BaseException] = None
+        for attempt in range(self.retries):
+            if attempt:
+                await asyncio.sleep(0.2 * (2 ** (attempt - 1)))
+            try:
+                async with aiohttp.ClientSession(
+                    timeout=self.timeout
+                ) as session:
+                    async with session.request(
+                        method, url, data=data, json=json_body,
+                        headers=self._headers(), params=params,
+                    ) as response:
+                        if response.status >= 400:
+                            body = await response.text()
+                            error = AdminClientError(response.status, body)
+                            if response.status >= 500 and idempotent:
+                                last_error = error
+                                continue
+                            raise error
+                        if expect_bytes:
+                            return await response.read()
+                        if expect_text:
+                            return await response.text()
+                        return await response.json()
+            except aiohttp.ClientConnectionError as error:
+                if data is not None:
+                    # multipart form data is consumed on first send and
+                    # cannot be replayed — surface the failure
+                    raise
+                last_error = error
+                continue
+        raise last_error  # type: ignore[misc]
 
     # -- applications (reference: AdminClient.applications()) ----------- #
     async def deploy_application(
@@ -149,6 +177,11 @@ class AdminClient:
     # -- archetypes ----------------------------------------------------- #
     async def list_archetypes(self) -> List[Dict[str, Any]]:
         return await self._request("GET", f"/api/archetypes/{self.tenant}")
+
+    async def get_archetype(self, archetype_id: str) -> Dict[str, Any]:
+        return await self._request(
+            "GET", f"/api/archetypes/{self.tenant}/{archetype_id}"
+        )
 
     async def deploy_from_archetype(
         self, archetype_id: str, application_id: str,

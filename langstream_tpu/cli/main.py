@@ -223,6 +223,18 @@ async def _apps_download(args) -> None:
     print(f"wrote {len(data)} bytes to {target}")
 
 
+async def _archetypes_cmd(args) -> None:
+    client = _admin(args)
+    if args.archetypes_command == "list":
+        _print_json(await client.list_archetypes())
+    elif args.archetypes_command == "get":
+        _print_json(await client.get_archetype(args.archetype_id))
+    elif args.archetypes_command == "deploy":
+        _print_json(await client.deploy_from_archetype(
+            args.archetype_id, args.app_id, _parse_params(args.param)
+        ))
+
+
 async def _tenants_cmd(args) -> None:
     client = _admin(args)
     if args.tenants_command == "list":
@@ -374,6 +386,24 @@ def build_parser() -> argparse.ArgumentParser:
     cmd.add_argument("-o", "--output", default=None)
     add_admin_flags(cmd)
 
+    archetypes = sub.add_parser("archetypes", help="application archetypes")
+    archetypes_sub = archetypes.add_subparsers(
+        dest="archetypes_command", required=True
+    )
+    cmd = archetypes_sub.add_parser("list")
+    add_admin_flags(cmd)
+    cmd = archetypes_sub.add_parser("get")
+    cmd.add_argument("archetype_id")
+    add_admin_flags(cmd)
+    cmd = archetypes_sub.add_parser(
+        "deploy", help="deploy an app from an archetype"
+    )
+    cmd.add_argument("archetype_id")
+    cmd.add_argument("app_id")
+    cmd.add_argument("-p", "--param", action="append", default=[],
+                     help="archetype parameter name=value")
+    add_admin_flags(cmd)
+
     tenants = sub.add_parser("tenants", help="tenant administration")
     tenants_sub = tenants.add_subparsers(dest="tenants_command", required=True)
     for name in ("list", "get", "put", "create", "delete"):
@@ -506,6 +536,8 @@ def main(argv: Optional[List[str]] = None) -> None:
         asyncio.run(_apps_logs(args))
     elif args.command == "apps" and args.apps_command == "download":
         asyncio.run(_apps_download(args))
+    elif args.command == "archetypes":
+        asyncio.run(_archetypes_cmd(args))
     elif args.command == "tenants":
         asyncio.run(_tenants_cmd(args))
     elif args.command == "profiles":

@@ -25,6 +25,7 @@ from langstream_tpu.controlplane.stores import (  # noqa: F401
     ApplicationStore,
     FileSystemApplicationStore,
     KubernetesApplicationStore,
+    KubernetesGlobalMetadataStore,
     GlobalMetadataStore,
     InMemoryApplicationStore,
     StoredApplication,

@@ -335,3 +335,56 @@ class KubernetesApplicationStore:
     def on_tenant_deleted(self, tenant: str) -> None:
         for doc in self.kube.list("Application", tenant):
             self.delete(tenant, doc["metadata"]["name"])
+
+
+class KubernetesGlobalMetadataStore:
+    """Global metadata in one ConfigMap (reference:
+    ``KubernetesGlobalMetadataStore`` — the tenant registry and other
+    cluster-wide state survive control-plane restarts through the
+    cluster itself). Same get/put/delete/keys surface as
+    :class:`GlobalMetadataStore`."""
+
+    CONFIGMAP = "langstream-global-metadata"
+
+    def __init__(self, kube, namespace: str = "default") -> None:
+        self.kube = kube
+        self.namespace = namespace
+        self._lock = threading.Lock()
+
+    def _load(self) -> Dict[str, Any]:
+        doc = self.kube.get("ConfigMap", self.namespace, self.CONFIGMAP)
+        if doc is None:
+            return {}
+        raw = (doc.get("data") or {}).get("metadata.json")
+        return json.loads(raw) if raw else {}
+
+    def _store(self, data: Dict[str, Any]) -> None:
+        self.kube.apply({
+            "apiVersion": "v1",
+            "kind": "ConfigMap",
+            "metadata": {
+                "name": self.CONFIGMAP, "namespace": self.namespace,
+            },
+            "data": {"metadata.json": json.dumps(data)},
+        })
+
+    def get(self, key: str, default: Any = None) -> Any:
+        with self._lock:
+            return self._load().get(key, default)
+
+    def put(self, key: str, value: Any) -> None:
+        with self._lock:
+            data = self._load()
+            data[key] = value
+            self._store(data)
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            data = self._load()
+            if key in data:
+                del data[key]
+                self._store(data)
+
+    def keys(self) -> List[str]:
+        with self._lock:
+            return sorted(self._load())

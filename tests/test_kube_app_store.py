@@ -63,3 +63,27 @@ def test_tenant_cleanup():
     store.on_tenant_deleted("team-a")
     assert store.list("team-a") == []
     assert [a.application_id for a in store.list("team-b")] == ["other"]
+
+
+def test_configmap_metadata_store_and_tenants():
+    from langstream_tpu.controlplane import (
+        KubernetesGlobalMetadataStore,
+        TenantService,
+    )
+
+    kube = MockKubeApi()
+    store = KubernetesGlobalMetadataStore(kube, namespace="langstream")
+    store.put("k1", {"a": 1})
+    assert store.get("k1") == {"a": 1}
+    assert store.keys() == ["k1"]
+    # persisted through the cluster: a new store instance sees it
+    assert KubernetesGlobalMetadataStore(
+        kube, namespace="langstream"
+    ).get("k1") == {"a": 1}
+    store.delete("k1")
+    assert store.keys() == []
+
+    # the tenant registry rides it unchanged
+    tenants = TenantService(store)
+    tenants.create("team-a")
+    assert "team-a" in {t.name for t in tenants.list()}
