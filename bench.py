@@ -47,6 +47,8 @@ REQUESTS = int(os.environ.get("BENCH_REQUESTS", "96"))
 MODE = os.environ.get("BENCH_MODE", "e2e")          # e2e | engine
 CLIENTS = int(os.environ.get("BENCH_CLIENTS", str(MAX_SLOTS)))
 ROUNDS = int(os.environ.get("BENCH_ROUNDS", "3"))   # questions per client
+# pipelined decode dispatch (hides the host/tunnel gap between chunks)
+PIPELINE = os.environ.get("BENCH_PIPELINE", "1") not in ("", "0")
 BASELINE_TOK_S = 800.0
 # the bench must ALWAYS emit its JSON line before the driver's timeout
 # kills it (round-1 failure mode: axon backend init hung ~25 min → rc=124,
@@ -184,6 +186,7 @@ async def run_bench():
         prefill_buckets=[PROMPT_LEN],
         decode_chunk=DECODE_CHUNK,
         quantize=QUANT,
+        pipeline_decode=PIPELINE,
     )
     try:
         engine.start()
@@ -272,6 +275,7 @@ async def run_bench_e2e():
                 "max-tokens": NEW_TOKENS,
                 "quantization": QUANT or "",
                 "decode-chunk": DECODE_CHUNK,
+                "pipeline-decode": PIPELINE,
             },
         }
     }

@@ -140,6 +140,8 @@ class _Slot:
     history: Optional[List[int]] = None  # full token history in cache
     session_id: Optional[str] = None     # pinned session (slot free but warm)
     last_used: float = 0.0               # monotonic; drives LRU eviction
+    epoch: int = 0                       # bumps on assign/finish; guards
+                                         # pipelined results for recycled slots
 
     @property
     def active(self) -> bool:
@@ -168,10 +170,17 @@ class DecodeEngine:
         decode_chunk: int = 8,
         seed: int = 0,
         quantize: Optional[str] = None,  # "int8" = weight-only int8
+        pipeline_decode: bool = False,
     ) -> None:
         self.config = config
         self.max_slots = max_slots
         self.decode_chunk = max(1, decode_chunk)
+        # pipelined decode: dispatch chunk N+1 from chunk N's on-device
+        # carry BEFORE host-processing N's tokens, hiding the host (and
+        # tunnel) round trip between chunks. Finished slots may burn up
+        # to one surplus chunk; results are epoch-guarded so a recycled
+        # slot never receives the old request's tokens.
+        self.pipeline_decode = pipeline_decode
         self.max_seq_len = min(
             max_seq_len or config.max_seq_len, config.max_seq_len
         )
@@ -334,10 +343,12 @@ class DecodeEngine:
                     return (cache, sampled, lengths), (sampled, lp)
 
                 keys = jax.random.split(rng, steps)
-                (cache, _, _), (out, lps) = jax.lax.scan(
+                (cache, final_tokens, final_lengths), (out, lps) = jax.lax.scan(
                     body, (cache, tokens, lengths), keys
                 )
-                return cache, out.T, lps.T  # [S, K] each
+                # final carry is returned ON DEVICE so a pipelined next
+                # chunk can chain without a host round trip
+                return cache, out.T, lps.T, final_tokens, final_lengths
 
             fn = run
             self._decode_fns[steps] = fn
@@ -415,8 +426,13 @@ class DecodeEngine:
         )
         try:
             with self.mesh:
+                inflight = None
                 while self._running:
-                    self._drain_queue(block=not self._any_active() and not self._pending)
+                    self._drain_queue(
+                        block=not self._any_active()
+                        and not self._pending
+                        and inflight is None
+                    )
                     if not self._running:
                         break
                     if self._pending and any(not s.active for s in self.slots):
@@ -425,9 +441,22 @@ class DecodeEngine:
                         # waves stay aligned (amortizes dispatch latency)
                         time.sleep(0.003)
                         self._drain_queue(block=False)
+                    if inflight is not None:
+                        # overlap: chain the next chunk off the device-side
+                        # carry BEFORE blocking on this one's tokens
+                        chained = None
+                        if self.pipeline_decode and self._can_chain(inflight):
+                            chained = self._dispatch_decode(carry=inflight)
+                        self._process_decode(inflight)
+                        self._admit()
+                        inflight = chained
+                        continue
                     self._admit()
                     if self._any_active():
-                        self._decode_once()
+                        inflight = self._dispatch_decode()
+                        if not self.pipeline_decode:
+                            self._process_decode(inflight)
+                            inflight = None
         except BaseException as exc:  # noqa: BLE001
             logger.exception("engine loop crashed")
             # flip the crash flag BEFORE failing waiters so a racing
@@ -566,6 +595,7 @@ class DecodeEngine:
                 slot.session_id = None
                 slot.length = len(prompt)
                 slot.last_used = time.monotonic()
+                slot.epoch += 1
             run = self._get_prefill(bucket)
             self.cache, logits = run(
                 self.params,
@@ -600,6 +630,7 @@ class DecodeEngine:
         slot.session_id = None
         slot.length = len(prompt)
         slot.last_used = time.monotonic()
+        slot.epoch += 1
         tokens = np.zeros((1, bucket), dtype=np.int32)
         tokens[0, : len(suffix)] = suffix
         run = self._get_prefill_offset(bucket)
@@ -629,38 +660,93 @@ class DecodeEngine:
         )
         return int(np.asarray(token)[0]), float(np.asarray(lp)[0])
 
-    def _decode_once(self) -> None:
-        started = time.perf_counter()
-        tokens = np.zeros((self.max_slots,), dtype=np.int32)
-        lengths = np.zeros((self.max_slots,), dtype=np.int32)
-        active = np.zeros((self.max_slots,), dtype=bool)
-        temperature = np.zeros((self.max_slots,), dtype=np.float32)
-        top_k = np.zeros((self.max_slots,), dtype=np.int32)
-        top_p = np.zeros((self.max_slots,), dtype=np.float32)
-        steps = self.decode_chunk
+    def _can_chain(self, inflight: Dict[str, Any]) -> bool:
+        """A chunk may be pre-dispatched off the in-flight carry only when
+        no admission is waiting and every active slot has ≥2 chunks of
+        budget and context left (so the blind chunk can't overrun)."""
+        if self._pending:
+            return False
+        steps = inflight["steps"]
         for i, slot in enumerate(self.slots):
-            lengths[i] = slot.length
-            if slot.active:
-                active[i] = True
-                tokens[i] = slot.history[-1]
-                lengths[i] = slot.length + 1
-                temperature[i] = slot.request.sampling.temperature
-                top_k[i] = slot.request.sampling.top_k
-                top_p[i] = slot.request.sampling.top_p
-                # a chunk writes cache positions up to length+steps-1;
-                # drop to single-step near the context boundary
-                if self.max_seq_len - slot.length - 1 < steps:
-                    steps = 1
+            if not inflight["active"][i]:
+                continue
+            if not slot.active or slot.epoch != inflight["epochs"][i]:
+                return False
+            request = slot.request
+            if len(slot.generated) + 2 * steps > request.sampling.max_new_tokens:
+                return False
+            if slot.length + 1 + 2 * steps >= self.max_seq_len:
+                return False
+        return True
+
+    def _dispatch_decode(
+        self, carry: Optional[Dict[str, Any]] = None
+    ) -> Dict[str, Any]:
+        """Dispatch one decode chunk. With ``carry`` (a previous chunk's
+        record), tokens/lengths chain on-device — no host round trip."""
+        started = time.perf_counter()
+        if carry is not None:
+            steps = carry["steps"]
+            active = carry["active"]
+            temperature, top_k, top_p = carry["sampling_arrays"]
+            tokens_arg = carry["final_tokens"]
+            lengths_arg = carry["final_lengths"]
+            active_arg = carry["active_dev"]
+            epochs = carry["epochs"]
+        else:
+            tokens = np.zeros((self.max_slots,), dtype=np.int32)
+            lengths = np.zeros((self.max_slots,), dtype=np.int32)
+            active = np.zeros((self.max_slots,), dtype=bool)
+            temperature = np.zeros((self.max_slots,), dtype=np.float32)
+            top_k = np.zeros((self.max_slots,), dtype=np.int32)
+            top_p = np.zeros((self.max_slots,), dtype=np.float32)
+            epochs = [0] * self.max_slots
+            steps = self.decode_chunk
+            for i, slot in enumerate(self.slots):
+                lengths[i] = slot.length
+                epochs[i] = slot.epoch
+                if slot.active:
+                    active[i] = True
+                    tokens[i] = slot.history[-1]
+                    lengths[i] = slot.length + 1
+                    temperature[i] = slot.request.sampling.temperature
+                    top_k[i] = slot.request.sampling.top_k
+                    top_p[i] = slot.request.sampling.top_p
+                    # a chunk writes cache positions up to length+steps-1;
+                    # drop to single-step near the context boundary
+                    if self.max_seq_len - slot.length - 1 < steps:
+                        steps = 1
+            temperature = jnp.asarray(temperature)
+            top_k = jnp.asarray(top_k)
+            top_p = jnp.asarray(top_p)
+            tokens_arg = jnp.asarray(tokens)
+            lengths_arg = jnp.asarray(lengths)
+            active_arg = jnp.asarray(active)
         run = self._get_decode(steps)
         self._rng, step_key = jax.random.split(self._rng)
-        self.cache, out_tokens, out_lps = run(
-            self.params, self.cache, jnp.asarray(tokens), jnp.asarray(lengths),
-            jnp.asarray(active), jnp.asarray(active), jnp.asarray(temperature),
-            jnp.asarray(top_k), jnp.asarray(top_p), step_key,
+        self.cache, out_tokens, out_lps, final_tokens, final_lengths = run(
+            self.params, self.cache, tokens_arg, lengths_arg,
+            active_arg, active_arg, temperature, top_k, top_p, step_key,
         )
-        out_host = np.asarray(out_tokens)  # [S, steps]
-        lps_host = np.asarray(out_lps)
-        wall = time.perf_counter() - started
+        return {
+            "out_tokens": out_tokens,
+            "out_lps": out_lps,
+            "final_tokens": final_tokens,
+            "final_lengths": final_lengths,
+            "active": active,
+            "active_dev": active_arg,
+            "sampling_arrays": (temperature, top_k, top_p),
+            "epochs": list(epochs),
+            "steps": steps,
+            "started": started,
+        }
+
+    def _process_decode(self, inflight: Dict[str, Any]) -> None:
+        steps = inflight["steps"]
+        active = inflight["active"]
+        out_host = np.asarray(inflight["out_tokens"])  # [S, steps]
+        lps_host = np.asarray(inflight["out_lps"])
+        wall = time.perf_counter() - inflight["started"]
         n_active = int(active.sum())
         self.stats["decode_steps"] += steps
         self.stats["decode_chunks"] += 1
@@ -670,6 +756,10 @@ class DecodeEngine:
             self.chunk_log.append((steps, n_active, wall))
         for i, slot in enumerate(self.slots):
             if not active[i]:
+                continue
+            if slot.epoch != inflight["epochs"][i]:
+                # the slot was recycled while this chunk was in flight —
+                # its sampled tokens belong to the finished request
                 continue
             for j in range(steps):
                 if not slot.active:
@@ -721,6 +811,7 @@ class DecodeEngine:
         self.stats["requests"] += 1
         # pin the slot for session reuse; otherwise free it fully
         slot.request = None
+        slot.epoch += 1
         slot.generated = None
         slot.logprobs = None
         if request.session_id is not None:

@@ -125,6 +125,9 @@ class JaxCompletionsService(CompletionsService):
             prefill_buckets=[int(b) for b in buckets] if buckets else None,
             decode_chunk=int(engine_config.get("decode-chunk", 8)),
             quantize=config.get("quantization"),
+            pipeline_decode=str(
+                engine_config.get("pipeline-decode", "")
+            ).lower() in ("1", "true", "yes"),
         )
         self.engine.start()
 

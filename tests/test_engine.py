@@ -403,3 +403,51 @@ def test_session_lru_eviction_under_pressure():
             engine.stop()
 
     asyncio.run(main())
+
+
+def test_pipeline_decode_matches_serial():
+    """Pipelined dispatch (chunk N+1 chained off chunk N's device carry)
+    must be token-identical to serial dispatch — including stop tokens
+    finishing mid-chunk, session reuse, and slot recycling under
+    concurrent load."""
+
+    async def run_engine(pipeline: bool):
+        config = LlamaConfig.tiny(max_seq_len=128)
+        params = init_params(config)
+        engine = DecodeEngine(
+            config, params, max_slots=2, max_seq_len=128,
+            prefill_buckets=[16, 32], decode_chunk=4,
+            pipeline_decode=pipeline,
+        )
+        engine.start()
+        try:
+            sampling = SamplingParams(max_new_tokens=17)
+            # concurrent burst: more requests than slots → recycling
+            results = await asyncio.gather(*[
+                engine.generate(
+                    [1 + i, 2, 3], sampling,
+                    stop_tokens={7} if i % 2 else set(),
+                    session_id=f"s{i}" if i < 2 else None,
+                )
+                for i in range(5)
+            ])
+            # warm follow-up on a pinned session
+            follow = await engine.generate(
+                [1, 2, 3] + results[0].tokens + [9],
+                SamplingParams(max_new_tokens=5), session_id="s0",
+            )
+            return (
+                [r.tokens for r in results],
+                [r.finish_reason for r in results],
+                follow.tokens,
+                engine.stats["session_hits"],
+            )
+        finally:
+            engine.stop()
+
+    serial = asyncio.run(run_engine(False))
+    pipelined = asyncio.run(run_engine(True))
+    assert serial[0] == pipelined[0]
+    assert serial[1] == pipelined[1]
+    assert serial[2] == pipelined[2]
+    assert serial[3] == pipelined[3]
