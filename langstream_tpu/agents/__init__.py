@@ -64,6 +64,14 @@ _BUILTIN = {
     # generic connector escape hatch (reference role: Camel / Kafka Connect)
     "exec-source": ("langstream_tpu.agents.connector", "ExecSource"),
     "exec-sink": ("langstream_tpu.agents.connector", "ExecSink"),
+    # Kafka Connect adapters (connector managed via the Connect REST
+    # API; data rides the kafka topic runtime)
+    "kafka-connect-source": (
+        "langstream_tpu.agents.kafka_connect", "KafkaConnectSourceAgent"
+    ),
+    "kafka-connect-sink": (
+        "langstream_tpu.agents.kafka_connect", "KafkaConnectSinkAgent"
+    ),
 }
 
 

@@ -34,7 +34,7 @@ def _parse_params(values: List[str]) -> dict:
 # ---------------------------------------------------------------------- #
 # apps
 # ---------------------------------------------------------------------- #
-async def _apps_run(args) -> None:
+async def _apps_run(args, ui: bool = False) -> None:
     from langstream_tpu.gateway import GatewayServer
     from langstream_tpu.runtime.local import run_application
 
@@ -53,6 +53,20 @@ async def _apps_run(args) -> None:
         gateway.register_local_runner(runner, tenant=args.tenant)
         await gateway.start()
         print(f"gateway on ws://127.0.0.1:{args.gateway_port}/v1/...")
+        ui_url = (
+            f"http://127.0.0.1:{args.gateway_port}/ui/{args.tenant}/"
+            f"{runner.application.application_id}"
+        )
+        print(f"ui: {ui_url}")
+        if ui:
+            import webbrowser
+
+            try:
+                webbrowser.open(ui_url)
+            except Exception:  # noqa: BLE001 — headless is fine
+                pass
+    elif ui:
+        print("no gateways declared; the UI needs at least one")
     try:
         await runner.join()
     except KeyboardInterrupt:
@@ -358,12 +372,16 @@ def build_parser() -> argparse.ArgumentParser:
 
     apps = sub.add_parser("apps", help="application commands")
     apps_sub = apps.add_subparsers(dest="apps_command", required=True)
-    for name in ("run", "plan"):
-        cmd = apps_sub.add_parser(name)
+    for name in ("run", "plan", "ui"):
+        cmd = apps_sub.add_parser(
+            name,
+            help="run the app locally and open the web UI"
+            if name == "ui" else None,
+        )
         cmd.add_argument("app_dir")
         cmd.add_argument("-i", "--instance", default=None)
         cmd.add_argument("-s", "--secrets", default=None)
-        if name == "run":
+        if name in ("run", "ui"):
             cmd.add_argument("--gateway-port", type=int, default=8091)
             cmd.add_argument("--tenant", default="default")
     # control-plane application commands (reference: apps deploy/update/...)
@@ -507,6 +525,20 @@ def build_parser() -> argparse.ArgumentParser:
     gws.add_argument("--port", type=int, default=8091)
     gws.add_argument("--sync-interval", type=float, default=5.0)
 
+    python_cmd = sub.add_parser(
+        "python", help="application Python dependency tooling"
+    )
+    python_sub = python_cmd.add_subparsers(
+        dest="python_command", required=True
+    )
+    deps = python_sub.add_parser(
+        "load-deps",
+        help="pip-install python/requirements.txt into python/lib "
+             "(shipped with the code archive; reference: "
+             "langstream python load-pip-requirements)",
+    )
+    deps.add_argument("app_dir")
+
     plugins = sub.add_parser("plugins", help="agent plugin packaging")
     plugins_sub = plugins.add_subparsers(dest="plugins_command", required=True)
     pkg = plugins_sub.add_parser(
@@ -520,8 +552,8 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: Optional[List[str]] = None) -> None:
     args = build_parser().parse_args(argv)
-    if args.command == "apps" and args.apps_command == "run":
-        asyncio.run(_apps_run(args))
+    if args.command == "apps" and args.apps_command in ("run", "ui"):
+        asyncio.run(_apps_run(args, ui=args.apps_command == "ui"))
     elif args.command == "apps" and args.apps_command == "plan":
         _apps_plan(args)
     elif args.command == "apps" and args.apps_command in ("deploy", "update"):
@@ -582,6 +614,24 @@ def main(argv: Optional[List[str]] = None) -> None:
         from langstream_tpu.cli.services import gateway_server_main
 
         asyncio.run(gateway_server_main(args))
+    elif args.command == "python" and args.python_command == "load-deps":
+        import os
+        import subprocess
+
+        requirements = os.path.join(
+            args.app_dir, "python", "requirements.txt"
+        )
+        target = os.path.join(args.app_dir, "python", "lib")
+        if not os.path.isfile(requirements):
+            raise SystemExit(f"no {requirements}")
+        os.makedirs(target, exist_ok=True)
+        subprocess.run(
+            [sys.executable, "-m", "pip", "install",
+             "--target", target, "--upgrade",
+             "-r", requirements],
+            check=True,
+        )
+        print(f"installed {requirements} -> {target}")
     elif args.command == "plugins" and args.plugins_command == "package":
         import os
         import zipfile

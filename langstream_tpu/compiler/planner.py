@@ -76,9 +76,11 @@ _KIND: Dict[str, ComponentType] = {
     "file-source": ComponentType.SOURCE,
     "azure-blob-storage-source": ComponentType.SOURCE,
     "exec-source": ComponentType.SOURCE,
+    "kafka-connect-source": ComponentType.SOURCE,
     "python-sink": ComponentType.SINK,
     "vector-db-sink": ComponentType.SINK,
     "exec-sink": ComponentType.SINK,
+    "kafka-connect-sink": ComponentType.SINK,
     "python-service": ComponentType.SERVICE,
 }
 

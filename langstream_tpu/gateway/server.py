@@ -96,6 +96,9 @@ class GatewayServer:
             "/api/gateways/service/{tenant}/{application}/{gateway}", self._http_service
         )
         app.router.add_get("/healthz", self._healthz)
+        # local UI (reference: `langstream apps ui`)
+        app.router.add_get("/ui/{tenant}/{application}", self._ui_page)
+        app.router.add_get("/ui/api/{tenant}/{application}", self._ui_api)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)
@@ -109,6 +112,30 @@ class GatewayServer:
 
     async def _healthz(self, request) -> web.Response:
         return web.json_response({"status": "OK", "apps": len(self._apps)})
+
+    def _ui_app(self, request):
+        key = (request.match_info["tenant"], request.match_info["application"])
+        registered = self._apps.get(key)
+        if registered is None:
+            raise web.HTTPNotFound(text=f"no application {key}")
+        return registered.application
+
+    async def _ui_page(self, request) -> web.Response:
+        from langstream_tpu.gateway.ui import render_page
+
+        self._ui_app(request)  # 404 for unknown apps
+        return web.Response(
+            text=render_page(
+                request.match_info["tenant"],
+                request.match_info["application"],
+            ),
+            content_type="text/html",
+        )
+
+    async def _ui_api(self, request) -> web.Response:
+        from langstream_tpu.gateway.ui import describe
+
+        return web.json_response(describe(self._ui_app(request)))
 
     # ------------------------------------------------------------------ #
     # request validation (GatewayRequestHandler.validateRequest parity)

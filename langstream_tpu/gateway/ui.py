@@ -1,0 +1,145 @@
+"""Local application UI (reference: ``langstream-cli/.../applications/
+UIAppCmd.java`` — ``langstream apps ui`` serves a small page for poking
+an app's gateways). One static page + one JSON describe endpoint; all
+data flows through the same public WS gateways a real client uses.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+PAGE = """<!DOCTYPE html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>langstream-tpu — __APP__</title>
+<style>
+  body { font-family: system-ui, sans-serif; margin: 2rem; max-width: 60rem; }
+  h1 { font-size: 1.2rem; }
+  fieldset { margin: 1rem 0; border: 1px solid #999; border-radius: 4px; }
+  textarea, input[type=text] { width: 100%; box-sizing: border-box; }
+  #log { background: #111; color: #ddd; padding: .75rem; height: 18rem;
+         overflow-y: auto; font-family: monospace; font-size: .85rem;
+         white-space: pre-wrap; }
+  .meta { color: #666; font-size: .85rem; }
+  button { margin-top: .4rem; }
+</style>
+</head>
+<body>
+<h1>langstream-tpu · <code>__TENANT__/__APP__</code></h1>
+<div class="meta">gateways: <span id="gateways"></span></div>
+
+<fieldset>
+  <legend>chat</legend>
+  <label>gateway <select id="chat-gateway"></select></label>
+  <input type="text" id="chat-input" placeholder="type a message, press Enter">
+</fieldset>
+
+<fieldset>
+  <legend>produce</legend>
+  <label>gateway <select id="produce-gateway"></select></label>
+  <textarea id="produce-value" rows="2" placeholder="record value"></textarea>
+  <button onclick="produce()">send</button>
+</fieldset>
+
+<fieldset>
+  <legend>consume</legend>
+  <label>gateway <select id="consume-gateway"></select></label>
+  <button onclick="consume()">attach</button>
+</fieldset>
+
+<div id="log"></div>
+
+<script>
+const tenant = "__TENANT__", app = "__APP__";
+const base = `ws://${location.host}/v1`;
+const log = (line) => {
+  const el = document.getElementById("log");
+  el.textContent += line + "\\n";
+  el.scrollTop = el.scrollHeight;
+};
+let chatWs = null, consumeWs = null;
+const session = Math.random().toString(36).slice(2);
+
+fetch(`/ui/api/${tenant}/${app}`).then(r => r.json()).then(info => {
+  document.getElementById("gateways").textContent =
+    info.gateways.map(g => `${g.id} (${g.type})`).join(", ") || "none";
+  for (const g of info.gateways) {
+    const sel = document.getElementById(`${g.type}-gateway`);
+    if (sel) sel.add(new Option(g.id, g.id));
+  }
+});
+
+function wsUrl(kind, gateway) {
+  return `${base}/${kind}/${tenant}/${app}/${gateway}` +
+         `?param:session-id=${session}&param:sessionId=${session}`;
+}
+
+document.getElementById("chat-input").addEventListener("keydown", (e) => {
+  if (e.key !== "Enter") return;
+  const gateway = document.getElementById("chat-gateway").value;
+  if (!gateway) { log("! no chat gateway"); return; }
+  const value = e.target.value;
+  e.target.value = "";
+  const send = () => { log(`> ${value}`); chatWs.send(JSON.stringify({value})); };
+  if (!chatWs || chatWs.readyState !== 1) {
+    chatWs = new WebSocket(wsUrl("chat", gateway));
+    let acc = "";
+    chatWs.onmessage = (m) => {
+      const doc = JSON.parse(m.data);
+      const rec = doc.record || {};
+      const headers = rec.headers || {};
+      if (headers["stream-last-message"] === "true") {
+        log(`< ${acc + (rec.value || "")}`); acc = "";
+      } else if (headers["stream-index"]) {
+        acc += rec.value || "";
+      } else {
+        log(`< ${rec.value}`);
+      }
+    };
+    chatWs.onopen = send;
+    chatWs.onerror = () => log("! chat socket error");
+  } else { send(); }
+});
+
+function produce() {
+  const gateway = document.getElementById("produce-gateway").value;
+  if (!gateway) { log("! no produce gateway"); return; }
+  const value = document.getElementById("produce-value").value;
+  const ws = new WebSocket(wsUrl("produce", gateway));
+  ws.onopen = () => ws.send(JSON.stringify({value}));
+  ws.onmessage = (m) => { log(`produce ack: ${m.data}`); ws.close(); };
+}
+
+function consume() {
+  const gateway = document.getElementById("consume-gateway").value;
+  if (!gateway) { log("! no consume gateway"); return; }
+  if (consumeWs) consumeWs.close();
+  consumeWs = new WebSocket(wsUrl("consume", gateway));
+  consumeWs.onmessage = (m) => {
+    const rec = (JSON.parse(m.data).record || {});
+    log(`[${gateway}] ${JSON.stringify(rec.value)}`);
+  };
+  log(`attached to ${gateway}`);
+}
+</script>
+</body>
+</html>
+"""
+
+
+def render_page(tenant: str, application_id: str) -> str:
+    return (
+        PAGE.replace("__TENANT__", tenant)
+        .replace("__APP__", application_id)
+    )
+
+
+def describe(application) -> Dict[str, Any]:
+    return {
+        "application-id": application.application_id,
+        "gateways": [
+            {"id": g.id, "type": g.type, "topic": g.topic}
+            for g in application.gateways
+        ],
+    }

@@ -382,6 +382,28 @@ for doc in [
     AgentDoc("exec-sink", "Run a command; records stream to its stdin", (
         _P("command", "string", "command line to run", required=True),
     ), category="sink"),
+    AgentDoc("kafka-connect-source", "Run a Kafka Connect source connector", (
+        _P("connect-url", "string", "Connect worker REST URL", required=True),
+        _P("connector-name", "string", "connector name", required=True),
+        _P("connector-config", "object", "raw Connect connector config",
+           required=True),
+        _P("topic", "string", "Kafka topic the connector writes",
+           required=True),
+        _P("bootstrapServers", "string", "Kafka bootstrap for the data topic"),
+        _P("delete-on-close", "boolean", "delete the connector on shutdown",
+           default=False),
+    ), category="source"),
+    AgentDoc("kafka-connect-sink", "Run a Kafka Connect sink connector", (
+        _P("connect-url", "string", "Connect worker REST URL", required=True),
+        _P("connector-name", "string", "connector name", required=True),
+        _P("connector-config", "object", "raw Connect connector config",
+           required=True),
+        _P("topic", "string", "staging Kafka topic the connector consumes",
+           required=True),
+        _P("bootstrapServers", "string", "Kafka bootstrap for the data topic"),
+        _P("delete-on-close", "boolean", "delete the connector on shutdown",
+           default=False),
+    ), category="sink"),
     AgentDoc("identity", "Pass records through unchanged", ()),
     AgentDoc("ai-tools", "GenAI toolkit executor (compiled steps)", (),
              allow_unknown=True),

@@ -159,3 +159,8 @@ def test_profile_env_overrides(tmp_path, monkeypatch):
     settings = resolve_profile()
     assert settings["webServiceUrl"] == "http://env"
     assert settings["tenant"] == "t2"
+
+
+def test_python_load_deps_requires_requirements(tmp_path):
+    with pytest.raises(SystemExit, match="requirements"):
+        cli_main(["python", "load-deps", str(tmp_path)])

@@ -1,6 +1,7 @@
 import asyncio
 import json
 import textwrap
+import urllib.error
 
 import pytest
 
@@ -277,3 +278,44 @@ def test_cli_plan_and_docs(tmp_path, capsys):
     out = capsys.readouterr().out
     assert "ai-tools" in out
     assert "compute-ai-embeddings" in out
+
+
+def test_ui_page_and_describe(tmp_path):
+    """`apps ui` surface: the gateway serves the app page + describe JSON
+    (reference: UIAppCmd)."""
+    import urllib.request
+
+    async def main():
+        runner, gateway = await start_app_and_gateway(tmp_path, 0)
+        try:
+            port = gateway._runner.addresses[0][1]  # noqa: SLF001
+            app_id = runner.application.application_id
+            loop = asyncio.get_running_loop()
+
+            def fetch(path):
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=5
+                ) as response:
+                    return response.read().decode()
+
+            page = await loop.run_in_executor(
+                None, fetch, f"/ui/default/{app_id}"
+            )
+            assert "<html>" in page and app_id in page
+            info = json.loads(await loop.run_in_executor(
+                None, fetch, f"/ui/api/default/{app_id}"
+            ))
+            assert {g["type"] for g in info["gateways"]} >= {
+                "produce", "consume", "chat",
+            }
+            # unknown app -> 404
+            try:
+                await loop.run_in_executor(None, fetch, "/ui/default/nope")
+                raise AssertionError("expected 404")
+            except urllib.error.HTTPError as error:
+                assert error.code == 404
+        finally:
+            await gateway.stop()
+            await runner.stop()
+
+    asyncio.run(main())
