@@ -285,8 +285,15 @@ async def run_bench_e2e():
         json.dump(instance, handle)
         instance_file = handle.name
 
+    tracer = None
+    if os.environ.get("BENCH_TRACE"):
+        from langstream_tpu.runtime.tracing import Tracer
+
+        tracer = Tracer()
     t0 = time.perf_counter()
-    runner = await run_application(app_dir, instance_file=instance_file)
+    runner = await run_application(
+        app_dir, instance_file=instance_file, tracer=tracer
+    )
     gateway = None
     try:
         gateway = GatewayServer(port=0)
@@ -297,7 +304,14 @@ async def run_bench_e2e():
             port = addr[1]
         engine = runner._service_provider_registry.completions().engine  # noqa: SLF001
         log(f"app+gateway up: {time.perf_counter() - t0:.1f}s (port {port})")
-        return await _drive_e2e(runner, gateway, port, engine)
+        result = await _drive_e2e(runner, gateway, port, engine)
+        if tracer is not None:
+            trace_path = os.environ.get(
+                "BENCH_TRACE_PATH", "/tmp/bench_e2e_trace.json"
+            )
+            tracer.dump(trace_path)
+            log(f"chrome trace written to {trace_path}")
+        return result
     finally:
         # release HBM + the engine thread even on setup failure, or the
         # engine-mode fallback inits a second model into a full chip

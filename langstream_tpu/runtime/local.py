@@ -252,6 +252,7 @@ async def run_application(
     *,
     instance_file: Optional[str] = None,
     secrets_file: Optional[str] = None,
+    tracer=None,
 ) -> LocalApplicationRunner:
     """Parse, plan, and start an application directory (the ``docker run``
     path, ``langstream-cli/.../docker/LocalRunApplicationCmd.java:56``)."""
@@ -266,6 +267,6 @@ async def run_application(
         app_dir, instance_file=instance_file, secrets_file=secrets_file
     )
     plan = build_execution_plan(application)
-    runner = LocalApplicationRunner(plan)
+    runner = LocalApplicationRunner(plan, tracer=tracer)
     await runner.start()
     return runner
