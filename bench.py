@@ -286,10 +286,10 @@ async def run_bench_e2e():
         instance_file = handle.name
 
     tracer = None
-    if os.environ.get("BENCH_TRACE"):
+    if os.environ.get("BENCH_TRACE", "0") not in ("", "0"):
         from langstream_tpu.runtime.tracing import Tracer
 
-        tracer = Tracer()
+        tracer = Tracer("bench-e2e")
     t0 = time.perf_counter()
     runner = await run_application(
         app_dir, instance_file=instance_file, tracer=tracer
@@ -304,15 +304,18 @@ async def run_bench_e2e():
             port = addr[1]
         engine = runner._service_provider_registry.completions().engine  # noqa: SLF001
         log(f"app+gateway up: {time.perf_counter() - t0:.1f}s (port {port})")
-        result = await _drive_e2e(runner, gateway, port, engine)
+        return await _drive_e2e(runner, gateway, port, engine)
+    finally:
         if tracer is not None:
+            # dump in finally: the trace matters MOST when the drive fails
             trace_path = os.environ.get(
                 "BENCH_TRACE_PATH", "/tmp/bench_e2e_trace.json"
             )
-            tracer.dump(trace_path)
-            log(f"chrome trace written to {trace_path}")
-        return result
-    finally:
+            try:
+                tracer.dump(trace_path)
+                log(f"chrome trace written to {trace_path}")
+            except Exception as error:  # noqa: BLE001
+                log(f"trace dump failed: {error!r}")
         # release HBM + the engine thread even on setup failure, or the
         # engine-mode fallback inits a second model into a full chip
         if gateway is not None:
