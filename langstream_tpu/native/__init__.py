@@ -96,3 +96,33 @@ def load_logstore() -> Optional[ctypes.CDLL]:
             lib.ls_close.argtypes = [ctypes.c_void_p]
         _LIBS["logstore"] = lib
         return lib
+
+
+def load_kafkacodec() -> Optional[ctypes.CDLL]:
+    """Load (compiling if needed) the Kafka codec hot-path library."""
+    with _LOCK:
+        if "kafkacodec" in _LIBS:
+            return _LIBS["kafkacodec"]
+        lib = None
+        path = build_library("kafkacodec.cpp")
+        if path is not None:
+            try:
+                lib = ctypes.CDLL(str(path))
+            except OSError:
+                lib = None
+        if lib is not None:
+            lib.ls_crc32c.restype = ctypes.c_uint32
+            lib.ls_crc32c.argtypes = [
+                ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint32,
+            ]
+            lib.ls_varint_encode.restype = ctypes.c_int
+            lib.ls_varint_encode.argtypes = [
+                ctypes.c_int64, ctypes.c_char_p,
+            ]
+            lib.ls_varint_decode.restype = ctypes.c_int
+            lib.ls_varint_decode.argtypes = [
+                ctypes.c_char_p, ctypes.c_size_t,
+                ctypes.POINTER(ctypes.c_int64),
+            ]
+        _LIBS["kafkacodec"] = lib
+        return lib

@@ -338,3 +338,33 @@ def test_app_runs_unchanged_on_kafka(tmp_path):
                 await facade.close()
 
     asyncio.run(main())
+
+
+def test_native_crc32c_matches_python():
+    """The native slice-by-8 CRC32C must agree with the table loop on
+    the standard vector and on sized/seeded inputs (skips gracefully when
+    the toolchain is absent — the fallback is then what's in use)."""
+    from langstream_tpu.native import load_kafkacodec
+    from langstream_tpu.topics.kafka.protocol import _crc32c_python
+
+    lib = load_kafkacodec()
+    if lib is None:
+        pytest.skip("native toolchain unavailable")
+    import os as _os
+
+    for data in (b"", b"123456789", b"x" * 1023, _os.urandom(4096)):
+        assert lib.ls_crc32c(data, len(data), 0) == _crc32c_python(data)
+    # seeded continuation
+    blob = _os.urandom(300)
+    assert lib.ls_crc32c(blob, len(blob), 7) == _crc32c_python(blob, 7)
+
+    # varint round trip against the Python writer/reader
+    import ctypes
+
+    for value in (0, 1, -1, 300, -300, 2**40, -(2**40)):
+        out = ctypes.create_string_buffer(10)
+        n = lib.ls_varint_encode(value, out)
+        assert proto.Reader(out.raw[:n]).varlong() == value
+        decoded = ctypes.c_int64()
+        consumed = lib.ls_varint_decode(out, n, ctypes.byref(decoded))
+        assert consumed == n and decoded.value == value

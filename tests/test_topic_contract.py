@@ -1,0 +1,174 @@
+"""ONE contract suite over every topic runtime (VERDICT r2 order #4):
+memory, tpulog (embedded), kafka (facade broker over real TCP), and
+pulsar (WS proxy mock). A runtime passes by honoring the Topic SPI:
+FIFO delivery per partition, out-of-order commit safety (uncommitted
+records redeliver to the next group member), group-less readers with
+earliest/latest positioning, and typed payload round-tripping.
+
+Set KAFKA_BOOTSTRAP / PULSAR_WEB_URL to run the same contract against
+real clusters.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import os
+import uuid
+
+import pytest
+
+from langstream_tpu.api.records import Record
+from langstream_tpu.api.topics import OffsetPosition, TopicSpec
+from langstream_tpu.topics import create_topic_runtime
+
+RUNTIMES = ["memory", "tpulog", "kafka", "pulsar"]
+
+
+@contextlib.asynccontextmanager
+async def make_runtime(kind: str, tmp_path):
+    cleanup = []
+    if kind == "memory":
+        runtime = create_topic_runtime({"type": "memory"})
+    elif kind == "tpulog":
+        runtime = create_topic_runtime({
+            "type": "tpulog",
+            "configuration": {"directory": str(tmp_path / "log")},
+        })
+    elif kind == "kafka":
+        bootstrap = os.environ.get("KAFKA_BOOTSTRAP")
+        if not bootstrap:
+            from langstream_tpu.topics.kafka.server import serve_kafka_facade
+
+            facade = await serve_kafka_facade()
+            cleanup.append(facade.close)
+            bootstrap = facade.bootstrap
+        runtime = create_topic_runtime({
+            "type": "kafka",
+            "configuration": {"bootstrapServers": bootstrap},
+        })
+    elif kind == "pulsar":
+        web_url = os.environ.get("PULSAR_WEB_URL")
+        if not web_url:
+            from tests.pulsar_mock import MockPulsar
+
+            mock = await MockPulsar().start()
+            cleanup.append(mock.close)
+            web_url = mock.url
+        runtime = create_topic_runtime({
+            "type": "pulsar",
+            "configuration": {"webServiceUrl": web_url},
+        })
+    else:  # pragma: no cover
+        raise ValueError(kind)
+    try:
+        yield runtime
+    finally:
+        await runtime.close()
+        for fn in cleanup:
+            await fn()
+
+
+async def _drain(consumer_or_reader, want: int, timeout: float = 20.0):
+    out = []
+    deadline = asyncio.get_event_loop().time() + timeout
+    while len(out) < want:
+        if asyncio.get_event_loop().time() > deadline:
+            raise TimeoutError(f"got {len(out)}/{want}: {out}")
+        out.extend(await consumer_or_reader.read(timeout=0.2))
+    return out
+
+
+@pytest.mark.parametrize("kind", RUNTIMES)
+def test_typed_payload_roundtrip(kind, tmp_path):
+    async def main():
+        topic = f"t-{uuid.uuid4().hex[:8]}"
+        async with make_runtime(kind, tmp_path) as runtime:
+            admin = runtime.create_admin()
+            await admin.create_topic(TopicSpec(name=topic))
+            producer = runtime.create_producer("p", {"topic": topic})
+            await producer.start()
+            payloads = [
+                "text", {"nested": [1, 2]}, b"\x00raw\xff", None, 3.5,
+            ]
+            for value in payloads:
+                await producer.write(Record(
+                    value=value, key="k",
+                    headers=(("h-str", "x"), ("h-bytes", b"\x01")),
+                ))
+            reader = runtime.create_reader(
+                {"topic": topic}, OffsetPosition.EARLIEST
+            )
+            await reader.start()
+            got = await _drain(reader, len(payloads))
+            assert [r.value for r in got] == payloads
+            assert got[0].key == "k"
+            assert got[0].header("h-str") == "x"
+            assert got[0].header("h-bytes") == b"\x01"
+            await producer.close()
+            await reader.close()
+
+    asyncio.run(main())
+
+
+@pytest.mark.parametrize("kind", RUNTIMES)
+def test_uncommitted_records_redeliver(kind, tmp_path):
+    """Commit only a suffix; the unacked record must return to the group
+    after the member leaves — at-least-once, no matter which runtime."""
+
+    async def main():
+        topic = f"t-{uuid.uuid4().hex[:8]}"
+        group = f"g-{uuid.uuid4().hex[:8]}"
+        async with make_runtime(kind, tmp_path) as runtime:
+            admin = runtime.create_admin()
+            await admin.create_topic(TopicSpec(name=topic))
+            producer = runtime.create_producer("p", {"topic": topic})
+            await producer.start()
+            for i in range(3):
+                await producer.write(Record(value=f"r{i}"))
+
+            consumer = runtime.create_consumer(
+                "a", {"topic": topic, "group": group}
+            )
+            await consumer.start()
+            got = await _drain(consumer, 3)
+            assert [r.value for r in got] == ["r0", "r1", "r2"]
+            # ack r1 and r2 but NOT r0 (out-of-order ack)
+            await consumer.commit([got[1], got[2]])
+            await consumer.close()
+
+            consumer2 = runtime.create_consumer(
+                "a", {"topic": topic, "group": group}
+            )
+            await consumer2.start()
+            redelivered = await _drain(consumer2, 1)
+            assert redelivered[0].value == "r0"
+            await consumer2.commit(redelivered)
+            await consumer2.close()
+            await producer.close()
+
+    asyncio.run(main())
+
+
+@pytest.mark.parametrize("kind", RUNTIMES)
+def test_reader_latest_sees_only_new(kind, tmp_path):
+    async def main():
+        topic = f"t-{uuid.uuid4().hex[:8]}"
+        async with make_runtime(kind, tmp_path) as runtime:
+            admin = runtime.create_admin()
+            await admin.create_topic(TopicSpec(name=topic))
+            producer = runtime.create_producer("p", {"topic": topic})
+            await producer.start()
+            await producer.write(Record(value="old"))
+            reader = runtime.create_reader(
+                {"topic": topic}, OffsetPosition.LATEST
+            )
+            await reader.start()
+            assert await reader.read(timeout=0.2) == []
+            await producer.write(Record(value="new"))
+            got = await _drain(reader, 1)
+            assert [r.value for r in got] == ["new"]
+            await producer.close()
+            await reader.close()
+
+    asyncio.run(main())
