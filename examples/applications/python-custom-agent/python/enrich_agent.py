@@ -1,0 +1,10 @@
+"""A custom processor: the class just needs init(config) and
+process(record) -> list (reference: the Python agent SDK)."""
+
+
+class Enricher:
+    def init(self, config):
+        self.greeting = config.get("greeting", "hi")
+
+    def process(self, record):
+        return [{"original": record.value, "greeting": self.greeting}]
