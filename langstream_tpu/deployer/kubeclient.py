@@ -202,6 +202,30 @@ class RealKubeApi:
                 return False
             raise
 
+    def pod_logs(
+        self, namespace: str, pod: str, *, tail_lines: int = 200,
+        container: Optional[str] = None,
+    ) -> str:
+        """Pod log read (reference: the webservice's kubectl-free log
+        streaming, ApplicationResource.java:311-459)."""
+        query = f"tailLines={tail_lines}"
+        if container:
+            query += f"&container={urllib.parse.quote(container)}"
+        url = (
+            f"{self.base_url}/api/v1/namespaces/{namespace}/pods/"
+            f"{pod}/log?{query}"
+        )
+        request = urllib.request.Request(url, method="GET")
+        if self.token:
+            request.add_header("Authorization", f"Bearer {self.token}")
+        try:
+            with urllib.request.urlopen(
+                request, timeout=self.timeout, context=self._context
+            ) as response:
+                return response.read().decode(errors="replace")
+        except urllib.error.HTTPError as error:
+            return f"<no logs: HTTP {error.code}>"
+
     def patch_status(
         self, kind: str, namespace: str, name: str, status: Dict[str, Any]
     ) -> Optional[Manifest]:

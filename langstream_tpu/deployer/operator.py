@@ -240,4 +240,13 @@ class KubernetesExecutor:
             out.append(
                 f"agent {agent['metadata']['name']}: {agent.get('status', {})}"
             )
+        # real clusters: stream each runner pod's log tail (reference:
+        # ApplicationResource.java:311-459)
+        if hasattr(self.kube, "pod_logs"):
+            for pod in self.kube.list(
+                "Pod", tenant, {_APP_LABEL: application_id}
+            ):
+                name = pod["metadata"]["name"]
+                out.append(f"--- pod {name} ---")
+                out.append(self.kube.pod_logs(tenant, name))
         return out

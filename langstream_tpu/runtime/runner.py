@@ -282,8 +282,29 @@ class AgentRunner:
     # ------------------------------------------------------------------ #
     # the hot loop
     # ------------------------------------------------------------------ #
+    async def _stats_dump_loop(self, interval: float = 30.0) -> None:
+        """Periodic one-line stats dump (reference:
+        ``AgentRunner.PendingRecordsCounterSource.dumpStats``,
+        AgentRunner.java:598-618 — counts + memory every 30 s)."""
+        import resource
+
+        while True:
+            await asyncio.sleep(interval)
+            rss_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+            snapshot = self.stats.snapshot()
+            logger.info(
+                "agent %s stats: in=%d out=%d errors=%d pending=%d "
+                "rss=%.0fMB",
+                self.agent_id, snapshot["records-in"],
+                snapshot["records-out"], snapshot["errors"],
+                self._pending, rss_kb / 1024,
+            )
+
     async def run(self) -> None:
         await self.start_agents()
+        stats_dump = asyncio.get_running_loop().create_task(
+            self._stats_dump_loop()
+        )
         result_worker = asyncio.get_running_loop().create_task(
             self._result_worker()
         )
@@ -314,11 +335,13 @@ class AgentRunner:
             if self._failure is not None:
                 raise self._failure
         finally:
+            stats_dump.cancel()
             result_worker.cancel()
-            try:
-                await result_worker
-            except asyncio.CancelledError:
-                pass
+            for task in (stats_dump, result_worker):
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
             # cancel any still-running per-record tasks BEFORE closing the
             # agents they write through
             for task in self._tasks:
