@@ -132,5 +132,62 @@ def main() -> None:
         print(f"roofline step time at 819 GB/s: {per_step / (819 * 2**30) * 1e3:.1f} ms")
 
 
-if __name__ == "__main__":
+if __name__ == "__main__" and "--prefill" not in sys.argv:
     main()
+
+
+def probe_prefill(preset="llama-3-8b", batch=32, bucket=128, slots=32,
+                  seq=320) -> None:
+    """Same memory/cost analysis for the batched prefill jit."""
+    import dataclasses
+
+    config = model_lib.LlamaConfig.from_dict({"preset": preset})
+    config = dataclasses.replace(config, max_seq_len=seq)
+    topo = topologies.get_topology_desc("v5e:2x2", "tpu")
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    mesh = Mesh(topo.devices[:1], ("d",))
+    sharding = NamedSharding(mesh, PartitionSpec())
+
+    def shapes_of(tree):
+        return jax.tree_util.tree_map(
+            lambda leaf: jax.ShapeDtypeStruct(
+                leaf.shape, leaf.dtype, sharding=sharding
+            ),
+            tree,
+        )
+
+    params = shapes_of(
+        jax.eval_shape(lambda: init_quantized_params(config, seed=0))
+    )
+    cache = shapes_of(
+        jax.eval_shape(lambda: model_lib.init_cache(config, slots, seq))
+    )
+    freqs = rope_frequencies(
+        config.dims_per_head, config.max_seq_len, config.rope_theta
+    )
+
+    def arg(shape, dtype):
+        return jax.ShapeDtypeStruct(shape, dtype, sharding=sharding)
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def prefill_run(params, cache, tokens, lengths, slot_ids):
+        return model_lib.prefill(
+            config, params, cache, tokens, lengths, slot_ids, freqs
+        )
+
+    compiled = prefill_run.lower(
+        params, cache,
+        arg((batch, bucket), jnp.int32),
+        arg((batch,), jnp.int32),
+        arg((batch,), jnp.int32),
+    ).compile()
+    mem = compiled.memory_analysis()
+    gb = 2 ** 30
+    print(f"== prefill ({preset}, batch {batch} x bucket {bucket}) ==")
+    print(f"temp: {mem.temp_size_in_bytes / gb:.3f} GB  "
+          f"args: {mem.argument_size_in_bytes / gb:.2f} GB")
+
+
+if __name__ == "__main__" and "--prefill" in sys.argv:
+    probe_prefill()
