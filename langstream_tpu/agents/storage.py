@@ -245,17 +245,66 @@ class FileSource(AgentSource):
 
 
 class AzureBlobStorageSource(AgentSource):
-    """Gated: requires the Azure SDK, which is not bundled
-    (reference: ``AzureBlobStorageSource.java:39``)."""
+    """Emit one record per blob; delete on commit when configured
+    (reference: ``AzureBlobStorageSource.java:39`` — same polling shape
+    as :class:`S3Source`, over the native Azure REST client)."""
 
     agent_type = "azure-blob-storage-source"
 
     async def init(self, configuration: Dict[str, Any]) -> None:
-        raise ValueError(
-            "azure-blob-storage-source requires the azure-storage-blob "
-            "client, which is not bundled in this build; use s3-source "
-            "(SigV4 REST, works with any S3-compatible store) or file-source"
+        from langstream_tpu.agents.azure_blob import AzureBlobClient
+
+        endpoint = configuration.get("endpoint")
+        account = configuration.get("storage-account-name")
+        if not endpoint:
+            if not account:
+                raise ValueError(
+                    "azure-blob-storage-source needs 'endpoint' or "
+                    "'storage-account-name'"
+                )
+            endpoint = f"https://{account}.blob.core.windows.net"
+        self.client = AzureBlobClient(
+            endpoint=endpoint,
+            container=configuration.get("container", "langstream-source"),
+            account=account,
+            account_key=configuration.get("storage-account-key"),
+            sas_token=configuration.get("sas-token"),
         )
+        self.delete_after = bool(configuration.get("delete-objects", True))
+        self.idle_time = float(configuration.get("idle-time", 5))
+        self.extensions = [
+            e.strip()
+            for e in str(configuration.get("file-extensions", "")).split(",")
+            if e.strip()
+        ]
+        self._processed: set = set()
 
     async def read(self, max_records: int = 100) -> List[Record]:
-        return []
+        blobs = await self.client.list_blobs()
+        out: List[Record] = []
+        for blob in blobs:
+            name = blob["name"]
+            if name in self._processed:
+                continue
+            if self.extensions and not any(
+                name.endswith(f".{e}") for e in self.extensions
+            ):
+                continue
+            body = await self.client.get_blob(name)
+            self._processed.add(name)
+            out.append(Record(value=body, key=name, headers=(("name", name),)))
+            if len(out) >= max_records:
+                break
+        if not out:
+            await asyncio.sleep(self.idle_time)
+        return out
+
+    async def commit(self, records: List[Record]) -> None:
+        if not self.delete_after:
+            return
+        for record in records:
+            if record.key:
+                await self.client.delete_blob(str(record.key))
+
+    async def close(self) -> None:
+        await self.client.close()

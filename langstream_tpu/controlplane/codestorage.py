@@ -210,6 +210,87 @@ class S3CodeStorage:
         self._thread.join(timeout=10)
 
 
+class AzureBlobCodeStorage:
+    """Azure-backed archives at ``<prefix>/<tenant>/<code_id>.zip``
+    (reference: ``langstream-k8s-storage/.../codestorage/
+    AzureBlobCodeStorage.java``), over the native REST client — same
+    dedicated-loop sync facade as :class:`S3CodeStorage`."""
+
+    def __init__(
+        self,
+        *,
+        endpoint: str,
+        container: str,
+        account: Optional[str] = None,
+        account_key: Optional[str] = None,
+        sas_token: Optional[str] = None,
+        prefix: str = "code",
+    ) -> None:
+        import asyncio
+        import threading
+
+        from langstream_tpu.agents.azure_blob import AzureBlobClient
+
+        self.prefix = prefix.strip("/")
+        self._client = AzureBlobClient(
+            endpoint=endpoint, container=container, account=account,
+            account_key=account_key, sas_token=sas_token,
+        )
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(
+            target=self._loop.run_forever, name="azure-codestorage",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def _run(self, coro):
+        import asyncio
+
+        return asyncio.run_coroutine_threadsafe(coro, self._loop).result(120)
+
+    def _key(self, tenant: str, code_id: str) -> str:
+        _validate_ids(tenant, code_id)
+        return f"{self.prefix}/{tenant}/{code_id}.zip"
+
+    def store(self, tenant: str, application_id: str, archive: bytes) -> str:
+        code_id = f"{application_id}-{uuid.uuid4().hex[:12]}"
+        self._run(self._client.put_blob(self._key(tenant, code_id), archive))
+        return code_id
+
+    def download(self, tenant: str, code_id: str) -> bytes:
+        try:
+            return self._run(
+                self._client.get_blob(self._key(tenant, code_id))
+            )
+        except IOError as error:
+            if "404" in str(error):
+                raise CodeArchiveNotFound(f"{tenant}/{code_id}") from None
+            raise
+
+    def delete(self, tenant: str, code_id: str) -> None:
+        self._run(self._client.delete_blob(self._key(tenant, code_id)))
+
+    def delete_tenant(self, tenant: str) -> None:
+        for code_id in self.list(tenant):
+            self.delete(tenant, code_id)
+
+    def list(self, tenant: str) -> List[str]:
+        blobs = self._run(
+            self._client.list_blobs(prefix=f"{self.prefix}/{tenant}/")
+        )
+        out = []
+        for blob in blobs:
+            name = blob["name"].rsplit("/", 1)[-1]
+            if name.endswith(".zip"):
+                out.append(name[:-4])
+        return sorted(out)
+
+    def close(self) -> None:
+        self._run(self._client.close())
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=10)
+
+
 def create_code_storage(config: Optional[Dict[str, Any]] = None) -> CodeStorage:
     """Factory keyed on ``type``: ``local-disk`` (default), ``memory``,
     ``s3`` (native SigV4 client); ``azure`` stays gated (no Azure SDK in
@@ -237,8 +318,21 @@ def create_code_storage(config: Optional[Dict[str, Any]] = None) -> CodeStorage:
             prefix=config.get("prefix", "code"),
         )
     if kind in ("azure", "azure-blob-storage"):
-        raise NotImplementedError(
-            f"code storage type {kind!r} requires the Azure SDK, which is "
-            "not present in this environment; use 's3' or 'local-disk'"
+        endpoint = config.get("endpoint")
+        account = config.get("storage-account-name")
+        if not endpoint and account:
+            endpoint = f"https://{account}.blob.core.windows.net"
+        if not endpoint:
+            raise ValueError(
+                "azure code storage needs 'endpoint' or "
+                "'storage-account-name'"
+            )
+        return AzureBlobCodeStorage(
+            endpoint=endpoint,
+            container=config.get("container", "langstream-code"),
+            account=account,
+            account_key=config.get("storage-account-key"),
+            sas_token=config.get("sas-token"),
+            prefix=config.get("prefix", "code"),
         )
     raise ValueError(f"unknown code storage type {kind!r}")

@@ -1,0 +1,141 @@
+"""Azure Blob source + code storage against an in-process Azure-REST
+mock (Shared Key auth header checked for presence/shape; signature
+validation is the server's job and not re-implemented here)."""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import threading
+
+import pytest
+from aiohttp import web
+
+from langstream_tpu.api.records import Record
+from langstream_tpu.controlplane.codestorage import (
+    CodeArchiveNotFound,
+    create_code_storage,
+)
+from langstream_tpu.runtime.registry import create_agent
+
+
+class MockAzure:
+    def __init__(self) -> None:
+        self.blobs: dict = {}
+        self.auth_headers: list = []
+        self.port = None
+        self._runner = None
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(
+            target=self._loop.run_forever, daemon=True
+        )
+        self._thread.start()
+
+    def start(self) -> int:
+        async def go():
+            app = web.Application()
+            app.router.add_route("*", "/{container}{tail:.*}", self._dispatch)
+            self._runner = web.AppRunner(app, access_log=None)
+            await self._runner.setup()
+            site = web.TCPSite(self._runner, "127.0.0.1", 0)
+            await site.start()
+            return site._server.sockets[0].getsockname()[1]  # noqa: SLF001
+
+        self.port = asyncio.run_coroutine_threadsafe(
+            go(), self._loop
+        ).result(10)
+        return self.port
+
+    def stop(self):
+        asyncio.run_coroutine_threadsafe(
+            self._runner.cleanup(), self._loop
+        ).result(10)
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=10)
+
+    async def _dispatch(self, request: web.Request):
+        self.auth_headers.append(request.headers.get("Authorization", ""))
+        name = request.match_info["tail"].lstrip("/")
+        if request.method == "GET" and request.query.get("comp") == "list":
+            prefix = request.query.get("prefix", "")
+            blobs = "".join(
+                f"<Blob><Name>{n}</Name><Properties>"
+                f"<Content-Length>{len(b)}</Content-Length>"
+                f"</Properties></Blob>"
+                for n, b in sorted(self.blobs.items())
+                if n.startswith(prefix)
+            )
+            return web.Response(
+                text=f"<?xml version=\"1.0\"?><EnumerationResults>"
+                     f"<Blobs>{blobs}</Blobs><NextMarker/>"
+                     f"</EnumerationResults>",
+                content_type="application/xml",
+            )
+        if request.method == "PUT":
+            self.blobs[name] = await request.read()
+            return web.Response(status=201)
+        if request.method == "GET":
+            if name not in self.blobs:
+                return web.Response(status=404)
+            return web.Response(body=self.blobs[name])
+        if request.method == "DELETE":
+            self.blobs.pop(name, None)
+            return web.Response(status=202)
+        return web.Response(status=405)
+
+
+@pytest.fixture()
+def azure():
+    mock = MockAzure()
+    mock.start()
+    try:
+        yield mock
+    finally:
+        mock.stop()
+
+
+def test_azure_source_reads_and_deletes(azure):
+    azure.blobs["doc-1.txt"] = b"first doc"
+    azure.blobs["skip.bin"] = b"\x00"
+
+    async def main():
+        source = create_agent("azure-blob-storage-source")
+        await source.init({
+            "endpoint": f"http://127.0.0.1:{azure.port}",
+            "container": "docs",
+            "storage-account-name": "testacct",
+            "storage-account-key": base64.b64encode(b"k" * 32).decode(),
+            "file-extensions": "txt",
+            "idle-time": 0.05,
+        })
+        await source.start()
+        got = await source.read()
+        assert [r.key for r in got] == ["doc-1.txt"]
+        assert got[0].value == b"first doc"
+        await source.commit(got)
+        assert "doc-1.txt" not in azure.blobs  # delete-objects default
+        assert "skip.bin" in azure.blobs       # extension filter
+        await source.close()
+
+    asyncio.run(main())
+    # Shared Key auth was attached
+    assert any(h.startswith("SharedKey testacct:") for h in azure.auth_headers)
+
+
+def test_azure_code_storage_roundtrip(azure):
+    storage = create_code_storage({
+        "type": "azure",
+        "endpoint": f"http://127.0.0.1:{azure.port}",
+        "container": "code",
+        "sas-token": "sv=2021&sig=test",
+    })
+    try:
+        code_id = storage.store("t1", "app", b"zipbytes")
+        assert storage.download("t1", code_id) == b"zipbytes"
+        assert storage.list("t1") == [code_id]
+        with pytest.raises(CodeArchiveNotFound):
+            storage.download("t1", "missing")
+        storage.delete("t1", code_id)
+        assert storage.list("t1") == []
+    finally:
+        storage.close()
