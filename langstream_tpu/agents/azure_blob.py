@@ -11,7 +11,7 @@ implements SigV4 for S3.
 from __future__ import annotations
 
 import base64
-import datetime
+import email.utils
 import hashlib
 import hmac
 import urllib.parse
@@ -32,7 +32,14 @@ class AzureBlobClient:
         self.endpoint = endpoint.rstrip("/")
         self.container = container
         parsed = urllib.parse.urlparse(self.endpoint)
-        self.account = account or parsed.netloc.split(".")[0]
+        if account:
+            self.account = account
+        elif parsed.path.strip("/"):
+            # path-style endpoint (Azurite / emulators):
+            # http://host:port/<account> — the account is the path
+            self.account = parsed.path.strip("/").split("/")[0]
+        else:
+            self.account = parsed.netloc.split(".")[0]
         self.account_key = account_key
         self.sas_token = (sas_token or "").lstrip("?")
         self._session = None
@@ -54,9 +61,9 @@ class AzureBlobClient:
         self, method: str, path: str, query: Dict[str, str],
         headers: Dict[str, str], content_length: int,
     ) -> Dict[str, str]:
-        now = datetime.datetime.now(datetime.timezone.utc).strftime(
-            "%a, %d %b %Y %H:%M:%S GMT"
-        )
+        # RFC 1123 in C locale — strftime('%a/%b') is locale-dependent
+        # and a localized day name breaks the Shared Key signature
+        now = email.utils.formatdate(usegmt=True)
         headers = {
             **headers,
             "x-ms-date": now,
@@ -154,3 +161,23 @@ class AzureBlobClient:
 
     async def delete_blob(self, name: str) -> None:
         await self._request("DELETE", name)
+
+
+def parse_connection_string(connection: str) -> Dict[str, Optional[str]]:
+    """Parse the standard ``AccountName=...;AccountKey=...;...`` form."""
+    parts: Dict[str, str] = {}
+    for piece in connection.split(";"):
+        name, _, value = piece.partition("=")
+        if name:
+            parts[name.strip()] = value.strip()
+    endpoint = parts.get("BlobEndpoint")
+    account = parts.get("AccountName")
+    if not endpoint and account:
+        suffix = parts.get("EndpointSuffix", "core.windows.net")
+        protocol = parts.get("DefaultEndpointsProtocol", "https")
+        endpoint = f"{protocol}://{account}.blob.{suffix}"
+    return {
+        "endpoint": endpoint,
+        "account": account,
+        "key": parts.get("AccountKey"),
+    }

@@ -7,9 +7,10 @@ list objects in a bucket, emit one record per object, optionally delete
 after downstream processing commits (``delete-objects``).
 
 The S3 client here is a minimal aiohttp+SigV4 implementation (no boto3 in
-this image) that works against AWS S3 and MinIO. Azure blob requires the
-Azure SDK and is gated with a clear error. ``file-source`` reads a local
-directory — the zero-infra analogue used by tests and local runs.
+this image) that works against AWS S3 and MinIO; Azure rides the native
+REST client in ``agents/azure_blob.py`` (Shared Key or SAS auth, no
+Azure SDK). ``file-source`` reads a local directory — the zero-infra
+analogue used by tests and local runs.
 """
 
 from __future__ import annotations
@@ -252,22 +253,34 @@ class AzureBlobStorageSource(AgentSource):
     agent_type = "azure-blob-storage-source"
 
     async def init(self, configuration: Dict[str, Any]) -> None:
-        from langstream_tpu.agents.azure_blob import AzureBlobClient
+        from langstream_tpu.agents.azure_blob import (
+            AzureBlobClient,
+            parse_connection_string,
+        )
 
         endpoint = configuration.get("endpoint")
         account = configuration.get("storage-account-name")
+        account_key = configuration.get("storage-account-key")
+        connection = configuration.get("storage-account-connection-string")
+        if connection:
+            parsed = parse_connection_string(connection)
+            endpoint = endpoint or parsed.get("endpoint")
+            account = account or parsed.get("account")
+            account_key = account_key or parsed.get("key")
         if not endpoint:
             if not account:
                 raise ValueError(
-                    "azure-blob-storage-source needs 'endpoint' or "
-                    "'storage-account-name'"
+                    "azure-blob-storage-source needs 'endpoint', "
+                    "'storage-account-name', or a connection string"
                 )
             endpoint = f"https://{account}.blob.core.windows.net"
         self.client = AzureBlobClient(
             endpoint=endpoint,
-            container=configuration.get("container", "langstream-source"),
+            container=configuration.get(
+                "container", "langstream-azure-source"
+            ),
             account=account,
-            account_key=configuration.get("storage-account-key"),
+            account_key=account_key,
             sas_token=configuration.get("sas-token"),
         )
         self.delete_after = bool(configuration.get("delete-objects", True))

@@ -119,16 +119,92 @@ class InMemoryCodeStorage:
         return sorted(self._archives.get(tenant, {}))
 
 
-class S3CodeStorage:
-    """S3-backed archives at ``<prefix>/<tenant>/<code_id>.zip``
-    (reference: ``langstream-k8s-storage/.../codestorage/S3CodeStorage.java``
-    — bucket + endpoint + keys config shape kept compatible).
+class _ObjectStoreCodeStorage:
+    """Shared sync facade for object-store-backed archives at
+    ``<prefix>/<tenant>/<code_id>.zip``: a dedicated event-loop thread
+    serves the async client, so the store works from both sync CLI paths
+    (code-download) and inside async webservice handlers (where
+    ``asyncio.run`` would be illegal). Subclasses provide the four async
+    object ops."""
 
-    Sync facade over the async SigV4 client from ``agents/storage.py``:
-    a dedicated event-loop thread serves all calls, so the store works
-    from both sync CLI paths (code-download) and inside async webservice
-    handlers (where ``asyncio.run`` would be illegal).
-    """
+    def __init__(self, prefix: str, thread_name: str) -> None:
+        import asyncio
+        import threading
+
+        self.prefix = prefix.strip("/")
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(
+            target=self._loop.run_forever, name=thread_name, daemon=True
+        )
+        self._thread.start()
+
+    # -- async object ops (subclass hooks) ------------------------------ #
+    async def _put(self, key: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    async def _get(self, key: str) -> bytes:
+        raise NotImplementedError
+
+    async def _delete(self, key: str) -> None:
+        raise NotImplementedError
+
+    async def _list(self, prefix: str) -> List[str]:
+        """Object keys under ``prefix``."""
+        raise NotImplementedError
+
+    async def _close_client(self) -> None:
+        raise NotImplementedError
+
+    # -- CodeStorage surface --------------------------------------------- #
+    def _run(self, coro):
+        import asyncio
+
+        return asyncio.run_coroutine_threadsafe(coro, self._loop).result(120)
+
+    def _key(self, tenant: str, code_id: str) -> str:
+        _validate_ids(tenant, code_id)
+        return f"{self.prefix}/{tenant}/{code_id}.zip"
+
+    def store(self, tenant: str, application_id: str, archive: bytes) -> str:
+        code_id = f"{application_id}-{uuid.uuid4().hex[:12]}"
+        self._run(self._put(self._key(tenant, code_id), archive))
+        return code_id
+
+    def download(self, tenant: str, code_id: str) -> bytes:
+        try:
+            return self._run(self._get(self._key(tenant, code_id)))
+        except IOError as error:
+            if "404" in str(error):
+                raise CodeArchiveNotFound(f"{tenant}/{code_id}") from None
+            raise
+
+    def delete(self, tenant: str, code_id: str) -> None:
+        self._run(self._delete(self._key(tenant, code_id)))
+
+    def delete_tenant(self, tenant: str) -> None:
+        for code_id in self.list(tenant):
+            self.delete(tenant, code_id)
+
+    def list(self, tenant: str) -> List[str]:
+        keys = self._run(self._list(f"{self.prefix}/{tenant}/"))
+        out = []
+        for key in keys:
+            name = key.rsplit("/", 1)[-1]
+            if name.endswith(".zip"):
+                out.append(name[:-4])
+        return sorted(out)
+
+    def close(self) -> None:
+        self._run(self._close_client())
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=10)
+
+
+class S3CodeStorage(_ObjectStoreCodeStorage):
+    """S3-backed archives (reference: ``langstream-k8s-storage/.../
+    codestorage/S3CodeStorage.java`` — bucket + endpoint + keys config
+    shape kept compatible) over the SigV4 client from
+    ``agents/storage.py``."""
 
     def __init__(
         self,
@@ -140,81 +216,36 @@ class S3CodeStorage:
         region: str = "us-east-1",
         prefix: str = "code",
     ) -> None:
-        import threading
-
         from langstream_tpu.agents.storage import S3Client
 
+        super().__init__(prefix, "s3-codestorage")
         self.bucket = bucket
-        self.prefix = prefix.strip("/")
         self._client = S3Client(
             endpoint=endpoint, access_key=access_key,
             secret_key=secret_key, region=region,
         )
-        import asyncio
 
-        self._loop = asyncio.new_event_loop()
-        self._thread = threading.Thread(
-            target=self._loop.run_forever, name="s3-codestorage", daemon=True
-        )
-        self._thread.start()
+    async def _put(self, key: str, data: bytes) -> None:
+        await self._client.put_object(self.bucket, key, data)
 
-    def _run(self, coro):
-        import asyncio
+    async def _get(self, key: str) -> bytes:
+        return await self._client.get_object(self.bucket, key)
 
-        return asyncio.run_coroutine_threadsafe(coro, self._loop).result(120)
+    async def _delete(self, key: str) -> None:
+        await self._client.delete_object(self.bucket, key)
 
-    def _key(self, tenant: str, code_id: str) -> str:
-        _validate_ids(tenant, code_id)
-        return f"{self.prefix}/{tenant}/{code_id}.zip"
+    async def _list(self, prefix: str) -> List[str]:
+        objects = await self._client.list_objects(self.bucket, prefix=prefix)
+        return [obj["key"] for obj in objects]
 
-    def store(self, tenant: str, application_id: str, archive: bytes) -> str:
-        code_id = f"{application_id}-{uuid.uuid4().hex[:12]}"
-        self._run(self._client.put_object(
-            self.bucket, self._key(tenant, code_id), archive
-        ))
-        return code_id
-
-    def download(self, tenant: str, code_id: str) -> bytes:
-        try:
-            return self._run(self._client.get_object(
-                self.bucket, self._key(tenant, code_id)
-            ))
-        except IOError as error:
-            if "404" in str(error):
-                raise CodeArchiveNotFound(f"{tenant}/{code_id}") from None
-            raise
-
-    def delete(self, tenant: str, code_id: str) -> None:
-        self._run(self._client.delete_object(
-            self.bucket, self._key(tenant, code_id)
-        ))
-
-    def delete_tenant(self, tenant: str) -> None:
-        for code_id in self.list(tenant):
-            self.delete(tenant, code_id)
-
-    def list(self, tenant: str) -> List[str]:
-        objects = self._run(self._client.list_objects(
-            self.bucket, prefix=f"{self.prefix}/{tenant}/"
-        ))
-        out = []
-        for obj in objects:
-            name = obj["key"].rsplit("/", 1)[-1]
-            if name.endswith(".zip"):
-                out.append(name[:-4])
-        return sorted(out)
-
-    def close(self) -> None:
-        self._run(self._client.close())
-        self._loop.call_soon_threadsafe(self._loop.stop)
-        self._thread.join(timeout=10)
+    async def _close_client(self) -> None:
+        await self._client.close()
 
 
-class AzureBlobCodeStorage:
-    """Azure-backed archives at ``<prefix>/<tenant>/<code_id>.zip``
-    (reference: ``langstream-k8s-storage/.../codestorage/
-    AzureBlobCodeStorage.java``), over the native REST client — same
-    dedicated-loop sync facade as :class:`S3CodeStorage`."""
+class AzureBlobCodeStorage(_ObjectStoreCodeStorage):
+    """Azure-backed archives (reference: ``langstream-k8s-storage/.../
+    codestorage/AzureBlobCodeStorage.java``) over the native REST client
+    (``agents/azure_blob.py``)."""
 
     def __init__(
         self,
@@ -226,75 +257,35 @@ class AzureBlobCodeStorage:
         sas_token: Optional[str] = None,
         prefix: str = "code",
     ) -> None:
-        import asyncio
-        import threading
-
         from langstream_tpu.agents.azure_blob import AzureBlobClient
 
-        self.prefix = prefix.strip("/")
+        super().__init__(prefix, "azure-codestorage")
         self._client = AzureBlobClient(
             endpoint=endpoint, container=container, account=account,
             account_key=account_key, sas_token=sas_token,
         )
-        self._loop = asyncio.new_event_loop()
-        self._thread = threading.Thread(
-            target=self._loop.run_forever, name="azure-codestorage",
-            daemon=True,
-        )
-        self._thread.start()
 
-    def _run(self, coro):
-        import asyncio
+    async def _put(self, key: str, data: bytes) -> None:
+        await self._client.put_blob(key, data)
 
-        return asyncio.run_coroutine_threadsafe(coro, self._loop).result(120)
+    async def _get(self, key: str) -> bytes:
+        return await self._client.get_blob(key)
 
-    def _key(self, tenant: str, code_id: str) -> str:
-        _validate_ids(tenant, code_id)
-        return f"{self.prefix}/{tenant}/{code_id}.zip"
+    async def _delete(self, key: str) -> None:
+        await self._client.delete_blob(key)
 
-    def store(self, tenant: str, application_id: str, archive: bytes) -> str:
-        code_id = f"{application_id}-{uuid.uuid4().hex[:12]}"
-        self._run(self._client.put_blob(self._key(tenant, code_id), archive))
-        return code_id
+    async def _list(self, prefix: str) -> List[str]:
+        blobs = await self._client.list_blobs(prefix=prefix)
+        return [blob["name"] for blob in blobs]
 
-    def download(self, tenant: str, code_id: str) -> bytes:
-        try:
-            return self._run(
-                self._client.get_blob(self._key(tenant, code_id))
-            )
-        except IOError as error:
-            if "404" in str(error):
-                raise CodeArchiveNotFound(f"{tenant}/{code_id}") from None
-            raise
-
-    def delete(self, tenant: str, code_id: str) -> None:
-        self._run(self._client.delete_blob(self._key(tenant, code_id)))
-
-    def delete_tenant(self, tenant: str) -> None:
-        for code_id in self.list(tenant):
-            self.delete(tenant, code_id)
-
-    def list(self, tenant: str) -> List[str]:
-        blobs = self._run(
-            self._client.list_blobs(prefix=f"{self.prefix}/{tenant}/")
-        )
-        out = []
-        for blob in blobs:
-            name = blob["name"].rsplit("/", 1)[-1]
-            if name.endswith(".zip"):
-                out.append(name[:-4])
-        return sorted(out)
-
-    def close(self) -> None:
-        self._run(self._client.close())
-        self._loop.call_soon_threadsafe(self._loop.stop)
-        self._thread.join(timeout=10)
+    async def _close_client(self) -> None:
+        await self._client.close()
 
 
 def create_code_storage(config: Optional[Dict[str, Any]] = None) -> CodeStorage:
     """Factory keyed on ``type``: ``local-disk`` (default), ``memory``,
-    ``s3`` (native SigV4 client); ``azure`` stays gated (no Azure SDK in
-    this image)."""
+    ``s3`` (native SigV4 client), ``azure`` (native Shared Key/SAS REST
+    client)."""
     config = config or {}
     kind = config.get("type", "local-disk")
     if kind in ("local-disk", "local"):

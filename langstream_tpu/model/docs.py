@@ -293,7 +293,7 @@ for doc in [
     ), category="source"),
     AgentDoc("azure-blob-storage-source", "Read blobs from Azure storage", (
         _P("container", "string", "container name", default="langstream-azure-source"),
-        _P("endpoint", "string", "storage endpoint", required=True),
+        _P("endpoint", "string", "storage endpoint (or derive from account name)"),
         _P("sas-token", "string", "SAS token"),
         _P("storage-account-name", "string", "account name"),
         _P("storage-account-key", "string", "account key"),
