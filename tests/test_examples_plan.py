@@ -62,3 +62,46 @@ def test_instances_parse():
             doc = yaml.safe_load(handle)
         assert "instance" in doc, name
         assert "streamingCluster" in doc["instance"], name
+
+
+def test_shipped_archetype_deploys(tmp_path):
+    """The examples/archetypes/chatbot archetype must deploy through the
+    webservice archetype endpoint (parameters -> globals merge)."""
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from langstream_tpu.controlplane import (
+        ApplicationService,
+        GlobalMetadataStore,
+        InMemoryApplicationStore,
+        TenantService,
+    )
+    from langstream_tpu.controlplane.codestorage import InMemoryCodeStorage
+    from langstream_tpu.controlplane.webservice import ControlPlaneWebService
+
+    async def main():
+        tenants = TenantService(GlobalMetadataStore())
+        tenants.create("default")
+        service = ApplicationService(
+            InMemoryApplicationStore(), InMemoryCodeStorage(), tenants,
+        )
+        ws = ControlPlaneWebService(
+            service,
+            archetypes_path=os.path.join(EXAMPLES, "archetypes"),
+        )
+        async with TestClient(TestServer(ws.app)) as client:
+            response = await client.get("/api/archetypes/default")
+            listed = await response.json()
+            assert [a["id"] for a in listed] == ["chatbot"]
+            assert listed[0]["title"] == "TPU chatbot"
+
+            response = await client.post(
+                "/api/archetypes/default/chatbot/applications/bot1",
+                json={"model": "tiny", "max-tokens": 8},
+            )
+            assert response.status == 200, await response.text()
+            deployed = await response.json()
+            assert deployed["application-id"] == "bot1"
+
+    asyncio.run(main())
