@@ -451,3 +451,40 @@ def test_pipeline_decode_matches_serial():
     assert serial[1] == pipelined[1]
     assert serial[2] == pipelined[2]
     assert serial[3] == pipelined[3]
+
+
+@pytest.mark.slow
+def test_engine_tp8_matches_single_device():
+    """tp=8 (the BASELINE #5 mesh width) must be token-identical to the
+    unsharded engine on the full 8-device CPU mesh."""
+    import dataclasses
+
+    from langstream_tpu.parallel.mesh import MeshConfig
+
+    async def main():
+        config = dataclasses.replace(
+            LlamaConfig.tiny(max_seq_len=64),
+            num_heads=8, num_kv_heads=8, intermediate_size=256,
+        )
+        params = init_params(config)
+        solo = DecodeEngine(config, params, max_slots=2, max_seq_len=64,
+                            prefill_buckets=[16])
+        solo.start()
+        r1 = await solo.generate(
+            [1, 2, 3, 4], SamplingParams(max_new_tokens=6)
+        )
+        solo.stop()
+
+        sharded = DecodeEngine(
+            config, params, max_slots=2, max_seq_len=64,
+            prefill_buckets=[16], mesh_config=MeshConfig(tp=8),
+        )
+        assert dict(sharded.mesh.shape)["tp"] == 8
+        sharded.start()
+        r2 = await sharded.generate(
+            [1, 2, 3, 4], SamplingParams(max_new_tokens=6)
+        )
+        sharded.stop()
+        assert r1.tokens == r2.tokens
+
+    asyncio.run(main())
