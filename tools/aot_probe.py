@@ -132,7 +132,7 @@ def main() -> None:
         print(f"roofline step time at 819 GB/s: {per_step / (819 * 2**30) * 1e3:.1f} ms")
 
 
-if __name__ == "__main__" and "--prefill" not in sys.argv:
+if __name__ == "__main__" and "--prefill" not in sys.argv and "--tp8-70b" not in sys.argv:
     main()
 
 
@@ -191,3 +191,105 @@ def probe_prefill(preset="llama-3-8b", batch=32, bucket=128, slots=32,
 
 if __name__ == "__main__" and "--prefill" in sys.argv:
     probe_prefill()
+
+
+def probe_tp8_70b(slots=8, chunk=16, seq=512) -> None:
+    """BASELINE config #5: compile the 70B int8 decode chunk tp=8-sharded
+    for an 8-device v5e topology and report per-chip memory — proves the
+    sharded program builds and fits HBM without the hardware."""
+    import dataclasses
+
+    from langstream_tpu.parallel.mesh import (
+        MeshConfig,
+        build_mesh,
+        param_shardings,
+        shard_params,  # noqa: F401 (sharding rules live beside it)
+    )
+    from langstream_tpu.parallel import mesh as mesh_lib
+
+    config = model_lib.LlamaConfig.from_dict({"preset": "llama-3-70b"})
+    config = dataclasses.replace(config, max_seq_len=seq)
+    topo = topologies.get_topology_desc("v5e:2x4", "tpu")
+    mesh = build_mesh(MeshConfig(tp=8), devices=list(topo.devices)[:8])
+
+    from langstream_tpu.providers.jax_local.quant import (
+        quantize_logical_axes,
+    )
+
+    axes = model_lib.logical_axes(config)
+    param_shapes = jax.eval_shape(lambda: init_quantized_params(config, 0))
+    axes = quantize_logical_axes(axes, param_shapes)
+    shardings = param_shardings(axes, mesh)
+
+    def with_sharding(shape_tree, sharding_tree):
+        return jax.tree_util.tree_map(
+            lambda leaf, s: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
+                                                 sharding=s),
+            shape_tree, sharding_tree,
+        )
+
+    params = with_sharding(param_shapes, shardings)
+    cache_shapes = jax.eval_shape(
+        lambda: model_lib.init_cache(config, slots, seq)
+    )
+    cache_shardings = param_shardings(model_lib.cache_logical_axes(), mesh)
+    cache = with_sharding(cache_shapes, cache_shardings)
+    freqs = rope_frequencies(
+        config.dims_per_head, config.max_seq_len, config.rope_theta
+    )
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    replicated = NamedSharding(mesh, PartitionSpec())
+
+    def arg(shape, dtype):
+        return jax.ShapeDtypeStruct(shape, dtype, sharding=replicated)
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def decode_run(params, cache, tokens, lengths, active, write_mask,
+                   temperature, top_k, top_p, rng):
+        def body(carry, key):
+            cache, tokens, lengths = carry
+            cache, logits = model_lib.decode_step(
+                config, params, cache, tokens, lengths, freqs, write_mask
+            )
+            sampled, lp = _sample_with_logprob(
+                logits, temperature, top_k, key, top_p
+            )
+            lengths = jnp.where(active, lengths + 1, lengths)
+            return (cache, sampled, lengths), (sampled, lp)
+
+        keys = jax.random.split(rng, chunk)
+        (cache, _, _), (out, lps) = jax.lax.scan(
+            body, (cache, tokens, lengths), keys
+        )
+        return cache, out.T, lps.T
+
+    with mesh:
+        compiled = decode_run.lower(
+            params, cache,
+            arg((slots,), jnp.int32), arg((slots,), jnp.int32),
+            arg((slots,), jnp.bool_), arg((slots,), jnp.bool_),
+            arg((slots,), jnp.float32), arg((slots,), jnp.int32),
+            arg((slots,), jnp.float32), arg((2,), jnp.uint32),
+        ).compile()
+    mem = compiled.memory_analysis()
+    gb = 2 ** 30
+    weight_bytes = sum(
+        leaf.size * leaf.dtype.itemsize
+        for leaf in jax.tree_util.tree_leaves(param_shapes)
+    )
+    print(f"== 70B int8 decode, tp=8 on v5e:2x4 "
+          f"({slots} slots x {chunk} steps, seq {seq}) ==")
+    print(f"total weights: {weight_bytes / gb:.1f} GB "
+          f"(~{weight_bytes / 8 / gb:.2f} GB/chip sharded)")
+    print(f"per-chip: args {mem.argument_size_in_bytes / gb:.2f} GB, "
+          f"temp {mem.temp_size_in_bytes / gb:.3f} GB, "
+          f"output {mem.output_size_in_bytes / gb:.2f} GB")
+    assert mem.argument_size_in_bytes + mem.temp_size_in_bytes < 15 * gb, (
+        "does not fit a 16 GB v5e chip"
+    )
+    print("fits one v5e chip's HBM per shard: OK")
+
+
+if __name__ == "__main__" and "--tp8-70b" in sys.argv:
+    probe_tp8_70b()
