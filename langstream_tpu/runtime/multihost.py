@@ -1,0 +1,113 @@
+"""Multi-host DCN mesh bootstrap for agent-runner pods.
+
+SURVEY §7 hard part (e): a TPU slice larger than one host (v5e > 8
+chips) runs one replica across several pods; every pod of the replica
+must enter the same pjit program, which requires
+``jax.distributed.initialize`` with a shared coordinator and a stable
+process id. The operator's StatefulSet provides the ingredients
+(reference-side analogue is GKE's JobSet/TPU webhook; the reference
+itself never spans a model across processes):
+
+- ``podManagementPolicy: Parallel`` + a headless service → every pod
+  has a stable DNS name ``{sts}-{ordinal}.{sts}.{ns}.svc``.
+- ``LANGSTREAM_HOSTS_PER_REPLICA`` (H): pods ``r*H .. r*H+H-1`` form
+  data-parallel replica ``r``; within it, the pod with local rank 0 is
+  the jax coordinator.
+
+``plan_from_statefulset`` derives (replica, process id, coordinator)
+from the pod's own hostname, and :func:`initialize_multihost` applies
+it. Single-host replicas (H == 1) are a no-op.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import re
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_ORDINAL = re.compile(r"^(?P<base>.+)-(?P<ordinal>\d+)$")
+
+
+@dataclasses.dataclass(frozen=True)
+class MultihostPlan:
+    replica: int            # data-parallel replica this pod belongs to
+    process_id: int         # jax process id within the replica (0..H-1)
+    num_processes: int      # H
+    coordinator: str        # host:port of the replica's rank-0 pod
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.process_id == 0
+
+
+def plan_from_statefulset(
+    hostname: Optional[str] = None,
+    *,
+    hosts_per_replica: Optional[int] = None,
+    namespace: Optional[str] = None,
+    service: Optional[str] = None,
+    port: int = 8476,
+) -> Optional[MultihostPlan]:
+    """Derive the jax.distributed topology from StatefulSet identity.
+
+    Returns None when this pod is a single-host replica (H <= 1) or is
+    not running under a StatefulSet-shaped hostname.
+    """
+    hosts = int(
+        hosts_per_replica
+        if hosts_per_replica is not None
+        else os.environ.get("LANGSTREAM_HOSTS_PER_REPLICA", "1")
+    )
+    if hosts <= 1:
+        return None
+    hostname = hostname or os.environ.get("HOSTNAME", "")
+    match = _ORDINAL.match(hostname)
+    if not match:
+        raise ValueError(
+            f"multi-host replica needs a StatefulSet ordinal hostname, "
+            f"got {hostname!r}"
+        )
+    base = match.group("base")
+    ordinal = int(match.group("ordinal"))
+    replica, process_id = divmod(ordinal, hosts)
+    namespace = namespace or os.environ.get(
+        "LANGSTREAM_NAMESPACE", "default"
+    )
+    # the headless service shares the StatefulSet's name
+    # (deployer/resources.py generate_headless_service)
+    service = service or base
+    coordinator_pod = f"{base}-{replica * hosts}"
+    coordinator = (
+        f"{coordinator_pod}.{service}.{namespace}.svc:{port}"
+    )
+    return MultihostPlan(
+        replica=replica,
+        process_id=process_id,
+        num_processes=hosts,
+        coordinator=coordinator,
+    )
+
+
+def initialize_multihost(plan: Optional[MultihostPlan] = None) -> bool:
+    """Bring up jax.distributed for this pod's replica when needed.
+    Returns True when distributed init ran."""
+    if plan is None:
+        plan = plan_from_statefulset()
+    if plan is None:
+        return False
+    import jax
+
+    logger.info(
+        "multi-host replica %d: process %d/%d, coordinator %s",
+        plan.replica, plan.process_id, plan.num_processes, plan.coordinator,
+    )
+    jax.distributed.initialize(
+        coordinator_address=plan.coordinator,
+        num_processes=plan.num_processes,
+        process_id=plan.process_id,
+    )
+    return True

@@ -224,6 +224,11 @@ async def agent_runner_main(
         from langstream_tpu.runtime.plugins import load_plugins
 
         load_plugins(plugins_dir)
+    # multi-host slice: all pods of this replica enter one pjit program
+    # (SURVEY §7 hard part (e)); a no-op for single-host replicas
+    from langstream_tpu.runtime.multihost import initialize_multihost
+
+    initialize_multihost()
     config = load_pod_configuration(config_path)
     node = node_from_document(config["agentNode"])
     # one pod = one replica; data parallelism is the StatefulSet's

@@ -1,0 +1,50 @@
+"""Multi-host replica topology derivation (SURVEY §7 hard part (e)):
+StatefulSet ordinals → (replica, process id, coordinator)."""
+
+from __future__ import annotations
+
+import pytest
+
+from langstream_tpu.runtime.multihost import plan_from_statefulset
+
+
+def test_single_host_is_noop():
+    assert plan_from_statefulset("app-shout-3", hosts_per_replica=1) is None
+
+
+def test_ordinals_group_into_replicas():
+    # 16-chip replicas on v5e = 2 hosts each; replicas r: pods 2r, 2r+1
+    plans = [
+        plan_from_statefulset(
+            f"app-llm-{i}", hosts_per_replica=2, namespace="team-a",
+        )
+        for i in range(4)
+    ]
+    assert [(p.replica, p.process_id) for p in plans] == [
+        (0, 0), (0, 1), (1, 0), (1, 1),
+    ]
+    assert plans[0].is_coordinator and not plans[1].is_coordinator
+    # both pods of replica 1 agree on the coordinator: pod 2's DNS name
+    assert plans[2].coordinator == plans[3].coordinator
+    assert plans[2].coordinator == "app-llm-2.app-llm.team-a.svc:8476"
+    assert plans[0].coordinator == "app-llm-0.app-llm.team-a.svc:8476"
+
+
+def test_replica_grouping_matches_statefulset_factory():
+    """The factory's replica math (pods r*H..r*H+H-1 form replica r,
+    deployer/resources.py) and the runtime derivation must agree."""
+    from langstream_tpu.deployer.resources import hosts_per_replica
+
+    chips = 16  # v5e-16 → 2 hosts per replica
+    hosts = hosts_per_replica(chips)
+    assert hosts == 2
+    plan = plan_from_statefulset(
+        "a-b-5", hosts_per_replica=hosts, namespace="ns"
+    )
+    assert (plan.replica, plan.process_id) == (2, 1)
+    assert plan.num_processes == hosts
+
+
+def test_bad_hostname_rejected():
+    with pytest.raises(ValueError, match="ordinal hostname"):
+        plan_from_statefulset("not-a-statefulset-pod-name-", hosts_per_replica=2)
