@@ -186,10 +186,15 @@ class LocalApplicationRunner:
                 runner = await self._build_runner(node, replica)
                 self.runners.append(runner)
         # bring every replica's agents (and consumer-group membership) up
-        # BEFORE any loop runs: one rebalance generation, no redelivery churn
-        for runner in self.runners:
-            if hasattr(runner, "start_agents"):
-                await runner.start_agents()
+        # BEFORE any loop runs — and CONCURRENTLY, so all members of a
+        # group land in one rebalance generation (a sequential bring-up
+        # makes each later member wait out a full rebalance window while
+        # the earlier ones aren't polling yet)
+        await asyncio.gather(*[
+            runner.start_agents()
+            for runner in self.runners
+            if hasattr(runner, "start_agents")
+        ])
         for runner in self.runners:
             self._tasks.append(loop.create_task(runner.run()))
         self._started.set()

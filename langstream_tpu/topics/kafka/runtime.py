@@ -243,6 +243,10 @@ class KafkaTopicConsumer(TopicConsumer):
         self._rejoin_needed = False
         self._coord_conn = None  # dedicated coordinator channel
         self._heartbeat_task: Optional[asyncio.Task] = None
+        # serializes membership changes (join/rejoin) against read():
+        # the heartbeat task rejoins PROMPTLY on a rebalance signal even
+        # when the owner isn't polling (e.g. during app bring-up)
+        self._membership_lock = asyncio.Lock()
         self._fetch_cursor = 0
         self._delivered = 0
         self._started = False
@@ -252,7 +256,8 @@ class KafkaTopicConsumer(TopicConsumer):
         if self._started:
             return
         self._started = True
-        await self._join()
+        async with self._membership_lock:
+            await self._join()
         self._heartbeat_task = asyncio.get_running_loop().create_task(
             self._heartbeat_loop()
         )
@@ -368,6 +373,17 @@ class KafkaTopicConsumer(TopicConsumer):
                 proto.UNKNOWN_MEMBER_ID, proto.NOT_COORDINATOR,
             ):
                 self._rejoin_needed = True
+                # rejoin NOW (not at the next poll): other members'
+                # rebalance windows wait for this member, and the owner
+                # may not be polling yet
+                try:
+                    async with self._membership_lock:
+                        if self._rejoin_needed:
+                            if self._member_id:
+                                self._generation = -1
+                            await self._join()
+                except Exception:  # noqa: BLE001 — retry next beat
+                    continue
 
     # -- data ------------------------------------------------------------ #
     async def read(
@@ -376,9 +392,11 @@ class KafkaTopicConsumer(TopicConsumer):
         if not self._started:
             await self.start()
         if self._rejoin_needed:
-            if self._member_id:
-                self._generation = -1
-            await self._join()
+            async with self._membership_lock:
+                if self._rejoin_needed:  # heartbeat task may have done it
+                    if self._member_id:
+                        self._generation = -1
+                    await self._join()
         if not self._assignment:
             await asyncio.sleep(timeout)
             return []

@@ -368,3 +368,90 @@ def test_native_crc32c_matches_python():
         decoded = ctypes.c_int64()
         consumed = lib.ls_varint_decode(out, n, ctypes.byref(decoded))
         assert consumed == n and decoded.value == value
+
+
+@pytest.mark.slow
+def test_dp_fanout_app_on_kafka(tmp_path):
+    """DP by replication on the Kafka runtime: 4 partitions, 2 replicas
+    in one consumer group through the real runner — the BASELINE #4
+    shape on an external-broker data plane."""
+    from langstream_tpu.runtime.local import run_application
+
+    app_dir = tmp_path / "app"
+    (app_dir / "python").mkdir(parents=True)
+    (app_dir / "pipeline.yaml").write_text(textwrap.dedent("""
+        topics:
+          - name: "in"
+            creation-mode: create-if-not-exists
+            partitions: 4
+          - name: "out"
+            creation-mode: create-if-not-exists
+        pipeline:
+          - id: "shout"
+            type: "python-processor"
+            input: "in"
+            output: "out"
+            resources:
+              parallelism: 2
+            configuration:
+              className: "shout_agent.Shout"
+    """))
+    (app_dir / "python" / "shout_agent.py").write_text(textwrap.dedent("""
+        class Shout:
+            def process(self, record):
+                return [record.value.upper()]
+    """))
+
+    async def main():
+        facade = None
+        if EXTERNAL:
+            bootstrap = EXTERNAL
+        else:
+            facade = await serve_kafka_facade()
+            bootstrap = facade.bootstrap
+        (tmp_path / "instance.yaml").write_text(textwrap.dedent(f"""
+            instance:
+              streamingCluster:
+                type: kafka
+                configuration:
+                  bootstrapServers: "{bootstrap}"
+        """))
+        runner = await run_application(
+            str(app_dir), instance_file=str(tmp_path / "instance.yaml")
+        )
+        try:
+            assert len(runner.runners) == 2  # two replicas, one group
+            producer = runner.producer("in")
+            await producer.start()
+            for i in range(12):
+                await producer.write(Record(value=f"m{i}", key=f"k{i}"))
+            reader = runner.reader("out")
+            await reader.start()
+            got = []
+            for _ in range(300):
+                got.extend(await reader.read(timeout=0.2))
+                if len(got) >= 12:
+                    break
+            assert sorted(r.value for r in got) == sorted(
+                f"M{i}" for i in range(12)
+            )
+            # both replicas converge to a 2/2 partition split (the
+            # heartbeat-triggered rejoin may need a beat after bring-up)
+            consumers = [
+                r.source.consumer for r in runner.runners
+                if hasattr(r.source, "consumer")
+            ]
+            deadline = asyncio.get_event_loop().time() + 20
+            while True:
+                assignments = sorted(len(c._assignment) for c in consumers)
+                if assignments == [2, 2]:
+                    break
+                if asyncio.get_event_loop().time() > deadline:
+                    raise AssertionError(f"never converged: {assignments}")
+                await asyncio.sleep(0.3)
+        finally:
+            await runner.stop()
+            if facade is not None:
+                await facade.close()
+
+    asyncio.run(main())
