@@ -189,12 +189,31 @@ class LocalApplicationRunner:
         # BEFORE any loop runs — and CONCURRENTLY, so all members of a
         # group land in one rebalance generation (a sequential bring-up
         # makes each later member wait out a full rebalance window while
-        # the earlier ones aren't polling yet)
-        await asyncio.gather(*[
-            runner.start_agents()
-            for runner in self.runners
-            if hasattr(runner, "start_agents")
-        ])
+        # the earlier ones aren't polling yet). On any failure, close
+        # everything that DID start: a leaked consumer's heartbeat task
+        # would hold its group membership (and partitions) alive forever
+        results = await asyncio.gather(
+            *[
+                runner.start_agents()
+                for runner in self.runners
+                if hasattr(runner, "start_agents")
+            ],
+            return_exceptions=True,
+        )
+        failure = next(
+            (r for r in results if isinstance(r, BaseException)), None
+        )
+        if failure is not None:
+            for runner in self.runners:
+                if not hasattr(runner, "_close_agents"):
+                    continue
+                try:
+                    await runner._close_agents()  # noqa: SLF001
+                except Exception:  # noqa: BLE001
+                    logger.exception("cleanup after failed start")
+            await self._service_provider_registry.close()
+            await self.topic_runtime.close()
+            raise failure
         for runner in self.runners:
             self._tasks.append(loop.create_task(runner.run()))
         self._started.set()

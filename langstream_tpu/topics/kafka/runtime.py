@@ -362,28 +362,32 @@ class KafkaTopicConsumer(TopicConsumer):
         while True:
             await asyncio.sleep(self._heartbeat_interval)
             try:
-                code = await self._client.heartbeat(
-                    self._coordinator, self._group, self._generation,
-                    self._member_id, conn=self._coord_conn,
-                )
+                # the lock covers the heartbeat AND any rejoin it
+                # triggers: membership state (generation, member id,
+                # coordinator connection) is only ever read or mutated
+                # under the lock, so a heartbeat can't carry a stale
+                # generation from a half-finished join and a rejoin can't
+                # close the coordinator connection under an in-flight
+                # commit (read()/commit() hold the same lock)
+                async with self._membership_lock:
+                    code = await self._client.heartbeat(
+                        self._coordinator, self._group, self._generation,
+                        self._member_id, conn=self._coord_conn,
+                    )
+                    if code in (
+                        proto.REBALANCE_IN_PROGRESS,
+                        proto.ILLEGAL_GENERATION,
+                        proto.UNKNOWN_MEMBER_ID, proto.NOT_COORDINATOR,
+                    ):
+                        # rejoin NOW (not at the next poll): other
+                        # members' rebalance windows wait for this
+                        # member, and the owner may not be polling yet
+                        if self._member_id:
+                            self._generation = -1
+                        await self._join()
+                        self._rejoin_needed = False
             except Exception:  # noqa: BLE001 — transient; retry next beat
                 continue
-            if code in (
-                proto.REBALANCE_IN_PROGRESS, proto.ILLEGAL_GENERATION,
-                proto.UNKNOWN_MEMBER_ID, proto.NOT_COORDINATOR,
-            ):
-                self._rejoin_needed = True
-                # rejoin NOW (not at the next poll): other members'
-                # rebalance windows wait for this member, and the owner
-                # may not be polling yet
-                try:
-                    async with self._membership_lock:
-                        if self._rejoin_needed:
-                            if self._member_id:
-                                self._generation = -1
-                            await self._join()
-                except Exception:  # noqa: BLE001 — retry next beat
-                    continue
 
     # -- data ------------------------------------------------------------ #
     async def read(
@@ -391,54 +395,70 @@ class KafkaTopicConsumer(TopicConsumer):
     ) -> List[Record]:
         if not self._started:
             await self.start()
-        if self._rejoin_needed:
-            async with self._membership_lock:
-                if self._rejoin_needed:  # heartbeat task may have done it
-                    if self._member_id:
-                        self._generation = -1
-                    await self._join()
-        if not self._assignment:
-            await asyncio.sleep(timeout)
-            return []
-        out: List[Record] = []
-        # ONE fetch covering every assigned partition: idle partitions
-        # share a single long-poll instead of serializing P timeouts
-        results = await self._client.fetch_multi(
-            self._topic,
-            {p: self._fetch_pos[p] for p in self._assignment},
-            max_wait_ms=int(timeout * 1000),
-        )
-        # rotate the partition order so no partition starves when
-        # max_records truncates the batch
-        order = (
-            self._assignment[self._fetch_cursor:]
-            + self._assignment[:self._fetch_cursor]
-        )
-        self._fetch_cursor = (self._fetch_cursor + 1) % len(self._assignment)
-        for partition in order:
-            records, _hw = results.get(partition, ([], -1))
-            for kafka_record in records:
-                if kafka_record.offset < self._fetch_pos[partition]:
-                    continue  # batch replay below requested offset
-                if len(out) >= max_records:
-                    break
-                view = decode_record(kafka_record, self._topic)
-                view = _dataclasses.replace(view, partition=partition)
-                out.append(view)
-                self._fetch_pos[partition] = kafka_record.offset + 1
-                self._outstanding.setdefault(partition, set()).add(
-                    kafka_record.offset
+        # the WHOLE poll body runs under the membership lock: the
+        # heartbeat task's rejoin can then only interleave BETWEEN
+        # polls, never against an in-flight fetch whose positions a
+        # _reset_positions() would invalidate
+        async with self._membership_lock:
+            if self._rejoin_needed:
+                if self._member_id:
+                    self._generation = -1
+                await self._join()
+            if not self._assignment:
+                pause = timeout
+            else:
+                pause = 0.0
+                out: List[Record] = []
+                # ONE fetch covering every assigned partition: idle
+                # partitions share a single long-poll instead of
+                # serializing P timeouts
+                results = await self._client.fetch_multi(
+                    self._topic,
+                    {p: self._fetch_pos[p] for p in self._assignment},
+                    max_wait_ms=int(timeout * 1000),
                 )
-                self._next_after_delivered[partition] = (
-                    kafka_record.offset + 1
+                # rotate the partition order so no partition starves
+                # when max_records truncates the batch
+                order = (
+                    self._assignment[self._fetch_cursor:]
+                    + self._assignment[:self._fetch_cursor]
                 )
-        self._delivered += len(out)
-        return out
+                self._fetch_cursor = (
+                    self._fetch_cursor + 1
+                ) % len(self._assignment)
+                for partition in order:
+                    records, _hw = results.get(partition, ([], -1))
+                    for kafka_record in records:
+                        if kafka_record.offset < self._fetch_pos[partition]:
+                            continue  # batch replay below requested offset
+                        if len(out) >= max_records:
+                            break
+                        view = decode_record(kafka_record, self._topic)
+                        view = _dataclasses.replace(
+                            view, partition=partition
+                        )
+                        out.append(view)
+                        self._fetch_pos[partition] = kafka_record.offset + 1
+                        self._outstanding.setdefault(partition, set()).add(
+                            kafka_record.offset
+                        )
+                        self._next_after_delivered[partition] = (
+                            kafka_record.offset + 1
+                        )
+                self._delivered += len(out)
+                return out
+        # empty assignment: idle OUTSIDE the lock so heartbeats flow
+        await asyncio.sleep(pause)
+        return []
 
     async def commit(self, records: List[Record]) -> None:
         """Out-of-order acks allowed; durable offset = contiguous prefix
         (KafkaConsumerWrapper.java:52-230 semantics)."""
         to_commit: Dict[Tuple[str, int], int] = {}
+        async with self._membership_lock:
+            await self._commit_locked(records, to_commit)
+
+    async def _commit_locked(self, records, to_commit) -> None:
         for record in records:
             if not isinstance(record, KafkaRecordView):
                 raise ValueError(

@@ -394,13 +394,17 @@ def test_dp_fanout_app_on_kafka(tmp_path):
             resources:
               parallelism: 2
             configuration:
-              className: "shout_agent.Shout"
+              className: "fanout_upper_agent.Upper"
     """))
-    (app_dir / "python" / "shout_agent.py").write_text(textwrap.dedent("""
-        class Shout:
+    # unique module name: user python modules import by name process-wide
+    # (sys.modules), so another test's shout_agent would shadow this one
+    (app_dir / "python" / "fanout_upper_agent.py").write_text(
+        textwrap.dedent("""
+        class Upper:
             def process(self, record):
                 return [record.value.upper()]
-    """))
+        """)
+    )
 
     async def main():
         facade = None
