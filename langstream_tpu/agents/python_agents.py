@@ -44,6 +44,48 @@ async def _maybe_await(value):
     return value
 
 
+def _load_user_class(class_name: str, python_paths) -> type:
+    """Resolve ``module.Class`` from the app's python dirs.
+
+    When ``pythonPath`` entries are given, the user modules import under
+    a synthetic package namespaced by the path set (same isolation the
+    plugin system uses): two apps in one process may both ship a module
+    named ``my_agent`` without the first import shadowing the second —
+    a plain ``sys.path`` + ``import_module`` would cache the first one
+    process-wide in ``sys.modules``. Without pythonPath, fall back to
+    the plain import (framework-provided classes on sys.path).
+    """
+    if not python_paths:
+        return load_class(class_name)
+    import hashlib
+    import importlib
+    import types
+
+    tag = hashlib.sha256(
+        "\x00".join(sorted(str(p) for p in python_paths)).encode()
+    ).hexdigest()[:12]
+    namespace = "_ls_apps"
+    package_name = f"{namespace}.app_{tag}"
+    root = sys.modules.get(namespace)
+    if root is None:
+        root = types.ModuleType(namespace)
+        root.__path__ = []  # type: ignore[attr-defined]
+        sys.modules[namespace] = root
+    package = sys.modules.get(package_name)
+    if package is None:
+        package = types.ModuleType(package_name)
+        package.__path__ = [str(p) for p in python_paths]  # type: ignore[attr-defined]
+        package.__package__ = package_name
+        sys.modules[package_name] = package
+    module_name, _, cls_name = class_name.rpartition(".")
+    if not module_name:
+        raise ValueError(
+            f"className must be 'module.Class', got {class_name!r}"
+        )
+    module = importlib.import_module(f"{package_name}.{module_name}")
+    return getattr(module, cls_name)
+
+
 class _PythonAgentMixin:
     user_agent: Any = None
 
@@ -53,10 +95,7 @@ class _PythonAgentMixin:
         if not class_name:
             raise ValueError("python agent requires 'className' configuration")
         extra_path = configuration.get("pythonPath") or []
-        for path in extra_path:
-            if path not in sys.path:
-                sys.path.insert(0, path)
-        cls = load_class(class_name)
+        cls = _load_user_class(class_name, extra_path)
         self.user_agent = cls()
         if hasattr(self.user_agent, "init"):
             await _maybe_await(self.user_agent.init(configuration))

@@ -329,3 +329,33 @@ def test_file_source(tmp_path):
         assert sorted(p.name for p in tmp_path.iterdir()) == ["c.bin"]
 
     run(main())
+
+
+def test_python_agents_same_module_name_no_collision(tmp_path):
+    """Two apps shipping the SAME user module name must not shadow each
+    other in one process (per-pythonPath namespacing, like plugins)."""
+    import textwrap
+
+    for name, body in (
+        ("app_a", "class P:\n    def process(self, record):\n        return [record.value + '-A']"),
+        ("app_b", "class P:\n    def process(self, record):\n        return [record.value + '-B']"),
+    ):
+        d = tmp_path / name / "python"
+        d.mkdir(parents=True)
+        (d / "dup_module.py").write_text(body)
+
+    async def main():
+        outs = []
+        for name in ("app_a", "app_b"):
+            agent = await make(
+                "python-processor",
+                {
+                    "className": "dup_module.P",
+                    "pythonPath": [str(tmp_path / name / "python")],
+                },
+            )
+            out = await one(agent, Record(value="x"))
+            outs.append(out[0].value)
+        assert outs == ["x-A", "x-B"]
+
+    run(main())
