@@ -206,8 +206,15 @@ def test_python_source_and_sink(tmp_path):
     async def main():
         runner = await run_application(app_dir)
         try:
-            import sspy
+            import sys
 
+            # user modules import under the app's synthetic namespace
+            # (shared between the app's agents — Src and Snk see one
+            # module instance); find it by suffix
+            sspy = next(
+                module for name, module in sys.modules.items()
+                if name.endswith(".sspy")
+            )
             deadline = asyncio.get_event_loop().time() + 5
             while len(sspy.SEEN) < 2:
                 if asyncio.get_event_loop().time() > deadline:
