@@ -28,13 +28,61 @@ class Counter:
         return self._value
 
 
+class Histogram:
+    """Fixed-bucket histogram (Prometheus classic shape: cumulative
+    ``le`` buckets + sum + count). Default buckets suit latencies in
+    seconds from sub-millisecond to minutes."""
+
+    DEFAULT_BUCKETS = (
+        0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+        0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+    )
+
+    __slots__ = ("name", "buckets", "_counts", "_sum", "_count", "_lock")
+
+    def __init__(self, name: str, buckets=None) -> None:
+        self.name = name
+        self.buckets = tuple(buckets or self.DEFAULT_BUCKETS)
+        self._counts = [0] * (len(self.buckets) + 1)  # +inf bucket
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        index = len(self.buckets)
+        for i, upper in enumerate(self.buckets):
+            if value <= upper:
+                index = i
+                break
+        with self._lock:
+            self._counts[index] += 1
+            self._sum += value
+            self._count += 1
+
+    def snapshot(self) -> Dict[str, float]:
+        """Cumulative bucket counts keyed by ``le`` plus sum/count."""
+        with self._lock:
+            counts = list(self._counts)
+            total_sum, total_count = self._sum, self._count
+        out: Dict[str, float] = {}
+        running = 0
+        for upper, count in zip(self.buckets, counts):
+            running += count
+            out[f"{upper}"] = running
+        out["+Inf"] = running + counts[-1]
+        out["sum"] = total_sum
+        out["count"] = total_count
+        return out
+
+
 class MetricsReporter:
-    """Namespaced counter registry; ``with_prefix`` mirrors the reference's
-    ``MetricsReporter.withPodName/withAgentName`` chaining."""
+    """Namespaced counter/histogram registry; ``with_prefix`` mirrors the
+    reference's ``MetricsReporter.withPodName/withAgentName`` chaining."""
 
     def __init__(self, prefix: str = "") -> None:
         self.prefix = prefix
         self._counters: Dict[str, Counter] = {}
+        self._histograms: Dict[str, Histogram] = {}
         self._lock = threading.Lock()
 
     def with_prefix(self, prefix: str) -> "MetricsReporter":
@@ -42,6 +90,7 @@ class MetricsReporter:
             f"{self.prefix}_{prefix}" if self.prefix else prefix
         )
         child._counters = self._counters  # shared registry
+        child._histograms = self._histograms
         child._lock = self._lock
         return child
 
@@ -54,9 +103,23 @@ class MetricsReporter:
                 self._counters[full] = counter
             return counter
 
+    def histogram(self, name: str, buckets=None) -> Histogram:
+        full = f"{self.prefix}_{name}" if self.prefix else name
+        with self._lock:
+            histogram = self._histograms.get(full)
+            if histogram is None:
+                histogram = Histogram(full, buckets)
+                self._histograms[full] = histogram
+            return histogram
+
     def snapshot(self) -> Dict[str, int]:
         with self._lock:
             return {name: c.value() for name, c in self._counters.items()}
+
+    def histogram_snapshots(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            histograms = dict(self._histograms)
+        return {name: h.snapshot() for name, h in histograms.items()}
 
 
 DISABLED = MetricsReporter()

@@ -60,6 +60,21 @@ import weakref
 
 _LIVE_ENGINES: "weakref.WeakSet" = weakref.WeakSet()
 
+# decode-step latency histogram across every engine in the process
+# (observed once per chunk at wall/steps; buckets tuned to the ms range
+# a decode step lives in)
+from langstream_tpu.api.metrics import Histogram
+
+DECODE_STEP_SECONDS = Histogram(
+    "jax_engine_decode_step_seconds",
+    buckets=(0.001, 0.002, 0.005, 0.01, 0.02, 0.035, 0.05, 0.075,
+             0.1, 0.15, 0.25, 0.5, 1.0),
+)
+
+
+def engines_histograms():
+    return {DECODE_STEP_SECONDS.name: DECODE_STEP_SECONDS.snapshot()}
+
 
 def engines_snapshot() -> Dict[str, float]:
     """Prometheus-gauge view over every live engine in this process:
@@ -754,6 +769,7 @@ class DecodeEngine:
         self.stats["active_slot_steps"] += n_active * steps
         if len(self.chunk_log) < 65536:
             self.chunk_log.append((steps, n_active, wall))
+        DECODE_STEP_SECONDS.observe(wall / max(steps, 1))
         for i, slot in enumerate(self.slots):
             if not active[i]:
                 continue

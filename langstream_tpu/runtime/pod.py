@@ -118,9 +118,13 @@ _METRIC_NAME = re.compile(r"[^a-zA-Z0-9_:]")
 
 
 def prometheus_text(
-    counters: Dict[str, int], gauges: Optional[Dict[str, float]] = None
+    counters: Dict[str, int],
+    gauges: Optional[Dict[str, float]] = None,
+    histograms: Optional[Dict[str, Dict[str, float]]] = None,
 ) -> str:
-    """Render counters/gauges in the Prometheus text exposition format."""
+    """Render counters/gauges/histograms in the Prometheus text
+    exposition format (histogram snapshots are the ``le``-keyed dicts
+    :meth:`api.metrics.Histogram.snapshot` produces)."""
     lines: List[str] = []
     for name, value in sorted(counters.items()):
         metric = _METRIC_NAME.sub("_", name)
@@ -132,6 +136,15 @@ def prometheus_text(
         metric = _METRIC_NAME.sub("_", name)
         lines.append(f"# TYPE {metric} gauge")
         lines.append(f"{metric} {value}")
+    for name, snapshot in sorted((histograms or {}).items()):
+        metric = _METRIC_NAME.sub("_", name)
+        lines.append(f"# TYPE {metric} histogram")
+        for le, value in snapshot.items():
+            if le in ("sum", "count"):
+                continue
+            lines.append(f'{metric}_bucket{{le="{le}"}} {int(value)}')
+        lines.append(f"{metric}_sum {snapshot.get('sum', 0.0)}")
+        lines.append(f"{metric}_count {int(snapshot.get('count', 0))}")
     return "\n".join(lines) + "\n"
 
 
@@ -145,12 +158,14 @@ class AgentHttpServer:
         info: Any,            # () -> dict
         metrics: Any = None,  # MetricsReporter
         gauges: Any = None,   # () -> dict of name -> float
+        histograms: Any = None,  # () -> dict of name -> le-snapshot
         port: int = AGENT_HTTP_PORT,
         host: str = "0.0.0.0",
     ) -> None:
         self._info = info
         self._metrics = metrics
         self._gauges = gauges
+        self._histograms = histograms
         self.port = port
         self.host = host
         self._runner = None
@@ -189,8 +204,15 @@ class AgentHttpServer:
 
         counters = self._metrics.snapshot() if self._metrics else {}
         gauges = self._gauges() if self._gauges else {}
+        histograms: Dict[str, Any] = {}
+        if self._metrics is not None and hasattr(
+            self._metrics, "histogram_snapshots"
+        ):
+            histograms.update(self._metrics.histogram_snapshots())
+        if self._histograms is not None:
+            histograms.update(self._histograms())
         return web.Response(
-            text=prometheus_text(counters, gauges),
+            text=prometheus_text(counters, gauges, histograms),
             content_type="text/plain",
         )
 
@@ -243,16 +265,23 @@ async def agent_runner_main(
         os.makedirs(state_dir, exist_ok=True)
     runner = LocalApplicationRunner(plan, state_directory=state_dir or None)
 
-    def gauges() -> Dict[str, float]:
-        # TPU engine internals, when this pod hosts a jax-local engine
+    def _engine_module():
         import sys
 
-        module = sys.modules.get("langstream_tpu.providers.jax_local.engine")
+        return sys.modules.get("langstream_tpu.providers.jax_local.engine")
+
+    def gauges() -> Dict[str, float]:
+        # TPU engine internals, when this pod hosts a jax-local engine
+        module = _engine_module()
         return module.engines_snapshot() if module else {}
+
+    def histograms() -> Dict[str, Any]:
+        module = _engine_module()
+        return module.engines_histograms() if module else {}
 
     http = AgentHttpServer(
         info=runner.info, metrics=runner.metrics, gauges=gauges,
-        port=http_port,
+        histograms=histograms, port=http_port,
     )
     await http.start()
     logger.info(

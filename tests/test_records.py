@@ -44,3 +44,29 @@ def test_record_from_value_coercions():
 def test_estimated_size():
     assert Record(value="abcd").estimated_size() >= 4
     assert Record(value=b"abcd", key="k").estimated_size() >= 5
+
+
+def test_histogram_snapshot_and_prometheus_rendering():
+    from langstream_tpu.api.metrics import Histogram, MetricsReporter
+    from langstream_tpu.runtime.pod import prometheus_text
+
+    reporter = MetricsReporter(prefix="agent_x")
+    histogram = reporter.histogram("latency_seconds", buckets=(0.01, 0.1, 1.0))
+    for value in (0.005, 0.05, 0.05, 0.5, 2.0):
+        histogram.observe(value)
+    snapshot = histogram.snapshot()
+    assert snapshot["0.01"] == 1
+    assert snapshot["0.1"] == 3
+    assert snapshot["1.0"] == 4
+    assert snapshot["+Inf"] == 5
+    assert snapshot["count"] == 5
+    assert abs(snapshot["sum"] - 2.605) < 1e-9
+
+    text = prometheus_text(
+        reporter.snapshot(), {},
+        reporter.histogram_snapshots(),
+    )
+    assert '# TYPE agent_x_latency_seconds histogram' in text
+    assert 'agent_x_latency_seconds_bucket{le="0.1"} 3' in text
+    assert 'agent_x_latency_seconds_bucket{le="+Inf"} 5' in text
+    assert 'agent_x_latency_seconds_count 5' in text
