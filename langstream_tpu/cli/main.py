@@ -47,6 +47,38 @@ async def _apps_run(args, ui: bool = False) -> None:
             f"  agent {node.id}: {node.input_topic or '(source)'} -> "
             f"{node.output_topic or '(sink)'}"
         )
+    http = None
+    if getattr(args, "http_port", -1) >= 0:
+        from langstream_tpu.runtime.pod import AgentHttpServer
+
+        def _engine_module():
+            import sys
+
+            return sys.modules.get(
+                "langstream_tpu.providers.jax_local.engine"
+            )
+
+        http = AgentHttpServer(
+            info=runner.info,
+            metrics=runner.metrics,
+            gauges=lambda: (
+                _engine_module().engines_snapshot()
+                if _engine_module() else {}
+            ),
+            histograms=lambda: (
+                _engine_module().engines_histograms()
+                if _engine_module() else {}
+            ),
+            port=args.http_port,
+            host="127.0.0.1",
+        )
+        try:
+            await http.start()
+            http.ready = True
+            print(f"metrics on http://127.0.0.1:{http.port}/metrics")
+        except OSError as error:
+            print(f"(metrics server disabled: {error})")
+            http = None
     gateway = None
     if runner.application.gateways:
         gateway = GatewayServer(port=args.gateway_port)
@@ -74,6 +106,8 @@ async def _apps_run(args, ui: bool = False) -> None:
     finally:
         if gateway is not None:
             await gateway.stop()
+        if http is not None:
+            await http.stop()
         await runner.stop()
 
 
@@ -384,6 +418,10 @@ def build_parser() -> argparse.ArgumentParser:
         if name in ("run", "ui"):
             cmd.add_argument("--gateway-port", type=int, default=8091)
             cmd.add_argument("--tenant", default="default")
+            cmd.add_argument(
+                "--http-port", type=int, default=8080,
+                help="/info + /metrics port (-1 disables)",
+            )
     # control-plane application commands (reference: apps deploy/update/...)
     for name in ("deploy", "update"):
         cmd = apps_sub.add_parser(name, help=f"{name} via the control plane")
