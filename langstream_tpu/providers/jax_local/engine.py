@@ -543,12 +543,15 @@ class DecodeEngine:
 
     def _admit(self) -> None:
         """Move pending requests into slots. Cold requests sharing a prompt
-        bucket are prefilled in ONE batched device call (batch padded to a
-        power of two so compilations stay bounded); warm-session requests
-        take one chunked prefill-at-offset dispatch each."""
+        bucket are prefilled in ONE batched device call, and warm-session
+        follow-ups sharing a suffix bucket likewise batch into one
+        prefill-at-offset dispatch (batches split into power-of-two group
+        sizes so compilations stay bounded)."""
         while self._pending:
             cold: List[Tuple[int, GenerationRequest]] = []
             cold_bucket: Optional[int] = None
+            # suffix bucket -> [(slot index, request, reused prefix len)]
+            warm: Dict[int, List[Tuple[int, GenerationRequest, int]]] = {}
             progressed = False
             while self._pending:
                 request = self._pending[0]
@@ -556,9 +559,17 @@ class DecodeEngine:
                 if index is None:
                     break
                 if self._session_warm(index, request):
+                    slot = self.slots[index]
+                    reused = len(slot.history)
+                    suffix_bucket = _bucket(
+                        len(request.prompt_tokens) - reused,
+                        self.prefill_buckets,
+                    )
                     self._pending.pop(0)
-                    self._prefill_warm(index, request)
-                    progressed = True
+                    slot.request = request  # reserve the slot
+                    warm.setdefault(suffix_bucket, []).append(
+                        (index, request, reused)
+                    )
                     continue
                 bucket = _bucket(len(request.prompt_tokens), self.prefill_buckets)
                 if cold_bucket is None:
@@ -571,6 +582,9 @@ class DecodeEngine:
                 # batch caps at the largest power of two ≤ max_slots
                 if len(cold) >= self.max_slots:
                     break
+            for suffix_bucket, batch in warm.items():
+                self._prefill_warm_batch(batch, suffix_bucket)
+                progressed = True
             if cold:
                 self._prefill_batch(cold, cold_bucket)
                 progressed = True
@@ -627,42 +641,63 @@ class DecodeEngine:
                 self._emit_token(index, int(first), lp)
                 request._prefill_time = time.perf_counter() - started  # type: ignore[attr-defined]
 
-    def _prefill_warm(self, index: int, request: GenerationRequest) -> None:
-        """Warm-session admission: the cache already holds the shared
-        prefix; prefill the new suffix AT OFFSET in one bucketed,
-        jitted dispatch (chunked prefill — no per-token forcing)."""
-        slot = self.slots[index]
-        prompt = request.prompt_tokens
-        started = time.perf_counter()
-        reused = len(slot.history)
-        suffix = prompt[reused:]
-        bucket = _bucket(len(suffix), self.prefill_buckets)
-        self.stats["session_hits"] += 1
-        slot.request = request
-        slot.generated = []
-        slot.logprobs = []
-        slot.history = list(prompt)
-        slot.session_id = None
-        slot.length = len(prompt)
-        slot.last_used = time.monotonic()
-        slot.epoch += 1
-        tokens = np.zeros((1, bucket), dtype=np.int32)
-        tokens[0, : len(suffix)] = suffix
-        run = self._get_prefill_offset(bucket)
-        self.cache, logits = run(
-            self.params,
-            self.cache,
-            jnp.asarray(tokens),
-            jnp.asarray([len(suffix)], dtype=jnp.int32),
-            jnp.asarray([reused], dtype=jnp.int32),
-            jnp.asarray([index], dtype=jnp.int32),
-        )
-        self.stats["warm_prefill_calls"] += 1
-        jax.block_until_ready(logits)
-        self.stats["prefill_time"] += time.perf_counter() - started
-        first, lp = self._sample_host(logits[0], request.sampling)
-        self._emit_token(index, int(first), lp)
-        request._prefill_time = time.perf_counter() - started  # type: ignore[attr-defined]
+    def _prefill_warm_batch(
+        self,
+        batch: List[Tuple[int, GenerationRequest, int]],
+        bucket: int,
+    ) -> None:
+        """Warm-session admissions sharing a suffix bucket: the cache
+        already holds each slot's shared prefix; ONE bucketed
+        prefill-at-offset dispatch writes every suffix (chunked prefill —
+        no per-token forcing, no per-request dispatch). Groups split to
+        power-of-two sizes to bound compilations, like cold prefill."""
+        groups: List[List[Tuple[int, GenerationRequest, int]]] = []
+        remaining = batch
+        while remaining:
+            size = 1
+            while size * 2 <= len(remaining):
+                size *= 2
+            groups.append(remaining[:size])
+            remaining = remaining[size:]
+        for group in groups:
+            started = time.perf_counter()
+            size = len(group)
+            tokens = np.zeros((size, bucket), dtype=np.int32)
+            lengths = np.zeros((size,), dtype=np.int32)
+            offsets = np.zeros((size,), dtype=np.int32)
+            slot_ids = np.zeros((size,), dtype=np.int32)
+            for row, (index, request, reused) in enumerate(group):
+                slot = self.slots[index]
+                prompt = request.prompt_tokens
+                suffix = prompt[reused:]
+                tokens[row, : len(suffix)] = suffix
+                lengths[row] = len(suffix)
+                offsets[row] = reused
+                slot_ids[row] = index
+                self.stats["session_hits"] += 1
+                slot.generated = []
+                slot.logprobs = []
+                slot.history = list(prompt)
+                slot.session_id = None
+                slot.length = len(prompt)
+                slot.last_used = time.monotonic()
+                slot.epoch += 1
+            run = self._get_prefill_offset(bucket)
+            self.cache, logits = run(
+                self.params,
+                self.cache,
+                jnp.asarray(tokens),
+                jnp.asarray(lengths),
+                jnp.asarray(offsets),
+                jnp.asarray(slot_ids),
+            )
+            self.stats["warm_prefill_calls"] += 1
+            jax.block_until_ready(logits)
+            self.stats["prefill_time"] += time.perf_counter() - started
+            for row, (index, request, _reused) in enumerate(group):
+                first, lp = self._sample_host(logits[row], request.sampling)
+                self._emit_token(index, int(first), lp)
+                request._prefill_time = time.perf_counter() - started  # type: ignore[attr-defined]
 
     def _sample_host(self, logits, sampling: SamplingParams) -> Tuple[int, float]:
         self._rng, key = jax.random.split(self._rng)

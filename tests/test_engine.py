@@ -488,3 +488,40 @@ def test_engine_tp8_matches_single_device():
         assert r1.tokens == r2.tokens
 
     asyncio.run(main())
+
+
+def test_warm_followups_batch_into_one_dispatch():
+    """Several sessions' follow-ups arriving together must share ONE
+    prefill-at-offset dispatch (BASELINE #5: bursts of session turns)."""
+
+    async def main():
+        config = LlamaConfig.tiny(max_seq_len=128)
+        params = init_params(config)
+        engine = DecodeEngine(
+            config, params, max_slots=4, max_seq_len=128,
+            prefill_buckets=[16, 32],
+        )
+        engine.start()
+        try:
+            sampling = SamplingParams(max_new_tokens=3)
+            first = await asyncio.gather(*[
+                engine.generate([i + 1, 2, 3], sampling, session_id=f"s{i}")
+                for i in range(4)
+            ])
+            engine.reset_stats()
+            follow = await asyncio.gather(*[
+                engine.generate(
+                    [i + 1, 2, 3] + first[i].tokens + [9],
+                    sampling, session_id=f"s{i}",
+                )
+                for i in range(4)
+            ])
+            assert all(len(r.tokens) == 3 for r in follow)
+            assert engine.stats["session_hits"] == 4
+            assert engine.stats["prefill_calls"] == 0  # all warm
+            # 4 same-bucket suffixes -> one batched dispatch
+            assert engine.stats["warm_prefill_calls"] == 1, engine.stats
+        finally:
+            engine.stop()
+
+    asyncio.run(main())
