@@ -591,14 +591,12 @@ class DecodeEngine:
             if not progressed:
                 return
 
-    def _prefill_batch(
-        self, batch: List[Tuple[int, GenerationRequest]], bucket: int
-    ) -> None:
-        started = time.perf_counter()
-        # split into power-of-two group sizes (no padding rows — a padding
-        # row would have to scatter somewhere in the cache) so the per-
-        # (bucket, batch) compilation count stays logarithmic
-        groups: List[List[Tuple[int, GenerationRequest]]] = []
+    @staticmethod
+    def _pow2_groups(batch: List[Any]) -> List[List[Any]]:
+        """Split into power-of-two group sizes (no padding rows — a
+        padding row would have to scatter somewhere in the cache) so the
+        per-(bucket, batch-size) compilation count stays logarithmic."""
+        groups: List[List[Any]] = []
         remaining = batch
         while remaining:
             size = 1
@@ -606,7 +604,24 @@ class DecodeEngine:
                 size *= 2
             groups.append(remaining[:size])
             remaining = remaining[size:]
-        for group in groups:
+        return groups
+
+    def _assign_slot(self, index: int, request: GenerationRequest) -> None:
+        """Reset a slot's bookkeeping for a newly admitted request."""
+        slot = self.slots[index]
+        slot.generated = []
+        slot.logprobs = []
+        slot.history = list(request.prompt_tokens)
+        slot.session_id = None
+        slot.length = len(request.prompt_tokens)
+        slot.last_used = time.monotonic()
+        slot.epoch += 1
+
+    def _prefill_batch(
+        self, batch: List[Tuple[int, GenerationRequest]], bucket: int
+    ) -> None:
+        started = time.perf_counter()
+        for group in self._pow2_groups(batch):
             group_started = time.perf_counter()
             size = len(group)
             tokens = np.zeros((size, bucket), dtype=np.int32)
@@ -617,14 +632,7 @@ class DecodeEngine:
                 tokens[row, : len(prompt)] = prompt
                 lengths[row] = len(prompt)
                 slot_ids[row] = index
-                slot = self.slots[index]
-                slot.generated = []
-                slot.logprobs = []
-                slot.history = list(prompt)
-                slot.session_id = None
-                slot.length = len(prompt)
-                slot.last_used = time.monotonic()
-                slot.epoch += 1
+                self._assign_slot(index, request)
             run = self._get_prefill(bucket)
             self.cache, logits = run(
                 self.params,
@@ -651,15 +659,7 @@ class DecodeEngine:
         prefill-at-offset dispatch writes every suffix (chunked prefill —
         no per-token forcing, no per-request dispatch). Groups split to
         power-of-two sizes to bound compilations, like cold prefill."""
-        groups: List[List[Tuple[int, GenerationRequest, int]]] = []
-        remaining = batch
-        while remaining:
-            size = 1
-            while size * 2 <= len(remaining):
-                size *= 2
-            groups.append(remaining[:size])
-            remaining = remaining[size:]
-        for group in groups:
+        for group in self._pow2_groups(batch):
             started = time.perf_counter()
             size = len(group)
             tokens = np.zeros((size, bucket), dtype=np.int32)
@@ -667,21 +667,13 @@ class DecodeEngine:
             offsets = np.zeros((size,), dtype=np.int32)
             slot_ids = np.zeros((size,), dtype=np.int32)
             for row, (index, request, reused) in enumerate(group):
-                slot = self.slots[index]
-                prompt = request.prompt_tokens
-                suffix = prompt[reused:]
+                suffix = request.prompt_tokens[reused:]
                 tokens[row, : len(suffix)] = suffix
                 lengths[row] = len(suffix)
                 offsets[row] = reused
                 slot_ids[row] = index
                 self.stats["session_hits"] += 1
-                slot.generated = []
-                slot.logprobs = []
-                slot.history = list(prompt)
-                slot.session_id = None
-                slot.length = len(prompt)
-                slot.last_used = time.monotonic()
-                slot.epoch += 1
+                self._assign_slot(index, request)
             run = self._get_prefill_offset(bucket)
             self.cache, logits = run(
                 self.params,

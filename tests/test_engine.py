@@ -5,6 +5,7 @@ import pytest
 
 from langstream_tpu.providers.jax_local.engine import (
     DecodeEngine,
+    GenerationRequest,
     SamplingParams,
 )
 from langstream_tpu.providers.jax_local.model import LlamaConfig, init_params
@@ -509,13 +510,31 @@ def test_warm_followups_batch_into_one_dispatch():
                 for i in range(4)
             ])
             engine.reset_stats()
-            follow = await asyncio.gather(*[
-                engine.generate(
-                    [i + 1, 2, 3] + first[i].tokens + [9],
-                    sampling, session_id=f"s{i}",
+            # submit all four follow-ups while the engine thread is
+            # stopped, then restart: one admission sees the whole burst
+            # (deterministic — no reliance on the 3ms admission linger)
+            engine.stop()
+            import concurrent.futures
+
+            futures = []
+            for i in range(4):
+                future: "concurrent.futures.Future" = (
+                    concurrent.futures.Future()
                 )
-                for i in range(4)
-            ])
+                engine.submit(GenerationRequest(
+                    prompt_tokens=[i + 1, 2, 3] + first[i].tokens + [9],
+                    sampling=sampling,
+                    session_id=f"s{i}",
+                    future=future,
+                ))
+                futures.append(future)
+            engine.start()
+            follow = [
+                await asyncio.get_running_loop().run_in_executor(
+                    None, future.result, 60
+                )
+                for future in futures
+            ]
             assert all(len(r.tokens) == 3 for r in follow)
             assert engine.stats["session_hits"] == 4
             assert engine.stats["prefill_calls"] == 0  # all warm
