@@ -471,9 +471,43 @@ class GatewayServer:
     # ------------------------------------------------------------------ #
     # service gateway (topic round-trip; GatewayResource.java:156-190)
     # ------------------------------------------------------------------ #
+    async def _proxy_service(
+        self, request, base_url: str, suffix: str = ""
+    ) -> web.Response:
+        """Forward the request to an agent service endpoint and relay the
+        response verbatim (the reference's direct-proxy service mode);
+        ``option:path`` selects the upstream path."""
+        import aiohttp
+
+        body = await request.read()
+        target = base_url.rstrip("/") + (
+            "/" + suffix.lstrip("/") if suffix else ""
+        )
+        headers = {}
+        if request.content_type:
+            headers["Content-Type"] = request.content_type
+        try:
+            async with aiohttp.ClientSession() as session:
+                async with session.request(
+                    request.method, target, data=body or None,
+                    headers=headers,
+                    timeout=aiohttp.ClientTimeout(total=60),
+                ) as upstream:
+                    payload = await upstream.read()
+                    return web.Response(
+                        body=payload,
+                        status=upstream.status,
+                        content_type=upstream.content_type,
+                    )
+        except aiohttp.ClientError as error:
+            return web.json_response(
+                {"status": "ERROR", "reason": f"service unreachable: {error}"},
+                status=502,
+            )
+
     async def _http_service(self, request) -> web.Response:
         try:
-            registered, gateway, parameters, _options, credentials = self._resolve(
+            registered, gateway, parameters, options, credentials = self._resolve(
                 request, "service"
             )
             principal = await self._authenticate(gateway, credentials)
@@ -482,6 +516,21 @@ class GatewayServer:
                 {"status": "ERROR", "reason": str(error)}, status=error.status
             )
         service = gateway.service_options
+        # direct proxy mode (reference: GatewayResource.java:234,331-345
+        # getExecutorServiceURI): forward straight to the agent service
+        # pod instead of a topic round trip
+        proxy_url = service.get("service-url")
+        if not proxy_url and service.get("agent-id"):
+            name = (
+                f"{registered.application.application_id}-"
+                f"{service['agent-id']}"
+            )
+            tenant = request.match_info["tenant"]
+            proxy_url = f"http://{name}.{tenant}.svc:8000"
+        if proxy_url:
+            return await self._proxy_service(
+                request, proxy_url, options.get("path", "")
+            )
         input_topic = service.get("input-topic")
         output_topic = service.get("output-topic")
         if not input_topic or not output_topic:

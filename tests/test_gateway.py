@@ -319,3 +319,61 @@ def test_ui_page_and_describe(tmp_path):
             await runner.stop()
 
     asyncio.run(main())
+
+
+def test_service_gateway_direct_proxy(tmp_path):
+    """Service gateway with service-url proxies straight to the agent
+    endpoint (reference: GatewayResource getExecutorServiceURI mode)."""
+    from aiohttp import web as aioweb
+
+    async def main():
+        # a stand-in agent service endpoint
+        async def handler(request):
+            body = await request.json()
+            return aioweb.json_response({"echo": body, "path": request.path})
+
+        backend = aioweb.Application()
+        backend.router.add_post("/{tail:.*}", handler)
+        backend_runner = aioweb.AppRunner(backend, access_log=None)
+        await backend_runner.setup()
+        site = aioweb.TCPSite(backend_runner, "127.0.0.1", 0)
+        await site.start()
+        backend_port = site._server.sockets[0].getsockname()[1]  # noqa: SLF001
+
+        files = dict(APP_FILES)
+        files["gateways.yaml"] = textwrap.dedent(f"""
+            gateways:
+              - id: "direct"
+                type: service
+                service-options:
+                  service-url: "http://127.0.0.1:{backend_port}"
+        """)
+        app_dir = write_app(tmp_path, files)
+        from langstream_tpu.gateway import GatewayServer
+        from langstream_tpu.runtime.local import run_application
+
+        runner = await run_application(app_dir)
+        gateway = GatewayServer(port=0)
+        gateway.register_local_runner(runner)
+        await gateway.start()
+        try:
+            port = gateway._runner.addresses[0][1]  # noqa: SLF001
+            import aiohttp
+
+            async with aiohttp.ClientSession() as session:
+                async with session.post(
+                    f"http://127.0.0.1:{port}/api/gateways/service/"
+                    f"default/{runner.application.application_id}/direct"
+                    "?option:path=v1/invoke",
+                    json={"value": {"q": 1}},
+                ) as response:
+                    assert response.status == 200
+                    payload = await response.json()
+            assert payload["path"] == "/v1/invoke"
+            assert payload["echo"] == {"value": {"q": 1}}
+        finally:
+            await gateway.stop()
+            await runner.stop()
+            await backend_runner.cleanup()
+
+    asyncio.run(main())
