@@ -14,11 +14,12 @@ Built-ins:
 - ``service: vector``  — the TPU-native vector store
   (``langstream_tpu.agents.vectorstore``), queried with JSON specs.
 
-External engines: OpenSearch/Elasticsearch, Pinecone, and Solr are
-implemented natively over their REST APIs (``external_stores.py``);
-Cassandra/Astra and Milvus (binary protocols needing client libraries
-not in this image) are declared-but-gated — configs validate and fail at
-``start`` with an explicit message rather than at plan time.
+External engines: OpenSearch/Elasticsearch, Pinecone, Solr, and Astra
+(Data API) are implemented natively over their REST APIs
+(``external_stores.py``); Cassandra CQL and Milvus gRPC (binary
+protocols needing client libraries not in this image) are
+declared-but-gated — configs validate and fail at ``start`` with an
+explicit message rather than at plan time.
 """
 
 from __future__ import annotations
@@ -31,7 +32,7 @@ from typing import Any, Dict, List, Optional
 # engines whose client protocol needs a library not in this image
 # (CQL / Milvus gRPC); REST-based engines are implemented natively in
 # ``external_stores.py``
-_GATED_SERVICES = {"astra", "cassandra", "milvus", "jdbc"}
+_GATED_SERVICES = {"cassandra", "milvus", "jdbc"}
 
 
 class DataSource:
@@ -177,6 +178,10 @@ class DataSourceRegistry:
             from langstream_tpu.agents.external_stores import SolrDataSource
 
             source = SolrDataSource(config)
+        elif service in ("astra", "astra-vector"):
+            from langstream_tpu.agents.external_stores import AstraDataSource
+
+            source = AstraDataSource(config)
         elif service in _GATED_SERVICES:
             raise ValueError(
                 f"datasource service {service!r} requires a client library "

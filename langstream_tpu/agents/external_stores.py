@@ -274,3 +274,92 @@ class SolrDataSource(_RestDataSource):
             )
             return {"rowcount": 1}
         raise ValueError(f"unsupported solr action {action!r}")
+
+
+class AstraDataSource(_RestDataSource):
+    """Astra DB via the Data API (JSON over HTTP — reference:
+    ``vector/astra/``; the Java driver's CQL path is replaced by Astra's
+    own document/vector REST surface, no driver needed).
+
+    Config: ``endpoint`` (the database API endpoint), ``token``
+    (``AstraCS:...``), ``keyspace`` (default ``default_keyspace``),
+    ``collection-name``.
+    """
+
+    def __init__(self, config: Dict[str, Any]) -> None:
+        super().__init__()
+        endpoint = config.get("endpoint") or config.get("api-endpoint")
+        if not endpoint:
+            raise ValueError("astra datasource needs 'endpoint'")
+        self.endpoint = endpoint.rstrip("/")
+        self.token = config.get("token", "")
+        self.keyspace = config.get("keyspace", "default_keyspace")
+        self.collection = config.get(
+            "collection-name", config.get("collection", "langstream")
+        )
+
+    def _headers(self) -> Dict[str, str]:
+        return {"Token": self.token}
+
+    async def _command(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        url = (
+            f"{self.endpoint}/api/json/v1/{self.keyspace}/{self.collection}"
+        )
+        return await self._call("POST", url, body)
+
+    async def query(self, query: str, params: List[Any]) -> List[Dict[str, Any]]:
+        spec = _fill(query, params)
+        if "find" in spec:  # raw passthrough
+            payload = await self._command({"find": spec["find"]})
+        else:
+            find: Dict[str, Any] = {
+                "sort": {"$vector": spec["vector"]},
+                "options": {
+                    "limit": int(spec.get("top-k", 10)),
+                    "includeSimilarity": True,
+                },
+            }
+            if spec.get("filter"):
+                find["filter"] = spec["filter"]
+            payload = await self._command({"find": find})
+        out = []
+        for document in (
+            payload.get("data", {}).get("documents", []) or []
+        ):
+            document = dict(document)
+            document.pop("$vector", None)
+            out.append({
+                "id": document.pop("_id", None),
+                "similarity": document.pop("$similarity", 0.0),
+                **document,
+            })
+        return out
+
+    async def execute(self, statement: str, params: List[Any]) -> Dict[str, Any]:
+        spec = _fill(statement, params)
+        action = spec.get("action")
+        if action == "upsert":
+            document = {
+                "_id": str(spec["id"]),
+                "$vector": spec["vector"],
+                **(spec.get("metadata") or {}),
+            }
+            # findOneAndReplace with upsert = true: idempotent writes
+            await self._command({
+                "findOneAndReplace": {
+                    "filter": {"_id": str(spec["id"])},
+                    "replacement": document,
+                    "options": {"upsert": True},
+                }
+            })
+            return {"rowcount": 1}
+        if action == "delete":
+            payload = await self._command({
+                "deleteOne": {"filter": {"_id": str(spec["id"])}}
+            })
+            return {
+                "rowcount": int(
+                    payload.get("status", {}).get("deletedCount", 0)
+                )
+            }
+        raise ValueError(f"unsupported astra action {action!r}")

@@ -196,3 +196,48 @@ def test_solr_through_vector_agents():
             r for r in server.requests if r["path"].endswith("/select")
         )
         assert "{!knn f=embeddings topK=5}" in select["json"]["query"]
+
+
+def test_astra_through_vector_agents():
+    def handler(request):
+        body = request["json"]
+        if "find" in body:
+            return web.json_response({"data": {"documents": [
+                {"_id": "a1", "$similarity": 0.91, "text": "astra doc",
+                 "$vector": [0, 0]},
+            ]}})
+        return web.json_response({"status": {"deletedCount": 1}})
+
+    with _Server(handler) as server:
+        resources = {"astra": {"type": "datasource", "configuration": {
+            "service": "astra",
+            "endpoint": f"http://127.0.0.1:{server.port}",
+            "token": "AstraCS:test",
+            "keyspace": "ks", "collection-name": "docs",
+        }}}
+        out = asyncio.run(_sink_and_query(
+            resources,
+            {"datasource": "astra", "vector.id": "value.id",
+             "vector.vector": "value.vec", "vector.text": "value.text"},
+            {"datasource": "astra",
+             "query": json.dumps({"action": "search", "vector": "?", "top-k": 3}),
+             "fields": ["value.qv"], "output-field": "value.hits"},
+            [Record(value={"id": "a1", "vec": [0.1, 0.2], "text": "astra doc"})],
+        ))
+        assert out.value["hits"][0] == {
+            "id": "a1", "similarity": 0.91, "text": "astra doc",
+        }
+        upsert = next(
+            r for r in server.requests
+            if r["json"] and "findOneAndReplace" in r["json"]
+        )
+        assert upsert["path"] == "/api/json/v1/ks/docs"
+        assert upsert["headers"]["Token"] == "AstraCS:test"
+        replacement = upsert["json"]["findOneAndReplace"]["replacement"]
+        assert replacement["$vector"] == [0.1, 0.2]
+        assert upsert["json"]["findOneAndReplace"]["options"]["upsert"]
+        find = next(
+            r for r in server.requests if r["json"] and "find" in r["json"]
+        )
+        assert find["json"]["find"]["sort"]["$vector"] == [0.1, 0.2]
+        assert find["json"]["find"]["options"]["limit"] == 3
