@@ -544,3 +544,35 @@ def test_warm_followups_batch_into_one_dispatch():
             engine.stop()
 
     asyncio.run(main())
+
+
+def test_pipeline_decode_matches_serial_sampled():
+    """Sampled decoding (temperature/top-k/top-p) must also be identical
+    under pipelined dispatch: chaining changes WHEN chunks dispatch, not
+    the rng key sequence or chunk shapes."""
+
+    async def run_engine(pipeline: bool):
+        config = LlamaConfig.tiny(max_seq_len=128)
+        params = init_params(config)
+        engine = DecodeEngine(
+            config, params, max_slots=2, max_seq_len=128,
+            prefill_buckets=[16], decode_chunk=4, seed=7,
+            pipeline_decode=pipeline,
+        )
+        engine.start()
+        try:
+            results = await asyncio.gather(*[
+                engine.generate(
+                    [1 + i, 2, 3],
+                    SamplingParams(
+                        temperature=0.9, top_k=8, top_p=0.95,
+                        max_new_tokens=13,
+                    ),
+                )
+                for i in range(3)
+            ])
+            return [r.tokens for r in results]
+        finally:
+            engine.stop()
+
+    assert asyncio.run(run_engine(False)) == asyncio.run(run_engine(True))
