@@ -33,6 +33,7 @@ from langstream_tpu.api.topics import (
     TopicReader,
     TopicSpec,
 )
+from langstream_tpu.topics.kafka import avro as avro_codec
 from langstream_tpu.topics.kafka import protocol as proto
 from langstream_tpu.topics.kafka.client import KafkaClient
 
@@ -103,6 +104,26 @@ class KafkaRecordView(Record):
 
     partition: int = -1
     offset: int = -1
+
+
+
+
+async def _maybe_avro(registry, kafka_record, view):
+    """Decode a FOREIGN Confluent-framed Avro value into plain Python
+    (records produced by this framework carry the ls-meta envelope and
+    are never reinterpreted)."""
+    if registry is None:
+        return view
+    if any(name == "ls-meta" for name, _ in kafka_record.headers):
+        return view
+    if not avro_codec.is_confluent_framed(kafka_record.value):
+        return view
+    try:
+        value = await registry.decode_value(kafka_record.value)
+    except Exception:  # noqa: BLE001 — undecodable: keep raw bytes
+        logger.exception("confluent avro decode failed; passing raw bytes")
+        return view
+    return _dataclasses.replace(view, value=value)
 
 
 # ---------------------------------------------------------------------- #
@@ -219,10 +240,12 @@ class KafkaTopicConsumer(TopicConsumer):
         session_timeout_ms: int = 10000,
         heartbeat_interval: float = 3.0,
         auto_offset_reset: int = EARLIEST,
+        registry: Optional[avro_codec.SchemaRegistryClient] = None,
     ) -> None:
         self._client = client
         self._topic = topic
         self._group = group
+        self._registry = registry
         self._session_timeout_ms = session_timeout_ms
         self._heartbeat_interval = heartbeat_interval
         self._auto_offset_reset = auto_offset_reset
@@ -437,6 +460,9 @@ class KafkaTopicConsumer(TopicConsumer):
                         view = _dataclasses.replace(
                             view, partition=partition
                         )
+                        view = await _maybe_avro(
+                            self._registry, kafka_record, view
+                        )
                         out.append(view)
                         self._fetch_pos[partition] = kafka_record.offset + 1
                         self._outstanding.setdefault(partition, set()).add(
@@ -519,11 +545,13 @@ class KafkaTopicConsumer(TopicConsumer):
 # ---------------------------------------------------------------------- #
 class KafkaTopicReader(TopicReader):
     def __init__(
-        self, client: KafkaClient, topic: str, position: OffsetPosition
+        self, client: KafkaClient, topic: str, position: OffsetPosition,
+        registry: Optional[avro_codec.SchemaRegistryClient] = None,
     ) -> None:
         self._client = client
         self._topic = topic
         self._position = position
+        self._registry = registry
         self._offsets: Dict[int, int] = {}
 
     async def start(self) -> None:
@@ -552,7 +580,11 @@ class KafkaTopicReader(TopicReader):
                 if len(out) >= max_records:
                     return out
                 view = decode_record(kafka_record, self._topic)
-                out.append(_dataclasses.replace(view, partition=partition))
+                view = _dataclasses.replace(view, partition=partition)
+                view = await _maybe_avro(
+                    self._registry, kafka_record, view
+                )
+                out.append(view)
                 self._offsets[partition] = kafka_record.offset + 1
         return out
 
@@ -596,6 +628,16 @@ class KafkaTopicConnectionsRuntime(TopicConnectionsRuntime):
             client_id=configuration.get("clientId", "langstream-tpu"),
         )
         self._replication = int(configuration.get("replicationFactor", 1))
+        registry_url = (
+            configuration.get("schemaRegistryUrl")
+            or configuration.get("schema.registry.url")
+        )
+        # foreign Confluent-Avro records decode into plain dict values
+        # (the reference's schema-registry deserializer path)
+        self._registry = (
+            avro_codec.SchemaRegistryClient(registry_url)
+            if registry_url else None
+        )
 
     def create_consumer(
         self, agent_id: str, config: Dict[str, Any]
@@ -612,6 +654,7 @@ class KafkaTopicConnectionsRuntime(TopicConnectionsRuntime):
                 if self.configuration.get("autoOffsetReset") == "latest"
                 else EARLIEST
             ),
+            registry=self._registry,
         )
 
     def create_producer(
@@ -624,10 +667,15 @@ class KafkaTopicConnectionsRuntime(TopicConnectionsRuntime):
         config: Dict[str, Any],
         initial_position: OffsetPosition = OffsetPosition.LATEST,
     ) -> TopicReader:
-        return KafkaTopicReader(self._client, config["topic"], initial_position)
+        return KafkaTopicReader(
+            self._client, config["topic"], initial_position,
+            registry=self._registry,
+        )
 
     def create_admin(self) -> TopicAdmin:
         return KafkaTopicAdmin(self._client, self._replication)
 
     async def close(self) -> None:
+        if self._registry is not None:
+            await self._registry.close()
         await self._client.close()

@@ -1,0 +1,179 @@
+"""Avro binary codec + Confluent framing + schema-registry decode path
+(reference: langstream-agents-commons Avro converters + registry
+serializers)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import struct
+import threading
+
+import pytest
+from aiohttp import web
+
+from langstream_tpu.topics.kafka import avro
+
+USER_SCHEMA = {
+    "type": "record",
+    "name": "User",
+    "fields": [
+        {"name": "name", "type": "string"},
+        {"name": "age", "type": "long"},
+        {"name": "email", "type": ["null", "string"], "default": None},
+        {"name": "tags", "type": {"type": "array", "items": "string"}},
+        {"name": "scores", "type": {"type": "map", "values": "double"}},
+        {"name": "kind", "type": {
+            "type": "enum", "name": "Kind", "symbols": ["A", "B"],
+        }},
+        {"name": "active", "type": "boolean"},
+        {"name": "blob", "type": "bytes"},
+    ],
+}
+
+USER = {
+    "name": "ada",
+    "age": 36,
+    "email": "ada@example.com",
+    "tags": ["x", "y"],
+    "scores": {"m": 1.5},
+    "kind": "B",
+    "active": True,
+    "blob": b"\x01\x02",
+}
+
+
+def test_roundtrip_all_types():
+    payload = avro.encode(avro.parse_schema(USER_SCHEMA), USER)
+    assert avro.decode_bytes(USER_SCHEMA, payload) == USER
+
+
+def test_golden_vector_hand_encoded():
+    """Spec-derived byte check: record {s: string, n: long} with
+    ("hi", -2) encodes as len-zigzag(2)=0x04, 'h','i', zigzag(-2)=0x03."""
+    schema = {
+        "type": "record", "name": "T",
+        "fields": [
+            {"name": "s", "type": "string"},
+            {"name": "n", "type": "long"},
+        ],
+    }
+    payload = avro.encode(schema, {"s": "hi", "n": -2})
+    assert payload == b"\x04hi\x03"
+    assert avro.decode_bytes(schema, payload) == {"s": "hi", "n": -2}
+
+
+def test_union_null_branch_and_confluent_frame():
+    payload = avro.encode(
+        avro.parse_schema(USER_SCHEMA), {**USER, "email": None}
+    )
+    assert avro.decode_bytes(USER_SCHEMA, payload)["email"] is None
+
+    framed = avro.encode_confluent(7, USER_SCHEMA, USER)
+    assert framed[0] == 0
+    assert struct.unpack(">I", framed[1:5])[0] == 7
+    assert avro.is_confluent_framed(framed)
+    assert not avro.is_confluent_framed(b"plain text")
+    schema_id, body = avro.split_confluent(framed)
+    assert schema_id == 7
+    assert avro.decode_bytes(USER_SCHEMA, body) == USER
+
+
+class _Registry:
+    def __init__(self, schemas):
+        self.schemas = schemas
+        self.requests = 0
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(
+            target=self._loop.run_forever, daemon=True
+        )
+        self._thread.start()
+        self._runner = None
+        self.port = None
+
+    def __enter__(self):
+        async def go():
+            app = web.Application()
+
+            async def get_schema(request):
+                self.requests += 1
+                schema_id = int(request.match_info["id"])
+                if schema_id not in self.schemas:
+                    return web.json_response({}, status=404)
+                return web.json_response(
+                    {"schema": json.dumps(self.schemas[schema_id])}
+                )
+
+            app.router.add_get("/schemas/ids/{id}", get_schema)
+            self._runner = web.AppRunner(app, access_log=None)
+            await self._runner.setup()
+            site = web.TCPSite(self._runner, "127.0.0.1", 0)
+            await site.start()
+            return site._server.sockets[0].getsockname()[1]  # noqa: SLF001
+
+        self.port = asyncio.run_coroutine_threadsafe(
+            go(), self._loop
+        ).result(10)
+        return self
+
+    def __exit__(self, *exc):
+        asyncio.run_coroutine_threadsafe(
+            self._runner.cleanup(), self._loop
+        ).result(10)
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=10)
+
+
+def test_consumer_decodes_foreign_confluent_records():
+    """A record produced by a FOREIGN Confluent-Avro producer (no
+    ls-meta envelope) decodes into a dict value; framework records are
+    untouched."""
+    from langstream_tpu.api.records import Record
+    from langstream_tpu.api.topics import OffsetPosition, TopicSpec
+    from langstream_tpu.topics.kafka import protocol as proto
+    from langstream_tpu.topics.kafka.runtime import (
+        KafkaTopicConnectionsRuntime,
+    )
+    from langstream_tpu.topics.kafka.server import serve_kafka_facade
+
+    schema = {
+        "type": "record", "name": "Evt",
+        "fields": [{"name": "q", "type": "string"}],
+    }
+
+    async def main(registry_port):
+        facade = await serve_kafka_facade()
+        runtime = KafkaTopicConnectionsRuntime({
+            "bootstrapServers": facade.bootstrap,
+            "schemaRegistryUrl": f"http://127.0.0.1:{registry_port}",
+        })
+        try:
+            admin = runtime.create_admin()
+            await admin.create_topic(TopicSpec(name="t"))
+            # foreign producer: raw confluent-framed value, no envelope
+            framed = avro.encode_confluent(42, schema, {"q": "hello"})
+            batch = proto.encode_record_batch([(None, framed, [], 1000)])
+            await runtime._client.produce("t", 0, batch)  # noqa: SLF001
+            # framework producer: envelope, must pass through unchanged
+            producer = runtime.create_producer("p", {"topic": "t"})
+            await producer.write(Record(value={"native": True}))
+
+            consumer = runtime.create_consumer(
+                "a", {"topic": "t", "group": "g"}
+            )
+            await consumer.start()
+            got = []
+            for _ in range(100):
+                got.extend(await consumer.read(timeout=0.2))
+                if len(got) >= 2:
+                    break
+            assert got[0].value == {"q": "hello"}   # avro-decoded
+            assert got[1].value == {"native": True}  # envelope path
+            await consumer.close()
+        finally:
+            await runtime.close()
+            await facade.close()
+
+    with _Registry({42: schema}) as registry:
+        asyncio.run(main(registry.port))
+        assert registry.requests == 1  # schema cached after first fetch
