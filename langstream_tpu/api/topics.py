@@ -39,6 +39,9 @@ class TopicSpec:
     options: Dict[str, Any] = dataclasses.field(default_factory=dict)
     config: Dict[str, Any] = dataclasses.field(default_factory=dict)
     implicit: bool = False
+    # declared value schema ({type: avro, schema: "<json>"}) — flows to
+    # schema-aware producers (Kafka + registry → Confluent framing)
+    schema: Optional[Dict[str, Any]] = None
 
 
 class TopicProducer(abc.ABC):

@@ -165,6 +165,7 @@ def _topic_spec(topic: TopicDefinition) -> TopicSpec:
         options=topic.options,
         config=topic.config,
         implicit=topic.implicit,
+        schema=topic.schema,
     )
 
 

@@ -155,8 +155,14 @@ class LocalApplicationRunner:
         if node.sink is not None:
             sink = await self._build_agent(node.sink, context)
         elif node.output_topic is not None:
+            producer_config: Dict[str, Any] = {"topic": node.output_topic}
+            topic_spec = self.plan.topics.get(node.output_topic)
+            if topic_spec is not None and topic_spec.schema:
+                # declared topic schema flows to the producer (avro
+                # interop on schema-aware runtimes)
+                producer_config["schema"] = topic_spec.schema
             producer = self.topic_runtime.create_producer(
-                node.id, {"topic": node.output_topic}
+                node.id, producer_config
             )
             sink = TopicProducerSink(producer)
         else:

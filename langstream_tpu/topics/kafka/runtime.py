@@ -139,11 +139,21 @@ class KafkaTopicProducer(TopicProducer):
     def __init__(
         self, client: KafkaClient, topic: str,
         *, linger: float = 0.002, batch_max: int = 256,
+        value_schema: Optional[Any] = None,
+        registry: Optional[avro_codec.SchemaRegistryClient] = None,
+        subject: Optional[str] = None,
     ) -> None:
         self._client = client
         self._topic = topic
         self._linger = linger
         self._batch_max = batch_max
+        # declared avro topic schema + registry → publish Confluent-
+        # framed values foreign consumers understand (no ls-meta
+        # envelope); lazily registered under <topic>-value
+        self._value_schema = value_schema
+        self._registry = registry
+        self._subject = subject or f"{topic}-value"
+        self._schema_id: Optional[int] = None
         self._written = 0
         self._round_robin = 0
         # partition -> [((key, value, headers, ts), future)]
@@ -163,7 +173,24 @@ class KafkaTopicProducer(TopicProducer):
             raise proto.KafkaProtocolError(
                 proto.UNKNOWN_TOPIC_OR_PARTITION, self._topic
             )
-        key, value, headers = encode_record(record)
+        if self._value_schema is not None and self._registry is not None:
+            if self._schema_id is None:
+                self._schema_id = await self._registry.register(
+                    self._subject, self._value_schema
+                )
+            value = avro_codec.encode_confluent(
+                self._schema_id, self._value_schema, record.value
+            )
+            key = (
+                str(record.key).encode("utf-8")
+                if record.key is not None else None
+            )
+            headers = []
+            for name, hvalue in record.headers:
+                data, _kind = _encode_payload(hvalue)
+                headers.append((name, data))
+        else:
+            key, value, headers = encode_record(record)
         if record.key is not None:
             # stable key → partition affinity (session/KV locality rides
             # partitioning, like the reference's keyed producer). crc32 is
@@ -660,7 +687,18 @@ class KafkaTopicConnectionsRuntime(TopicConnectionsRuntime):
     def create_producer(
         self, agent_id: str, config: Dict[str, Any]
     ) -> TopicProducer:
-        return KafkaTopicProducer(self._client, config["topic"])
+        value_schema = None
+        schema_config = config.get("schema") or {}
+        if (
+            self._registry is not None
+            and str(schema_config.get("type", "")).lower() == "avro"
+            and schema_config.get("schema")
+        ):
+            value_schema = avro_codec.parse_schema(schema_config["schema"])
+        return KafkaTopicProducer(
+            self._client, config["topic"],
+            value_schema=value_schema, registry=self._registry,
+        )
 
     def create_reader(
         self,

@@ -177,3 +177,135 @@ def test_consumer_decodes_foreign_confluent_records():
     with _Registry({42: schema}) as registry:
         asyncio.run(main(registry.port))
         assert registry.requests == 1  # schema cached after first fetch
+
+
+def test_pipeline_publishes_confluent_avro_for_declared_schema(tmp_path):
+    """A YAML app whose output topic declares an avro schema publishes
+    Confluent-framed records a foreign consumer can read (write-side
+    interop: registry registration + framing, no ls-meta envelope)."""
+    import textwrap
+
+    from langstream_tpu.api.records import Record
+    from langstream_tpu.runtime.local import run_application
+    from langstream_tpu.topics.kafka.server import serve_kafka_facade
+
+    schema_json = json.dumps({
+        "type": "record", "name": "Out",
+        "fields": [{"name": "text", "type": "string"}],
+    })
+
+    async def main(registry_port):
+        facade = await serve_kafka_facade()
+        app_dir = tmp_path / "app"
+        (app_dir / "python").mkdir(parents=True)
+        (app_dir / "pipeline.yaml").write_text(textwrap.dedent(f"""
+            topics:
+              - name: "in"
+                creation-mode: create-if-not-exists
+              - name: "out"
+                creation-mode: create-if-not-exists
+                schema:
+                  type: avro
+                  schema: '{schema_json}'
+            pipeline:
+              - id: "wrap"
+                type: "python-processor"
+                input: "in"
+                output: "out"
+                configuration:
+                  className: "wrap_agent.Wrap"
+        """))
+        (app_dir / "python" / "wrap_agent.py").write_text(textwrap.dedent("""
+            class Wrap:
+                def process(self, record):
+                    return [{"text": record.value.upper()}]
+        """))
+        (tmp_path / "instance.yaml").write_text(textwrap.dedent(f"""
+            instance:
+              streamingCluster:
+                type: kafka
+                configuration:
+                  bootstrapServers: "{facade.bootstrap}"
+                  schemaRegistryUrl: "http://127.0.0.1:{registry_port}"
+        """))
+        runner = await run_application(
+            str(app_dir), instance_file=str(tmp_path / "instance.yaml")
+        )
+        try:
+            producer = runner.producer("in")
+            await producer.start()
+            await producer.write(Record(value="ping"))
+            # read the RAW bytes off the broker like a foreign consumer
+            from langstream_tpu.topics.kafka import protocol as proto
+
+            raw = []
+            for _ in range(150):
+                records, _hw = await runner.topic_runtime._client.fetch(  # noqa: SLF001
+                    "out", 0, 0, max_wait_ms=200
+                )
+                raw = records
+                if raw:
+                    break
+            assert raw, "nothing produced"
+            framed = raw[0].value
+            assert avro.is_confluent_framed(framed)
+            schema_id, body = avro.split_confluent(framed)
+            decoded = avro.decode_bytes(json.loads(schema_json), body)
+            assert decoded == {"text": "PING"}
+            assert not any(n == "ls-meta" for n, _ in raw[0].headers)
+        finally:
+            await runner.stop()
+            await facade.close()
+
+    # simple registry mock with register support
+    registered = {}
+
+    class _Reg:
+        def __init__(self):
+            self._loop = asyncio.new_event_loop()
+            self._thread = threading.Thread(
+                target=self._loop.run_forever, daemon=True
+            )
+            self._thread.start()
+            self._runner = None
+            self.port = None
+
+        def __enter__(self):
+            async def go():
+                app = web.Application()
+
+                async def register(request):
+                    body = await request.json()
+                    registered[request.match_info["subject"]] = body["schema"]
+                    return web.json_response({"id": 99})
+
+                async def get_schema(request):
+                    return web.json_response(
+                        {"schema": list(registered.values())[0]}
+                    )
+
+                app.router.add_post(
+                    "/subjects/{subject}/versions", register
+                )
+                app.router.add_get("/schemas/ids/{id}", get_schema)
+                self._runner = web.AppRunner(app, access_log=None)
+                await self._runner.setup()
+                site = web.TCPSite(self._runner, "127.0.0.1", 0)
+                await site.start()
+                return site._server.sockets[0].getsockname()[1]  # noqa: SLF001
+
+            self.port = asyncio.run_coroutine_threadsafe(
+                go(), self._loop
+            ).result(10)
+            return self
+
+        def __exit__(self, *exc):
+            asyncio.run_coroutine_threadsafe(
+                self._runner.cleanup(), self._loop
+            ).result(10)
+            self._loop.call_soon_threadsafe(self._loop.stop)
+            self._thread.join(timeout=10)
+
+    with _Reg() as registry:
+        asyncio.run(main(registry.port))
+        assert "out-value" in registered  # subject registered
