@@ -300,6 +300,13 @@ class KafkaTopicConsumer(TopicConsumer):
         self._fetch_cursor = 0
         self._delivered = 0
         self._started = False
+        # commit coalescing: watermark advances collect here and flush
+        # to the coordinator at most every _commit_interval (plus on
+        # close and before any rejoin) — the runner acks once per source
+        # record, which would otherwise be one OffsetCommit RPC each
+        self._commit_dirty: Dict[Tuple[str, int], int] = {}
+        self._last_commit_flush = 0.0
+        self._commit_interval = 0.1
 
     # -- membership ----------------------------------------------------- #
     async def start(self) -> None:
@@ -319,6 +326,13 @@ class KafkaTopicConsumer(TopicConsumer):
         self._coord_conn = self._client.dedicated_connection(self._coordinator)
 
     async def _join(self) -> None:
+        # push pending watermark advances under the OLD generation first:
+        # after the rebalance they would be rejected (ILLEGAL_GENERATION)
+        # and the work they represent re-delivered unnecessarily
+        try:
+            await self._flush_commits_locked(force=True)
+        except Exception:  # noqa: BLE001 — redelivery-safe to drop
+            self._commit_dirty.clear()
         await self._reconnect_coordinator()
         for attempt in range(10):
             try:
@@ -506,10 +520,28 @@ class KafkaTopicConsumer(TopicConsumer):
 
     async def commit(self, records: List[Record]) -> None:
         """Out-of-order acks allowed; durable offset = contiguous prefix
-        (KafkaConsumerWrapper.java:52-230 semantics)."""
-        to_commit: Dict[Tuple[str, int], int] = {}
+        (KafkaConsumerWrapper.java:52-230 semantics). The RPC itself is
+        coalesced onto a short timer."""
         async with self._membership_lock:
-            await self._commit_locked(records, to_commit)
+            await self._commit_locked(records, self._commit_dirty)
+            await self._flush_commits_locked()
+
+    async def _flush_commits_locked(self, force: bool = False) -> None:
+        import time as _time
+
+        if not self._commit_dirty:
+            return
+        now = _time.monotonic()
+        if not force and now - self._last_commit_flush < self._commit_interval:
+            return
+        if self._generation < 0:
+            return
+        dirty, self._commit_dirty = self._commit_dirty, {}
+        self._last_commit_flush = now
+        await self._client.offset_commit(
+            self._coordinator, self._group, self._generation,
+            self._member_id, dirty, conn=self._coord_conn,
+        )
 
     async def _commit_locked(self, records, to_commit) -> None:
         for record in records:
@@ -536,16 +568,17 @@ class KafkaTopicConsumer(TopicConsumer):
             if watermark > self._committed.get(record.partition, -1):
                 self._committed[record.partition] = watermark
                 to_commit[(self._topic, record.partition)] = watermark
-        if to_commit and self._generation >= 0:
-            await self._client.offset_commit(
-                self._coordinator, self._group, self._generation,
-                self._member_id, to_commit, conn=self._coord_conn,
-            )
+        # RPC handled by _flush_commits_locked (coalesced)
 
     def committed_offsets(self) -> Dict[int, int]:
         return dict(self._committed)
 
     async def close(self) -> None:
+        async with self._membership_lock:
+            try:
+                await self._flush_commits_locked(force=True)
+            except Exception:  # noqa: BLE001 — at-least-once: safe
+                pass
         if self._heartbeat_task is not None:
             self._heartbeat_task.cancel()
             try:

@@ -104,3 +104,45 @@ def test_gateway_server_command_boots(tmp_path):
             await _stop(process)
 
     asyncio.run(main())
+
+
+def test_gateway_app_watcher_sync():
+    """gateway-server discovers apps from Application CRs and registers
+    them with topic runtimes; removed CRs unregister and close."""
+    import dataclasses as dc
+
+    from langstream_tpu.cli.services import GatewayAppWatcher
+    from langstream_tpu.deployer.crds import ApplicationCustomResource
+    from langstream_tpu.deployer.kube import MockKubeApi
+    from langstream_tpu.gateway import GatewayServer
+
+    async def main():
+        kube = MockKubeApi()
+        gateway = GatewayServer(port=0)
+        watcher = GatewayAppWatcher(gateway, kube)
+
+        definition = {
+            "application_id": "w1", "tenant": "t",
+            "modules": {}, "gateways": [
+                {"id": "g", "type": "produce", "topic": "in"},
+            ],
+        }
+        kube.apply(ApplicationCustomResource(
+            name="w1", namespace="t", application=definition,
+            instance={"streaming_cluster": {"type": "memory"}},
+        ).to_manifest())
+
+        await watcher.sync()
+        assert ("t", "w1") in gateway._apps  # noqa: SLF001
+        registered = gateway._apps[("t", "w1")]  # noqa: SLF001
+        assert registered.application.gateways[0].id == "g"
+
+        # idempotent re-sync
+        await watcher.sync()
+        assert len(watcher._registered) == 1  # noqa: SLF001
+
+        kube.delete("Application", "t", "w1")
+        await watcher.sync()
+        assert ("t", "w1") not in gateway._apps  # noqa: SLF001
+
+    asyncio.run(main())
