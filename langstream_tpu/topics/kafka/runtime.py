@@ -154,6 +154,9 @@ class KafkaTopicProducer(TopicProducer):
         self._registry = registry
         self._subject = subject or f"{topic}-value"
         self._schema_id: Optional[int] = None
+        # plain schema types publish WITHOUT the envelope: string/json/
+        # bytes values any foreign consumer reads directly
+        self._plain_type: Optional[str] = None
         self._written = 0
         self._round_robin = 0
         # partition -> [((key, value, headers, ts), future)]
@@ -173,7 +176,30 @@ class KafkaTopicProducer(TopicProducer):
             raise proto.KafkaProtocolError(
                 proto.UNKNOWN_TOPIC_OR_PARTITION, self._topic
             )
-        if self._value_schema is not None and self._registry is not None:
+        if self._plain_type is not None:
+            if self._plain_type == "string":
+                value = (
+                    record.value.encode("utf-8")
+                    if isinstance(record.value, str)
+                    else json.dumps(record.value).encode("utf-8")
+                )
+            elif self._plain_type == "json":
+                value = json.dumps(record.value).encode("utf-8")
+            else:  # bytes
+                value = (
+                    record.value
+                    if isinstance(record.value, (bytes, bytearray))
+                    else str(record.value).encode("utf-8")
+                )
+            key = (
+                str(record.key).encode("utf-8")
+                if record.key is not None else None
+            )
+            headers = []
+            for name, hvalue in record.headers:
+                data, _kind = _encode_payload(hvalue)
+                headers.append((name, data))
+        elif self._value_schema is not None and self._registry is not None:
             if self._schema_id is None:
                 self._schema_id = await self._registry.register(
                     self._subject, self._value_schema
@@ -722,16 +748,20 @@ class KafkaTopicConnectionsRuntime(TopicConnectionsRuntime):
     ) -> TopicProducer:
         value_schema = None
         schema_config = config.get("schema") or {}
+        schema_type = str(schema_config.get("type", "")).lower()
         if (
             self._registry is not None
-            and str(schema_config.get("type", "")).lower() == "avro"
+            and schema_type == "avro"
             and schema_config.get("schema")
         ):
             value_schema = avro_codec.parse_schema(schema_config["schema"])
-        return KafkaTopicProducer(
+        producer = KafkaTopicProducer(
             self._client, config["topic"],
             value_schema=value_schema, registry=self._registry,
         )
+        if schema_type in ("string", "json", "bytes"):
+            producer._plain_type = schema_type  # noqa: SLF001
+        return producer
 
     def create_reader(
         self,

@@ -309,3 +309,38 @@ def test_pipeline_publishes_confluent_avro_for_declared_schema(tmp_path):
     with _Reg() as registry:
         asyncio.run(main(registry.port))
         assert "out-value" in registered  # subject registered
+
+
+def test_plain_string_schema_publishes_raw_utf8():
+    """schema type 'string' publishes envelope-free UTF-8 any foreign
+    consumer reads directly."""
+    from langstream_tpu.api.records import Record
+    from langstream_tpu.api.topics import TopicSpec
+    from langstream_tpu.topics.kafka.runtime import (
+        KafkaTopicConnectionsRuntime,
+    )
+    from langstream_tpu.topics.kafka.server import serve_kafka_facade
+
+    async def main():
+        facade = await serve_kafka_facade()
+        runtime = KafkaTopicConnectionsRuntime(
+            {"bootstrapServers": facade.bootstrap}
+        )
+        try:
+            admin = runtime.create_admin()
+            await admin.create_topic(TopicSpec(name="t"))
+            producer = runtime.create_producer(
+                "p", {"topic": "t", "schema": {"type": "string"}}
+            )
+            await producer.write(Record(value="plain text", key="k1"))
+            records, _hw = await runtime._client.fetch(  # noqa: SLF001
+                "t", 0, 0, max_wait_ms=500
+            )
+            assert records[0].value == b"plain text"
+            assert records[0].key == b"k1"
+            assert not any(n == "ls-meta" for n, _ in records[0].headers)
+        finally:
+            await runtime.close()
+            await facade.close()
+
+    asyncio.run(main())
