@@ -523,23 +523,50 @@ class DecodeEngine:
                 victim = i
         return victim
 
-    def _session_warm(self, index: int, request: GenerationRequest) -> bool:
+    # a PARTIAL prefix match must cover at least this many tokens to be
+    # worth a warm admission (below it, warm ≈ cold anyway); full
+    # extensions of the pinned history always qualify
+    WARM_MIN_PREFIX = 16
+
+    def _session_warm(self, index: int, request: GenerationRequest):
+        """Return the reusable prefix length for a warm admission, or
+        None for cold.
+
+        Longest-common-prefix reuse (the block-prefix-cache idea): chat
+        templates re-render earlier turns with role markers the raw
+        generated tokens don't carry, so a follow-up prompt usually
+        EXTENDS only part of the pinned history before diverging. The
+        shared prefix stays in the KV cache; prefill resumes from the
+        divergence point and overwrites the stale rows beyond it."""
         slot = self.slots[index]
         prompt = request.prompt_tokens
         if not (
             request.session_id is not None
             and slot.session_id == request.session_id
-            and slot.history is not None
-            and len(slot.history) < len(prompt)
-            and prompt[: len(slot.history)] == slot.history
+            and slot.history
         ):
-            return False
-        # the suffix's bucket window must fit past the cached prefix —
+            return None
+        limit = min(len(slot.history), len(prompt))
+        lcp = 0
+        while lcp < limit and prompt[lcp] == slot.history[lcp]:
+            lcp += 1
+        if lcp == len(prompt):
+            # the prompt is entirely inside the cache: re-prefill the
+            # last token so fresh logits exist for the first sample
+            lcp = len(prompt) - 1
+        if lcp <= 0:
+            return None
+        full_extension = lcp == len(slot.history)
+        if not full_extension and lcp < self.WARM_MIN_PREFIX:
+            return None
+        # the suffix's bucket window must fit past the reused prefix —
         # prefill_at_offset writes a full bucket-sized window at the
         # offset, and a clamped write would clobber live prefix rows
-        suffix = len(prompt) - len(slot.history)
+        suffix = len(prompt) - lcp
         bucket = _bucket(suffix, self.prefill_buckets)
-        return len(slot.history) + bucket <= self.max_seq_len
+        if lcp + bucket > self.max_seq_len:
+            return None
+        return lcp
 
     def _admit(self) -> None:
         """Move pending requests into slots. Cold requests sharing a prompt
@@ -558,9 +585,9 @@ class DecodeEngine:
                 index = self._find_slot(request)
                 if index is None:
                     break
-                if self._session_warm(index, request):
+                reused = self._session_warm(index, request)
+                if reused is not None:
                     slot = self.slots[index]
-                    reused = len(slot.history)
                     suffix_bucket = _bucket(
                         len(request.prompt_tokens) - reused,
                         self.prefill_buckets,

@@ -576,3 +576,45 @@ def test_pipeline_decode_matches_serial_sampled():
             engine.stop()
 
     assert asyncio.run(run_engine(False)) == asyncio.run(run_engine(True))
+
+
+def test_partial_prefix_session_reuse_matches_cold():
+    """A session follow-up that DIVERGES mid-prompt (chat-template role
+    markers) reuses the common prefix and must produce exactly the
+    tokens a cold run of the same prompt produces."""
+
+    async def main():
+        config = LlamaConfig.tiny(max_seq_len=128)
+        params = init_params(config)
+        engine = DecodeEngine(
+            config, params, max_slots=2, max_seq_len=128,
+            prefill_buckets=[16, 32, 64],
+        )
+        engine.start()
+        try:
+            sampling = SamplingParams(max_new_tokens=5)
+            shared = list(range(1, 25))          # 24-token shared prefix
+            first = await engine.generate(
+                shared + [30, 31], sampling, session_id="s"
+            )
+            # follow-up: same 24-token prefix, then different tokens
+            divergent = shared + [40, 41, 42]
+            hits = engine.stats["session_hits"]
+            warm = await engine.generate(
+                divergent, sampling, session_id="s"
+            )
+            assert engine.stats["session_hits"] == hits + 1  # partial warm
+
+            cold_engine = DecodeEngine(
+                config, params, max_slots=2, max_seq_len=128,
+                prefill_buckets=[16, 32, 64],
+            )
+            cold_engine.start()
+            cold = await cold_engine.generate(divergent, sampling)
+            cold_engine.stop()
+            assert warm.tokens == cold.tokens
+            assert first.tokens  # sanity
+        finally:
+            engine.stop()
+
+    asyncio.run(main())

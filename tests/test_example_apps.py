@@ -96,3 +96,58 @@ def test_dp_embeddings_example(tmp_path):
             await runner.stop()
 
     asyncio.run(main())
+
+
+@pytest.mark.slow
+def test_chatbot_memory_session_kv_reuse():
+    """Two turns of a conversation through the chatbot-memory app: the
+    second prompt extends the first (history accumulation), so the
+    engine serves it from the pinned session KV cache — warm prefill,
+    zero cold prefills (BASELINE config #5 end-to-end)."""
+
+    async def main():
+        runner = await run_application(
+            os.path.join(EXAMPLES, "applications", "chatbot-memory"),
+            instance_file=os.path.join(
+                EXAMPLES, "instances", "local-tiny.yaml"
+            ),
+        )
+        try:
+            engine = (
+                runner._service_provider_registry.completions().engine  # noqa: SLF001
+            )
+            producer = runner.producer("questions")
+            await producer.start()
+            reader = runner.reader("answers")
+            await reader.start()
+
+            async def turn(question):
+                await producer.write(Record(
+                    value=question,
+                    headers=(("langstream-client-session-id", "conv-1"),),
+                ))
+                deadline = asyncio.get_event_loop().time() + 60
+                while True:
+                    if asyncio.get_event_loop().time() > deadline:
+                        raise TimeoutError(question)
+                    for record in await reader.read(timeout=0.2):
+                        if record.value.get("answer") is not None:
+                            return record.value
+                    await asyncio.sleep(0.05)
+
+            first = await turn("hello there.")
+            assert first["history"] == ""
+            cold_after_first = engine.stats["prefill_calls"]
+            assert engine.stats["session_hits"] == 0
+
+            second = await turn("and another thing.")
+            # memory made the second prompt extend the first transcript
+            assert second["history"].startswith("hello there.")
+            # served from the pinned session: warm, no new cold prefill
+            assert engine.stats["session_hits"] == 1
+            assert engine.stats["prefill_calls"] == cold_after_first
+            assert engine.stats["warm_prefill_calls"] >= 1
+        finally:
+            await runner.stop()
+
+    asyncio.run(main())
