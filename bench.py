@@ -237,7 +237,10 @@ async def run_bench():
         f"p50 {p50:.2f} / p95 {p95:.2f} ms/step per chunk)\n"
         f"  occupancy: {occupancy * 100:.1f}% of {MAX_SLOTS} slots\n"
         f"  prefill: {stats['prefill_calls']} calls, "
-        f"{stats['prefill_time']:.2f}s\n"
+        f"{stats['prefill_time']:.2f}s "
+        f"(+{stats['sample_time']:.2f}s first-token sampling)\n"
+        f"  engine thread: idle {stats['idle_time']:.2f}s, "
+        f"host emit {stats['emit_time']:.2f}s\n"
         f"  unaccounted (host/admission): "
         f"{elapsed - stats['decode_time'] - stats['prefill_time']:.2f}s"
     )
@@ -381,7 +384,10 @@ async def _drive_e2e(runner, gateway, port, engine):
         f"({decode_time / steps * 1e3:.2f} ms/step, "
         f"{occupancy * 100:.1f}% of {MAX_SLOTS} slots)\n"
         f"  prefill: {stats['prefill_calls']} cold + "
-        f"{stats['warm_prefill_calls']} warm, {stats['prefill_time']:.2f}s\n"
+        f"{stats['warm_prefill_calls']} warm, {stats['prefill_time']:.2f}s "
+        f"(+{stats['sample_time']:.2f}s first-token sampling)\n"
+        f"  engine thread: idle {stats['idle_time']:.2f}s, "
+        f"host emit {stats['emit_time']:.2f}s\n"
         f"  p50 RTT {p50_rtt * 1e3:.0f} ms over {len(rtts)} requests "
         f"({CLIENTS} clients x {ROUNDS} rounds)"
     )
