@@ -185,6 +185,8 @@ for doc in [
         _P("fields", "list", "expressions bound to the placeholders"),
         _P("output-field", "string", "where results land", required=True),
         _P("only-first", "boolean", "unwrap single row", default=False),
+        _P("mode", "string", "query returns rows, execute mutates",
+           default="query", choices=("query", "execute")),
         _WHEN,
     )),
     AgentDoc("ai-chat-completions", "Chat completion via the configured model service", (
