@@ -157,10 +157,17 @@ class _Slot:
     last_used: float = 0.0               # monotonic; drives LRU eviction
     epoch: int = 0                       # bumps on assign/finish; guards
                                          # pipelined results for recycled slots
+    prefilling: bool = False             # prefill dispatched, first token
+                                         # not yet harvested
 
     @property
     def active(self) -> bool:
         return self.request is not None
+
+    @property
+    def ready(self) -> bool:
+        """Participating in decode chunks (prefill result harvested)."""
+        return self.request is not None and not self.prefilling
 
 
 def _bucket(length: int, buckets: List[int]) -> int:
@@ -258,6 +265,9 @@ class DecodeEngine:
         self._compiled_prefill: Dict[int, Any] = {}
         self._prefill_offset_fns: Dict[int, Any] = {}
         self._decode_fns: Dict[int, Any] = {}
+        # prefill dispatches whose first tokens are not yet harvested
+        # (FIFO — the device executes dispatches in order)
+        self._prefill_inflight: List[Dict[str, Any]] = []
         self.stats = self._fresh_stats()
         # per-chunk dispatch log: (steps, active_slots, wall_seconds) —
         # the occupancy/step-time evidence the bench prints (bounded)
@@ -281,7 +291,6 @@ class DecodeEngine:
             # so "unaccounted" time has a name (VERDICT r2 weak #1)
             "idle_time": 0.0,        # engine thread blocked on empty queue
             "emit_time": 0.0,        # host token bookkeeping + callbacks
-            "sample_time": 0.0,      # first-token sampling after prefill
         }
 
     def reset_stats(self) -> None:
@@ -302,6 +311,10 @@ class DecodeEngine:
     # jitted device functions
     # ------------------------------------------------------------------ #
     def _get_prefill(self, bucket: int):
+        """Prefill + first-token sampling in ONE jit: the engine never
+        blocks on prefill — sampling on-device means harvesting is a pure
+        D2H read of [B] tokens once the dispatch completes, so decode
+        chunks for already-running slots keep flowing underneath."""
         fn = self._compiled_prefill.get(bucket)
         if fn is None:
             config, freqs = self.config, self.freqs
@@ -310,11 +323,16 @@ class DecodeEngine:
             )
 
             @functools.partial(jax.jit, donate_argnums=(1,))
-            def run(params, cache, tokens, lengths, slot_ids):
-                return model_lib.prefill(
+            def run(params, cache, tokens, lengths, slot_ids,
+                    temperature, top_k, top_p, key):
+                cache, logits = model_lib.prefill(
                     config, params, cache, tokens, lengths, slot_ids, freqs,
                     mesh=mesh,
                 )
+                sampled, lp = _sample_with_logprob(
+                    logits, temperature, top_k, key, top_p
+                )
+                return cache, sampled, lp
 
             fn = run
             self._compiled_prefill[bucket] = fn
@@ -326,11 +344,16 @@ class DecodeEngine:
             config, freqs = self.config, self.freqs
 
             @functools.partial(jax.jit, donate_argnums=(1,))
-            def run(params, cache, tokens, lengths, offsets, slot_ids):
-                return model_lib.prefill_at_offset(
+            def run(params, cache, tokens, lengths, offsets, slot_ids,
+                    temperature, top_k, top_p, key):
+                cache, logits = model_lib.prefill_at_offset(
                     config, params, cache, tokens, lengths, offsets,
                     slot_ids, freqs,
                 )
+                sampled, lp = _sample_with_logprob(
+                    logits, temperature, top_k, key, top_p
+                )
+                return cache, sampled, lp
 
             fn = run
             self._prefill_offset_fns[bucket] = fn
@@ -374,6 +397,51 @@ class DecodeEngine:
             self._decode_fns[steps] = fn
         return fn
 
+    def precompile(self) -> None:
+        """Compile-and-execute every (bucket, pow2-group-size) prefill
+        variant and the decode chunks BEFORE serving traffic. Group sizes
+        are timing-dependent (admission batching), so relying on warmup
+        traffic to cover them is racy — a variant first seen under load
+        stalls every active request for the whole compile. Dummy rows
+        target slot 0, so this must run before real requests occupy the
+        cache (call right after construction; ``start()`` is fine too
+        since the engine thread is idle until the first submit)."""
+        sizes = []
+        size = 1
+        while size <= self.max_slots:
+            sizes.append(size)
+            size *= 2
+        zero = lambda n, dtype: jnp.zeros((n,), dtype)  # noqa: E731
+        with self.mesh:
+            for bucket in self.prefill_buckets:
+                for size in sizes:
+                    sampling = (
+                        zero(size, jnp.float32), zero(size, jnp.int32),
+                        zero(size, jnp.float32), self._rng,
+                    )
+                    tokens = jnp.zeros((size, bucket), jnp.int32)
+                    ones = jnp.ones((size,), jnp.int32)
+                    self.cache, _, _ = self._get_prefill(bucket)(
+                        self.params, self.cache, tokens,
+                        ones, zero(size, jnp.int32), *sampling,
+                    )
+                    self.cache, _, _ = self._get_prefill_offset(bucket)(
+                        self.params, self.cache, tokens,
+                        ones, zero(size, jnp.int32), zero(size, jnp.int32),
+                        *sampling,
+                    )
+            slots = self.max_slots
+            inactive = jnp.zeros((slots,), bool)  # no cache writes
+            for steps in {self.decode_chunk, 1}:
+                self.cache, _, _, _, _ = self._get_decode(steps)(
+                    self.params, self.cache,
+                    zero(slots, jnp.int32), jnp.ones((slots,), jnp.int32),
+                    inactive, inactive,
+                    zero(slots, jnp.float32), zero(slots, jnp.int32),
+                    zero(slots, jnp.float32), self._rng,
+                )
+            jax.block_until_ready(self.cache)
+
     # ------------------------------------------------------------------ #
     # public API (thread-safe)
     # ------------------------------------------------------------------ #
@@ -398,12 +466,13 @@ class DecodeEngine:
     def submit(self, request: GenerationRequest) -> None:
         if self._crashed is not None:
             raise RuntimeError("decode engine crashed") from self._crashed
-        limit = min(self.max_seq_len - 1, self.prefill_buckets[-1])
+        # prompts longer than the largest bucket prefill in bucket-sized
+        # windows (chunked prefill), so context length is the only limit
+        limit = self.max_seq_len - 1
         if len(request.prompt_tokens) > limit:
             raise ValueError(
                 f"prompt of {len(request.prompt_tokens)} tokens exceeds the "
-                f"engine limit of {limit} (max_seq_len {self.max_seq_len}, "
-                f"largest prefill bucket {self.prefill_buckets[-1]})"
+                f"context limit of {limit} (max_seq_len {self.max_seq_len})"
             )
         self._queue.put(request)
         if self._crashed is not None:
@@ -452,6 +521,7 @@ class DecodeEngine:
                         block=not self._any_active()
                         and not self._pending
                         and inflight is None
+                        and not self._prefill_inflight
                     )
                     if not self._running:
                         break
@@ -461,6 +531,10 @@ class DecodeEngine:
                         # waves stay aligned (amortizes dispatch latency)
                         time.sleep(0.003)
                         self._drain_queue(block=False)
+                    # dispatch prefills WITHOUT blocking: they queue behind
+                    # the in-flight decode chunk and overlap with the next
+                    # ones; their slots join decode once harvested
+                    self._admit()
                     if inflight is not None:
                         # overlap: chain the next chunk off the device-side
                         # carry BEFORE blocking on this one's tokens
@@ -468,15 +542,18 @@ class DecodeEngine:
                         if self.pipeline_decode and self._can_chain(inflight):
                             chained = self._dispatch_decode(carry=inflight)
                         self._process_decode(inflight)
-                        self._admit()
                         inflight = chained
-                        continue
-                    self._admit()
-                    if self._any_active():
+                    # pick up finished prefills; block for the oldest one
+                    # only when decode has nothing to run anyway
+                    self._harvest_prefills(
+                        block=inflight is None and not self._any_ready()
+                    )
+                    if inflight is None and self._any_ready():
                         inflight = self._dispatch_decode()
                         if not self.pipeline_decode:
                             self._process_decode(inflight)
                             inflight = None
+                            self._harvest_prefills(block=False)
         except BaseException as exc:  # noqa: BLE001
             logger.exception("engine loop crashed")
             # flip the crash flag BEFORE failing waiters so a racing
@@ -488,6 +565,9 @@ class DecodeEngine:
 
     def _any_active(self) -> bool:
         return any(slot.active for slot in self.slots)
+
+    def _any_ready(self) -> bool:
+        return any(slot.ready for slot in self.slots)
 
     def _drain_queue(self, block: bool) -> None:
         try:
@@ -571,13 +651,6 @@ class DecodeEngine:
         full_extension = lcp == len(slot.history)
         if not full_extension and lcp < self.WARM_MIN_PREFIX:
             return None
-        # the suffix's bucket window must fit past the reused prefix —
-        # prefill_at_offset writes a full bucket-sized window at the
-        # offset, and a clamped write would clobber live prefix rows
-        suffix = len(prompt) - lcp
-        bucket = _bucket(suffix, self.prefill_buckets)
-        if lcp + bucket > self.max_seq_len:
-            return None
         return lcp
 
     def _admit(self) -> None:
@@ -598,17 +671,33 @@ class DecodeEngine:
                 if index is None:
                     break
                 reused = self._session_warm(index, request)
+                largest = self.prefill_buckets[-1]
                 if reused is not None:
                     slot = self.slots[index]
-                    suffix_bucket = _bucket(
-                        len(request.prompt_tokens) - reused,
-                        self.prefill_buckets,
-                    )
+                    suffix = len(request.prompt_tokens) - reused
+                    suffix_bucket = _bucket(suffix, self.prefill_buckets)
                     self._pending.pop(0)
                     slot.request = request  # reserve the slot
+                    if (
+                        suffix > largest
+                        or reused + suffix_bucket > self.max_seq_len
+                    ):
+                        # too big for one batched window, or a window at
+                        # the reused offset would clamp past max_seq_len
+                        # — the chunked path's overlap-shifted tail
+                        # handles both
+                        self._prefill_long(index, request, reused)
+                        progressed = True
+                        continue
                     warm.setdefault(suffix_bucket, []).append(
                         (index, request, reused)
                     )
+                    continue
+                if len(request.prompt_tokens) > largest:
+                    self._pending.pop(0)
+                    self.slots[index].request = request  # reserve the slot
+                    self._prefill_long(index, request, 0)
+                    progressed = True
                     continue
                 bucket = _bucket(len(request.prompt_tokens), self.prefill_buckets)
                 if cold_bucket is None:
@@ -656,12 +745,27 @@ class DecodeEngine:
         slot.last_used = time.monotonic()
         slot.epoch += 1
 
+    def _sampling_arrays(self, requests: List[GenerationRequest]):
+        self._rng, key = jax.random.split(self._rng)
+        return (
+            jnp.asarray(
+                [r.sampling.temperature for r in requests], dtype=jnp.float32
+            ),
+            jnp.asarray([r.sampling.top_k for r in requests], dtype=jnp.int32),
+            jnp.asarray(
+                [r.sampling.top_p for r in requests], dtype=jnp.float32
+            ),
+            key,
+        )
+
     def _prefill_batch(
         self, batch: List[Tuple[int, GenerationRequest]], bucket: int
     ) -> None:
-        started = time.perf_counter()
+        """Dispatch cold prefills (first token sampled in-jit) WITHOUT
+        blocking — the result is picked up by :meth:`_harvest_prefills`
+        while decode chunks for already-running slots continue."""
         for group in self._pow2_groups(batch):
-            group_started = time.perf_counter()
+            started = time.perf_counter()
             size = len(group)
             tokens = np.zeros((size, bucket), dtype=np.int32)
             lengths = np.zeros((size,), dtype=np.int32)
@@ -672,23 +776,27 @@ class DecodeEngine:
                 lengths[row] = len(prompt)
                 slot_ids[row] = index
                 self._assign_slot(index, request)
+                self.slots[index].prefilling = True
             run = self._get_prefill(bucket)
-            self.cache, logits = run(
+            temperature, top_k, top_p, key = self._sampling_arrays(
+                [request for _, request in group]
+            )
+            self.cache, sampled, lps = run(
                 self.params,
                 self.cache,
                 jnp.asarray(tokens),
                 jnp.asarray(lengths),
                 jnp.asarray(slot_ids),
+                temperature, top_k, top_p, key,
             )
             self.stats["prefill_calls"] += 1
-            jax.block_until_ready(logits)
-            self.stats["prefill_time"] += time.perf_counter() - group_started
-            firsts, lps = self._sample_group(
-                logits, [request for _, request in group]
-            )
-            for row, (index, request) in enumerate(group):
-                self._emit_token(index, int(firsts[row]), float(lps[row]))
-                request._prefill_time = time.perf_counter() - started  # type: ignore[attr-defined]
+            self.stats["prefill_time"] += time.perf_counter() - started
+            self._prefill_inflight.append({
+                "group": [(index, request) for index, request in group],
+                "sampled": sampled,
+                "lps": lps,
+                "started": started,
+            })
 
     def _prefill_warm_batch(
         self,
@@ -699,7 +807,8 @@ class DecodeEngine:
         already holds each slot's shared prefix; ONE bucketed
         prefill-at-offset dispatch writes every suffix (chunked prefill —
         no per-token forcing, no per-request dispatch). Groups split to
-        power-of-two sizes to bound compilations, like cold prefill."""
+        power-of-two sizes to bound compilations, like cold prefill.
+        Non-blocking, like :meth:`_prefill_batch`."""
         for group in self._pow2_groups(batch):
             started = time.perf_counter()
             size = len(group)
@@ -715,53 +824,117 @@ class DecodeEngine:
                 slot_ids[row] = index
                 self.stats["session_hits"] += 1
                 self._assign_slot(index, request)
+                self.slots[index].prefilling = True
             run = self._get_prefill_offset(bucket)
-            self.cache, logits = run(
+            temperature, top_k, top_p, key = self._sampling_arrays(
+                [request for _, request, _ in group]
+            )
+            self.cache, sampled, lps = run(
                 self.params,
                 self.cache,
                 jnp.asarray(tokens),
                 jnp.asarray(lengths),
                 jnp.asarray(offsets),
                 jnp.asarray(slot_ids),
+                temperature, top_k, top_p, key,
             )
             self.stats["warm_prefill_calls"] += 1
-            jax.block_until_ready(logits)
             self.stats["prefill_time"] += time.perf_counter() - started
-            firsts, lps = self._sample_group(
-                logits, [request for _, request, _ in group]
-            )
-            for row, (index, request, _reused) in enumerate(group):
-                self._emit_token(index, int(firsts[row]), float(lps[row]))
-                request._prefill_time = time.perf_counter() - started  # type: ignore[attr-defined]
+            self._prefill_inflight.append({
+                "group": [(index, request) for index, request, _ in group],
+                "sampled": sampled,
+                "lps": lps,
+                "started": started,
+            })
 
-    def _sample_group(
-        self, logits, requests: List[GenerationRequest]
-    ) -> Tuple[np.ndarray, np.ndarray]:
-        """Sample every row of a prefill group's logits in ONE device call
-        (+ one D2H): a per-row loop costs a dispatch round trip per
-        admitted request, which at 32 concurrent sessions dominated the
-        whole e2e gap (measured round 3: the per-row path was the single
-        largest 'unaccounted' wall-time bucket in the bench)."""
+    def _prefill_long(
+        self, index: int, request: GenerationRequest, reused: int
+    ) -> None:
+        """Chunked prefill for a prompt (or warm-session suffix) longer
+        than the largest bucket: write it in bucket-sized windows, left to
+        right, each one a prefill-at-offset dispatch (non-blocking, like
+        the batched paths). The FINAL window is shifted left to end
+        exactly at the prompt's last token — re-teaching a few
+        already-written positions (identical tokens → identical KV) is
+        cheaper than a dedicated ragged-tail compilation, and it
+        guarantees the window never writes past ``max_seq_len``. This is
+        what lets long-context prompts (ring/Ulysses scale) enter the
+        slot cache without a giant single-dispatch bucket."""
+        prompt = request.prompt_tokens
+        total = len(prompt)
+        largest = self.prefill_buckets[-1]
+        if reused > 0:
+            self.stats["session_hits"] += 1
+        self._assign_slot(index, request)
+        self.slots[index].prefilling = True
+        windows: List[Tuple[int, int]] = []  # (offset, bucket)
+        position = reused
+        while total - position > largest:
+            windows.append((position, largest))
+            position += largest
+        tail_bucket = _bucket(total - position, self.prefill_buckets)
+        # shift the tail window left so offset + bucket == total
+        windows.append((max(0, total - tail_bucket), tail_bucket))
         started = time.perf_counter()
-        self._rng, key = jax.random.split(self._rng)
-        tokens, lps = _sample_with_logprob_jit(
-            logits,
-            jnp.asarray(
-                [r.sampling.temperature for r in requests], dtype=jnp.float32
-            ),
-            jnp.asarray([r.sampling.top_k for r in requests], dtype=jnp.int32),
-            key,
-            jnp.asarray([r.sampling.top_p for r in requests], dtype=jnp.float32),
-        )
-        out = np.asarray(tokens), np.asarray(lps)
-        self.stats["sample_time"] += time.perf_counter() - started
-        return out
+        temperature, top_k, top_p, key = self._sampling_arrays([request])
+        for step, (offset, bucket) in enumerate(windows):
+            chunk = prompt[offset:offset + bucket]
+            tokens = np.zeros((1, bucket), dtype=np.int32)
+            tokens[0, : len(chunk)] = chunk
+            run = self._get_prefill_offset(bucket)
+            self.cache, sampled, lps = run(
+                self.params,
+                self.cache,
+                jnp.asarray(tokens),
+                jnp.asarray([len(chunk)], dtype=jnp.int32),
+                jnp.asarray([offset], dtype=jnp.int32),
+                jnp.asarray([index], dtype=jnp.int32),
+                temperature, top_k, top_p, key,
+            )
+            if step == len(windows) - 1:
+                # only the final window's sampled token is the real first
+                # token; intermediate windows' samples are discarded
+                self._prefill_inflight.append({
+                    "group": [(index, request)],
+                    "sampled": sampled,
+                    "lps": lps,
+                    "started": started,
+                })
+        self.stats["warm_prefill_calls" if reused else "prefill_calls"] += 1
+        self.stats["prefill_time"] += time.perf_counter() - started
+
+    def _harvest_prefills(self, block: bool = False) -> None:
+        """Emit first tokens of completed prefill dispatches (FIFO — the
+        device runs dispatches in order, so if the oldest isn't done the
+        younger ones aren't either). ``block`` waits for the oldest one;
+        used only when decode has no ready slots, so waiting IS the
+        fastest path to progress."""
+        while self._prefill_inflight:
+            record = self._prefill_inflight[0]
+            sampled = record["sampled"]
+            if not block:
+                is_ready = getattr(sampled, "is_ready", None)
+                if is_ready is not None and not is_ready():
+                    return
+            wait_started = time.perf_counter()
+            firsts = np.asarray(sampled)
+            lps = np.asarray(record["lps"])
+            self.stats["prefill_time"] += time.perf_counter() - wait_started
+            age = time.perf_counter() - record["started"]
+            for row, (index, request) in enumerate(record["group"]):
+                self.slots[index].prefilling = False
+                self._emit_token(index, int(firsts[row]), float(lps[row]))
+                request._prefill_time = age  # type: ignore[attr-defined]
+            self._prefill_inflight.pop(0)
+            block = False  # only the oldest is worth waiting for
 
     def _can_chain(self, inflight: Dict[str, Any]) -> bool:
         """A chunk may be pre-dispatched off the in-flight carry only when
         no admission is waiting and every active slot has ≥2 chunks of
         budget and context left (so the blind chunk can't overrun)."""
-        if self._pending:
+        if self._pending or self._prefill_inflight:
+            # harvested prefill slots should join the NEXT chunk, not wait
+            # out a blind pre-dispatched one
             return False
         steps = inflight["steps"]
         for i, slot in enumerate(self.slots):
@@ -802,7 +975,7 @@ class DecodeEngine:
             for i, slot in enumerate(self.slots):
                 lengths[i] = slot.length
                 epochs[i] = slot.epoch
-                if slot.active:
+                if slot.ready:
                     active[i] = True
                     tokens[i] = slot.history[-1]
                     lengths[i] = slot.length + 1
@@ -979,10 +1152,12 @@ class DecodeEngine:
         for request in self._pending:
             fail(request)
         self._pending = []
+        self._prefill_inflight = []
         for slot in self.slots:
             if slot.active:
                 fail(slot.request)
                 slot.request = None
+                slot.prefilling = False
 
 
 def _sample(
@@ -1065,8 +1240,3 @@ def _sample_with_logprob(
     picked = jnp.take_along_axis(logits32, token[:, None], axis=-1)[:, 0]
     lp = picked - jax.scipy.special.logsumexp(logits32, axis=-1)
     return token, lp
-
-
-# host-path entry (first token after prefill): ONE compiled dispatch per
-# (batch, vocab) shape instead of an eager op-by-op chain
-_sample_with_logprob_jit = jax.jit(_sample_with_logprob)

@@ -116,19 +116,36 @@ class JaxCompletionsService(CompletionsService):
             MeshConfig.from_config(config.get("mesh")) if config.get("mesh") else None
         )
         buckets = engine_config.get("prefill-buckets")
+        if isinstance(buckets, str):
+            # allow "128" / "128,256" spellings from globals
+            buckets = [
+                int(b) for b in buckets.replace(",", " ").split()
+            ] or None
+        elif isinstance(buckets, int):
+            buckets = [buckets]
+        elif buckets:
+            buckets = [int(b) for b in buckets]
+        else:
+            buckets = None
         self.engine = DecodeEngine(
             model_config,
             params,
             mesh_config=mesh_config,
             max_slots=int(engine_config.get("max-slots", 8)),
             max_seq_len=engine_config.get("max-seq-len"),
-            prefill_buckets=[int(b) for b in buckets] if buckets else None,
+            prefill_buckets=buckets,
             decode_chunk=int(engine_config.get("decode-chunk", 8)),
             quantize=config.get("quantization"),
             pipeline_decode=str(
                 engine_config.get("pipeline-decode", "")
             ).lower() in ("1", "true", "yes"),
         )
+        if str(engine_config.get("precompile", "")).lower() in (
+            "1", "true", "yes",
+        ):
+            # compile every prefill/decode variant before the first
+            # request so no jit compile ever stalls live traffic
+            self.engine.precompile()
         self.engine.start()
 
     async def get_chat_completions(

@@ -135,6 +135,113 @@ def test_prompt_too_long_rejected(engine):
     asyncio.run(main())
 
 
+def test_long_prompt_chunked_prefill_matches_single_window():
+    """A prompt longer than the largest bucket prefills in bucket-sized
+    windows (overlap-shifted tail); greedy output must be identical to an
+    engine whose bucket swallows the prompt whole."""
+    config = LlamaConfig.tiny(max_seq_len=256)
+    params = init_params(config)
+    prompt = [(13 * i) % 250 + 1 for i in range(90)]
+    sampling = SamplingParams(max_new_tokens=10)
+
+    async def run(buckets):
+        engine = DecodeEngine(
+            config, params, max_slots=2, max_seq_len=256,
+            prefill_buckets=buckets,
+        )
+        engine.start()
+        try:
+            return (await engine.generate(prompt, sampling)).tokens
+        finally:
+            engine.stop()
+
+    chunked = asyncio.run(run([32]))       # 90 tokens -> 2 full + tail
+    whole = asyncio.run(run([128]))
+    assert len(chunked) == 10
+    assert chunked == whole
+
+
+def test_long_warm_suffix_chunked_and_reused():
+    """A session follow-up whose suffix exceeds the largest bucket still
+    reuses the pinned prefix (session hit) and decodes the same tokens as
+    a cold engine fed the full prompt."""
+    config = LlamaConfig.tiny(max_seq_len=256)
+    params = init_params(config)
+    first = [(7 * i) % 250 + 1 for i in range(24)]
+    sampling = SamplingParams(max_new_tokens=6)
+
+    async def main():
+        engine = DecodeEngine(
+            config, params, max_slots=2, max_seq_len=256,
+            prefill_buckets=[32],
+        )
+        engine.start()
+        try:
+            r1 = await engine.generate(first, sampling, session_id="s")
+            follow = first + list(r1.tokens) + [
+                (11 * i) % 250 + 1 for i in range(70)
+            ]
+            r2 = await engine.generate(follow, sampling, session_id="s")
+            assert engine.stats["session_hits"] == 1
+            cold_engine = DecodeEngine(
+                config, params, max_slots=2, max_seq_len=256,
+                prefill_buckets=[128],
+            )
+            cold_engine.start()
+            try:
+                cold = await cold_engine.generate(follow, sampling)
+            finally:
+                cold_engine.stop()
+            assert r2.tokens == cold.tokens
+        finally:
+            engine.stop()
+
+    asyncio.run(main())
+
+
+def test_sampling_tiers_match_full_path():
+    """The lax.cond tiers in _sample are an optimization, not a
+    semantics change: for any given key, the cheap tiers must produce
+    EXACTLY the token the full truncated path would (greedy == argmax;
+    k=0/p=0 masking is the identity, so plain categorical == truncated
+    categorical on the same scaled logits)."""
+    import jax
+    import jax.numpy as jnp
+
+    from langstream_tpu.providers.jax_local.engine import _sample
+
+    key = jax.random.PRNGKey(7)
+    logits = jax.random.normal(key, (5, 64), dtype=jnp.float32) * 3.0
+
+    def run(temperature, top_k, top_p, sample_key):
+        return _sample(
+            logits,
+            jnp.full((5,), temperature, jnp.float32),
+            jnp.full((5,), top_k, jnp.int32),
+            sample_key,
+            jnp.full((5,), top_p, jnp.float32),
+        )
+
+    # greedy tier == argmax
+    sample_key = jax.random.PRNGKey(11)
+    assert (run(0.0, 0, 0.0, sample_key) == jnp.argmax(logits, -1)).all()
+    # plain tier (no truncation) == truncated path with identity masks:
+    # force the truncated branch by setting top_k to the full vocab
+    # (keeps >= 64th largest = everything, i.e. no truncation)
+    plain = run(0.9, 0, 0.0, sample_key)
+    truncated_identity = run(0.9, 64, 0.0, sample_key)
+    assert (plain == truncated_identity).all()
+    # top-p = 1.0 keeps the whole nucleus: also identical to plain
+    assert (plain == run(0.9, 0, 1.0, sample_key)).all()
+    # a tight top-k must restrict samples to the k best tokens
+    top2 = jnp.argsort(logits, axis=-1)[:, -2:]
+    for seed in range(5):
+        picks = run(1.3, 2, 0.0, jax.random.PRNGKey(seed))
+        assert all(
+            int(picks[row]) in set(top2[row].tolist()) for row in range(5)
+        )
+
+
 def test_temperature_sampling_varies(engine):
     async def main():
         results = set()
