@@ -138,6 +138,9 @@ class GenerationResult:
     tokens: List[int]
     prompt_tokens: int
     finish_reason: str = "stop"
+    # dispatch-to-harvest age of this request's prefill: since prefill
+    # overlaps decode, this is the first-token admission latency the
+    # caller experienced, NOT pure device prefill compute time
     prefill_time: float = 0.0
     decode_time: float = 0.0
     # per-token log-probability under the untruncated distribution,
@@ -591,16 +594,23 @@ class DecodeEngine:
             except queue.Empty:
                 return
 
+    def _find_warm_slot(self, request: GenerationRequest) -> Optional[int]:
+        if request.session_id is None:
+            return None
+        for i, slot in enumerate(self.slots):
+            if (
+                not slot.active
+                and slot.session_id == request.session_id
+                and slot.history is not None
+            ):
+                return i
+        return None
+
     def _find_slot(self, request: GenerationRequest) -> Optional[int]:
         # session hit first
-        if request.session_id is not None:
-            for i, slot in enumerate(self.slots):
-                if (
-                    not slot.active
-                    and slot.session_id == request.session_id
-                    and slot.history is not None
-                ):
-                    return i
+        warm = self._find_warm_slot(request)
+        if warm is not None:
+            return warm
         for i, slot in enumerate(self.slots):
             if not slot.active and slot.session_id is None:
                 return i
@@ -619,6 +629,9 @@ class DecodeEngine:
     # worth a warm admission (below it, warm ≈ cold anyway); full
     # extensions of the pinned history always qualify
     WARM_MIN_PREFIX = 16
+    # warm-first admission fairness: after this many jump-aheads the
+    # queue head is admitted regardless, so warm traffic can't starve it
+    MAX_HEAD_SKIPS = 4
 
     def _session_warm(self, index: int, request: GenerationRequest):
         """Return the reusable prefix length for a warm admission, or
@@ -666,17 +679,41 @@ class DecodeEngine:
             warm: Dict[int, List[Tuple[int, GenerationRequest, int]]] = {}
             progressed = False
             while self._pending:
-                request = self._pending[0]
-                index = self._find_slot(request)
+                # admit warm-eligible requests FIRST: a strictly-FIFO
+                # admission lets a burst of cold requests evict pinned
+                # sessions whose follow-ups sit right behind them in the
+                # same queue (measured: zero reuse at 2× slot pressure).
+                # Bounded both ways: the scan looks at most 2×slots deep
+                # (deeper entries are nowhere near admission), and a head
+                # request skipped MAX_HEAD_SKIPS times is force-admitted
+                # so sustained warm traffic cannot starve cold arrivals.
+                position, index, reused = 0, None, None
+                head = self._pending[0]
+                if getattr(head, "_skipped", 0) < self.MAX_HEAD_SKIPS:
+                    depth = max(2 * self.max_slots, 8)
+                    for p, queued in enumerate(self._pending[:depth]):
+                        warm_index = self._find_warm_slot(queued)
+                        if warm_index is None:
+                            continue
+                        lcp = self._session_warm(warm_index, queued)
+                        if lcp is not None:
+                            position, index, reused = p, warm_index, lcp
+                            break
+                request = self._pending[position]
+                if index is None:
+                    index = self._find_slot(request)
+                    if index is not None:
+                        reused = self._session_warm(index, request)
                 if index is None:
                     break
-                reused = self._session_warm(index, request)
+                if position > 0:
+                    head._skipped = getattr(head, "_skipped", 0) + 1
                 largest = self.prefill_buckets[-1]
                 if reused is not None:
                     slot = self.slots[index]
                     suffix = len(request.prompt_tokens) - reused
                     suffix_bucket = _bucket(suffix, self.prefill_buckets)
-                    self._pending.pop(0)
+                    self._pending.pop(position)
                     slot.request = request  # reserve the slot
                     if (
                         suffix > largest
@@ -694,7 +731,7 @@ class DecodeEngine:
                     )
                     continue
                 if len(request.prompt_tokens) > largest:
-                    self._pending.pop(0)
+                    self._pending.pop(position)
                     self.slots[index].request = request  # reserve the slot
                     self._prefill_long(index, request, 0)
                     progressed = True
@@ -704,7 +741,7 @@ class DecodeEngine:
                     cold_bucket = bucket
                 elif bucket != cold_bucket:
                     break  # different bucket: next outer round
-                self._pending.pop(0)
+                self._pending.pop(position)
                 self.slots[index].request = request  # reserve the slot
                 cold.append((index, request))
                 # batch caps at the largest power of two ≤ max_slots
