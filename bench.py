@@ -45,6 +45,10 @@ PROMPT_LEN = int(os.environ.get("BENCH_PROMPT_LEN", "128"))
 NEW_TOKENS = int(os.environ.get("BENCH_NEW_TOKENS", "128"))
 REQUESTS = int(os.environ.get("BENCH_REQUESTS", "96"))
 MODE = os.environ.get("BENCH_MODE", "e2e")          # e2e | engine
+# one closed-loop client per slot: oversubscribing evicts pinned
+# sessions (measured slower than the turnaround gaps it fills, now that
+# prefill overlaps decode), and 1:1 matches the BASELINE #5 session
+# semantics
 CLIENTS = int(os.environ.get("BENCH_CLIENTS", str(MAX_SLOTS)))
 ROUNDS = int(os.environ.get("BENCH_ROUNDS", "3"))   # questions per client
 # pipelined decode dispatch (hides the host/tunnel gap between chunks)

@@ -400,7 +400,53 @@ class DecodeEngine:
             self._decode_fns[steps] = fn
         return fn
 
-    def precompile(self) -> None:
+    def _variant_jobs(self) -> List[Tuple[Any, Tuple[Any, ...]]]:
+        """One (jit fn, arg avals) entry per prefill/decode variant the
+        engine can ever dispatch — the single source both precompile
+        phases drive from, so they cannot drift. Args 0/1 are always
+        params/cache avals; the last arg is always the RNG key."""
+
+        def aval(x):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+
+        params_aval = jax.tree_util.tree_map(aval, self.params)
+        cache_aval = jax.tree_util.tree_map(aval, self.cache)
+        rng_aval = jax.ShapeDtypeStruct(self._rng.shape, self._rng.dtype)
+
+        def vec(n, dtype):
+            return jax.ShapeDtypeStruct((n,), dtype)
+
+        jobs: List[Tuple[Any, Tuple[Any, ...]]] = []
+        size = 1
+        while size <= self.max_slots:
+            for bucket in self.prefill_buckets:
+                sampling = (
+                    vec(size, jnp.float32), vec(size, jnp.int32),
+                    vec(size, jnp.float32), rng_aval,
+                )
+                tokens = jax.ShapeDtypeStruct((size, bucket), jnp.int32)
+                jobs.append((self._get_prefill(bucket), (
+                    params_aval, cache_aval, tokens,
+                    vec(size, jnp.int32), vec(size, jnp.int32), *sampling,
+                )))
+                jobs.append((self._get_prefill_offset(bucket), (
+                    params_aval, cache_aval, tokens,
+                    vec(size, jnp.int32), vec(size, jnp.int32),
+                    vec(size, jnp.int32), *sampling,
+                )))
+            size *= 2
+        slots = self.max_slots
+        for steps in {self.decode_chunk, 1}:
+            jobs.append((self._get_decode(steps), (
+                params_aval, cache_aval,
+                vec(slots, jnp.int32), vec(slots, jnp.int32),
+                vec(slots, jnp.bool_), vec(slots, jnp.bool_),
+                vec(slots, jnp.float32), vec(slots, jnp.int32),
+                vec(slots, jnp.float32), rng_aval,
+            )))
+        return jobs
+
+    def precompile(self, workers: int = 4) -> None:
         """Compile-and-execute every (bucket, pow2-group-size) prefill
         variant and the decode chunks BEFORE serving traffic. Group sizes
         are timing-dependent (admission batching), so relying on warmup
@@ -408,41 +454,44 @@ class DecodeEngine:
         stalls every active request for the whole compile. Dummy rows
         target slot 0, so this must run before real requests occupy the
         cache (call right after construction; ``start()`` is fine too
-        since the engine thread is idle until the first submit)."""
-        sizes = []
-        size = 1
-        while size <= self.max_slots:
-            sizes.append(size)
-            size *= 2
-        zero = lambda n, dtype: jnp.zeros((n,), dtype)  # noqa: E731
+        since the engine thread is idle until the first submit).
+
+        Two phases over the SAME job list (:meth:`_variant_jobs`):
+        (1) every variant is lowered + compiled concurrently in a thread
+        pool — on a big model a cold cache means tens of ~minute-long
+        XLA compiles, and they parallelize well; the results land in the
+        persistent compile cache. (2) each variant executes once
+        sequentially with zero-filled args (its compile step now hits
+        the cache), which also warms the jit call caches."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        jobs = self._variant_jobs()
+
+        def build(job):
+            fn, args = job
+            with self.mesh:
+                fn.lower(*args).compile()
+
+        started = time.perf_counter()
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            list(pool.map(build, jobs))
+        logger.info(
+            "precompiled %d variants in %.1fs",
+            len(jobs), time.perf_counter() - started,
+        )
         with self.mesh:
-            for bucket in self.prefill_buckets:
-                for size in sizes:
-                    sampling = (
-                        zero(size, jnp.float32), zero(size, jnp.int32),
-                        zero(size, jnp.float32), self._rng,
-                    )
-                    tokens = jnp.zeros((size, bucket), jnp.int32)
-                    ones = jnp.ones((size,), jnp.int32)
-                    self.cache, _, _ = self._get_prefill(bucket)(
-                        self.params, self.cache, tokens,
-                        ones, zero(size, jnp.int32), *sampling,
-                    )
-                    self.cache, _, _ = self._get_prefill_offset(bucket)(
-                        self.params, self.cache, tokens,
-                        ones, zero(size, jnp.int32), zero(size, jnp.int32),
-                        *sampling,
-                    )
-            slots = self.max_slots
-            inactive = jnp.zeros((slots,), bool)  # no cache writes
-            for steps in {self.decode_chunk, 1}:
-                self.cache, _, _, _, _ = self._get_decode(steps)(
-                    self.params, self.cache,
-                    zero(slots, jnp.int32), jnp.ones((slots,), jnp.int32),
-                    inactive, inactive,
-                    zero(slots, jnp.float32), zero(slots, jnp.int32),
-                    zero(slots, jnp.float32), self._rng,
-                )
+            for fn, avals in jobs:
+                # real params + live cache (donated and rethreaded), zeros
+                # for data args, the real key for the RNG (always last).
+                # Zero decode `active`/`write_mask` masks mean no cache row
+                # is written; prefill windows write garbage into slot 0's
+                # rows, which is why this must run before traffic.
+                args: List[Any] = [self.params, self.cache]
+                for spec in avals[2:-1]:
+                    args.append(jnp.zeros(spec.shape, spec.dtype))
+                args.append(self._rng)
+                outputs = fn(*args)
+                self.cache = outputs[0]
             jax.block_until_ready(self.cache)
 
     # ------------------------------------------------------------------ #

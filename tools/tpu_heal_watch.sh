@@ -8,7 +8,10 @@ echo "$(date -u +%FT%TZ) watcher started" >> "$LOG"
 while true; do
     if timeout 120 python -c "import jax, jax.numpy as jnp; jax.jit(lambda x: x*2)(jnp.ones(4)).block_until_ready()" 2>/dev/null; then
         echo "$(date -u +%FT%TZ) TPU responsive — running bench" >> "$LOG"
-        if python bench.py > "$OUT" 2>> "$LOG"; then
+        # first post-change run pays every variant compile: raise the
+        # deadline; the persistent compile cache makes later runs (and
+        # the driver's own bench) fast
+        if BENCH_DEADLINE=3600 BENCH_INIT_TIMEOUT=600 python bench.py > "$OUT" 2>> "$LOG"; then
             echo "$(date -u +%FT%TZ) bench done: $(cat "$OUT")" >> "$LOG"
             exit 0
         fi
