@@ -201,6 +201,7 @@ for doc in [
         _P("max-tokens", "integer", "max new tokens"),
         _P("top-p", "number", "nucleus sampling"),
         _P("top-k", "integer", "top-k sampling"),
+        _P("stop", "list", "stop strings: generation ends at the first match"),
         _P("session-field", "string", "expression for KV-cache session affinity"),
         _P("ai-service", "string", "resource name of the AI service"),
         _P("logprobs", "boolean", "emit per-token text + logprobs", default=False),
@@ -218,6 +219,9 @@ for doc in [
         _P("min-chunks-per-message", "integer", "chunk batching ramp", default=20),
         _P("temperature", "number", "sampling temperature"),
         _P("max-tokens", "integer", "max new tokens"),
+        _P("top-p", "number", "nucleus sampling"),
+        _P("top-k", "integer", "top-k sampling"),
+        _P("stop", "list", "stop strings: generation ends at the first match"),
         _P("ai-service", "string", "resource name of the AI service"),
         _P("logprobs", "boolean", "emit per-token text + logprobs", default=False),
         _P("logprobs-field", "string", "field for token logprobs", default="value.logprobs"),

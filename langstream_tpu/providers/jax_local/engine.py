@@ -131,6 +131,13 @@ class GenerationRequest:
     session_id: Optional[str] = None
     future: Optional[Any] = None  # asyncio.Future or concurrent future
     loop: Optional[Any] = None
+    # set from ANY thread via cancel(); the engine finishes the request
+    # with reason "cancelled" at the next token boundary (or drops it
+    # from the queue before admission), freeing the slot for others
+    cancelled: bool = False
+
+    def cancel(self) -> None:
+        self.cancelled = True
 
 
 @dataclasses.dataclass
@@ -465,6 +472,17 @@ class DecodeEngine:
         the cache), which also warms the jit call caches."""
         from concurrent.futures import ThreadPoolExecutor
 
+        # phase 1's executables reach phase 2 (and later processes) only
+        # through the persistent compile cache — without one configured,
+        # parallel compilation would be pure waste, so default it
+        if not jax.config.jax_compilation_cache_dir:
+            jax.config.update(
+                "jax_compilation_cache_dir", "/tmp/jax_compile_cache"
+            )
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 1.0
+            )
+
         jobs = self._variant_jobs()
 
         def build(job):
@@ -540,8 +558,12 @@ class DecodeEngine:
         stop_tokens: Optional[Set[int]] = None,
         on_token: Optional[Callable[[int, bool], None]] = None,
         session_id: Optional[str] = None,
+        handle: Optional[List[GenerationRequest]] = None,
     ) -> GenerationResult:
-        """Asyncio entry: submit and await the result."""
+        """Asyncio entry: submit and await the result. Pass ``handle``
+        (an empty list) to receive the live request — its ``cancel()``
+        ends generation at the next token boundary (used by the service
+        layer for stop-string matches and disconnected clients)."""
         loop = asyncio.get_running_loop()
         future: "asyncio.Future[GenerationResult]" = loop.create_future()
         request = GenerationRequest(
@@ -553,9 +575,18 @@ class DecodeEngine:
             future=future,
             loop=loop,
         )
+        if handle is not None:
+            handle.append(request)
         self.start()
         self.submit(request)
-        return await future
+        try:
+            return await future
+        except asyncio.CancelledError:
+            # caller gave up (client disconnect, task cancelled): free
+            # the slot at the next token boundary instead of decoding a
+            # full answer nobody reads
+            request.cancel()
+            raise
 
     # ------------------------------------------------------------------ #
     # engine thread
@@ -721,6 +752,16 @@ class DecodeEngine:
         follow-ups sharing a suffix bucket likewise batch into one
         prefill-at-offset dispatch (batches split into power-of-two group
         sizes so compilations stay bounded)."""
+        if any(r.cancelled for r in self._pending):
+            # resolve cancelled-before-admission requests without ever
+            # spending a slot or a prefill on them
+            keep: List[GenerationRequest] = []
+            for queued in self._pending:
+                if queued.cancelled:
+                    self._resolve_cancelled(queued)
+                else:
+                    keep.append(queued)
+            self._pending = keep
         while self._pending:
             cold: List[Tuple[int, GenerationRequest]] = []
             cold_bucket: Optional[int] = None
@@ -1144,13 +1185,20 @@ class DecodeEngine:
         self.stats["tokens_generated"] += 1
         done = (
             hit_stop
+            or request.cancelled
             or len(slot.generated) >= request.sampling.max_new_tokens
             or slot.length + 1 >= self.max_seq_len
         )
         if request.on_token is not None and not hit_stop:
             self._post(request, request.on_token, token, done)
         if done:
-            self._finish(index, "stop" if hit_stop else "length")
+            if hit_stop:
+                reason = "stop"
+            elif request.cancelled:
+                reason = "cancelled"
+            else:
+                reason = "length"
+            self._finish(index, reason)
 
     def _finish(self, index: int, reason: str) -> None:
         slot = self.slots[index]
@@ -1185,6 +1233,19 @@ class DecodeEngine:
             slot.length = 0
         if request.future is not None:
             self._post_future(request, result)
+
+    def _resolve_cancelled(self, request: GenerationRequest) -> None:
+        """Resolve a request cancelled before it ever reached a slot."""
+        self.stats["requests"] += 1
+        if request.future is not None:
+            self._post_future(
+                request,
+                GenerationResult(
+                    tokens=[],
+                    prompt_tokens=len(request.prompt_tokens),
+                    finish_reason="cancelled",
+                ),
+            )
 
     def _post(self, request: GenerationRequest, fn, *args) -> None:
         if request.loop is not None:

@@ -166,6 +166,56 @@ class JaxCompletionsService(CompletionsService):
             max_new_tokens=int(options.get("max-tokens") or 256),
         )
         session_id = options.get("session-id")
+        # OpenAI-style stop STRINGS (`stop:` agent config): generation is
+        # cancelled at the next token boundary once one appears in the
+        # decoded text, and the result is trimmed at the match
+        # (reference: ChatCompletionsConfig stop list)
+        stop = options.get("stop") or []
+        stop_strings = [stop] if isinstance(stop, str) else [
+            s for s in stop if s
+        ]
+        handle: list = []
+        released_parts: list = []
+        retained = [""]
+        stop_cut: list = []
+        holdback = max((len(s) for s in stop_strings), default=1) - 1
+
+        def watch_stop(delta: str, final: bool = False) -> str:
+            """Watch the streamed text; on a stop match, cancel the
+            request and release only the text BEFORE the match. Withholds
+            the last ``len(longest stop) - 1`` chars until cleared so a
+            stop string split across two deltas never partially leaks
+            into the stream (released at ``final`` if no match). Only the
+            retained tail + the new delta are ever scanned — matches
+            wholly inside the retained window were ruled out last round
+            — so the per-token cost is O(delta), not O(answer)."""
+            if not stop_strings:
+                return delta
+            if stop_cut:
+                return ""
+            window = retained[0] + delta
+            hits = [
+                position for position in
+                (window.find(s) for s in stop_strings)
+                if position != -1
+            ]
+            if hits:
+                release = window[: min(hits)]
+                retained[0] = ""
+                stop_cut.append(True)
+                if handle:
+                    handle[0].cancel()
+            elif final:
+                release = window
+                retained[0] = ""
+            else:
+                keep = min(holdback, len(window))
+                release = window[: len(window) - keep]
+                retained[0] = window[len(window) - keep:]
+            if release:
+                released_parts.append(release)
+            return release
+
         answer_id = uuid.uuid4().hex
         on_token = None
         decoder = None
@@ -180,6 +230,7 @@ class JaxCompletionsService(CompletionsService):
                     # deliver any bytes the decoder was withholding as a
                     # possible partial UTF-8 sequence — last chance
                     text += decoder.flush()
+                text = watch_stop(text, final=is_last)
                 if text or is_last:
                     index = index_box[0]
                     index_box[0] += 1
@@ -191,37 +242,80 @@ class JaxCompletionsService(CompletionsService):
                         last=is_last,
                     )
 
+        elif stop_strings:
+            # no streaming: still watch the decoded text so long answers
+            # cancel at the stop instead of decoding to max-tokens
+            non_stream_decoder = self.tokenizer.stream_decoder()
+
+            def on_token(token_id: int, is_last: bool) -> None:
+                watch_stop(non_stream_decoder.push(token_id))
+
         result = await self.engine.generate(
             prompt_tokens,
             sampling,
             stop_tokens=set(self.tokenizer.eos_ids),
             on_token=on_token,
             session_id=session_id,
+            handle=handle,
         )
-        text = self.tokenizer.decode(result.tokens)
+        if stop_cut:
+            # the stream watcher found the stop: the final content IS the
+            # released stream (a batch re-decode can place multi-byte
+            # replacement boundaries differently than the incremental
+            # decoder, so re-finding the stop there could disagree)
+            text = "".join(released_parts)
+        else:
+            text = self.tokenizer.decode(result.tokens)
+        stop_trimmed = False
+        if stop_strings and not stop_cut:
+            for s in stop_strings:
+                cut = text.find(s)
+                if cut != -1:
+                    text = text[:cut]
+                    stop_trimmed = True
+        kept_tokens = result.tokens
+        kept_logprobs = result.logprobs
+        if stop_cut or stop_trimmed:
+            # drop the tokens past the stop so per-token data (logprobs,
+            # completion_tokens) aligns with the trimmed content — the
+            # engine decodes a few chunk-boundary tokens past the match
+            # before the cancel lands
+            walker = self.tokenizer.stream_decoder()
+            length = 0
+            kept = 0
+            for token in result.tokens:
+                length += len(walker.push(token))
+                if length > len(text):
+                    break
+                kept += 1
+            kept_tokens = result.tokens[:kept]
+            kept_logprobs = result.logprobs[:kept]
         if stream_consumer is not None and not last_sent[0]:
             # terminal marker for chunk batchers when the stop token arrived
             # without a trailing streamed delta (on_token is not called for
             # stop tokens, so no last=True was emitted yet)
-            tail = decoder.flush()
+            tail = watch_stop(decoder.flush(), final=True)
             stream_consumer.consume_chunk(
                 answer_id, index_box[0],
                 ChatChunk(content=tail, index=index_box[0]),
                 last=True,
             )
         want_logprobs = bool(options.get("logprobs"))
+        finish_reason = result.finish_reason
+        if stop_cut or stop_trimmed:
+            finish_reason = "stop"  # a stop STRING ended the answer
         return ChatCompletionResult(
             content=text,
-            finish_reason=result.finish_reason,
+            finish_reason=finish_reason,
             prompt_tokens=result.prompt_tokens,
-            completion_tokens=len(result.tokens),
+            completion_tokens=len(kept_tokens),
             # per-token decode only when the caller asked for logprobs —
             # N tokenizer round-trips are pure waste on the common path
             tokens=(
-                [self.tokenizer.decode([t]) for t in result.tokens]
+                [self.tokenizer.decode([t]) for t in kept_tokens]
                 if want_logprobs else None
             ),
-            logprobs=list(result.logprobs) if want_logprobs else None,
+            logprobs=list(kept_logprobs) if want_logprobs else None,
         )
 
     async def close(self) -> None:

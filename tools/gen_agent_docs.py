@@ -1,0 +1,56 @@
+"""Generate docs/agents.md from the agent doc model (the same source
+`langstream-tpu docs` serves):
+
+    python tools/gen_agent_docs.py > docs/agents.md
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from langstream_tpu.model.docs import all_docs  # noqa: E402
+
+
+def main() -> None:
+    print("# Agent configuration reference")
+    print()
+    print("Generated from the doc model (`langstream_tpu/model/docs.py`) —")
+    print("the same source the `langstream-tpu docs` CLI and plan-time")
+    print("validation use. Regenerate with:")
+    print("`python tools/gen_agent_docs.py > docs/agents.md`.")
+    by_category = {}
+    for doc in sorted(all_docs().values(), key=lambda d: d.agent_type):
+        category = getattr(doc, "category", None) or "processor"
+        by_category.setdefault(category, []).append(doc)
+    for category in ("source", "processor", "sink", "service"):
+        docs = by_category.pop(category, [])
+        if not docs:
+            continue
+        print(f"\n## {category.title()} agents\n")
+        for doc in docs:
+            print(f"### `{doc.agent_type}`\n")
+            print(doc.description)
+            print()
+            if doc.properties:
+                print("| property | type | default | description |")
+                print("|---|---|---|---|")
+                for prop in doc.properties:
+                    if prop.required:
+                        default = "**required**"
+                    elif prop.default is None:
+                        default = ""
+                    else:
+                        default = f"`{prop.default}`"
+                    print(
+                        f"| `{prop.name}` | {prop.type} | {default} "
+                        f"| {prop.description} |"
+                    )
+                print()
+    for category, docs in sorted(by_category.items()):
+        print(f"\n## {category}\n")
+        for doc in docs:
+            print(f"### `{doc.agent_type}`\n\n{doc.description}\n")
+
+
+if __name__ == "__main__":
+    main()
