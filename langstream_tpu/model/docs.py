@@ -202,6 +202,8 @@ for doc in [
         _P("top-p", "number", "nucleus sampling"),
         _P("top-k", "integer", "top-k sampling"),
         _P("stop", "list", "stop strings: generation ends at the first match"),
+        _P("presence-penalty", "number", "flat logit penalty on generated tokens"),
+        _P("frequency-penalty", "number", "per-count logit penalty on generated tokens"),
         _P("session-field", "string", "expression for KV-cache session affinity"),
         _P("ai-service", "string", "resource name of the AI service"),
         _P("logprobs", "boolean", "emit per-token text + logprobs", default=False),
@@ -222,6 +224,8 @@ for doc in [
         _P("top-p", "number", "nucleus sampling"),
         _P("top-k", "integer", "top-k sampling"),
         _P("stop", "list", "stop strings: generation ends at the first match"),
+        _P("presence-penalty", "number", "flat logit penalty on generated tokens"),
+        _P("frequency-penalty", "number", "per-count logit penalty on generated tokens"),
         _P("ai-service", "string", "resource name of the AI service"),
         _P("logprobs", "boolean", "emit per-token text + logprobs", default=False),
         _P("logprobs-field", "string", "field for token logprobs", default="value.logprobs"),

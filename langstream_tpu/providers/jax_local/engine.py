@@ -118,6 +118,12 @@ class SamplingParams:
     top_k: int = 0            # 0 = no top-k
     top_p: float = 0.0        # 0 = no nucleus truncation
     max_new_tokens: int = 256
+    # OpenAI-style repetition penalties over the GENERATED tokens (the
+    # engine keeps a per-slot token-count array on device):
+    # presence subtracts a flat amount from every already-seen token's
+    # logit; frequency subtracts count × the amount
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
 
 
 @dataclasses.dataclass
@@ -266,6 +272,13 @@ class DecodeEngine:
             )
         self.slots = [_Slot() for _ in range(max_slots)]
         self._rng = jax.random.PRNGKey(seed)
+        # per-slot generated-token counts for presence/frequency
+        # penalties; lives on device, threaded (donated) through every
+        # prefill/decode dispatch like the KV cache
+        with self.mesh:
+            self._counts = jnp.zeros(
+                (max_slots, config.vocab_size), jnp.int32
+            )
 
         self._queue: "queue.Queue[Optional[GenerationRequest]]" = queue.Queue()
         self._pending: List[GenerationRequest] = []
@@ -332,8 +345,8 @@ class DecodeEngine:
                 self.mesh if dict(self.mesh.shape).get("tp", 1) > 1 else None
             )
 
-            @functools.partial(jax.jit, donate_argnums=(1,))
-            def run(params, cache, tokens, lengths, slot_ids,
+            @functools.partial(jax.jit, donate_argnums=(1, 5))
+            def run(params, cache, tokens, lengths, slot_ids, counts,
                     temperature, top_k, top_p, key):
                 cache, logits = model_lib.prefill(
                     config, params, cache, tokens, lengths, slot_ids, freqs,
@@ -342,7 +355,11 @@ class DecodeEngine:
                 sampled, lp = _sample_with_logprob(
                     logits, temperature, top_k, key, top_p
                 )
-                return cache, sampled, lp
+                # fresh request: reset the slot's penalty counts, then
+                # count the first sampled token
+                counts = counts.at[slot_ids].set(0)
+                counts = counts.at[slot_ids, sampled].add(1)
+                return cache, counts, sampled, lp
 
             fn = run
             self._compiled_prefill[bucket] = fn
@@ -353,9 +370,9 @@ class DecodeEngine:
         if fn is None:
             config, freqs = self.config, self.freqs
 
-            @functools.partial(jax.jit, donate_argnums=(1,))
+            @functools.partial(jax.jit, donate_argnums=(1, 6))
             def run(params, cache, tokens, lengths, offsets, slot_ids,
-                    temperature, top_k, top_p, key):
+                    counts, temperature, top_k, top_p, key):
                 cache, logits = model_lib.prefill_at_offset(
                     config, params, cache, tokens, lengths, offsets,
                     slot_ids, freqs,
@@ -363,7 +380,9 @@ class DecodeEngine:
                 sampled, lp = _sample_with_logprob(
                     logits, temperature, top_k, key, top_p
                 )
-                return cache, sampled, lp
+                counts = counts.at[slot_ids].set(0)
+                counts = counts.at[slot_ids, sampled].add(1)
+                return cache, counts, sampled, lp
 
             fn = run
             self._prefill_offset_fns[bucket] = fn
@@ -380,28 +399,45 @@ class DecodeEngine:
         if fn is None:
             config, freqs = self.config, self.freqs
 
-            @functools.partial(jax.jit, donate_argnums=(1,))
+            @functools.partial(jax.jit, donate_argnums=(1, 6))
             def run(params, cache, tokens, lengths, active, write_mask,
-                    temperature, top_k, top_p, rng):
+                    counts, temperature, top_k, top_p,
+                    presence, frequency, rng):
+                slots = tokens.shape[0]
+
                 def body(carry, key):
-                    cache, tokens, lengths = carry
+                    cache, tokens, lengths, counts = carry
                     cache, logits = model_lib.decode_step(
                         config, params, cache, tokens, lengths, freqs, write_mask
                     )
-                    sampled, lp = _sample_with_logprob(
-                        logits, temperature, top_k, key, top_p
+                    # presence/frequency penalties over generated tokens
+                    # (identity when both are 0 — exact float math)
+                    adjusted = (
+                        logits
+                        - presence[:, None] * (counts > 0)
+                        - frequency[:, None] * counts
                     )
+                    sampled = _sample(adjusted, temperature, top_k, key, top_p)
+                    # logprob under the RAW untruncated distribution (the
+                    # model's own confidence — what FLARE consumes)
+                    lp = _token_logprob(logits, sampled)
                     sampled = jnp.where(active, sampled, 0)
+                    counts = counts.at[jnp.arange(slots), sampled].add(
+                        active.astype(jnp.int32)
+                    )
                     lengths = jnp.where(active, lengths + 1, lengths)
-                    return (cache, sampled, lengths), (sampled, lp)
+                    return (cache, sampled, lengths, counts), (sampled, lp)
 
                 keys = jax.random.split(rng, steps)
-                (cache, final_tokens, final_lengths), (out, lps) = jax.lax.scan(
-                    body, (cache, tokens, lengths), keys
+                (
+                    (cache, final_tokens, final_lengths, counts),
+                    (out, lps),
+                ) = jax.lax.scan(
+                    body, (cache, tokens, lengths, counts), keys
                 )
                 # final carry is returned ON DEVICE so a pipelined next
                 # chunk can chain without a host round trip
-                return cache, out.T, lps.T, final_tokens, final_lengths
+                return cache, counts, out.T, lps.T, final_tokens, final_lengths
 
             fn = run
             self._decode_fns[steps] = fn
@@ -418,6 +454,7 @@ class DecodeEngine:
 
         params_aval = jax.tree_util.tree_map(aval, self.params)
         cache_aval = jax.tree_util.tree_map(aval, self.cache)
+        counts_aval = aval(self._counts)
         rng_aval = jax.ShapeDtypeStruct(self._rng.shape, self._rng.dtype)
 
         def vec(n, dtype):
@@ -434,12 +471,13 @@ class DecodeEngine:
                 tokens = jax.ShapeDtypeStruct((size, bucket), jnp.int32)
                 jobs.append((self._get_prefill(bucket), (
                     params_aval, cache_aval, tokens,
-                    vec(size, jnp.int32), vec(size, jnp.int32), *sampling,
+                    vec(size, jnp.int32), vec(size, jnp.int32),
+                    counts_aval, *sampling,
                 )))
                 jobs.append((self._get_prefill_offset(bucket), (
                     params_aval, cache_aval, tokens,
                     vec(size, jnp.int32), vec(size, jnp.int32),
-                    vec(size, jnp.int32), *sampling,
+                    vec(size, jnp.int32), counts_aval, *sampling,
                 )))
             size *= 2
         slots = self.max_slots
@@ -448,7 +486,9 @@ class DecodeEngine:
                 params_aval, cache_aval,
                 vec(slots, jnp.int32), vec(slots, jnp.int32),
                 vec(slots, jnp.bool_), vec(slots, jnp.bool_),
+                counts_aval,
                 vec(slots, jnp.float32), vec(slots, jnp.int32),
+                vec(slots, jnp.float32), vec(slots, jnp.float32),
                 vec(slots, jnp.float32), rng_aval,
             )))
         return jobs
@@ -885,6 +925,15 @@ class DecodeEngine:
             key,
         )
 
+    def _penalty_arrays(self, slots: List[_Slot]):
+        presence = np.zeros((self.max_slots,), dtype=np.float32)
+        frequency = np.zeros((self.max_slots,), dtype=np.float32)
+        for i, slot in enumerate(slots):
+            if slot.active:
+                presence[i] = slot.request.sampling.presence_penalty
+                frequency[i] = slot.request.sampling.frequency_penalty
+        return jnp.asarray(presence), jnp.asarray(frequency)
+
     def _prefill_batch(
         self, batch: List[Tuple[int, GenerationRequest]], bucket: int
     ) -> None:
@@ -908,12 +957,13 @@ class DecodeEngine:
             temperature, top_k, top_p, key = self._sampling_arrays(
                 [request for _, request in group]
             )
-            self.cache, sampled, lps = run(
+            self.cache, self._counts, sampled, lps = run(
                 self.params,
                 self.cache,
                 jnp.asarray(tokens),
                 jnp.asarray(lengths),
                 jnp.asarray(slot_ids),
+                self._counts,
                 temperature, top_k, top_p, key,
             )
             self.stats["prefill_calls"] += 1
@@ -956,13 +1006,14 @@ class DecodeEngine:
             temperature, top_k, top_p, key = self._sampling_arrays(
                 [request for _, request, _ in group]
             )
-            self.cache, sampled, lps = run(
+            self.cache, self._counts, sampled, lps = run(
                 self.params,
                 self.cache,
                 jnp.asarray(tokens),
                 jnp.asarray(lengths),
                 jnp.asarray(offsets),
                 jnp.asarray(slot_ids),
+                self._counts,
                 temperature, top_k, top_p, key,
             )
             self.stats["warm_prefill_calls"] += 1
@@ -1009,13 +1060,14 @@ class DecodeEngine:
             tokens = np.zeros((1, bucket), dtype=np.int32)
             tokens[0, : len(chunk)] = chunk
             run = self._get_prefill_offset(bucket)
-            self.cache, sampled, lps = run(
+            self.cache, self._counts, sampled, lps = run(
                 self.params,
                 self.cache,
                 jnp.asarray(tokens),
                 jnp.asarray([len(chunk)], dtype=jnp.int32),
                 jnp.asarray([offset], dtype=jnp.int32),
                 jnp.asarray([index], dtype=jnp.int32),
+                self._counts,
                 temperature, top_k, top_p, key,
             )
             if step == len(windows) - 1:
@@ -1085,7 +1137,9 @@ class DecodeEngine:
         if carry is not None:
             steps = carry["steps"]
             active = carry["active"]
-            temperature, top_k, top_p = carry["sampling_arrays"]
+            temperature, top_k, top_p, presence, frequency = (
+                carry["sampling_arrays"]
+            )
             tokens_arg = carry["final_tokens"]
             lengths_arg = carry["final_lengths"]
             active_arg = carry["active_dev"]
@@ -1116,14 +1170,19 @@ class DecodeEngine:
             temperature = jnp.asarray(temperature)
             top_k = jnp.asarray(top_k)
             top_p = jnp.asarray(top_p)
+            presence, frequency = self._penalty_arrays(self.slots)
             tokens_arg = jnp.asarray(tokens)
             lengths_arg = jnp.asarray(lengths)
             active_arg = jnp.asarray(active)
         run = self._get_decode(steps)
         self._rng, step_key = jax.random.split(self._rng)
-        self.cache, out_tokens, out_lps, final_tokens, final_lengths = run(
+        (
+            self.cache, self._counts, out_tokens, out_lps,
+            final_tokens, final_lengths,
+        ) = run(
             self.params, self.cache, tokens_arg, lengths_arg,
-            active_arg, active_arg, temperature, top_k, top_p, step_key,
+            active_arg, active_arg, self._counts,
+            temperature, top_k, top_p, presence, frequency, step_key,
         )
         return {
             "out_tokens": out_tokens,
@@ -1132,7 +1191,7 @@ class DecodeEngine:
             "final_lengths": final_lengths,
             "active": active,
             "active_dev": active_arg,
-            "sampling_arrays": (temperature, top_k, top_p),
+            "sampling_arrays": (temperature, top_k, top_p, presence, frequency),
             "epochs": list(epochs),
             "steps": steps,
             "started": started,
@@ -1381,9 +1440,12 @@ def _sample_with_logprob(
     the UNTRUNCATED distribution (the model's own confidence — what the
     FLARE controller consumes; reference: OpenAI-style logprobs)."""
     token = _sample(logits, temperature, top_k, rng, top_p)
-    # lp = logits[token] - logsumexp(logits): same value as a full
-    # log_softmax gather without materializing a second [S, V] array
+    return token, _token_logprob(logits, token)
+
+
+def _token_logprob(logits: jnp.ndarray, token: jnp.ndarray) -> jnp.ndarray:
+    """lp = logits[token] - logsumexp(logits): same value as a full
+    log_softmax gather without materializing a second [S, V] array."""
     logits32 = logits.astype(jnp.float32)
     picked = jnp.take_along_axis(logits32, token[:, None], axis=-1)[:, 0]
-    lp = picked - jax.scipy.special.logsumexp(logits32, axis=-1)
-    return token, lp
+    return picked - jax.scipy.special.logsumexp(logits32, axis=-1)

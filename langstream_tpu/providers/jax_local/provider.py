@@ -164,6 +164,8 @@ class JaxCompletionsService(CompletionsService):
             top_k=int(options.get("top-k") or 0),
             top_p=float(options.get("top-p") or 0.0),
             max_new_tokens=int(options.get("max-tokens") or 256),
+            presence_penalty=float(options.get("presence-penalty") or 0.0),
+            frequency_penalty=float(options.get("frequency-penalty") or 0.0),
         )
         session_id = options.get("session-id")
         # OpenAI-style stop STRINGS (`stop:` agent config): generation is
@@ -171,9 +173,13 @@ class JaxCompletionsService(CompletionsService):
         # decoded text, and the result is trimmed at the match
         # (reference: ChatCompletionsConfig stop list)
         stop = options.get("stop") or []
-        stop_strings = [stop] if isinstance(stop, str) else [
-            s for s in stop if s
-        ]
+        if isinstance(stop, str):
+            stop_strings = [stop]
+        elif isinstance(stop, (list, tuple)):
+            # coerce entries: YAML users write bare numbers/bools too
+            stop_strings = [str(s) for s in stop if s is not None and s != ""]
+        else:
+            stop_strings = [str(stop)]
         handle: list = []
         released_parts: list = []
         retained = [""]

@@ -390,6 +390,48 @@ def test_provider_end_to_end():
     asyncio.run(main())
 
 
+def test_frequency_penalty_suppresses_repeats():
+    """A strong frequency penalty must cap per-token repeats in greedy
+    decoding (each use lowers that token's logit), while zero penalties
+    leave the distribution untouched (exact float identity)."""
+    config = LlamaConfig.tiny(max_seq_len=256)
+    params = init_params(config)
+
+    async def main():
+        engine = DecodeEngine(
+            config, params, max_slots=2, max_seq_len=256,
+            prefill_buckets=[16], decode_chunk=8,
+        )
+        engine.start()
+        try:
+            prompt = [5, 6, 7]
+            base = await engine.generate(
+                prompt, SamplingParams(max_new_tokens=40)
+            )
+            zeroed = await engine.generate(
+                prompt,
+                SamplingParams(
+                    max_new_tokens=40,
+                    presence_penalty=0.0, frequency_penalty=0.0,
+                ),
+            )
+            assert zeroed.tokens == base.tokens  # 0-penalty is identity
+            penalized = await engine.generate(
+                prompt,
+                SamplingParams(max_new_tokens=40, frequency_penalty=100.0),
+            )
+            from collections import Counter
+
+            worst = max(Counter(penalized.tokens).values())
+            # a 100-logit hit per use forces a new argmax every time
+            assert worst <= 2, Counter(penalized.tokens).most_common(3)
+            assert penalized.tokens != base.tokens
+        finally:
+            engine.stop()
+
+    asyncio.run(main())
+
+
 def test_cancel_frees_slot_and_resolves():
     """cancel() ends generation at the next token boundary (reason
     'cancelled'); a request cancelled before admission resolves without
