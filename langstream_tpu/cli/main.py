@@ -563,6 +563,24 @@ def build_parser() -> argparse.ArgumentParser:
     gws.add_argument("--port", type=int, default=8091)
     gws.add_argument("--sync-interval", type=float, default=5.0)
 
+    serve = sub.add_parser(
+        "serve",
+        help="OpenAI-compatible HTTP server over the TPU engine "
+             "(/v1/chat/completions, /v1/completions, /v1/embeddings)",
+    )
+    serve.add_argument("--model", default="tiny", help="model preset or name")
+    serve.add_argument("--checkpoint", default=None, help="HF/orbax dir")
+    serve.add_argument("--tokenizer", default=None, help="HF tokenizer path")
+    serve.add_argument("--quantization", default=None, choices=["int8"])
+    serve.add_argument("--tp", type=int, default=1, help="tensor parallelism")
+    serve.add_argument("--max-slots", type=int, default=8)
+    serve.add_argument("--max-seq-len", type=int, default=2048)
+    serve.add_argument("--decode-chunk", type=int, default=16)
+    serve.add_argument("--precompile", action="store_true")
+    serve.add_argument("--embeddings-checkpoint", default=None)
+    serve.add_argument("--host", default="0.0.0.0")
+    serve.add_argument("--port", type=int, default=8000)
+
     python_cmd = sub.add_parser(
         "python", help="application Python dependency tooling"
     )
@@ -652,6 +670,10 @@ def main(argv: Optional[List[str]] = None) -> None:
         from langstream_tpu.cli.services import gateway_server_main
 
         asyncio.run(gateway_server_main(args))
+    elif args.command == "serve":
+        from langstream_tpu.cli.services import serve_main
+
+        asyncio.run(serve_main(args))
     elif args.command == "python" and args.python_command == "load-deps":
         import os
         import subprocess

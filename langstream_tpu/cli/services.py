@@ -189,3 +189,83 @@ async def gateway_server_main(args) -> None:
         await watcher.run(stop, interval=args.sync_interval)
     finally:
         await gateway.stop()
+
+
+async def serve_main(args) -> None:
+    """`langstream-tpu serve`: OpenAI-compatible HTTP server straight
+    over the jax-local engine (no pipeline needed) — existing OpenAI
+    clients point their base URL at this process."""
+    import os
+
+    import jax
+
+    # the TPU plugin's sitecustomize overrides the JAX_PLATFORMS env
+    # var; restore normal env semantics (JAX_PLATFORMS=cpu must work)
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    from langstream_tpu.providers.jax_local.provider import (
+        JaxCompletionsService,
+        JaxEmbeddingsService,
+    )
+    from langstream_tpu.serving.openai_api import OpenAIApiServer
+
+    config = {
+        "model": {"preset": args.model, "max_seq_len": args.max_seq_len},
+        "engine": {
+            "max-slots": args.max_slots,
+            "max-seq-len": args.max_seq_len,
+            "decode-chunk": args.decode_chunk,
+            "precompile": bool(args.precompile),
+        },
+    }
+    from langstream_tpu.providers.jax_local.model import LlamaConfig
+
+    try:
+        LlamaConfig.from_dict({"preset": args.model})
+        known_preset = True
+    except KeyError:
+        known_preset = False
+    if args.checkpoint:
+        config["checkpoint"] = args.checkpoint
+        if not known_preset:
+            # the checkpoint carries the real model config; --model is
+            # then just the served model NAME, not a preset
+            config["model"] = {}
+    elif not known_preset:
+        raise SystemExit(
+            f"unknown model preset {args.model!r} and no --checkpoint "
+            "given; pass a preset (tiny, llama-3-1b, llama-3-8b, "
+            "llama-3-70b) or point --checkpoint at a model directory"
+        )
+    if args.tokenizer:
+        config["tokenizer"] = {"type": "hf", "path": args.tokenizer}
+    if args.quantization:
+        config["quantization"] = args.quantization
+    if args.tp and args.tp > 1:
+        config["mesh"] = {"tp": args.tp}
+    completions = JaxCompletionsService(config)
+    embeddings = None
+    if args.embeddings_checkpoint:
+        embeddings = JaxEmbeddingsService(
+            {"embeddings-model": {"checkpoint": args.embeddings_checkpoint}},
+            None,
+        )
+    server = OpenAIApiServer(
+        completions, embeddings,
+        model=args.model, host=args.host, port=args.port,
+    )
+    await server.start()
+    port = server.addresses[0][1] if server.addresses else args.port
+    print(
+        f"OpenAI-compatible API on http://{args.host}:{port}/v1 "
+        f"(model {args.model})",
+        flush=True,
+    )
+    stop = asyncio.Event()
+    _install_stop(asyncio.get_running_loop(), stop)
+    try:
+        await stop.wait()
+    finally:
+        await server.stop()
+        await completions.close()

@@ -1,0 +1,307 @@
+"""OpenAI-compatible HTTP serving surface over any CompletionsService.
+
+The reference *consumes* the OpenAI API (``OpenAICompletionService.java``);
+this module also *serves* it, so existing OpenAI clients (SDKs, curl,
+LangChain, the reference's own ``open-ai-configuration`` resource pointed
+at this URL) can talk straight to the TPU engine:
+
+- ``POST /v1/chat/completions`` — messages in, completion out; set
+  ``"stream": true`` for SSE ``data:`` chunks (OpenAI chunk format,
+  terminated by ``data: [DONE]``).
+- ``POST /v1/completions``       — prompt in (legacy text completions).
+- ``POST /v1/embeddings``        — input string/list in, vectors out.
+- ``GET  /v1/models``            — the single configured model.
+
+Start it with ``langstream-tpu serve --model llama-3-8b ...`` (see
+``cli.main``) or mount :func:`build_app` into an existing aiohttp site.
+Options map 1:1 onto the ServiceProvider SPI: temperature, top_p, top_k,
+max_tokens, stop, presence_penalty, frequency_penalty, logprobs, and a
+``session_id``/``user`` field for KV-cache session affinity.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+import uuid
+from typing import Any, Dict, Optional
+
+from aiohttp import web
+
+from langstream_tpu.api.service import ChatMessage
+
+
+def _sse(payload: Dict[str, Any]) -> bytes:
+    return b"data: " + json.dumps(payload, ensure_ascii=False).encode() + b"\n\n"
+
+
+def _error(status: int, message: str) -> web.Response:
+    """OpenAI-style JSON error envelope."""
+    return web.json_response(
+        {"error": {
+            "message": message,
+            "type": "invalid_request_error" if status == 400
+            else "server_error",
+        }},
+        status=status,
+    )
+
+
+def _options_from_request(body: Dict[str, Any], model: str) -> Dict[str, Any]:
+    """OpenAI request params → ServiceProvider option names."""
+    options: Dict[str, Any] = {"model": body.get("model") or model}
+    mapping = {
+        "temperature": "temperature",
+        "top_p": "top-p",
+        "top_k": "top-k",
+        "max_tokens": "max-tokens",
+        "max_completion_tokens": "max-tokens",
+        "stop": "stop",
+        "presence_penalty": "presence-penalty",
+        "frequency_penalty": "frequency-penalty",
+        "logprobs": "logprobs",
+    }
+    for source, target in mapping.items():
+        if body.get(source) is not None:
+            options[target] = body[source]
+    # session affinity for KV-cache reuse: explicit session_id, else the
+    # OpenAI `user` field (stable per end user)
+    session = body.get("session_id") or body.get("user")
+    if session:
+        options["session-id"] = str(session)
+    return options
+
+
+class OpenAIApiServer:
+    """aiohttp wrapper serving the OpenAI surface for one model."""
+
+    def __init__(
+        self,
+        completions=None,
+        embeddings=None,
+        *,
+        model: str = "jax-local",
+        host: str = "0.0.0.0",
+        port: int = 8000,
+    ) -> None:
+        self.completions = completions
+        self.embeddings = embeddings
+        self.model = model
+        self.host = host
+        self.port = port
+        self._runner: Optional[web.AppRunner] = None
+        self.addresses: list = []
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_post("/v1/chat/completions", self._chat)
+        app.router.add_post("/v1/completions", self._text)
+        app.router.add_post("/v1/embeddings", self._embeddings)
+        app.router.add_get("/v1/models", self._models)
+        app.router.add_get("/healthz", self._healthz)
+        return app
+
+    async def start(self) -> None:
+        self._runner = web.AppRunner(self.build_app())
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        self.addresses = list(self._runner.addresses)
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    # ------------------------------------------------------------------ #
+    async def _healthz(self, request) -> web.Response:
+        return web.json_response({"status": "ok", "model": self.model})
+
+    async def _models(self, request) -> web.Response:
+        return web.json_response({
+            "object": "list",
+            "data": [{
+                "id": self.model,
+                "object": "model",
+                "created": int(time.time()),
+                "owned_by": "langstream-tpu",
+            }],
+        })
+
+    async def _chat(self, request) -> web.StreamResponse:
+        return await self._complete(request, chat=True)
+
+    async def _text(self, request) -> web.StreamResponse:
+        return await self._complete(request, chat=False)
+
+    async def _complete(self, request, *, chat: bool) -> web.StreamResponse:
+        if self.completions is None:
+            return _error(503, "no completions service configured")
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return _error(400, "invalid JSON body")
+        if chat:
+            raw = body.get("messages")
+            if not isinstance(raw, list) or not raw:
+                return _error(400, "messages must be a non-empty list")
+            messages = [
+                ChatMessage(
+                    role=str(m.get("role", "user")),
+                    content=str(m.get("content", "")),
+                )
+                for m in raw
+            ]
+        else:
+            prompt = body.get("prompt")
+            if prompt is None:
+                return _error(400, "prompt is required")
+            if isinstance(prompt, list):
+                prompt = "".join(str(p) for p in prompt)
+            messages = [ChatMessage(role="user", content=str(prompt))]
+        options = _options_from_request(body, self.model)
+        created = int(time.time())
+        completion_id = ("chatcmpl-" if chat else "cmpl-") + uuid.uuid4().hex
+        object_name = "chat.completion" if chat else "text_completion"
+
+        if not body.get("stream"):
+            result = await self.completions.get_chat_completions(
+                messages, options
+            )
+            choice: Dict[str, Any] = {
+                "index": 0,
+                "finish_reason": result.finish_reason,
+            }
+            if chat:
+                choice["message"] = {
+                    "role": result.role, "content": result.content,
+                }
+            else:
+                choice["text"] = result.content
+            if result.logprobs is not None:
+                choice["logprobs"] = {
+                    "tokens": result.tokens,
+                    "token_logprobs": result.logprobs,
+                }
+            return web.json_response({
+                "id": completion_id,
+                "object": object_name,
+                "created": created,
+                "model": options["model"],
+                "choices": [choice],
+                "usage": {
+                    "prompt_tokens": result.prompt_tokens,
+                    "completion_tokens": result.completion_tokens,
+                    "total_tokens": (
+                        result.prompt_tokens + result.completion_tokens
+                    ),
+                },
+            })
+
+        # streaming: SSE chunks in the OpenAI chunk format
+        response = web.StreamResponse(headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+        })
+        await response.prepare(request)
+        queue: asyncio.Queue = asyncio.Queue()
+
+        class Consumer:
+            def consume_chunk(self, answer_id, index, chunk, last):
+                queue.put_nowait((chunk.content, last))
+
+        async def pump():
+            return await self.completions.get_chat_completions(
+                messages, options, Consumer()
+            )
+
+        task = asyncio.ensure_future(pump())
+        chunk_object = "chat.completion.chunk" if chat else "text_completion"
+        try:
+            if chat:
+                await response.write(_sse({
+                    "id": completion_id, "object": chunk_object,
+                    "created": created, "model": options["model"],
+                    "choices": [{
+                        "index": 0,
+                        "delta": {"role": "assistant", "content": ""},
+                        "finish_reason": None,
+                    }],
+                }))
+            while True:
+                content, last = await queue.get()
+                delta_choice: Dict[str, Any] = {
+                    "index": 0,
+                    "finish_reason": None,
+                }
+                if chat:
+                    delta_choice["delta"] = {"content": content}
+                else:
+                    delta_choice["text"] = content
+                if content:
+                    await response.write(_sse({
+                        "id": completion_id, "object": chunk_object,
+                        "created": created, "model": options["model"],
+                        "choices": [delta_choice],
+                    }))
+                if last:
+                    break
+            result = await task
+            final_choice: Dict[str, Any] = {
+                "index": 0,
+                "finish_reason": result.finish_reason,
+            }
+            if chat:
+                final_choice["delta"] = {}
+            else:
+                final_choice["text"] = ""
+            await response.write(_sse({
+                "id": completion_id, "object": chunk_object,
+                "created": created, "model": options["model"],
+                "choices": [final_choice],
+                "usage": {
+                    "prompt_tokens": result.prompt_tokens,
+                    "completion_tokens": result.completion_tokens,
+                    "total_tokens": (
+                        result.prompt_tokens + result.completion_tokens
+                    ),
+                },
+            }))
+            await response.write(b"data: [DONE]\n\n")
+        finally:
+            if not task.done():
+                # client went away mid-stream: cancel the generation so
+                # the engine frees the slot instead of finishing unread
+                task.cancel()
+                try:
+                    await task
+                except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                    pass
+        await response.write_eof()
+        return response
+
+    async def _embeddings(self, request) -> web.Response:
+        if self.embeddings is None:
+            return _error(503, "no embeddings service configured")
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return _error(400, "invalid JSON body")
+        texts = body.get("input")
+        if texts is None:
+            return _error(400, "input is required")
+        if isinstance(texts, str):
+            texts = [texts]
+        vectors = await self.embeddings.compute_embeddings(
+            [str(t) for t in texts]
+        )
+        return web.json_response({
+            "object": "list",
+            "model": body.get("model") or self.model,
+            "data": [
+                {"object": "embedding", "index": i, "embedding": vector}
+                for i, vector in enumerate(vectors)
+            ],
+            "usage": {"prompt_tokens": 0, "total_tokens": 0},
+        })

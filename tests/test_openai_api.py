@@ -1,0 +1,179 @@
+"""The OpenAI-compatible serving surface (serving/openai_api.py):
+request/response shapes, SSE streaming, embeddings, penalties/stop
+passthrough — driven over real HTTP against the tiny jax-local engine."""
+
+import asyncio
+import json
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def server_port():
+    from langstream_tpu.providers.jax_local.provider import (
+        JaxCompletionsService,
+        JaxEmbeddingsService,
+    )
+    from langstream_tpu.serving.openai_api import OpenAIApiServer
+
+    loop = asyncio.new_event_loop()
+    completions = JaxCompletionsService({
+        "model": {"preset": "tiny", "max_seq_len": 256},
+        "engine": {"max-slots": 2, "max-seq-len": 256},
+    })
+    embeddings = JaxEmbeddingsService({}, None)
+    server = OpenAIApiServer(
+        completions, embeddings, model="tiny", host="127.0.0.1", port=0
+    )
+    loop.run_until_complete(server.start())
+    port = server.addresses[0][1]
+
+    yield (loop, port)
+
+    loop.run_until_complete(server.stop())
+    loop.run_until_complete(completions.close())
+    loop.close()
+
+
+def _call(loop, coro):
+    return loop.run_until_complete(coro)
+
+
+async def _post(port, path, payload):
+    import aiohttp
+
+    async with aiohttp.ClientSession() as session:
+        async with session.post(
+            f"http://127.0.0.1:{port}{path}", json=payload
+        ) as response:
+            return response.status, await response.json()
+
+
+def test_chat_completion_shape(server_port):
+    loop, port = server_port
+    status, body = _call(loop, _post(port, "/v1/chat/completions", {
+        "model": "tiny",
+        "messages": [{"role": "user", "content": "hello"}],
+        "max_tokens": 8,
+    }))
+    assert status == 200
+    assert body["object"] == "chat.completion"
+    choice = body["choices"][0]
+    assert choice["message"]["role"] == "assistant"
+    assert isinstance(choice["message"]["content"], str)
+    assert body["usage"]["completion_tokens"] == 8
+    assert body["usage"]["total_tokens"] > 8
+
+
+def test_text_completion_and_logprobs(server_port):
+    loop, port = server_port
+    status, body = _call(loop, _post(port, "/v1/completions", {
+        "prompt": "tell me", "max_tokens": 6, "logprobs": True,
+    }))
+    assert status == 200
+    choice = body["choices"][0]
+    assert isinstance(choice["text"], str)
+    lp = choice["logprobs"]
+    assert len(lp["tokens"]) == len(lp["token_logprobs"]) == 6
+    assert all(v <= 0 for v in lp["token_logprobs"])
+
+
+def test_streaming_sse_matches_nonstream(server_port):
+    loop, port = server_port
+
+    async def run():
+        import aiohttp
+
+        payload = {
+            "messages": [{"role": "user", "content": "stream me"}],
+            "max_tokens": 10,
+        }
+        _, full = await _post(port, "/v1/chat/completions", payload)
+        content = full["choices"][0]["message"]["content"]
+
+        async with aiohttp.ClientSession() as session:
+            async with session.post(
+                f"http://127.0.0.1:{port}/v1/chat/completions",
+                json={**payload, "stream": True},
+            ) as response:
+                assert response.status == 200
+                assert response.headers["Content-Type"].startswith(
+                    "text/event-stream"
+                )
+                raw = await response.text()
+        events = [
+            line[len("data: "):]
+            for line in raw.splitlines() if line.startswith("data: ")
+        ]
+        assert events[-1] == "[DONE]"
+        chunks = [json.loads(e) for e in events[:-1]]
+        streamed = "".join(
+            c["choices"][0]["delta"].get("content", "") for c in chunks
+        )
+        assert streamed == content
+        assert chunks[-1]["choices"][0]["finish_reason"] == "length"
+        assert chunks[-1]["usage"]["completion_tokens"] == 10
+
+    _call(loop, run())
+
+
+def test_options_passthrough_stop_and_penalties(server_port):
+    loop, port = server_port
+    base_status, base = _call(loop, _post(port, "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "options test"}],
+        "max_tokens": 24,
+    }))
+    content = base["choices"][0]["message"]["content"]
+    stop = content[len(content) // 2:len(content) // 2 + 3]
+    status, stopped = _call(loop, _post(port, "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "options test"}],
+        "max_tokens": 24,
+        "stop": [stop],
+    }))
+    assert status == 200
+    assert stopped["choices"][0]["message"]["content"] == content[
+        : content.find(stop)
+    ]
+    assert stopped["choices"][0]["finish_reason"] == "stop"
+    status, penalized = _call(loop, _post(port, "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "options test"}],
+        "max_tokens": 24,
+        "frequency_penalty": 100.0,
+    }))
+    assert status == 200
+    assert penalized["choices"][0]["message"]["content"] != content
+
+
+def test_embeddings_and_models(server_port):
+    loop, port = server_port
+
+    async def run():
+        import aiohttp
+
+        status, body = await _post(port, "/v1/embeddings", {
+            "input": ["alpha", "beta"],
+        })
+        assert status == 200
+        assert len(body["data"]) == 2
+        assert all(
+            isinstance(d["embedding"], list) and d["embedding"]
+            for d in body["data"]
+        )
+        async with aiohttp.ClientSession() as session:
+            async with session.get(
+                f"http://127.0.0.1:{port}/v1/models"
+            ) as response:
+                models = await response.json()
+        assert models["data"][0]["id"] == "tiny"
+
+    _call(loop, run())
+
+
+def test_bad_requests(server_port):
+    loop, port = server_port
+    status, _ = _call(loop, _post(port, "/v1/chat/completions", {
+        "messages": [],
+    }))
+    assert status == 400
+    status, _ = _call(loop, _post(port, "/v1/completions", {}))
+    assert status == 400
