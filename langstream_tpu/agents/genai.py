@@ -323,7 +323,7 @@ class ChatCompletionsStep(Step):
             key: config.get(key)
             for key in (
                 "model", "max-tokens", "temperature", "top-p", "top-k",
-                "stop", "presence-penalty", "frequency-penalty",
+                "stop", "presence-penalty", "frequency-penalty", "seed",
                 "session-field",
             )
             if config.get(key) is not None
@@ -380,9 +380,16 @@ class ChatCompletionsStep(Step):
             session = str(ctx.record.key)
         if session is not None:
             options["session-id"] = session
-        result = await self._service.get_chat_completions(
-            messages, options, consumer
-        )
+        if self.KIND == "text":
+            # verbatim continuation, no chat template (reference:
+            # TextCompletionsStep calls getTextCompletions)
+            result = await self._service.get_text_completions(
+                [m.content for m in messages], options, consumer
+            )
+        else:
+            result = await self._service.get_chat_completions(
+                messages, options, consumer
+            )
         for task in stream_tasks:
             await task
         ctx.set_field(self.completion_field, result.content)

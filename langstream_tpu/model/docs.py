@@ -204,6 +204,7 @@ for doc in [
         _P("stop", "list", "stop strings: generation ends at the first match"),
         _P("presence-penalty", "number", "flat logit penalty on generated tokens"),
         _P("frequency-penalty", "number", "per-count logit penalty on generated tokens"),
+        _P("seed", "integer", "per-request sampling seed (reproducible sampling)"),
         _P("session-field", "string", "expression for KV-cache session affinity"),
         _P("ai-service", "string", "resource name of the AI service"),
         _P("logprobs", "boolean", "emit per-token text + logprobs", default=False),
@@ -226,6 +227,7 @@ for doc in [
         _P("stop", "list", "stop strings: generation ends at the first match"),
         _P("presence-penalty", "number", "flat logit penalty on generated tokens"),
         _P("frequency-penalty", "number", "per-count logit penalty on generated tokens"),
+        _P("seed", "integer", "per-request sampling seed (reproducible sampling)"),
         _P("ai-service", "string", "resource name of the AI service"),
         _P("logprobs", "boolean", "emit per-token text + logprobs", default=False),
         _P("logprobs-field", "string", "field for token logprobs", default="value.logprobs"),

@@ -124,6 +124,11 @@ class SamplingParams:
     # logit; frequency subtracts count × the amount
     presence_penalty: float = 0.0
     frequency_penalty: float = 0.0
+    # per-request RNG seed (OpenAI `seed`): sampling keys derive from
+    # (seed, cache position), so a seeded request reproduces its tokens
+    # EXACTLY regardless of what else shares the batch. None = a fresh
+    # auto-seed per request (still independent of batch composition).
+    seed: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -271,7 +276,8 @@ class DecodeEngine:
                 cache_sharding,
             )
         self.slots = [_Slot() for _ in range(max_slots)]
-        self._rng = jax.random.PRNGKey(seed)
+        self.base_seed = seed
+        self._seed_sequence = 0
         # per-slot generated-token counts for presence/frequency
         # penalties; lives on device, threaded (donated) through every
         # prefill/decode dispatch like the KV cache
@@ -347,13 +353,14 @@ class DecodeEngine:
 
             @functools.partial(jax.jit, donate_argnums=(1, 5))
             def run(params, cache, tokens, lengths, slot_ids, counts,
-                    temperature, top_k, top_p, key):
+                    temperature, top_k, top_p, seeds):
                 cache, logits = model_lib.prefill(
                     config, params, cache, tokens, lengths, slot_ids, freqs,
                     mesh=mesh,
                 )
+                keys = _sampling_keys(seeds, lengths)
                 sampled, lp = _sample_with_logprob(
-                    logits, temperature, top_k, key, top_p
+                    logits, temperature, top_k, keys, top_p
                 )
                 # fresh request: reset the slot's penalty counts, then
                 # count the first sampled token
@@ -372,13 +379,17 @@ class DecodeEngine:
 
             @functools.partial(jax.jit, donate_argnums=(1, 6))
             def run(params, cache, tokens, lengths, offsets, slot_ids,
-                    counts, temperature, top_k, top_p, key):
+                    counts, temperature, top_k, top_p, seeds):
                 cache, logits = model_lib.prefill_at_offset(
                     config, params, cache, tokens, lengths, offsets,
                     slot_ids, freqs,
                 )
+                # key position = the row's TOTAL cache length, so a warm
+                # continuation samples exactly like a cold run of the
+                # same full prompt
+                keys = _sampling_keys(seeds, offsets + lengths)
                 sampled, lp = _sample_with_logprob(
-                    logits, temperature, top_k, key, top_p
+                    logits, temperature, top_k, keys, top_p
                 )
                 counts = counts.at[slot_ids].set(0)
                 counts = counts.at[slot_ids, sampled].add(1)
@@ -402,10 +413,10 @@ class DecodeEngine:
             @functools.partial(jax.jit, donate_argnums=(1, 6))
             def run(params, cache, tokens, lengths, active, write_mask,
                     counts, temperature, top_k, top_p,
-                    presence, frequency, rng):
+                    presence, frequency, seeds):
                 slots = tokens.shape[0]
 
-                def body(carry, key):
+                def body(carry, _):
                     cache, tokens, lengths, counts = carry
                     cache, logits = model_lib.decode_step(
                         config, params, cache, tokens, lengths, freqs, write_mask
@@ -417,7 +428,10 @@ class DecodeEngine:
                         - presence[:, None] * (counts > 0)
                         - frequency[:, None] * counts
                     )
-                    sampled = _sample(adjusted, temperature, top_k, key, top_p)
+                    # per-slot keys from (seed, position): sampling never
+                    # depends on what else shares the batch
+                    keys = _sampling_keys(seeds, lengths)
+                    sampled = _sample(adjusted, temperature, top_k, keys, top_p)
                     # logprob under the RAW untruncated distribution (the
                     # model's own confidence — what FLARE consumes)
                     lp = _token_logprob(logits, sampled)
@@ -428,12 +442,11 @@ class DecodeEngine:
                     lengths = jnp.where(active, lengths + 1, lengths)
                     return (cache, sampled, lengths, counts), (sampled, lp)
 
-                keys = jax.random.split(rng, steps)
                 (
                     (cache, final_tokens, final_lengths, counts),
                     (out, lps),
                 ) = jax.lax.scan(
-                    body, (cache, tokens, lengths, counts), keys
+                    body, (cache, tokens, lengths, counts), None, length=steps
                 )
                 # final carry is returned ON DEVICE so a pipelined next
                 # chunk can chain without a host round trip
@@ -455,7 +468,6 @@ class DecodeEngine:
         params_aval = jax.tree_util.tree_map(aval, self.params)
         cache_aval = jax.tree_util.tree_map(aval, self.cache)
         counts_aval = aval(self._counts)
-        rng_aval = jax.ShapeDtypeStruct(self._rng.shape, self._rng.dtype)
 
         def vec(n, dtype):
             return jax.ShapeDtypeStruct((n,), dtype)
@@ -466,7 +478,7 @@ class DecodeEngine:
             for bucket in self.prefill_buckets:
                 sampling = (
                     vec(size, jnp.float32), vec(size, jnp.int32),
-                    vec(size, jnp.float32), rng_aval,
+                    vec(size, jnp.float32), vec(size, jnp.uint32),
                 )
                 tokens = jax.ShapeDtypeStruct((size, bucket), jnp.int32)
                 jobs.append((self._get_prefill(bucket), (
@@ -489,7 +501,7 @@ class DecodeEngine:
                 counts_aval,
                 vec(slots, jnp.float32), vec(slots, jnp.int32),
                 vec(slots, jnp.float32), vec(slots, jnp.float32),
-                vec(slots, jnp.float32), rng_aval,
+                vec(slots, jnp.float32), vec(slots, jnp.uint32),
             )))
         return jobs
 
@@ -540,14 +552,13 @@ class DecodeEngine:
         with self.mesh:
             for fn, avals in jobs:
                 # real params + live cache (donated and rethreaded), zeros
-                # for data args, the real key for the RNG (always last).
+                # for every data arg (incl. seeds — values are ignored).
                 # Zero decode `active`/`write_mask` masks mean no cache row
                 # is written; prefill windows write garbage into slot 0's
                 # rows, which is why this must run before traffic.
                 args: List[Any] = [self.params, self.cache]
-                for spec in avals[2:-1]:
+                for spec in avals[2:]:
                     args.append(jnp.zeros(spec.shape, spec.dtype))
-                args.append(self._rng)
                 outputs = fn(*args)
                 self.cache = outputs[0]
             jax.block_until_ready(self.cache)
@@ -912,8 +923,20 @@ class DecodeEngine:
         slot.last_used = time.monotonic()
         slot.epoch += 1
 
+    def _request_seed(self, request: GenerationRequest) -> int:
+        """The request's sampling seed: explicit (OpenAI `seed`) or a
+        fresh auto-seed, fixed for the request's whole lifetime."""
+        if request.sampling.seed is not None:
+            return request.sampling.seed & 0xFFFFFFFF
+        assigned = getattr(request, "_auto_seed", None)
+        if assigned is None:
+            self._seed_sequence += 1
+            assigned = (self.base_seed * 1_000_003 + self._seed_sequence) \
+                & 0xFFFFFFFF
+            request._auto_seed = assigned  # type: ignore[attr-defined]
+        return assigned
+
     def _sampling_arrays(self, requests: List[GenerationRequest]):
-        self._rng, key = jax.random.split(self._rng)
         return (
             jnp.asarray(
                 [r.sampling.temperature for r in requests], dtype=jnp.float32
@@ -922,7 +945,9 @@ class DecodeEngine:
             jnp.asarray(
                 [r.sampling.top_p for r in requests], dtype=jnp.float32
             ),
-            key,
+            jnp.asarray(
+                [self._request_seed(r) for r in requests], dtype=jnp.uint32
+            ),
         )
 
     def _penalty_arrays(self, slots: List[_Slot]):
@@ -954,7 +979,7 @@ class DecodeEngine:
                 self._assign_slot(index, request)
                 self.slots[index].prefilling = True
             run = self._get_prefill(bucket)
-            temperature, top_k, top_p, key = self._sampling_arrays(
+            temperature, top_k, top_p, seeds = self._sampling_arrays(
                 [request for _, request in group]
             )
             self.cache, self._counts, sampled, lps = run(
@@ -964,7 +989,7 @@ class DecodeEngine:
                 jnp.asarray(lengths),
                 jnp.asarray(slot_ids),
                 self._counts,
-                temperature, top_k, top_p, key,
+                temperature, top_k, top_p, seeds,
             )
             self.stats["prefill_calls"] += 1
             self.stats["prefill_time"] += time.perf_counter() - started
@@ -1003,7 +1028,7 @@ class DecodeEngine:
                 self._assign_slot(index, request)
                 self.slots[index].prefilling = True
             run = self._get_prefill_offset(bucket)
-            temperature, top_k, top_p, key = self._sampling_arrays(
+            temperature, top_k, top_p, seeds = self._sampling_arrays(
                 [request for _, request, _ in group]
             )
             self.cache, self._counts, sampled, lps = run(
@@ -1014,7 +1039,7 @@ class DecodeEngine:
                 jnp.asarray(offsets),
                 jnp.asarray(slot_ids),
                 self._counts,
-                temperature, top_k, top_p, key,
+                temperature, top_k, top_p, seeds,
             )
             self.stats["warm_prefill_calls"] += 1
             self.stats["prefill_time"] += time.perf_counter() - started
@@ -1054,7 +1079,7 @@ class DecodeEngine:
         # shift the tail window left so offset + bucket == total
         windows.append((max(0, total - tail_bucket), tail_bucket))
         started = time.perf_counter()
-        temperature, top_k, top_p, key = self._sampling_arrays([request])
+        temperature, top_k, top_p, seeds = self._sampling_arrays([request])
         for step, (offset, bucket) in enumerate(windows):
             chunk = prompt[offset:offset + bucket]
             tokens = np.zeros((1, bucket), dtype=np.int32)
@@ -1068,7 +1093,7 @@ class DecodeEngine:
                 jnp.asarray([offset], dtype=jnp.int32),
                 jnp.asarray([index], dtype=jnp.int32),
                 self._counts,
-                temperature, top_k, top_p, key,
+                temperature, top_k, top_p, seeds,
             )
             if step == len(windows) - 1:
                 # only the final window's sampled token is the real first
@@ -1137,7 +1162,7 @@ class DecodeEngine:
         if carry is not None:
             steps = carry["steps"]
             active = carry["active"]
-            temperature, top_k, top_p, presence, frequency = (
+            temperature, top_k, top_p, presence, frequency, seeds = (
                 carry["sampling_arrays"]
             )
             tokens_arg = carry["final_tokens"]
@@ -1151,6 +1176,7 @@ class DecodeEngine:
             temperature = np.zeros((self.max_slots,), dtype=np.float32)
             top_k = np.zeros((self.max_slots,), dtype=np.int32)
             top_p = np.zeros((self.max_slots,), dtype=np.float32)
+            seeds_host = np.zeros((self.max_slots,), dtype=np.uint32)
             epochs = [0] * self.max_slots
             steps = self.decode_chunk
             for i, slot in enumerate(self.slots):
@@ -1163,10 +1189,12 @@ class DecodeEngine:
                     temperature[i] = slot.request.sampling.temperature
                     top_k[i] = slot.request.sampling.top_k
                     top_p[i] = slot.request.sampling.top_p
+                    seeds_host[i] = self._request_seed(slot.request)
                     # a chunk writes cache positions up to length+steps-1;
                     # drop to single-step near the context boundary
                     if self.max_seq_len - slot.length - 1 < steps:
                         steps = 1
+            seeds = jnp.asarray(seeds_host)
             temperature = jnp.asarray(temperature)
             top_k = jnp.asarray(top_k)
             top_p = jnp.asarray(top_p)
@@ -1175,14 +1203,13 @@ class DecodeEngine:
             lengths_arg = jnp.asarray(lengths)
             active_arg = jnp.asarray(active)
         run = self._get_decode(steps)
-        self._rng, step_key = jax.random.split(self._rng)
         (
             self.cache, self._counts, out_tokens, out_lps,
             final_tokens, final_lengths,
         ) = run(
             self.params, self.cache, tokens_arg, lengths_arg,
             active_arg, active_arg, self._counts,
-            temperature, top_k, top_p, presence, frequency, step_key,
+            temperature, top_k, top_p, presence, frequency, seeds,
         )
         return {
             "out_tokens": out_tokens,
@@ -1191,7 +1218,7 @@ class DecodeEngine:
             "final_lengths": final_lengths,
             "active": active,
             "active_dev": active_arg,
-            "sampling_arrays": (temperature, top_k, top_p, presence, frequency),
+            "sampling_arrays": (temperature, top_k, top_p, presence, frequency, seeds),
             "epochs": list(epochs),
             "steps": steps,
             "started": started,
@@ -1366,11 +1393,29 @@ class DecodeEngine:
                 slot.prefilling = False
 
 
+def _sampling_keys(
+    seeds: jnp.ndarray,       # [S] uint32 per-request seeds
+    positions: jnp.ndarray,   # [S] cache positions (monotonic per step)
+) -> jnp.ndarray:
+    """One PRNG key per slot, derived from (seed, position) — sampling
+    is a pure function of the request, never of its batch neighbours."""
+    def derive(seed, position):
+        return jax.random.fold_in(jax.random.PRNGKey(seed), position)
+
+    return jax.vmap(derive)(seeds, positions)
+
+
+def _rowwise_categorical(keys: jnp.ndarray, scaled: jnp.ndarray) -> jnp.ndarray:
+    return jax.vmap(
+        lambda key, row: jax.random.categorical(key, row)
+    )(keys, scaled)
+
+
 def _sample(
     logits: jnp.ndarray,      # [S, V] f32
     temperature: jnp.ndarray, # [S]
     top_k: jnp.ndarray,       # [S] (0 = disabled)
-    rng: jnp.ndarray,
+    keys: jnp.ndarray,        # [S] per-slot PRNG keys (_sampling_keys)
     top_p: Optional[jnp.ndarray] = None,  # [S] (0 = disabled)
 ) -> jnp.ndarray:
     """Per-slot sampling on device: greedy when temperature==0, else
@@ -1386,7 +1431,7 @@ def _sample(
     def plain(_):
         # temperature softmax, no truncation: categorical needs no sort
         scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
-        return jax.random.categorical(rng, scaled, axis=-1)
+        return _rowwise_categorical(keys, scaled)
 
     def truncated(_):
         sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]  # descending
@@ -1411,7 +1456,7 @@ def _sample(
                 -jnp.inf, masked,
             )
         scaled = masked / jnp.maximum(temperature, 1e-6)[:, None]
-        return jax.random.categorical(rng, scaled, axis=-1)
+        return _rowwise_categorical(keys, scaled)
 
     any_truncation = jnp.any(top_k > 0)
     if top_p is not None:
@@ -1433,13 +1478,13 @@ def _sample_with_logprob(
     logits: jnp.ndarray,
     temperature: jnp.ndarray,
     top_k: jnp.ndarray,
-    rng: jnp.ndarray,
+    keys: jnp.ndarray,
     top_p: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Sample and also return each sampled token's log-probability under
     the UNTRUNCATED distribution (the model's own confidence — what the
     FLARE controller consumes; reference: OpenAI-style logprobs)."""
-    token = _sample(logits, temperature, top_k, rng, top_p)
+    token = _sample(logits, temperature, top_k, keys, top_p)
     return token, _token_logprob(logits, token)
 
 

@@ -154,11 +154,29 @@ class JaxCompletionsService(CompletionsService):
         options: Dict[str, Any],
         stream_consumer: Optional[StreamingChunksConsumer] = None,
     ) -> ChatCompletionResult:
-        from langstream_tpu.providers.jax_local.engine import SamplingParams
-
         prompt_tokens = self.tokenizer.apply_chat_template(
             [{"role": m.role, "content": m.content} for m in messages]
         )
+        return await self._generate(prompt_tokens, options, stream_consumer)
+
+    async def get_text_completions(
+        self,
+        prompt: List[str],
+        options: Dict[str, Any],
+        stream_consumer: Optional[StreamingChunksConsumer] = None,
+    ) -> ChatCompletionResult:
+        """Legacy text completions CONTINUE the prompt verbatim — no chat
+        template (OpenAI /v1/completions semantics)."""
+        prompt_tokens = self.tokenizer.encode("".join(prompt))
+        return await self._generate(prompt_tokens, options, stream_consumer)
+
+    async def _generate(
+        self,
+        prompt_tokens: List[int],
+        options: Dict[str, Any],
+        stream_consumer: Optional[StreamingChunksConsumer] = None,
+    ) -> ChatCompletionResult:
+        from langstream_tpu.providers.jax_local.engine import SamplingParams
         sampling = SamplingParams(
             temperature=float(options.get("temperature") or 0.0),
             top_k=int(options.get("top-k") or 0),
@@ -166,6 +184,10 @@ class JaxCompletionsService(CompletionsService):
             max_new_tokens=int(options.get("max-tokens") or 256),
             presence_penalty=float(options.get("presence-penalty") or 0.0),
             frequency_penalty=float(options.get("frequency-penalty") or 0.0),
+            seed=(
+                int(options["seed"]) if options.get("seed") is not None
+                else None
+            ),
         )
         session_id = options.get("session-id")
         # OpenAI-style stop STRINGS (`stop:` agent config): generation is

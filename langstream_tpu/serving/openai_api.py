@@ -61,6 +61,7 @@ def _options_from_request(body: Dict[str, Any], model: str) -> Dict[str, Any]:
         "presence_penalty": "presence-penalty",
         "frequency_penalty": "frequency-penalty",
         "logprobs": "logprobs",
+        "seed": "seed",
     }
     for source, target in mapping.items():
         if body.get(source) is not None:
@@ -153,22 +154,36 @@ class OpenAIApiServer:
                 )
                 for m in raw
             ]
+            prompt_texts = None
         else:
             prompt = body.get("prompt")
             if prompt is None:
                 return _error(400, "prompt is required")
             if isinstance(prompt, list):
                 prompt = "".join(str(p) for p in prompt)
-            messages = [ChatMessage(role="user", content=str(prompt))]
+            # legacy completions continue the prompt verbatim (the
+            # service's get_text_completions path — no chat template)
+            prompt_texts = [str(prompt)]
+            messages = []
         options = _options_from_request(body, self.model)
+
+        async def complete(consumer=None):
+            if chat:
+                return await self.completions.get_chat_completions(
+                    messages, options, consumer
+                )
+            return await self.completions.get_text_completions(
+                prompt_texts, options, consumer
+            )
         created = int(time.time())
         completion_id = ("chatcmpl-" if chat else "cmpl-") + uuid.uuid4().hex
         object_name = "chat.completion" if chat else "text_completion"
 
         if not body.get("stream"):
-            result = await self.completions.get_chat_completions(
-                messages, options
-            )
+            try:
+                result = await complete()
+            except (ValueError, TypeError) as error:
+                return _error(400, str(error))
             choice: Dict[str, Any] = {
                 "index": 0,
                 "finish_reason": result.finish_reason,
@@ -212,9 +227,13 @@ class OpenAIApiServer:
                 queue.put_nowait((chunk.content, last))
 
         async def pump():
-            return await self.completions.get_chat_completions(
-                messages, options, Consumer()
-            )
+            try:
+                return await complete(Consumer())
+            except BaseException:
+                # wake the SSE loop: without a terminal item it would
+                # await queue.get() forever on a failed generation
+                queue.put_nowait(("", True))
+                raise
 
         task = asyncio.ensure_future(pump())
         chunk_object = "chat.completion.chunk" if chat else "text_completion"
@@ -247,7 +266,15 @@ class OpenAIApiServer:
                     }))
                 if last:
                     break
-            result = await task
+            try:
+                result = await task
+            except Exception as error:  # noqa: BLE001
+                await response.write(_sse({
+                    "error": {"message": str(error), "type": "server_error"},
+                }))
+                await response.write(b"data: [DONE]\n\n")
+                await response.write_eof()
+                return response
             final_choice: Dict[str, Any] = {
                 "index": 0,
                 "finish_reason": result.finish_reason,

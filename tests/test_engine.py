@@ -302,35 +302,44 @@ def test_sampling_tiers_match_full_path():
     import jax
     import jax.numpy as jnp
 
-    from langstream_tpu.providers.jax_local.engine import _sample
+    from langstream_tpu.providers.jax_local.engine import (
+        _sample,
+        _sampling_keys,
+    )
 
     key = jax.random.PRNGKey(7)
     logits = jax.random.normal(key, (5, 64), dtype=jnp.float32) * 3.0
 
-    def run(temperature, top_k, top_p, sample_key):
+    def keys_for(seed_base):
+        return _sampling_keys(
+            jnp.arange(seed_base, seed_base + 5, dtype=jnp.uint32),
+            jnp.full((5,), 9, jnp.int32),
+        )
+
+    def run(temperature, top_k, top_p, keys):
         return _sample(
             logits,
             jnp.full((5,), temperature, jnp.float32),
             jnp.full((5,), top_k, jnp.int32),
-            sample_key,
+            keys,
             jnp.full((5,), top_p, jnp.float32),
         )
 
     # greedy tier == argmax
-    sample_key = jax.random.PRNGKey(11)
-    assert (run(0.0, 0, 0.0, sample_key) == jnp.argmax(logits, -1)).all()
+    sample_keys = keys_for(11)
+    assert (run(0.0, 0, 0.0, sample_keys) == jnp.argmax(logits, -1)).all()
     # plain tier (no truncation) == truncated path with identity masks:
     # force the truncated branch by setting top_k to the full vocab
     # (keeps >= 64th largest = everything, i.e. no truncation)
-    plain = run(0.9, 0, 0.0, sample_key)
-    truncated_identity = run(0.9, 64, 0.0, sample_key)
+    plain = run(0.9, 0, 0.0, sample_keys)
+    truncated_identity = run(0.9, 64, 0.0, sample_keys)
     assert (plain == truncated_identity).all()
     # top-p = 1.0 keeps the whole nucleus: also identical to plain
-    assert (plain == run(0.9, 0, 1.0, sample_key)).all()
+    assert (plain == run(0.9, 0, 1.0, sample_keys)).all()
     # a tight top-k must restrict samples to the k best tokens
     top2 = jnp.argsort(logits, axis=-1)[:, -2:]
     for seed in range(5):
-        picks = run(1.3, 2, 0.0, jax.random.PRNGKey(seed))
+        picks = run(1.3, 2, 0.0, keys_for(seed * 100))
         assert all(
             int(picks[row]) in set(top2[row].tolist()) for row in range(5)
         )
@@ -386,6 +395,48 @@ def test_provider_end_to_end():
         assert len(vectors) == 2
         norms = [sum(v * v for v in vec) for vec in vectors]
         assert all(abs(n - 1.0) < 1e-3 for n in norms)
+
+    asyncio.run(main())
+
+
+def test_seeded_sampling_reproducible_across_batches():
+    """A seeded request reproduces its sampled tokens EXACTLY no matter
+    what shares the batch (per-slot keys derive from seed + position);
+    different seeds diverge."""
+    config = LlamaConfig.tiny(max_seq_len=128)
+    params = init_params(config)
+    prompt = [11, 22, 33]
+
+    async def main():
+        engine = DecodeEngine(
+            config, params, max_slots=4, max_seq_len=128,
+            prefill_buckets=[16], decode_chunk=4,
+        )
+        engine.start()
+        try:
+            seeded = SamplingParams(
+                temperature=1.0, max_new_tokens=12, seed=1234
+            )
+            alone = await engine.generate(prompt, seeded)
+            # same seed, but now racing three other hot requests
+            crowded, *_ = await asyncio.gather(
+                engine.generate(prompt, seeded),
+                *[
+                    engine.generate(
+                        [7 * i, 9, 9, 9],
+                        SamplingParams(temperature=1.5, max_new_tokens=12),
+                    )
+                    for i in range(3)
+                ],
+            )
+            assert crowded.tokens == alone.tokens
+            other = await engine.generate(
+                prompt,
+                SamplingParams(temperature=1.0, max_new_tokens=12, seed=99),
+            )
+            assert other.tokens != alone.tokens
+        finally:
+            engine.stop()
 
     asyncio.run(main())
 

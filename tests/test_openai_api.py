@@ -169,6 +169,54 @@ def test_embeddings_and_models(server_port):
     _call(loop, run())
 
 
+def test_text_completions_continue_verbatim(server_port):
+    """/v1/completions must NOT wrap the prompt in a chat template: the
+    same words produce different prompt_tokens than /v1/chat/completions
+    (raw encoding vs template), and raw token count ≈ the prompt size."""
+    loop, port = server_port
+    words = "continue this text"
+    _, text_result = _call(loop, _post(port, "/v1/completions", {
+        "prompt": words, "max_tokens": 4,
+    }))
+    _, chat_result = _call(loop, _post(port, "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": words}], "max_tokens": 4,
+    }))
+    raw = text_result["usage"]["prompt_tokens"]
+    templated = chat_result["usage"]["prompt_tokens"]
+    assert raw < templated  # no role markers / template overhead
+    assert raw <= len(words) + 2  # byte tokenizer: ~1 token per char
+
+
+def test_streaming_error_terminates_sse(server_port):
+    """A generation that fails validation mid-stream (prompt beyond the
+    context limit) must emit an SSE error event and [DONE], not hang."""
+    loop, port = server_port
+
+    async def run():
+        import aiohttp
+
+        async with aiohttp.ClientSession() as session:
+            async with session.post(
+                f"http://127.0.0.1:{port}/v1/completions",
+                json={
+                    "prompt": "x" * 10_000,  # >> max_seq_len 256
+                    "max_tokens": 4,
+                    "stream": True,
+                },
+                timeout=aiohttp.ClientTimeout(total=30),
+            ) as response:
+                raw = await response.text()
+        events = [
+            line[len("data: "):]
+            for line in raw.splitlines() if line.startswith("data: ")
+        ]
+        assert events[-1] == "[DONE]"
+        payloads = [json.loads(e) for e in events[:-1]]
+        assert any("error" in p for p in payloads), payloads
+
+    _call(loop, run())
+
+
 def test_bad_requests(server_port):
     loop, port = server_port
     status, _ = _call(loop, _post(port, "/v1/chat/completions", {
