@@ -127,6 +127,17 @@ class JaxCompletionsService(CompletionsService):
             buckets = [int(b) for b in buckets]
         else:
             buckets = None
+        if engine_config.get("sampling-seed") is not None:
+            sampling_seed = int(engine_config["sampling-seed"])
+        else:
+            # real entropy by default: without it, every restart/replica
+            # would hand unseeded requests the SAME auto-seed sequence,
+            # making "random" sampling repeat across processes. Tests
+            # constructing DecodeEngine directly keep the deterministic
+            # seed=0 default.
+            import secrets as _secrets
+
+            sampling_seed = _secrets.randbits(32)
         self.engine = DecodeEngine(
             model_config,
             params,
@@ -135,6 +146,7 @@ class JaxCompletionsService(CompletionsService):
             max_seq_len=engine_config.get("max-seq-len"),
             prefill_buckets=buckets,
             decode_chunk=int(engine_config.get("decode-chunk", 8)),
+            seed=sampling_seed,
             quantize=config.get("quantization"),
             pipeline_decode=str(
                 engine_config.get("pipeline-decode", "")

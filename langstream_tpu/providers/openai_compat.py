@@ -48,17 +48,59 @@ class OpenAICompatCompletionsService(CompletionsService):
         options: Dict[str, Any],
         stream_consumer: Optional[StreamingChunksConsumer] = None,
     ) -> ChatCompletionResult:
-        session = await self._get_session()
         body: Dict[str, Any] = {
             "model": options.get("model", self.default_model),
             "messages": [{"role": m.role, "content": m.content} for m in messages],
             "stream": stream_consumer is not None,
         }
-        for key in ("max-tokens", "temperature", "top-p", "stop",
-                    "presence-penalty", "frequency-penalty"):
+        return await self._request_completion(
+            "chat/completions", body,
+            lambda choice: (
+                choice.get("delta", choice.get("message", {})) or {}
+            ).get("content"),
+            options, stream_consumer,
+        )
+
+    async def get_text_completions(
+        self,
+        prompt: List[str],
+        options: Dict[str, Any],
+        stream_consumer: Optional[StreamingChunksConsumer] = None,
+    ) -> ChatCompletionResult:
+        """Legacy /completions endpoint: the prompt continues verbatim
+        (reference: OpenAICompletionService.getTextCompletions)."""
+        body: Dict[str, Any] = {
+            "model": options.get("model", self.default_model),
+            "prompt": "".join(prompt),
+            "stream": stream_consumer is not None,
+        }
+        return await self._request_completion(
+            "completions", body,
+            lambda choice: choice.get("text"),
+            options, stream_consumer,
+        )
+
+    # options forwarded verbatim to the OpenAI body (dashes -> underscores)
+    FORWARDED_OPTIONS = (
+        "max-tokens", "temperature", "top-p", "stop",
+        "presence-penalty", "frequency-penalty", "seed",
+    )
+
+    async def _request_completion(
+        self,
+        path: str,
+        body: Dict[str, Any],
+        extract_content,
+        options: Dict[str, Any],
+        stream_consumer: Optional[StreamingChunksConsumer],
+    ) -> ChatCompletionResult:
+        """Shared request path for chat and text completions; only the
+        endpoint and the per-choice content extractor differ."""
+        session = await self._get_session()
+        for key in self.FORWARDED_OPTIONS:
             if options.get(key) is not None:
                 body[key.replace("-", "_")] = options[key]
-        endpoint = f"{self.url}/chat/completions"
+        endpoint = f"{self.url}/{path}"
         if stream_consumer is None:
             async with session.post(endpoint, json=body) as response:
                 response.raise_for_status()
@@ -66,7 +108,7 @@ class OpenAICompatCompletionsService(CompletionsService):
             choice = payload["choices"][0]
             usage = payload.get("usage", {})
             return ChatCompletionResult(
-                content=choice["message"]["content"],
+                content=extract_content(choice) or "",
                 finish_reason=choice.get("finish_reason", "stop"),
                 prompt_tokens=usage.get("prompt_tokens", 0),
                 completion_tokens=usage.get("completion_tokens", 0),
@@ -86,9 +128,12 @@ class OpenAICompatCompletionsService(CompletionsService):
                 if data == "[DONE]":
                     break
                 event = json.loads(data)
-                delta = event["choices"][0].get("delta", {})
-                content = delta.get("content")
-                finished = event["choices"][0].get("finish_reason") is not None
+                choices = event.get("choices") or []
+                if not choices:
+                    continue  # e.g. bare usage frames
+                choice = choices[0]
+                content = extract_content(choice)
+                finished = choice.get("finish_reason") is not None
                 if content:
                     parts.append(content)
                     stream_consumer.consume_chunk(
@@ -100,7 +145,8 @@ class OpenAICompatCompletionsService(CompletionsService):
                     last_emitted = finished
                 elif finished:
                     stream_consumer.consume_chunk(
-                        answer_id, index, ChatChunk(content="", index=index), last=True
+                        answer_id, index,
+                        ChatChunk(content="", index=index), last=True,
                     )
                     last_emitted = True
         if not last_emitted:

@@ -217,6 +217,55 @@ def test_streaming_error_terminates_sse(server_port):
     _call(loop, run())
 
 
+def test_openai_compat_provider_roundtrip(server_port):
+    """Interop loop: the openai_compat PROVIDER (the reference's
+    open-ai-configuration consumer role) talks to our own OpenAI SERVER
+    — chat + verbatim text completions, streaming and not."""
+    loop, port = server_port
+
+    async def run():
+        from langstream_tpu.api.service import ChatMessage
+        from langstream_tpu.providers.openai_compat import (
+            OpenAICompatCompletionsService,
+        )
+
+        provider = OpenAICompatCompletionsService({
+            "url": f"http://127.0.0.1:{port}/v1",
+            "access-key": "unused",
+        })
+        try:
+            chat = await provider.get_chat_completions(
+                [ChatMessage("user", "interop chat")],
+                {"model": "tiny", "max-tokens": 6},
+            )
+            assert chat.completion_tokens == 6
+            assert isinstance(chat.content, str) and chat.content
+
+            text = await provider.get_text_completions(
+                ["interop text"], {"model": "tiny", "max-tokens": 6},
+            )
+            assert isinstance(text.content, str) and text.content
+            # verbatim continuation: fewer prompt tokens than chat
+            assert text.prompt_tokens < chat.prompt_tokens
+
+            chunks = []
+
+            class Consumer:
+                def consume_chunk(self, answer_id, index, chunk, last):
+                    chunks.append((chunk.content, last))
+
+            streamed = await provider.get_text_completions(
+                ["interop stream"], {"model": "tiny", "max-tokens": 6},
+                Consumer(),
+            )
+            assert chunks and chunks[-1][1] is True
+            assert "".join(c for c, _ in chunks) == streamed.content
+        finally:
+            await provider.close()
+
+    _call(loop, run())
+
+
 def test_bad_requests(server_port):
     loop, port = server_port
     status, _ = _call(loop, _post(port, "/v1/chat/completions", {
