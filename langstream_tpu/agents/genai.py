@@ -324,6 +324,7 @@ class ChatCompletionsStep(Step):
             for key in (
                 "model", "max-tokens", "temperature", "top-p", "top-k",
                 "stop", "presence-penalty", "frequency-penalty", "seed",
+                "logit-bias",
                 "session-field",
             )
             if config.get(key) is not None

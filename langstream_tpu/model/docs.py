@@ -205,6 +205,7 @@ for doc in [
         _P("presence-penalty", "number", "flat logit penalty on generated tokens"),
         _P("frequency-penalty", "number", "per-count logit penalty on generated tokens"),
         _P("seed", "integer", "per-request sampling seed (reproducible sampling)"),
+        _P("logit-bias", "object", "token id -> additive logit adjustment"),
         _P("session-field", "string", "expression for KV-cache session affinity"),
         _P("ai-service", "string", "resource name of the AI service"),
         _P("logprobs", "boolean", "emit per-token text + logprobs", default=False),
@@ -228,6 +229,7 @@ for doc in [
         _P("presence-penalty", "number", "flat logit penalty on generated tokens"),
         _P("frequency-penalty", "number", "per-count logit penalty on generated tokens"),
         _P("seed", "integer", "per-request sampling seed (reproducible sampling)"),
+        _P("logit-bias", "object", "token id -> additive logit adjustment"),
         _P("ai-service", "string", "resource name of the AI service"),
         _P("logprobs", "boolean", "emit per-token text + logprobs", default=False),
         _P("logprobs-field", "string", "field for token logprobs", default="value.logprobs"),

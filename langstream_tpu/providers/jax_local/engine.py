@@ -21,9 +21,11 @@ Design (TPU-first, see SURVEY.md §7 phase 4/5):
   prompt extends the pinned history skips re-prefilling the shared prefix
   (teacher-forced suffix only). Keyed by record key upstream, so broker
   partitioning gives replica affinity.
-- **In-jit sampling**: greedy / temperature / top-k sampling runs on
-  device inside the decode jit; only the sampled token ids [S] cross to
-  host per step.
+- **In-jit sampling**: greedy / temperature / top-k / top-p sampling,
+  presence & frequency penalties, logit_bias, and per-request seeded
+  keys all run on device inside the decode jit (tiered with ``lax.cond``
+  so greedy traffic skips the sort); only the sampled token ids [S]
+  cross to host per chunk.
 """
 
 from __future__ import annotations
@@ -129,6 +131,10 @@ class SamplingParams:
     # EXACTLY regardless of what else shares the batch. None = a fresh
     # auto-seed per request (still independent of batch composition).
     seed: Optional[int] = None
+    # OpenAI `logit_bias`: token id → additive logit adjustment,
+    # applied before sampling (±100 effectively forces/bans a token).
+    # Capped at DecodeEngine.MAX_LOGIT_BIAS entries per request.
+    logit_bias: Optional[Dict[int, float]] = None
 
 
 @dataclasses.dataclass
@@ -353,15 +359,17 @@ class DecodeEngine:
 
             @functools.partial(jax.jit, donate_argnums=(1, 5))
             def run(params, cache, tokens, lengths, slot_ids, counts,
-                    temperature, top_k, top_p, seeds):
+                    temperature, top_k, top_p, seeds,
+                    bias_ids, bias_vals):
                 cache, logits = model_lib.prefill(
                     config, params, cache, tokens, lengths, slot_ids, freqs,
                     mesh=mesh,
                 )
                 keys = _sampling_keys(seeds, lengths)
-                sampled, lp = _sample_with_logprob(
-                    logits, temperature, top_k, keys, top_p
-                )
+                rows = jnp.arange(logits.shape[0])[:, None]
+                adjusted = logits.at[rows, bias_ids].add(bias_vals)
+                sampled = _sample(adjusted, temperature, top_k, keys, top_p)
+                lp = _token_logprob(logits, sampled)
                 # fresh request: reset the slot's penalty counts, then
                 # count the first sampled token
                 counts = counts.at[slot_ids].set(0)
@@ -379,7 +387,8 @@ class DecodeEngine:
 
             @functools.partial(jax.jit, donate_argnums=(1, 6))
             def run(params, cache, tokens, lengths, offsets, slot_ids,
-                    counts, temperature, top_k, top_p, seeds):
+                    counts, temperature, top_k, top_p, seeds,
+                    bias_ids, bias_vals):
                 cache, logits = model_lib.prefill_at_offset(
                     config, params, cache, tokens, lengths, offsets,
                     slot_ids, freqs,
@@ -388,9 +397,10 @@ class DecodeEngine:
                 # continuation samples exactly like a cold run of the
                 # same full prompt
                 keys = _sampling_keys(seeds, offsets + lengths)
-                sampled, lp = _sample_with_logprob(
-                    logits, temperature, top_k, keys, top_p
-                )
+                rows = jnp.arange(logits.shape[0])[:, None]
+                adjusted = logits.at[rows, bias_ids].add(bias_vals)
+                sampled = _sample(adjusted, temperature, top_k, keys, top_p)
+                lp = _token_logprob(logits, sampled)
                 counts = counts.at[slot_ids].set(0)
                 counts = counts.at[slot_ids, sampled].add(1)
                 return cache, counts, sampled, lp
@@ -413,7 +423,7 @@ class DecodeEngine:
             @functools.partial(jax.jit, donate_argnums=(1, 6))
             def run(params, cache, tokens, lengths, active, write_mask,
                     counts, temperature, top_k, top_p,
-                    presence, frequency, seeds):
+                    presence, frequency, seeds, bias_ids, bias_vals):
                 slots = tokens.shape[0]
 
                 def body(carry, _):
@@ -428,6 +438,9 @@ class DecodeEngine:
                         - presence[:, None] * (counts > 0)
                         - frequency[:, None] * counts
                     )
+                    adjusted = adjusted.at[
+                        jnp.arange(slots)[:, None], bias_ids
+                    ].add(bias_vals)
                     # per-slot keys from (seed, position): sampling never
                     # depends on what else shares the batch
                     keys = _sampling_keys(seeds, lengths)
@@ -460,7 +473,8 @@ class DecodeEngine:
         """One (jit fn, arg avals) entry per prefill/decode variant the
         engine can ever dispatch — the single source both precompile
         phases drive from, so they cannot drift. Args 0/1 are always
-        params/cache avals; the last arg is always the RNG key."""
+        params/cache avals; every other arg is a plain data array
+        (zeros are valid stand-ins for all of them)."""
 
         def aval(x):
             return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
@@ -479,6 +493,12 @@ class DecodeEngine:
                 sampling = (
                     vec(size, jnp.float32), vec(size, jnp.int32),
                     vec(size, jnp.float32), vec(size, jnp.uint32),
+                    jax.ShapeDtypeStruct(
+                        (size, self.MAX_LOGIT_BIAS), jnp.int32
+                    ),
+                    jax.ShapeDtypeStruct(
+                        (size, self.MAX_LOGIT_BIAS), jnp.float32
+                    ),
                 )
                 tokens = jax.ShapeDtypeStruct((size, bucket), jnp.int32)
                 jobs.append((self._get_prefill(bucket), (
@@ -502,6 +522,12 @@ class DecodeEngine:
                 vec(slots, jnp.float32), vec(slots, jnp.int32),
                 vec(slots, jnp.float32), vec(slots, jnp.float32),
                 vec(slots, jnp.float32), vec(slots, jnp.uint32),
+                jax.ShapeDtypeStruct(
+                    (slots, self.MAX_LOGIT_BIAS), jnp.int32
+                ),
+                jax.ShapeDtypeStruct(
+                    (slots, self.MAX_LOGIT_BIAS), jnp.float32
+                ),
             )))
         return jobs
 
@@ -587,6 +613,12 @@ class DecodeEngine:
     def submit(self, request: GenerationRequest) -> None:
         if self._crashed is not None:
             raise RuntimeError("decode engine crashed") from self._crashed
+        bias = request.sampling.logit_bias
+        if bias and len(bias) > self.MAX_LOGIT_BIAS:
+            raise ValueError(
+                f"logit_bias has {len(bias)} entries; this engine supports "
+                f"at most {self.MAX_LOGIT_BIAS}"
+            )
         # prompts longer than the largest bucket prefill in bucket-sized
         # windows (chunked prefill), so context length is the only limit
         limit = self.max_seq_len - 1
@@ -763,6 +795,10 @@ class DecodeEngine:
     # warm-first admission fairness: after this many jump-aheads the
     # queue head is admitted regardless, so warm traffic can't starve it
     MAX_HEAD_SKIPS = 4
+    # sparse per-request logit_bias entries threaded to the device as
+    # [batch, MAX_LOGIT_BIAS] (id, value) pairs; padding = (0, 0.0),
+    # a harmless +0 on token 0
+    MAX_LOGIT_BIAS = 64
 
     def _session_warm(self, index: int, request: GenerationRequest):
         """Return the reusable prefix length for a warm admission, or
@@ -959,6 +995,27 @@ class DecodeEngine:
                 frequency[i] = slot.request.sampling.frequency_penalty
         return jnp.asarray(presence), jnp.asarray(frequency)
 
+    def _bias_rows(self, requests: List[Optional[GenerationRequest]]):
+        """[len(requests), MAX_LOGIT_BIAS] (ids, values) for logit_bias;
+        rows for None/bias-less requests are all (0, 0.0) — a +0 on
+        token 0."""
+        k = self.MAX_LOGIT_BIAS
+        ids = np.zeros((len(requests), k), dtype=np.int32)
+        values = np.zeros((len(requests), k), dtype=np.float32)
+        vocab = self.config.vocab_size
+        for row, request in enumerate(requests):
+            bias = request.sampling.logit_bias if request else None
+            if not bias:
+                continue
+            valid = [
+                (int(token), float(value)) for token, value in bias.items()
+                if 0 <= int(token) < vocab
+            ]
+            for column, (token, value) in enumerate(valid[:k]):
+                ids[row, column] = token
+                values[row, column] = value
+        return jnp.asarray(ids), jnp.asarray(values)
+
     def _prefill_batch(
         self, batch: List[Tuple[int, GenerationRequest]], bucket: int
     ) -> None:
@@ -982,6 +1039,9 @@ class DecodeEngine:
             temperature, top_k, top_p, seeds = self._sampling_arrays(
                 [request for _, request in group]
             )
+            bias_ids, bias_vals = self._bias_rows(
+                [request for _, request in group]
+            )
             self.cache, self._counts, sampled, lps = run(
                 self.params,
                 self.cache,
@@ -989,7 +1049,7 @@ class DecodeEngine:
                 jnp.asarray(lengths),
                 jnp.asarray(slot_ids),
                 self._counts,
-                temperature, top_k, top_p, seeds,
+                temperature, top_k, top_p, seeds, bias_ids, bias_vals,
             )
             self.stats["prefill_calls"] += 1
             self.stats["prefill_time"] += time.perf_counter() - started
@@ -1031,6 +1091,9 @@ class DecodeEngine:
             temperature, top_k, top_p, seeds = self._sampling_arrays(
                 [request for _, request, _ in group]
             )
+            bias_ids, bias_vals = self._bias_rows(
+                [request for _, request, _ in group]
+            )
             self.cache, self._counts, sampled, lps = run(
                 self.params,
                 self.cache,
@@ -1039,7 +1102,7 @@ class DecodeEngine:
                 jnp.asarray(offsets),
                 jnp.asarray(slot_ids),
                 self._counts,
-                temperature, top_k, top_p, seeds,
+                temperature, top_k, top_p, seeds, bias_ids, bias_vals,
             )
             self.stats["warm_prefill_calls"] += 1
             self.stats["prefill_time"] += time.perf_counter() - started
@@ -1080,6 +1143,7 @@ class DecodeEngine:
         windows.append((max(0, total - tail_bucket), tail_bucket))
         started = time.perf_counter()
         temperature, top_k, top_p, seeds = self._sampling_arrays([request])
+        bias_ids, bias_vals = self._bias_rows([request])
         for step, (offset, bucket) in enumerate(windows):
             chunk = prompt[offset:offset + bucket]
             tokens = np.zeros((1, bucket), dtype=np.int32)
@@ -1093,7 +1157,7 @@ class DecodeEngine:
                 jnp.asarray([offset], dtype=jnp.int32),
                 jnp.asarray([index], dtype=jnp.int32),
                 self._counts,
-                temperature, top_k, top_p, seeds,
+                temperature, top_k, top_p, seeds, bias_ids, bias_vals,
             )
             if step == len(windows) - 1:
                 # only the final window's sampled token is the real first
@@ -1162,9 +1226,10 @@ class DecodeEngine:
         if carry is not None:
             steps = carry["steps"]
             active = carry["active"]
-            temperature, top_k, top_p, presence, frequency, seeds = (
-                carry["sampling_arrays"]
-            )
+            (
+                temperature, top_k, top_p, presence, frequency, seeds,
+                bias_ids, bias_vals,
+            ) = carry["sampling_arrays"]
             tokens_arg = carry["final_tokens"]
             lengths_arg = carry["final_lengths"]
             active_arg = carry["active_dev"]
@@ -1195,6 +1260,9 @@ class DecodeEngine:
                     if self.max_seq_len - slot.length - 1 < steps:
                         steps = 1
             seeds = jnp.asarray(seeds_host)
+            bias_ids, bias_vals = self._bias_rows(
+                [slot.request if slot.ready else None for slot in self.slots]
+            )
             temperature = jnp.asarray(temperature)
             top_k = jnp.asarray(top_k)
             top_p = jnp.asarray(top_p)
@@ -1210,6 +1278,7 @@ class DecodeEngine:
             self.params, self.cache, tokens_arg, lengths_arg,
             active_arg, active_arg, self._counts,
             temperature, top_k, top_p, presence, frequency, seeds,
+            bias_ids, bias_vals,
         )
         return {
             "out_tokens": out_tokens,
@@ -1218,7 +1287,10 @@ class DecodeEngine:
             "final_lengths": final_lengths,
             "active": active,
             "active_dev": active_arg,
-            "sampling_arrays": (temperature, top_k, top_p, presence, frequency, seeds),
+            "sampling_arrays": (
+                temperature, top_k, top_p, presence, frequency, seeds,
+                bias_ids, bias_vals,
+            ),
             "epochs": list(epochs),
             "steps": steps,
             "started": started,

@@ -200,6 +200,10 @@ class JaxCompletionsService(CompletionsService):
                 int(options["seed"]) if options.get("seed") is not None
                 else None
             ),
+            logit_bias=(
+                {int(k): float(v) for k, v in options["logit-bias"].items()}
+                if options.get("logit-bias") else None
+            ),
         )
         session_id = options.get("session-id")
         # OpenAI-style stop STRINGS (`stop:` agent config): generation is

@@ -83,7 +83,7 @@ class OpenAICompatCompletionsService(CompletionsService):
     # options forwarded verbatim to the OpenAI body (dashes -> underscores)
     FORWARDED_OPTIONS = (
         "max-tokens", "temperature", "top-p", "stop",
-        "presence-penalty", "frequency-penalty", "seed",
+        "presence-penalty", "frequency-penalty", "seed", "logit-bias",
     )
 
     async def _request_completion(

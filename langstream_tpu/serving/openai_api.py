@@ -63,6 +63,11 @@ def _options_from_request(body: Dict[str, Any], model: str) -> Dict[str, Any]:
         "logprobs": "logprobs",
         "seed": "seed",
     }
+    if isinstance(body.get("logit_bias"), dict):
+        # OpenAI spells token ids as string keys
+        options["logit-bias"] = {
+            int(k): float(v) for k, v in body["logit_bias"].items()
+        }
     for source, target in mapping.items():
         if body.get(source) is not None:
             options[target] = body[source]
@@ -165,7 +170,10 @@ class OpenAIApiServer:
             # service's get_text_completions path — no chat template)
             prompt_texts = [str(prompt)]
             messages = []
-        options = _options_from_request(body, self.model)
+        try:
+            options = _options_from_request(body, self.model)
+        except (ValueError, TypeError) as error:
+            return _error(400, f"invalid request parameter: {error}")
 
         async def complete(consumer=None):
             if chat:

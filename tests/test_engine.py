@@ -399,6 +399,47 @@ def test_provider_end_to_end():
     asyncio.run(main())
 
 
+def test_logit_bias_forces_and_bans_tokens():
+    """OpenAI logit_bias: +100 forces a token under greedy decoding
+    (including the prefill-sampled first token), -100 bans it; an empty
+    bias is an exact identity."""
+    config = LlamaConfig.tiny(max_seq_len=128)
+    params = init_params(config)
+    prompt = [3, 5, 7]
+
+    async def main():
+        engine = DecodeEngine(
+            config, params, max_slots=2, max_seq_len=128,
+            prefill_buckets=[16], decode_chunk=4,
+        )
+        engine.start()
+        try:
+            base = await engine.generate(
+                prompt, SamplingParams(max_new_tokens=8)
+            )
+            same = await engine.generate(
+                prompt, SamplingParams(max_new_tokens=8, logit_bias={})
+            )
+            assert same.tokens == base.tokens  # empty bias is identity
+            forced = await engine.generate(
+                prompt,
+                SamplingParams(max_new_tokens=8, logit_bias={42: 1000.0}),
+            )
+            assert forced.tokens == [42] * 8
+            banned_id = base.tokens[0]
+            banned = await engine.generate(
+                prompt,
+                SamplingParams(
+                    max_new_tokens=8, logit_bias={banned_id: -1000.0}
+                ),
+            )
+            assert banned_id not in banned.tokens
+        finally:
+            engine.stop()
+
+    asyncio.run(main())
+
+
 def test_seeded_sampling_reproducible_across_batches():
     """A seeded request reproduces its sampled tokens EXACTLY no matter
     what shares the batch (per-slot keys derive from seed + position);
