@@ -63,7 +63,9 @@ def _options_from_request(body: Dict[str, Any], model: str) -> Dict[str, Any]:
         "logprobs": "logprobs",
         "seed": "seed",
     }
-    if isinstance(body.get("logit_bias"), dict):
+    if body.get("logit_bias") is not None:
+        if not isinstance(body["logit_bias"], dict):
+            raise ValueError("logit_bias must be an object of id -> bias")
         # OpenAI spells token ids as string keys
         options["logit-bias"] = {
             int(k): float(v) for k, v in body["logit_bias"].items()
@@ -175,52 +177,87 @@ class OpenAIApiServer:
         except (ValueError, TypeError) as error:
             return _error(400, f"invalid request parameter: {error}")
 
-        async def complete(consumer=None):
+        async def complete(consumer=None, options_override=None):
+            request_options = options_override or options
             if chat:
                 return await self.completions.get_chat_completions(
-                    messages, options, consumer
+                    messages, request_options, consumer
                 )
             return await self.completions.get_text_completions(
-                prompt_texts, options, consumer
+                prompt_texts, request_options, consumer
             )
         created = int(time.time())
         completion_id = ("chatcmpl-" if chat else "cmpl-") + uuid.uuid4().hex
         object_name = "chat.completion" if chat else "text_completion"
 
+        n = body.get("n", 1) if body.get("n") is not None else 1
+        if isinstance(n, bool) or not isinstance(n, int):
+            return _error(400, "n must be an integer")
+        if not 1 <= n <= 16:
+            return _error(400, "n must be between 1 and 16")
         if not body.get("stream"):
+            # n > 1: independent generations fan out over the engine's
+            # continuous-batching slots concurrently; explicit seeds
+            # derive per-choice (seed + index) so choices differ
             try:
-                result = await complete()
+                per_choice = [dict(options) for _ in range(n)]
+                if n > 1 and options.get("seed") is not None:
+                    for index, choice_options in enumerate(per_choice):
+                        choice_options["seed"] = int(options["seed"]) + index
+                tasks = [
+                    asyncio.ensure_future(
+                        complete(options_override=per_choice[i])
+                    )
+                    for i in range(n)
+                ]
+                try:
+                    results = await asyncio.gather(*tasks)
+                except BaseException:
+                    # first failure: cancel siblings so their engine
+                    # generations free their slots instead of decoding
+                    # answers nobody will read
+                    for task in tasks:
+                        if not task.done():
+                            task.cancel()
+                    await asyncio.gather(*tasks, return_exceptions=True)
+                    raise
             except (ValueError, TypeError) as error:
                 return _error(400, str(error))
-            choice: Dict[str, Any] = {
-                "index": 0,
-                "finish_reason": result.finish_reason,
-            }
-            if chat:
-                choice["message"] = {
-                    "role": result.role, "content": result.content,
+            choices = []
+            for index, result in enumerate(results):
+                choice: Dict[str, Any] = {
+                    "index": index,
+                    "finish_reason": result.finish_reason,
                 }
-            else:
-                choice["text"] = result.content
-            if result.logprobs is not None:
-                choice["logprobs"] = {
-                    "tokens": result.tokens,
-                    "token_logprobs": result.logprobs,
-                }
+                if chat:
+                    choice["message"] = {
+                        "role": result.role, "content": result.content,
+                    }
+                else:
+                    choice["text"] = result.content
+                if result.logprobs is not None:
+                    choice["logprobs"] = {
+                        "tokens": result.tokens,
+                        "token_logprobs": result.logprobs,
+                    }
+                choices.append(choice)
+            completion_tokens = sum(r.completion_tokens for r in results)
             return web.json_response({
                 "id": completion_id,
                 "object": object_name,
                 "created": created,
                 "model": options["model"],
-                "choices": [choice],
+                "choices": choices,
                 "usage": {
-                    "prompt_tokens": result.prompt_tokens,
-                    "completion_tokens": result.completion_tokens,
+                    "prompt_tokens": results[0].prompt_tokens,
+                    "completion_tokens": completion_tokens,
                     "total_tokens": (
-                        result.prompt_tokens + result.completion_tokens
+                        results[0].prompt_tokens + completion_tokens
                     ),
                 },
             })
+        if n > 1:
+            return _error(400, "streaming supports n=1 only")
 
         # streaming: SSE chunks in the OpenAI chunk format
         response = web.StreamResponse(headers={

@@ -266,6 +266,32 @@ def test_openai_compat_provider_roundtrip(server_port):
     _call(loop, run())
 
 
+def test_n_choices(server_port):
+    """n > 1 returns n independent choices; with an explicit seed and
+    temperature they derive per-choice seeds (seed + index), so
+    repeating the request reproduces every choice."""
+    loop, port = server_port
+    payload = {
+        "messages": [{"role": "user", "content": "n test"}],
+        "max_tokens": 8, "temperature": 1.0, "seed": 31337, "n": 3,
+    }
+    status, body = _call(loop, _post(port, "/v1/chat/completions", payload))
+    assert status == 200
+    contents = [c["message"]["content"] for c in body["choices"]]
+    assert len(contents) == 3
+    assert [c["index"] for c in body["choices"]] == [0, 1, 2]
+    assert len(set(contents)) > 1  # derived seeds differ
+    assert body["usage"]["completion_tokens"] == 24
+    status, again = _call(loop, _post(port, "/v1/chat/completions", payload))
+    assert [
+        c["message"]["content"] for c in again["choices"]
+    ] == contents
+    status, _ = _call(loop, _post(port, "/v1/chat/completions", {
+        **payload, "stream": True,
+    }))
+    assert status == 400  # streaming supports n=1 only
+
+
 def test_bad_requests(server_port):
     loop, port = server_port
     status, _ = _call(loop, _post(port, "/v1/chat/completions", {
