@@ -303,6 +303,8 @@ class DecodeEngine:
         # prefill dispatches whose first tokens are not yet harvested
         # (FIFO — the device executes dispatches in order)
         self._prefill_inflight: List[Dict[str, Any]] = []
+        # end of the latest accounted decode interval (busy-time union)
+        self._decode_busy_until = 0.0
         self.stats = self._fresh_stats()
         # per-chunk dispatch log: (steps, active_slots, wall_seconds) —
         # the occupancy/step-time evidence the bench prints (bounded)
@@ -1301,11 +1303,20 @@ class DecodeEngine:
         active = inflight["active"]
         out_host = np.asarray(inflight["out_tokens"])  # [S, steps]
         lps_host = np.asarray(inflight["out_lps"])
-        wall = time.perf_counter() - inflight["started"]
+        ended = time.perf_counter()
+        wall = ended - inflight["started"]
         n_active = int(active.sum())
         self.stats["decode_steps"] += steps
         self.stats["decode_chunks"] += 1
-        self.stats["decode_time"] += wall
+        # pipelined chunks overlap in wall time (chunk N+1 is dispatched
+        # before N is processed): account the UNION of busy intervals, or
+        # decode_time would double-count overlap and the derived raw
+        # capability (tokens / decode_time) would mismeasure
+        self.stats["decode_time"] += max(
+            0.0,
+            ended - max(inflight["started"], self._decode_busy_until),
+        )
+        self._decode_busy_until = max(self._decode_busy_until, ended)
         self.stats["active_slot_steps"] += n_active * steps
         if len(self.chunk_log) < 65536:
             self.chunk_log.append((steps, n_active, wall))

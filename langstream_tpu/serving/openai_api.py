@@ -199,11 +199,20 @@ class OpenAIApiServer:
             # n > 1: independent generations fan out over the engine's
             # continuous-batching slots concurrently; explicit seeds
             # derive per-choice (seed + index) so choices differ
+            # NOTE: the n choices are fully independent generations — the
+            # shared prompt is prefilled n times (the engine's KV reuse is
+            # per-session, not cross-slot prompt caching). Fine for small
+            # n; budget TTFT accordingly for big prompts.
             try:
                 per_choice = [dict(options) for _ in range(n)]
-                if n > 1 and options.get("seed") is not None:
-                    for index, choice_options in enumerate(per_choice):
+                for index, choice_options in enumerate(per_choice):
+                    if n > 1 and options.get("seed") is not None:
                         choice_options["seed"] = int(options["seed"]) + index
+                    if index > 0:
+                        # only choice 0 keeps session affinity: n pinned
+                        # slots for one session would waste warm-cache
+                        # capacity and evict other sessions
+                        choice_options.pop("session-id", None)
                 tasks = [
                     asyncio.ensure_future(
                         complete(options_override=per_choice[i])
