@@ -399,6 +399,72 @@ def test_provider_end_to_end():
     asyncio.run(main())
 
 
+@pytest.mark.slow
+def test_engine_fuzz_interleavings():
+    """Soak the whole loop at once: pipelined dispatch, staggered
+    arrivals, session reuse under slot pressure, long prompts through
+    chunked prefill, random sampling params, and cancellations racing
+    admission. Every future must resolve; every uncancelled result must
+    be non-empty and within budget; the engine must stay serviceable."""
+    import random
+
+    config = LlamaConfig.tiny(max_seq_len=192)
+    params = init_params(config)
+    rng = random.Random(20260730)
+
+    async def main():
+        engine = DecodeEngine(
+            config, params, max_slots=3, max_seq_len=192,
+            prefill_buckets=[16, 32], decode_chunk=4,
+            pipeline_decode=True,
+        )
+        engine.start()
+
+        async def one(i):
+            length = rng.choice([3, 9, 20, 40, 90])  # 40/90 > bucket 32
+            prompt = [(i * 13 + j) % 250 + 1 for j in range(length)]
+            sampling = SamplingParams(
+                temperature=rng.choice([0.0, 0.0, 0.9]),
+                top_k=rng.choice([0, 5]),
+                top_p=rng.choice([0.0, 0.9]),
+                max_new_tokens=rng.choice([1, 4, 11]),
+                seed=rng.choice([None, 7]),
+                frequency_penalty=rng.choice([0.0, 2.0]),
+                logit_bias=rng.choice([None, {17: 5.0}]),
+            )
+            session = rng.choice([None, f"s{i % 4}"])
+            handle: list = []
+            await asyncio.sleep(rng.random() * 0.05)
+            task = asyncio.ensure_future(engine.generate(
+                prompt, sampling, session_id=session, handle=handle
+            ))
+            if rng.random() < 0.25:
+                await asyncio.sleep(rng.random() * 0.1)
+                if handle:
+                    handle[0].cancel()
+            result = await asyncio.wait_for(task, timeout=120)
+            if result.finish_reason != "cancelled":
+                assert 0 < len(result.tokens) <= sampling.max_new_tokens
+                assert len(result.logprobs) == len(result.tokens)
+            return result
+
+        try:
+            results = await asyncio.gather(*[one(i) for i in range(40)])
+            assert len(results) == 40
+            # the engine is still healthy afterwards
+            final = await asyncio.wait_for(
+                engine.generate([1, 2, 3], SamplingParams(max_new_tokens=3)),
+                timeout=60,
+            )
+            assert len(final.tokens) == 3
+            assert not engine._prefill_inflight
+            assert all(not s.active for s in engine.slots)
+        finally:
+            engine.stop()
+
+    asyncio.run(main())
+
+
 def test_logit_bias_forces_and_bans_tokens():
     """OpenAI logit_bias: +100 forces a token under greedy decoding
     (including the prefill-sampled first token), -100 bans it; an empty
