@@ -214,6 +214,13 @@ def load_hf_bert(path_or_model, dtype=jnp.float32) -> Tuple[EncoderConfig, Dict[
     return config, params
 
 
+def _next_pow2(value: int, floor: int = 1) -> int:
+    size = floor
+    while size < value:
+        size *= 2
+    return size
+
+
 class JaxEmbedder:
     """Bucketed-length batch embedding front-end."""
 
@@ -237,15 +244,18 @@ class JaxEmbedder:
             self.tokenizer.encode(text)[: self.max_length] for text in texts
         ]
         longest = max((len(t) for t in token_lists), default=1)
-        bucket = 16
-        while bucket < longest:
-            bucket *= 2
-        bucket = min(bucket, self.max_length)
-        batch = np.zeros((len(texts), bucket), dtype=np.int32)
-        mask = np.zeros((len(texts), bucket), dtype=bool)
+        bucket = min(_next_pow2(longest, floor=16), self.max_length)
+        # pad the batch DIMENSION to a power of two as well: the batch
+        # executor flushes partial batches on its linger timer, and every
+        # distinct (rows, bucket) shape is its own XLA compilation —
+        # without this, ragged traffic compiles up to batch-size variants
+        # instead of log2 of them (padding rows are all-masked)
+        padded_rows = _next_pow2(max(1, len(texts)))
+        batch = np.zeros((padded_rows, bucket), dtype=np.int32)
+        mask = np.zeros((padded_rows, bucket), dtype=bool)
         for i, tokens in enumerate(token_lists):
             tokens = tokens[:bucket]
             batch[i, : len(tokens)] = tokens
             mask[i, : len(tokens)] = True
         out = self._jit(self.params, jnp.asarray(batch), jnp.asarray(mask))
-        return np.asarray(out).tolist()
+        return np.asarray(out)[: len(texts)].tolist()
