@@ -251,9 +251,15 @@ async def serve_main(args) -> None:
             {"embeddings-model": {"checkpoint": args.embeddings_checkpoint}},
             None,
         )
+    from langstream_tpu.providers.jax_local.engine import (
+        engines_histograms,
+        engines_snapshot,
+    )
+
     server = OpenAIApiServer(
         completions, embeddings,
         model=args.model, host=args.host, port=args.port,
+        gauges=engines_snapshot, histograms=engines_histograms,
     )
     await server.start()
     port = server.addresses[0][1] if server.addresses else args.port

@@ -92,12 +92,16 @@ class OpenAIApiServer:
         model: str = "jax-local",
         host: str = "0.0.0.0",
         port: int = 8000,
+        gauges=None,       # () -> Dict[str, float], like AgentHttpServer
+        histograms=None,   # () -> Dict[str, Dict[str, float]]
     ) -> None:
         self.completions = completions
         self.embeddings = embeddings
         self.model = model
         self.host = host
         self.port = port
+        self._gauges = gauges
+        self._histograms = histograms
         self._runner: Optional[web.AppRunner] = None
         self.addresses: list = []
 
@@ -108,6 +112,7 @@ class OpenAIApiServer:
         app.router.add_post("/v1/embeddings", self._embeddings)
         app.router.add_get("/v1/models", self._models)
         app.router.add_get("/healthz", self._healthz)
+        app.router.add_get("/metrics", self._metrics)
         return app
 
     async def start(self) -> None:
@@ -125,6 +130,21 @@ class OpenAIApiServer:
     # ------------------------------------------------------------------ #
     async def _healthz(self, request) -> web.Response:
         return web.json_response({"status": "ok", "model": self.model})
+
+    async def _metrics(self, request) -> web.Response:
+        """Prometheus text from the injected gauge/histogram providers
+        (same exposition shape every runner pod serves); backends wire
+        their own — `serve` injects the jax-local engine snapshots."""
+        from langstream_tpu.runtime.pod import prometheus_text
+
+        return web.Response(
+            text=prometheus_text(
+                {},
+                self._gauges() if self._gauges else {},
+                self._histograms() if self._histograms else {},
+            ),
+            content_type="text/plain",
+        )
 
     async def _models(self, request) -> web.Response:
         return web.json_response({

@@ -22,8 +22,14 @@ def server_port():
         "engine": {"max-slots": 2, "max-seq-len": 256},
     })
     embeddings = JaxEmbeddingsService({}, None)
+    from langstream_tpu.providers.jax_local.engine import (
+        engines_histograms,
+        engines_snapshot,
+    )
+
     server = OpenAIApiServer(
-        completions, embeddings, model="tiny", host="127.0.0.1", port=0
+        completions, embeddings, model="tiny", host="127.0.0.1", port=0,
+        gauges=engines_snapshot, histograms=engines_histograms,
     )
     loop.run_until_complete(server.start())
     port = server.addresses[0][1]
@@ -142,6 +148,29 @@ def test_options_passthrough_stop_and_penalties(server_port):
     }))
     assert status == 200
     assert penalized["choices"][0]["message"]["content"] != content
+
+
+def test_metrics_endpoint(server_port):
+    """/metrics exposes the engine's Prometheus gauges after traffic."""
+    loop, port = server_port
+
+    async def run():
+        import aiohttp
+
+        await _post(port, "/v1/chat/completions", {
+            "messages": [{"role": "user", "content": "warm metrics"}],
+            "max_tokens": 4,
+        })
+        async with aiohttp.ClientSession() as session:
+            async with session.get(
+                f"http://127.0.0.1:{port}/metrics"
+            ) as response:
+                assert response.status == 200
+                text = await response.text()
+        assert "jax_engine_tokens_generated" in text
+        assert "jax_engine_decode_step_seconds_bucket" in text
+
+    _call(loop, run())
 
 
 def test_embeddings_and_models(server_port):
