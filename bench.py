@@ -53,6 +53,8 @@ CLIENTS = int(os.environ.get("BENCH_CLIENTS", str(MAX_SLOTS)))
 ROUNDS = int(os.environ.get("BENCH_ROUNDS", "3"))   # questions per client
 # pipelined decode dispatch (hides the host/tunnel gap between chunks)
 PIPELINE = os.environ.get("BENCH_PIPELINE", "1") not in ("", "0")
+# broker for the e2e pipeline: memory (default) | tpulog
+BROKER = os.environ.get("BENCH_BROKER", "memory")
 BASELINE_TOK_S = 800.0
 # the bench must ALWAYS emit its JSON line before the driver's timeout
 # kills it (round-1 failure mode: axon backend init hung ~25 min → rc=124,
@@ -270,9 +272,20 @@ async def run_bench_e2e():
     repo = os.path.dirname(os.path.abspath(__file__))
     app_dir = os.path.join(repo, "examples", "applications", "jax-completions")
     max_seq = PROMPT_LEN + NEW_TOKENS + 96
+    # BENCH_BROKER=tpulog measures the same pipeline on the durable C++
+    # segment-store broker instead of the in-memory one
+    broker_dir = None
+    if BROKER == "tpulog":
+        broker_dir = tempfile.mkdtemp(prefix="benchlog-")
+        streaming: dict = {
+            "type": "tpulog",
+            "configuration": {"directory": broker_dir},
+        }
+    else:
+        streaming = {"type": BROKER}
     instance = {
         "instance": {
-            "streamingCluster": {"type": "memory"},
+            "streamingCluster": streaming,
             "computeCluster": {"type": "local"},
             "globals": {
                 "model": MODEL_PRESET,
@@ -337,6 +350,10 @@ async def run_bench_e2e():
             await gateway.stop()
         await runner.stop()
         os.unlink(instance_file)
+        if broker_dir is not None:
+            import shutil
+
+            shutil.rmtree(broker_dir, ignore_errors=True)
 
 
 async def _drive_e2e(runner, gateway, port, engine):
@@ -405,6 +422,7 @@ async def _drive_e2e(runner, gateway, port, engine):
         f"({CLIENTS} clients x {ROUNDS} rounds)"
     )
     return tok_s, {
+        "broker": BROKER,
         "raw_engine_tok_s": round(raw_tok_s, 1),
         "p50_rtt_ms": round(p50_rtt * 1e3, 1),
         "decode_ms_per_step": round(decode_time / steps * 1e3, 3),
