@@ -21,7 +21,7 @@ import json
 import logging
 import os
 import signal
-from typing import Any, Dict, Optional
+from typing import Any, Dict
 
 logger = logging.getLogger(__name__)
 

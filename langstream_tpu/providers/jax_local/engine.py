@@ -47,7 +47,6 @@ from langstream_tpu.ops.rope import rope_frequencies
 from langstream_tpu.parallel.mesh import (
     MeshConfig,
     build_mesh,
-    logical_to_physical,
     param_shardings,
     shard_params,
     validate_mesh,
