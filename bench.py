@@ -416,6 +416,9 @@ async def _drive_e2e(runner, gateway, port, engine):
         f"{stats['warm_prefill_calls']} warm, {stats['prefill_time']:.2f}s "
         f"engine-thread stall (dispatch+harvest; device work overlaps "
         f"decode)\n"
+        f"  prefix cache: {stats['prefix_hits']} cross-slot hits, "
+        f"{stats['prefix_tokens_reused']} KV rows reused "
+        f"(+{stats['session_hits']} session hits)\n"
         f"  engine thread: idle {stats['idle_time']:.2f}s, "
         f"host emit {stats['emit_time']:.2f}s\n"
         f"  p50 RTT {p50_rtt * 1e3:.0f} ms over {len(rtts)} requests "

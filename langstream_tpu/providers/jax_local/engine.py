@@ -219,6 +219,7 @@ class DecodeEngine:
         seed: int = 0,
         quantize: Optional[str] = None,  # "int8" = weight-only int8
         pipeline_decode: bool = False,
+        prefix_cache: bool = True,
     ) -> None:
         self.config = config
         self.max_slots = max_slots
@@ -229,6 +230,12 @@ class DecodeEngine:
         # to one surplus chunk; results are epoch-guarded so a recycled
         # slot never receives the old request's tokens.
         self.pipeline_decode = pipeline_decode
+        # cross-slot prompt-prefix reuse: a cold request whose prompt
+        # shares a prefix with another live slot's cache copies those KV
+        # rows on-device (bandwidth-bound) instead of recomputing the
+        # prefill (FLOPs-bound), then prefills only the divergent suffix.
+        # Covers n>1 choices, shared chat templates, and repeated prompts.
+        self.prefix_cache = prefix_cache
         self.max_seq_len = min(
             max_seq_len or config.max_seq_len, config.max_seq_len
         )
@@ -299,6 +306,7 @@ class DecodeEngine:
         self._compiled_prefill: Dict[int, Any] = {}
         self._prefill_offset_fns: Dict[int, Any] = {}
         self._decode_fns: Dict[int, Any] = {}
+        self._copy_fns: Dict[int, Any] = {}
         # prefill dispatches whose first tokens are not yet harvested
         # (FIFO — the device executes dispatches in order)
         self._prefill_inflight: List[Dict[str, Any]] = []
@@ -319,6 +327,8 @@ class DecodeEngine:
             "warm_prefill_calls": 0,
             "decode_steps": 0,
             "session_hits": 0,
+            "prefix_hits": 0,            # cross-slot prefix-copy admissions
+            "prefix_tokens_reused": 0,   # KV rows copied instead of recomputed
             "decode_chunks": 0,
             "decode_time": 0.0,      # wall secs inside decode dispatches
             "prefill_time": 0.0,     # wall secs inside prefill dispatches
@@ -470,6 +480,64 @@ class DecodeEngine:
             self._decode_fns[steps] = fn
         return fn
 
+    def _get_copy_prefix(self, bucket: int):
+        """Jitted cross-slot KV copy: move ``bucket`` cache rows starting
+        at ``offset`` from slot ``src`` to slot ``dst``. Pure device-side
+        data movement — for a B-token prefix this reads+writes
+        ``B * layers * kv_heads * head_dim * 2`` elements (a few MB),
+        orders of magnitude cheaper than recomputing the prefill.
+        ``params`` is unused; it keeps the (params, cache, ...) argument
+        shape every other engine dispatch has, so :meth:`precompile` can
+        drive all variants uniformly."""
+        fn = self._copy_fns.get(bucket)
+        if fn is None:
+
+            @functools.partial(jax.jit, donate_argnums=(1,))
+            def run(params, cache, src, dst, offset):
+                del params
+
+                def move(c):
+                    layers, _, _, kv_heads, head_dim = c.shape
+                    chunk = jax.lax.dynamic_slice(
+                        c, (0, src, offset, 0, 0),
+                        (layers, 1, bucket, kv_heads, head_dim),
+                    )
+                    return jax.lax.dynamic_update_slice(
+                        c, chunk, (0, dst, offset, 0, 0)
+                    )
+
+                return (jax.tree_util.tree_map(move, cache),)
+
+            fn = run
+            self._copy_fns[bucket] = fn
+        return fn
+
+    def _dispatch_prefix_copy(self, src: int, dst: int, length: int) -> None:
+        """Copy cache rows [0:length) of ``src`` into ``dst`` in
+        bucket-sized windows. Windows may overshoot the exact length:
+        rows past the shared prefix are either overwritten by the
+        suffix prefill or masked by the slot's length, and decode writes
+        a row before ever attending to it — so no masking is needed."""
+        largest = self.prefill_buckets[-1]
+        position = 0
+        while position < length:
+            remaining = length - position
+            bucket = (
+                largest if remaining > largest
+                else _bucket(remaining, self.prefill_buckets)
+            )
+            run = self._get_copy_prefix(bucket)
+            (self.cache,) = run(
+                self.params,
+                self.cache,
+                jnp.asarray(src, dtype=jnp.int32),
+                jnp.asarray(dst, dtype=jnp.int32),
+                jnp.asarray(position, dtype=jnp.int32),
+            )
+            position += bucket
+        self.stats["prefix_hits"] += 1
+        self.stats["prefix_tokens_reused"] += length
+
     def _variant_jobs(self) -> List[Tuple[Any, Tuple[Any, ...]]]:
         """One (jit fn, arg avals) entry per prefill/decode variant the
         engine can ever dispatch — the single source both precompile
@@ -513,6 +581,12 @@ class DecodeEngine:
                     vec(size, jnp.int32), counts_aval, *sampling,
                 )))
             size *= 2
+        if self.prefix_cache:
+            scalar = jax.ShapeDtypeStruct((), jnp.int32)
+            for bucket in self.prefill_buckets:
+                jobs.append((self._get_copy_prefix(bucket), (
+                    params_aval, cache_aval, scalar, scalar, scalar,
+                )))
         slots = self.max_slots
         for steps in {self.decode_chunk, 1}:
             jobs.append((self._get_decode(steps), (
@@ -770,19 +844,29 @@ class DecodeEngine:
                 return i
         return None
 
-    def _find_slot(self, request: GenerationRequest) -> Optional[int]:
+    def _find_slot(
+        self, request: GenerationRequest, exclude: frozenset = frozenset()
+    ) -> Optional[int]:
+        """``exclude`` protects slots serving as cross-slot prefix-copy
+        sources this admission round: their rows must stay intact until
+        the copies dispatch (after the cold batch), so they cannot be
+        handed out or evicted in the same round."""
         # session hit first
         warm = self._find_warm_slot(request)
         if warm is not None:
             return warm
         for i, slot in enumerate(self.slots):
-            if not slot.active and slot.session_id is None:
+            if (
+                not slot.active
+                and slot.session_id is None
+                and i not in exclude
+            ):
                 return i
         # evict the least-recently USED pinned session (a hot session's
         # warm cache survives slot pressure; the stalest one pays)
         victim: Optional[int] = None
         for i, slot in enumerate(self.slots):
-            if not slot.active and (
+            if not slot.active and i not in exclude and (
                 victim is None
                 or slot.last_used < self.slots[victim].last_used
             ):
@@ -819,10 +903,7 @@ class DecodeEngine:
             and slot.history
         ):
             return None
-        limit = min(len(slot.history), len(prompt))
-        lcp = 0
-        while lcp < limit and prompt[lcp] == slot.history[lcp]:
-            lcp += 1
+        lcp = self._lcp(prompt, slot.history)
         if lcp == len(prompt):
             # the prompt is entirely inside the cache: re-prefill the
             # last token so fresh logits exist for the first sample
@@ -833,6 +914,73 @@ class DecodeEngine:
         if not full_extension and lcp < self.WARM_MIN_PREFIX:
             return None
         return lcp
+
+    @staticmethod
+    def _lcp(a: List[int], b: List[int]) -> int:
+        """Longest common prefix of two token lists (chunked slice
+        compares so the common case runs at C speed)."""
+        limit = min(len(a), len(b))
+        lcp = 0
+        while lcp < limit:
+            n = min(64, limit - lcp)
+            if a[lcp:lcp + n] == b[lcp:lcp + n]:
+                lcp += n
+                continue
+            while lcp < limit and a[lcp] == b[lcp]:
+                lcp += 1
+            break
+        return lcp
+
+    def _find_prefix_source(
+        self,
+        request: GenerationRequest,
+        cold_reserved: frozenset,
+        warm_reserved: frozenset,
+    ) -> Optional[Tuple[int, int, bool]]:
+        """Best cross-slot prefix source for a sessionless-cold request:
+        the slot whose cache holds the longest common prefix with the
+        prompt. Returns (source slot, lcp, in_round) or None.
+
+        Eligible sources, by dispatch-ordering safety:
+        - this round's cold reservations (``in_round=True``) — their
+          prefill batch dispatches BEFORE the copies, and their
+          prompt is known from the reserved request (this is what makes
+          n>1 choices submitted together share one prefill);
+        - slots with ``history`` set and no undispatched reservation:
+          decoding slots (decode writes only at positions ≥ length),
+          prefilling slots (their prefill is already dispatched), and
+          idle pinned sessions (protected from same-round eviction via
+          ``_find_slot``'s exclude set).
+        Warm reservations are skipped: their cache is mid-transition."""
+        prompt = request.prompt_tokens
+        best: Optional[Tuple[int, int, bool]] = None
+        for i, slot in enumerate(self.slots):
+            if i in cold_reserved:
+                history = slot.request.prompt_tokens if slot.request else None
+                in_round = True
+            elif i in warm_reserved:
+                continue
+            else:
+                history = slot.history
+                in_round = False
+            if not history:
+                continue
+            lcp = self._lcp(prompt, history)
+            if not in_round:
+                # an ACTIVE slot's newest history token has no KV row
+                # yet — it is written by the NEXT decode dispatch (the
+                # finish path trims history[:length] for the same
+                # reason); only rows [0:length) are copyable
+                lcp = min(lcp, slot.length)
+            if lcp == len(prompt):
+                # re-prefill the last token so fresh logits exist for
+                # the first sample (same rule as the session-warm path)
+                lcp = len(prompt) - 1
+            if lcp < self.WARM_MIN_PREFIX:
+                continue
+            if best is None or lcp > best[1]:
+                best = (i, lcp, in_round)
+        return best
 
     def _admit(self) -> None:
         """Move pending requests into slots. Cold requests sharing a prompt
@@ -855,6 +1003,21 @@ class DecodeEngine:
             cold_bucket: Optional[int] = None
             # suffix bucket -> [(slot index, request, reused prefix len)]
             warm: Dict[int, List[Tuple[int, GenerationRequest, int]]] = {}
+            # cross-slot prefix copies this round: (src, dst, lcp).
+            # When copies exist, round-end dispatch order is cold batch
+            # -> copies -> long-warm -> warm suffix prefills, so a copy
+            # always reads rows whose writes are already dispatched and
+            # never rows a warm prefill is about to overwrite. Without
+            # copies the old warm-first order is kept (better warm TTFT).
+            copies: List[Tuple[int, int, int]] = []
+            # session follow-ups with chunked (long) suffixes; deferred
+            # to round end for the same reason — an inline dispatch
+            # could overwrite a source's rows before a queued copy reads
+            # them
+            long_warm: List[Tuple[int, GenerationRequest, int]] = []
+            sources: set = set()        # slots protected from eviction
+            cold_reserved: set = set()  # this round's cold slot indices
+            warm_reserved: set = set()  # this round's warm slot indices
             progressed = False
             while self._pending:
                 # admit warm-eligible requests FIRST: a strictly-FIFO
@@ -879,7 +1042,7 @@ class DecodeEngine:
                             break
                 request = self._pending[position]
                 if index is None:
-                    index = self._find_slot(request)
+                    index = self._find_slot(request, frozenset(sources))
                     if index is not None:
                         reused = self._session_warm(index, request)
                 if index is None:
@@ -893,6 +1056,8 @@ class DecodeEngine:
                     suffix_bucket = _bucket(suffix, self.prefill_buckets)
                     self._pending.pop(position)
                     slot.request = request  # reserve the slot
+                    self.stats["session_hits"] += 1
+                    warm_reserved.add(index)
                     if (
                         suffix > largest
                         or reused + suffix_bucket > self.max_seq_len
@@ -900,21 +1065,77 @@ class DecodeEngine:
                         # too big for one batched window, or a window at
                         # the reused offset would clamp past max_seq_len
                         # — the chunked path's overlap-shifted tail
-                        # handles both
-                        self._prefill_long(index, request, reused)
-                        progressed = True
+                        # handles both (dispatched at round end)
+                        long_warm.append((index, request, reused))
                         continue
                     warm.setdefault(suffix_bucket, []).append(
                         (index, request, reused)
                     )
                     continue
-                if len(request.prompt_tokens) > largest:
+                prompt_len = len(request.prompt_tokens)
+                if self.prefix_cache:
+                    found = self._find_prefix_source(
+                        request,
+                        frozenset(cold_reserved),
+                        frozenset(warm_reserved),
+                    )
+                else:
+                    found = None
+                if found is not None:
+                    src, lcp, in_round = found
+                    suffix = prompt_len - lcp
+                    suffix_bucket = _bucket(suffix, self.prefill_buckets)
+                    needs_long = (
+                        suffix > largest
+                        or lcp + suffix_bucket > self.max_seq_len
+                    )
+                    if src == index:
+                        # the chosen slot itself holds the prefix (e.g.
+                        # an evicted session's cache salvaged by a new
+                        # request with the same template): rows already
+                        # in place, no copy
+                        self._pending.pop(position)
+                        self.slots[index].request = request
+                        self.stats["prefix_hits"] += 1
+                        self.stats["prefix_tokens_reused"] += lcp
+                        if needs_long:
+                            self._prefill_long(index, request, lcp)
+                            progressed = True
+                        else:
+                            warm.setdefault(suffix_bucket, []).append(
+                                (index, request, lcp)
+                            )
+                            warm_reserved.add(index)
+                        continue
+                    if needs_long and not in_round:
+                        # chunked suffix dispatches inline, so the copy
+                        # must too (the source's rows are all from
+                        # already-dispatched work — safe to read now)
+                        self._pending.pop(position)
+                        self.slots[index].request = request
+                        self._dispatch_prefix_copy(src, index, lcp)
+                        self._prefill_long(index, request, lcp)
+                        progressed = True
+                        continue
+                    if not needs_long:
+                        self._pending.pop(position)
+                        self.slots[index].request = request
+                        copies.append((src, index, lcp))
+                        sources.add(src)
+                        warm.setdefault(suffix_bucket, []).append(
+                            (index, request, lcp)
+                        )
+                        warm_reserved.add(index)
+                        continue
+                    # needs_long with an in-round source: the source's
+                    # prefill hasn't dispatched yet — fall through cold
+                if prompt_len > largest:
                     self._pending.pop(position)
                     self.slots[index].request = request  # reserve the slot
                     self._prefill_long(index, request, 0)
                     progressed = True
                     continue
-                bucket = _bucket(len(request.prompt_tokens), self.prefill_buckets)
+                bucket = _bucket(prompt_len, self.prefill_buckets)
                 if cold_bucket is None:
                     cold_bucket = bucket
                 elif bucket != cold_bucket:
@@ -922,15 +1143,38 @@ class DecodeEngine:
                 self._pending.pop(position)
                 self.slots[index].request = request  # reserve the slot
                 cold.append((index, request))
+                cold_reserved.add(index)
                 # batch caps at the largest power of two ≤ max_slots
                 if len(cold) >= self.max_slots:
                     break
-            for suffix_bucket, batch in warm.items():
-                self._prefill_warm_batch(batch, suffix_bucket)
-                progressed = True
-            if cold:
-                self._prefill_batch(cold, cold_bucket)
-                progressed = True
+            if copies:
+                # cold batch FIRST so same-round copies can source from
+                # it, then the copies, then every warm suffix prefill
+                # (which overwrites rows past each slot's reused point —
+                # including, for long_warm, rows a copy may have read)
+                if cold:
+                    self._prefill_batch(cold, cold_bucket)
+                    progressed = True
+                for src, dst, lcp in copies:
+                    self._dispatch_prefix_copy(src, dst, lcp)
+                for index, request, reused in long_warm:
+                    self._prefill_long(index, request, reused)
+                    progressed = True
+                for suffix_bucket, batch in warm.items():
+                    self._prefill_warm_batch(batch, suffix_bucket)
+                    progressed = True
+            else:
+                # no ordering constraint: keep warm-first (lower warm
+                # TTFT — a warm suffix is much cheaper than a cold batch)
+                for index, request, reused in long_warm:
+                    self._prefill_long(index, request, reused)
+                    progressed = True
+                for suffix_bucket, batch in warm.items():
+                    self._prefill_warm_batch(batch, suffix_bucket)
+                    progressed = True
+                if cold:
+                    self._prefill_batch(cold, cold_bucket)
+                    progressed = True
             if not progressed:
                 return
 
@@ -1085,7 +1329,6 @@ class DecodeEngine:
                 lengths[row] = len(suffix)
                 offsets[row] = reused
                 slot_ids[row] = index
-                self.stats["session_hits"] += 1
                 self._assign_slot(index, request)
                 self.slots[index].prefilling = True
             run = self._get_prefill_offset(bucket)
@@ -1130,8 +1373,6 @@ class DecodeEngine:
         prompt = request.prompt_tokens
         total = len(prompt)
         largest = self.prefill_buckets[-1]
-        if reused > 0:
-            self.stats["session_hits"] += 1
         self._assign_slot(index, request)
         self.slots[index].prefilling = True
         windows: List[Tuple[int, int]] = []  # (offset, bucket)
@@ -1394,6 +1635,12 @@ class DecodeEngine:
             slot.last_used = time.monotonic()
             # keep only the history that is actually IN the cache (the
             # final sampled token is never written before finish)
+            slot.history = slot.history[: slot.length]
+        elif self.prefix_cache:
+            # sessionless: the slot is fully free, but keep the (trimmed)
+            # token history so later traffic sharing a template prefix
+            # can cross-slot copy the rows instead of re-prefilling
+            slot.session_id = None
             slot.history = slot.history[: slot.length]
         else:
             slot.session_id = None

@@ -151,6 +151,9 @@ class JaxCompletionsService(CompletionsService):
             pipeline_decode=str(
                 engine_config.get("pipeline-decode", "")
             ).lower() in ("1", "true", "yes"),
+            prefix_cache=str(
+                engine_config.get("prefix-cache", "true")
+            ).lower() not in ("0", "false", "no"),
         )
         if str(engine_config.get("precompile", "")).lower() in (
             "1", "true", "yes",

@@ -420,9 +420,17 @@ def test_engine_fuzz_interleavings():
         )
         engine.start()
 
+        # a few shared templates so the cross-slot prefix cache (copies,
+        # salvage, same-round duplicates) races sessions/cancellations
+        templates = [
+            [(t * 31 + j) % 250 + 1 for j in range(24)] for t in range(3)
+        ]
+
         async def one(i):
             length = rng.choice([3, 9, 20, 40, 90])  # 40/90 > bucket 32
             prompt = [(i * 13 + j) % 250 + 1 for j in range(length)]
+            if rng.random() < 0.4:
+                prompt = templates[i % 3] + prompt[: max(length - 24, 2)]
             sampling = SamplingParams(
                 temperature=rng.choice([0.0, 0.0, 0.9]),
                 top_k=rng.choice([0, 5]),
@@ -1109,6 +1117,257 @@ def test_partial_prefix_session_reuse_matches_cold():
             cold_engine.stop()
             assert warm.tokens == cold.tokens
             assert first.tokens  # sanity
+        finally:
+            engine.stop()
+
+    asyncio.run(main())
+
+
+def test_cross_slot_prefix_copy_from_pinned_session():
+    """A sessionless request whose prompt shares a long prefix with a
+    DIFFERENT slot's pinned session copies the KV rows on-device instead
+    of re-prefilling; greedy tokens must match a prefix-cache-disabled
+    engine."""
+    config = LlamaConfig.tiny(max_seq_len=256)
+    params = init_params(config)
+    shared = [(5 * i) % 250 + 1 for i in range(40)]
+    first = shared + [7, 8]
+    second = shared + [9, 10, 11]  # diverges after the shared prefix
+    sampling = SamplingParams(max_new_tokens=6)
+
+    async def run(prefix_cache):
+        engine = DecodeEngine(
+            config, params, max_slots=4, max_seq_len=256,
+            prefill_buckets=[16, 32, 64], prefix_cache=prefix_cache,
+        )
+        engine.start()
+        try:
+            r1 = await engine.generate(first, sampling, session_id="pin")
+            r2 = await engine.generate(second, sampling)
+            return (r1.tokens, r2.tokens), dict(engine.stats)
+        finally:
+            engine.stop()
+
+    cold_out, cold_stats = asyncio.run(run(False))
+    out, stats = asyncio.run(run(True))
+    assert out == cold_out
+    assert cold_stats["prefix_hits"] == 0
+    # the pinned session sits in another slot -> real cross-slot copy
+    assert stats["prefix_hits"] == 1
+    assert stats["prefix_tokens_reused"] >= 40
+    assert stats["prefill_calls"] == cold_stats["prefill_calls"] - 1
+
+
+def test_prefix_salvage_from_finished_sessionless_slot():
+    """Sessionless slots retain their trimmed history at finish; a later
+    request with the same template prefix salvages those rows (same-slot,
+    no copy) or copies them, instead of a cold prefill."""
+    config = LlamaConfig.tiny(max_seq_len=256)
+    params = init_params(config)
+    shared = [(3 * i) % 250 + 1 for i in range(32)]
+    sampling = SamplingParams(max_new_tokens=5)
+
+    async def main():
+        engine = DecodeEngine(
+            config, params, max_slots=2, max_seq_len=256,
+            prefill_buckets=[16, 32, 64],
+        )
+        engine.start()
+        try:
+            r1 = await engine.generate(shared + [1, 2], sampling)
+            r2 = await engine.generate(shared + [3, 4, 5], sampling)
+            assert engine.stats["prefix_hits"] == 1
+            assert engine.stats["prefix_tokens_reused"] >= 32
+            cold_engine = DecodeEngine(
+                config, params, max_slots=2, max_seq_len=256,
+                prefill_buckets=[16, 32, 64], prefix_cache=False,
+            )
+            cold_engine.start()
+            try:
+                c1 = await cold_engine.generate(shared + [1, 2], sampling)
+                c2 = await cold_engine.generate(shared + [3, 4, 5], sampling)
+            finally:
+                cold_engine.stop()
+            assert r1.tokens == c1.tokens
+            assert r2.tokens == c2.tokens
+        finally:
+            engine.stop()
+
+    asyncio.run(main())
+
+
+def test_same_batch_duplicate_prompts_share_one_prefill():
+    """k identical prompts submitted together (the n>1 choices shape):
+    one cold prefill, the rest reuse its rows via same-round cross-slot
+    copies — and every choice still decodes the cold-engine tokens."""
+    config = LlamaConfig.tiny(max_seq_len=256)
+    params = init_params(config)
+    prompt = [(7 * i) % 250 + 1 for i in range(24)]
+    sampling = SamplingParams(max_new_tokens=6)
+
+    async def run(prefix_cache):
+        engine = DecodeEngine(
+            config, params, max_slots=4, max_seq_len=256,
+            prefill_buckets=[16, 32, 64], prefix_cache=prefix_cache,
+        )
+        engine.start()
+        try:
+            results = await asyncio.gather(
+                *[engine.generate(prompt, sampling) for _ in range(3)]
+            )
+            return [r.tokens for r in results], dict(engine.stats)
+        finally:
+            engine.stop()
+
+    cold_out, _ = asyncio.run(run(False))
+    out, stats = asyncio.run(run(True))
+    assert out == cold_out
+    # at least the followers admitted after the first dispatch reuse it;
+    # same-round batching may catch all three in one admission round
+    assert stats["prefix_hits"] >= 2
+    assert stats["prefill_calls"] + stats["warm_prefill_calls"] <= 3
+
+
+def test_cross_slot_long_suffix_inline_copy():
+    """Cross-slot reuse where the divergent suffix exceeds the largest
+    bucket: the copy dispatches inline and the suffix takes the chunked
+    prefill-at-offset path; tokens match the disabled-cache engine."""
+    config = LlamaConfig.tiny(max_seq_len=512)
+    params = init_params(config)
+    shared = [(11 * i) % 250 + 1 for i in range(100)]
+    long_tail = [(13 * i) % 250 + 1 for i in range(80)]  # > largest bucket
+    sampling = SamplingParams(max_new_tokens=5)
+
+    async def run(prefix_cache):
+        engine = DecodeEngine(
+            config, params, max_slots=4, max_seq_len=512,
+            prefill_buckets=[16, 32, 64], prefix_cache=prefix_cache,
+        )
+        engine.start()
+        try:
+            r1 = await engine.generate(shared, sampling, session_id="pin")
+            r2 = await engine.generate(shared[:90] + long_tail, sampling)
+            return (r1.tokens, r2.tokens), dict(engine.stats)
+        finally:
+            engine.stop()
+
+    cold_out, _ = asyncio.run(run(False))
+    out, stats = asyncio.run(run(True))
+    assert out == cold_out
+    assert stats["prefix_hits"] == 1
+    assert stats["prefix_tokens_reused"] >= 90
+
+
+def test_prefix_reuse_stress_parity():
+    """Sessionless template-sharing requests racing session follow-ups
+    (including chunked long suffixes on slots other requests are copying
+    from): every greedy result must equal a solo run on a
+    prefix-cache-disabled engine. Guards the copy/warm dispatch-ordering
+    invariant (a copy must never read rows a same-round warm prefill
+    overwrites)."""
+    config = LlamaConfig.tiny(max_seq_len=256)
+    params = init_params(config)
+    template = [(17 * j) % 250 + 1 for j in range(30)]
+    sampling = SamplingParams(max_new_tokens=6)
+
+    def prompt(i):
+        if i % 2 == 0:  # sessionless template sharer (copier)
+            return template + [(i * 7 + j) % 250 + 1 for j in range(4)]
+        # session traffic; every other one gets a long divergent suffix
+        tail = 70 if i % 4 == 3 else 6
+        return template[:20] + [(i * 11 + j) % 250 + 1 for j in range(tail)]
+
+    def session(i):
+        return None if i % 2 == 0 else f"sess-{i % 5}"
+
+    async def main():
+        engine = DecodeEngine(
+            config, params, max_slots=3, max_seq_len=256,
+            prefill_buckets=[16, 32, 64], decode_chunk=4,
+            pipeline_decode=True,
+        )
+        engine.start()
+
+        async def late(i):
+            await asyncio.sleep(0.003 * (i % 7))
+            return await engine.generate(prompt(i), sampling,
+                                         session_id=session(i))
+
+        try:
+            results = await asyncio.gather(*[late(i) for i in range(20)])
+            assert engine.stats["prefix_hits"] >= 1  # the path actually ran
+        finally:
+            engine.stop()
+        solo = DecodeEngine(
+            config, params, max_slots=3, max_seq_len=256,
+            prefill_buckets=[16, 32, 64], decode_chunk=4,
+            prefix_cache=False,
+        )
+        solo.start()
+        try:
+            for i in range(20):
+                expected = await solo.generate(prompt(i), sampling)
+                assert results[i].tokens == expected.tokens, f"request {i}"
+        finally:
+            solo.stop()
+
+    asyncio.run(main())
+
+
+def test_prefix_copy_from_actively_decoding_slot():
+    """A stateless continuation that resends a decoding slot's
+    prompt+partial answer: the copy must cap at the slot's written rows
+    (the newest history token's KV row is only written by the NEXT
+    decode dispatch). Greedy parity against a prefix-cache-disabled
+    engine catches any unwritten-row copy."""
+    config = LlamaConfig.tiny(max_seq_len=256)
+    params = init_params(config)
+    prompt_a = [(19 * j) % 250 + 1 for j in range(24)]
+    kwargs = dict(
+        max_slots=4, max_seq_len=256, prefill_buckets=[16, 32, 64],
+        decode_chunk=1,
+    )
+
+    async def main():
+        solo = DecodeEngine(config, params, prefix_cache=False, **kwargs)
+        solo.start()
+        try:
+            a_ref = await solo.generate(
+                prompt_a, SamplingParams(max_new_tokens=24)
+            )
+            prompt_b = prompt_a + a_ref.tokens  # extends A's full history
+            b_ref = await solo.generate(
+                prompt_b, SamplingParams(max_new_tokens=6)
+            )
+        finally:
+            solo.stop()
+
+        engine = DecodeEngine(config, params, **kwargs)
+        engine.start()
+        try:
+            streamed = asyncio.Event()
+            seen = 0
+
+            def on_token(token, last):
+                nonlocal seen
+                seen += 1
+                if seen >= 4:
+                    streamed.set()
+
+            a_task = asyncio.ensure_future(engine.generate(
+                prompt_a, SamplingParams(max_new_tokens=24),
+                on_token=on_token,
+            ))
+            await asyncio.wait_for(streamed.wait(), timeout=60)
+            # B admits while A is still decoding; its prompt extends A's
+            # history past the written rows
+            b = await engine.generate(
+                prompt_b, SamplingParams(max_new_tokens=6)
+            )
+            a = await a_task
+            assert a.tokens == a_ref.tokens
+            assert b.tokens == b_ref.tokens
+            assert engine.stats["prefix_hits"] >= 1
         finally:
             engine.stop()
 
