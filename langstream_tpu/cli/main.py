@@ -580,6 +580,22 @@ def build_parser() -> argparse.ArgumentParser:
     serve.add_argument("--embeddings-checkpoint", default=None)
     serve.add_argument("--host", default="0.0.0.0")
     serve.add_argument("--port", type=int, default=8000)
+    # multi-host SPMD serving (tp spanning hosts): host 0 serves HTTP
+    # and mirrors every dispatch; followers replay the stream on their
+    # shard of the global mesh. jax.distributed comes up first either
+    # way (runtime/multihost.py plan, or LANGSTREAM_* env on pods).
+    serve.add_argument(
+        "--followers", type=int, default=0,
+        help="leader: number of follower hosts to wait for",
+    )
+    serve.add_argument(
+        "--mirror-port", type=int, default=8477,
+        help="leader: port the dispatch mirror listens on",
+    )
+    serve.add_argument(
+        "--follower-of", default=None, metavar="HOST:PORT",
+        help="run as a follower replaying the leader's dispatch stream",
+    )
 
     python_cmd = sub.add_parser(
         "python", help="application Python dependency tooling"

@@ -204,6 +204,12 @@ async def serve_main(args) -> None:
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
+    # multi-host slice: bring up jax.distributed from StatefulSet/env
+    # identity before any device access, so the global mesh spans hosts
+    from langstream_tpu.runtime.multihost import initialize_multihost
+
+    initialize_multihost()
+
     from langstream_tpu.providers.jax_local.provider import (
         JaxCompletionsService,
         JaxEmbeddingsService,
@@ -245,6 +251,34 @@ async def serve_main(args) -> None:
     if args.tp and args.tp > 1:
         config["mesh"] = {"tp": args.tp}
     completions = JaxCompletionsService(config)
+    if getattr(args, "follower_of", None):
+        # follower host of a multi-host replica: no HTTP surface — just
+        # replay the leader's dispatch stream on this process's shard
+        from langstream_tpu.serving.mirror import FollowerExecutor
+
+        completions.engine.stop()  # executor owns the dispatches
+        leader_host, _, leader_port = args.follower_of.rpartition(":")
+        executor = FollowerExecutor(completions.engine)
+        executor.connect(leader_host or "127.0.0.1", int(leader_port))
+        print(
+            f"follower: replaying dispatch stream from {args.follower_of}",
+            flush=True,
+        )
+        records = await asyncio.to_thread(executor.run)
+        print(f"follower: stream ended after {records} records", flush=True)
+        return
+    mirror = None
+    if getattr(args, "followers", 0):
+        from langstream_tpu.serving.mirror import DispatchMirror
+
+        mirror = DispatchMirror(host=args.host, port=args.mirror_port)
+        print(
+            f"mirror: waiting for {args.followers} follower(s) "
+            f"on :{mirror.port}",
+            flush=True,
+        )
+        await asyncio.to_thread(mirror.wait_for_followers, args.followers)
+        completions.engine.mirror = mirror
     embeddings = None
     if args.embeddings_checkpoint:
         embeddings = JaxEmbeddingsService(

@@ -316,6 +316,11 @@ class DecodeEngine:
         # per-chunk dispatch log: (steps, active_slots, wall_seconds) —
         # the occupancy/step-time evidence the bench prints (bounded)
         self.chunk_log: List[Tuple[int, int, float]] = []
+        # multi-host SPMD serving: when set (serving/mirror.py), every
+        # device dispatch is also published as a compact record so
+        # follower hosts replay the identical jit sequence on their
+        # shards of the same global mesh
+        self.mirror: Optional[Any] = None
         _LIVE_ENGINES.add(self)
 
     @staticmethod
@@ -526,13 +531,17 @@ class DecodeEngine:
                 largest if remaining > largest
                 else _bucket(remaining, self.prefill_buckets)
             )
+            if self.mirror is not None:
+                self.mirror.publish("copy", {"bucket": bucket}, [
+                    np.int32(src), np.int32(dst), np.int32(position),
+                ])
             run = self._get_copy_prefix(bucket)
             (self.cache,) = run(
                 self.params,
                 self.cache,
-                jnp.asarray(src, dtype=jnp.int32),
-                jnp.asarray(dst, dtype=jnp.int32),
-                jnp.asarray(position, dtype=jnp.int32),
+                np.int32(src),
+                np.int32(dst),
+                np.int32(position),
             )
             position += bucket
         self.stats["prefix_hits"] += 1
@@ -684,6 +693,13 @@ class DecodeEngine:
         if self._thread is not None:
             self._thread.join(timeout=30)
             self._thread = None
+        if self.mirror is not None:
+            try:
+                self.mirror.publish("stop", {}, [])
+            except Exception:
+                # writer already dead (follower dropped) — still close
+                logger.warning("mirror: stop record not delivered")
+            self.mirror.close()
 
     def submit(self, request: GenerationRequest) -> None:
         if self._crashed is not None:
@@ -1218,16 +1234,18 @@ class DecodeEngine:
         return assigned
 
     def _sampling_arrays(self, requests: List[GenerationRequest]):
+        # numpy on purpose: jit dispatch converts implicitly, and the
+        # multi-host mirror can serialize the arrays without a D2H sync
         return (
-            jnp.asarray(
-                [r.sampling.temperature for r in requests], dtype=jnp.float32
+            np.asarray(
+                [r.sampling.temperature for r in requests], dtype=np.float32
             ),
-            jnp.asarray([r.sampling.top_k for r in requests], dtype=jnp.int32),
-            jnp.asarray(
-                [r.sampling.top_p for r in requests], dtype=jnp.float32
+            np.asarray([r.sampling.top_k for r in requests], dtype=np.int32),
+            np.asarray(
+                [r.sampling.top_p for r in requests], dtype=np.float32
             ),
-            jnp.asarray(
-                [self._request_seed(r) for r in requests], dtype=jnp.uint32
+            np.asarray(
+                [self._request_seed(r) for r in requests], dtype=np.uint32
             ),
         )
 
@@ -1238,7 +1256,7 @@ class DecodeEngine:
             if slot.active:
                 presence[i] = slot.request.sampling.presence_penalty
                 frequency[i] = slot.request.sampling.frequency_penalty
-        return jnp.asarray(presence), jnp.asarray(frequency)
+        return presence, frequency
 
     def _bias_rows(self, requests: List[Optional[GenerationRequest]]):
         """[len(requests), MAX_LOGIT_BIAS] (ids, values) for logit_bias;
@@ -1259,7 +1277,7 @@ class DecodeEngine:
             for column, (token, value) in enumerate(valid[:k]):
                 ids[row, column] = token
                 values[row, column] = value
-        return jnp.asarray(ids), jnp.asarray(values)
+        return ids, values
 
     def _prefill_batch(
         self, batch: List[Tuple[int, GenerationRequest]], bucket: int
@@ -1287,14 +1305,17 @@ class DecodeEngine:
             bias_ids, bias_vals = self._bias_rows(
                 [request for _, request in group]
             )
-            self.cache, self._counts, sampled, lps = run(
-                self.params,
-                self.cache,
-                jnp.asarray(tokens),
-                jnp.asarray(lengths),
-                jnp.asarray(slot_ids),
-                self._counts,
+            # ONE host-args list feeds both the mirror record and the
+            # dispatch, so the replayed argument order cannot drift
+            host_args = [
+                tokens, lengths, slot_ids,
                 temperature, top_k, top_p, seeds, bias_ids, bias_vals,
+            ]
+            if self.mirror is not None:
+                self.mirror.publish("prefill", {"bucket": bucket}, host_args)
+            self.cache, self._counts, sampled, lps = run(
+                self.params, self.cache, *host_args[:3],
+                self._counts, *host_args[3:],
             )
             self.stats["prefill_calls"] += 1
             self.stats["prefill_time"] += time.perf_counter() - started
@@ -1338,15 +1359,17 @@ class DecodeEngine:
             bias_ids, bias_vals = self._bias_rows(
                 [request for _, request, _ in group]
             )
-            self.cache, self._counts, sampled, lps = run(
-                self.params,
-                self.cache,
-                jnp.asarray(tokens),
-                jnp.asarray(lengths),
-                jnp.asarray(offsets),
-                jnp.asarray(slot_ids),
-                self._counts,
+            host_args = [
+                tokens, lengths, offsets, slot_ids,
                 temperature, top_k, top_p, seeds, bias_ids, bias_vals,
+            ]
+            if self.mirror is not None:
+                self.mirror.publish(
+                    "prefill_offset", {"bucket": bucket}, host_args
+                )
+            self.cache, self._counts, sampled, lps = run(
+                self.params, self.cache, *host_args[:4],
+                self._counts, *host_args[4:],
             )
             self.stats["warm_prefill_calls"] += 1
             self.stats["prefill_time"] += time.perf_counter() - started
@@ -1390,16 +1413,21 @@ class DecodeEngine:
             chunk = prompt[offset:offset + bucket]
             tokens = np.zeros((1, bucket), dtype=np.int32)
             tokens[0, : len(chunk)] = chunk
+            lengths = np.asarray([len(chunk)], dtype=np.int32)
+            offsets = np.asarray([offset], dtype=np.int32)
+            slot_ids = np.asarray([index], dtype=np.int32)
             run = self._get_prefill_offset(bucket)
-            self.cache, self._counts, sampled, lps = run(
-                self.params,
-                self.cache,
-                jnp.asarray(tokens),
-                jnp.asarray([len(chunk)], dtype=jnp.int32),
-                jnp.asarray([offset], dtype=jnp.int32),
-                jnp.asarray([index], dtype=jnp.int32),
-                self._counts,
+            host_args = [
+                tokens, lengths, offsets, slot_ids,
                 temperature, top_k, top_p, seeds, bias_ids, bias_vals,
+            ]
+            if self.mirror is not None:
+                self.mirror.publish(
+                    "prefill_offset", {"bucket": bucket}, host_args
+                )
+            self.cache, self._counts, sampled, lps = run(
+                self.params, self.cache, *host_args[:4],
+                self._counts, *host_args[4:],
             )
             if step == len(windows) - 1:
                 # only the final window's sampled token is the real first
@@ -1476,6 +1504,11 @@ class DecodeEngine:
             lengths_arg = carry["final_lengths"]
             active_arg = carry["active_dev"]
             epochs = carry["epochs"]
+            if self.mirror is not None:
+                # followers chain from their OWN previous decode output
+                # (identical values — SPMD determinism), so the record
+                # carries no arrays
+                self.mirror.publish("decode_chained", {"steps": steps}, [])
         else:
             tokens = np.zeros((self.max_slots,), dtype=np.int32)
             lengths = np.zeros((self.max_slots,), dtype=np.int32)
@@ -1501,17 +1534,20 @@ class DecodeEngine:
                     # drop to single-step near the context boundary
                     if self.max_seq_len - slot.length - 1 < steps:
                         steps = 1
-            seeds = jnp.asarray(seeds_host)
+            seeds = seeds_host
             bias_ids, bias_vals = self._bias_rows(
                 [slot.request if slot.ready else None for slot in self.slots]
             )
-            temperature = jnp.asarray(temperature)
-            top_k = jnp.asarray(top_k)
-            top_p = jnp.asarray(top_p)
             presence, frequency = self._penalty_arrays(self.slots)
-            tokens_arg = jnp.asarray(tokens)
-            lengths_arg = jnp.asarray(lengths)
-            active_arg = jnp.asarray(active)
+            tokens_arg = tokens
+            lengths_arg = lengths
+            active_arg = active
+            if self.mirror is not None:
+                self.mirror.publish("decode", {"steps": steps}, [
+                    tokens, lengths, active,
+                    temperature, top_k, top_p, presence, frequency, seeds,
+                    bias_ids, bias_vals,
+                ])
         run = self._get_decode(steps)
         (
             self.cache, self._counts, out_tokens, out_lps,
@@ -1521,7 +1557,7 @@ class DecodeEngine:
             active_arg, active_arg, self._counts,
             temperature, top_k, top_p, presence, frequency, seeds,
             bias_ids, bias_vals,
-        )
+        )  # arg order mirrored by FollowerExecutor._decode — keep in sync
         return {
             "out_tokens": out_tokens,
             "out_lps": out_lps,
