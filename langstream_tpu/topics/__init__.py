@@ -99,6 +99,12 @@ def _make_pulsar(configuration: Dict[str, Any]) -> TopicConnectionsRuntime:
     return PulsarTopicConnectionsRuntime(configuration)
 
 
+def _make_pravega(configuration: Dict[str, Any]) -> TopicConnectionsRuntime:
+    from langstream_tpu.topics.pravega import PravegaTopicConnectionsRuntime
+
+    return PravegaTopicConnectionsRuntime(configuration)
+
+
 def _register_builtin() -> None:
     from langstream_tpu.topics.memory import MemoryTopicConnectionsRuntime
 
@@ -106,6 +112,7 @@ def _register_builtin() -> None:
     register_topic_runtime("tpulog", _make_tpulog)
     register_topic_runtime("kafka", _make_kafka)
     register_topic_runtime("pulsar", _make_pulsar)
+    register_topic_runtime("pravega", _make_pravega)
 
 
 _register_builtin()

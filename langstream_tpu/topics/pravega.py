@@ -1,0 +1,401 @@
+"""Pravega topic runtime over the official ``pravega`` Python client.
+
+Reference: ``langstream-pravega-runtime/src/main/java/ai/langstream/
+pravega/PravegaTopicConnectionsRuntimeProvider.java`` — a thin adapter
+over ``io.pravega.client``. Wire compatibility is kept exactly:
+
+- events are UTF-8 JSON strings shaped
+  ``{"key":…, "value":…, "headers":{name: value}, "timestamp": millis}``
+  (``RecordWrapper``, provider:505-508); the routing key is the record
+  key stringified (``serialiseKey``, provider:483-493). Values that are
+  bytes travel base64-encoded (what Jackson does with ``byte[]`` on the
+  Java side); no extra fields are added because the reference's record
+  deserializer rejects unknown properties.
+- consumers are reader groups named by the agent's group; Pravega's
+  reader-group position tracking owns redelivery, so ``commit`` is a
+  broker-side no-op — same contract as the reference, whose consumer
+  also issues no per-event acks.
+- readers use an ephemeral ``reader-<uuid>`` group (provider:112-115);
+  like the reference, recovering an absolute ``initialPosition`` is not
+  supported (its TODO at provider:118).
+- admin maps ``TopicSpec`` to create-scope + create-stream with fixed
+  scaling = partitions, and delete to seal + delete.
+
+The Pravega wire protocol is binary (protobuf gRPC controller + custom
+segment-store framing) with no offline spec, so unlike Kafka (where the
+framework implements the protocol from scratch) this runtime needs the
+client library: ``pip install pravega`` (Rust-native bindings). The
+module import-gates on it with a clear error; every piece of adapter
+logic (envelope codec, group naming, slice draining, admin mapping) is
+tested lib-free against an in-memory fake client (tests/pravega_mock.py).
+
+Config (``streamingCluster.configuration``), mirroring
+``PravegaClientUtils.java:37-82``:
+
+- ``client.controller-uri`` — default ``tcp://localhost:9090``
+- ``client.scope``          — default ``langstream``
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import dataclasses
+import json
+import logging
+import uuid
+from typing import Any, Dict, List, Optional
+
+from langstream_tpu.api.records import Record, now_millis
+from langstream_tpu.api.topics import (
+    OffsetPosition,
+    TopicAdmin,
+    TopicConnectionsRuntime,
+    TopicConsumer,
+    TopicProducer,
+    TopicReader,
+    TopicSpec,
+)
+
+logger = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------- #
+# envelope codec (RecordWrapper wire shape)
+# ---------------------------------------------------------------------- #
+def _jsonable(value: Any) -> Any:
+    if isinstance(value, bytes):
+        return base64.b64encode(value).decode()
+    return value
+
+
+def serialise_key(key: Any) -> Optional[str]:
+    """Routing key, reference ``serialiseKey``: None stays None,
+    strings/numbers stringify, anything else JSON-serializes."""
+    if key is None:
+        return None
+    if isinstance(key, bytes):
+        return base64.b64encode(key).decode()
+    if isinstance(key, (str, int, float, bool)):
+        return str(key)
+    return json.dumps(key)
+
+
+def encode_event(record: Record) -> str:
+    headers = {name: _jsonable(value) for name, value in (record.headers or [])}
+    return json.dumps({
+        "key": _jsonable(record.key),
+        "value": _jsonable(record.value),
+        "headers": headers,
+        "timestamp": record.timestamp or now_millis(),
+    })
+
+
+def decode_event(payload: str, topic: str) -> Record:
+    wrapper = json.loads(payload)
+    return Record(
+        key=wrapper.get("key"),
+        value=wrapper.get("value"),
+        headers=tuple(sorted((wrapper.get("headers") or {}).items())),
+        origin=topic,
+        timestamp=wrapper.get("timestamp"),
+    )
+
+
+def _client_module(injected: Any = None):
+    if injected is not None:
+        return injected
+    try:
+        import pravega_client  # type: ignore
+    except ImportError as error:
+        raise RuntimeError(
+            "the 'pravega' streaming cluster needs the Pravega client "
+            "bindings (pip install pravega); its wire protocol is binary "
+            "and cannot be spoken without them"
+        ) from error
+    return pravega_client
+
+
+def _config(configuration: Dict[str, Any]) -> Dict[str, Any]:
+    return dict((configuration or {}).get("client") or {})
+
+
+class PravegaTopicProducer(TopicProducer):
+    def __init__(self, runtime: "PravegaTopicConnectionsRuntime",
+                 topic: str) -> None:
+        self._runtime = runtime
+        self._topic = topic
+        self._writer = None
+        self._total = 0
+
+    @property
+    def topic(self) -> str:
+        return self._topic
+
+    async def start(self) -> None:
+        if self._writer is None:
+            manager = self._runtime.manager()
+            self._writer = await asyncio.to_thread(
+                manager.create_writer, self._runtime.scope, self._topic
+            )
+
+    async def write(self, record: Record) -> None:
+        if self._writer is None:  # tolerate write-before-start like the
+            await self.start()    # memory/tpulog producers do
+        payload = encode_event(record)
+        key = serialise_key(record.key)
+
+        def send():
+            if key is not None:
+                self._writer.write_event(payload, routing_key=key)
+            else:
+                self._writer.write_event(payload)
+            flush = getattr(self._writer, "flush", None)
+            if flush is not None:
+                flush()
+
+        await asyncio.to_thread(send)
+        self._total += 1
+
+    async def close(self) -> None:
+        if self._writer is not None:
+            close = getattr(self._writer, "close", None)
+            if close is not None:
+                await asyncio.to_thread(close)
+            self._writer = None
+
+    def total_in(self) -> int:
+        return self._total
+
+
+class _GroupReader:
+    """Shared slice-draining logic for consumers and readers."""
+
+    def __init__(self, runtime: "PravegaTopicConnectionsRuntime",
+                 topic: str, group: str) -> None:
+        self._runtime = runtime
+        self._topic = topic
+        self._group = group
+        self._reader = None
+        self._buffer: List[Record] = []
+        # in-flight drain (the real bindings' get_segment_slice can
+        # block past the poll timeout); kept across read() calls so a
+        # drain finishing after a timeout is never dropped
+        self._pending: Optional[asyncio.Task] = None
+        self.total = 0
+
+    async def start(self) -> None:
+        if self._reader is not None:
+            return
+        manager = self._runtime.manager()
+        scope = self._runtime.scope
+
+        def bring_up():
+            group = manager.create_reader_group(self._group, scope, self._topic)
+            return group.create_reader(f"reader-{uuid.uuid4()}")
+
+        self._reader = await asyncio.to_thread(bring_up)
+
+    def _drain(self) -> List[Record]:
+        records: List[Record] = []
+        slice_ = self._reader.get_segment_slice()
+        if slice_ is None:
+            return records
+        for event in slice_:
+            records.append(
+                decode_event(
+                    bytes(event.data()).decode("utf-8"), self._topic
+                )
+            )
+        release = getattr(self._reader, "release_segment", None)
+        if release is not None:
+            release(slice_)
+        return records
+
+    async def read(self, max_records: int, timeout: float) -> List[Record]:
+        if self._reader is None:
+            await self.start()
+        if not self._buffer:
+            if self._pending is None:
+                self._pending = asyncio.ensure_future(
+                    asyncio.to_thread(self._drain)
+                )
+            try:
+                self._buffer.extend(
+                    await asyncio.wait_for(
+                        asyncio.shield(self._pending), timeout
+                    )
+                )
+                self._pending = None
+            except asyncio.TimeoutError:
+                return []  # drain keeps running; next read() awaits it
+        out, self._buffer = (
+            self._buffer[:max_records], self._buffer[max_records:]
+        )
+        self.total += len(out)
+        return out
+
+    async def close(self) -> None:
+        if self._pending is not None:
+            self._pending.cancel()
+            self._pending = None
+        if self._reader is not None:
+            offline = getattr(self._reader, "reader_offline", None)
+            if offline is not None:
+                await asyncio.to_thread(offline)
+            self._reader = None
+
+
+class PravegaTopicConsumer(TopicConsumer):
+    """Reader group named by the agent group: processes sharing the
+    group share the stream's segments; the group's server-side position
+    owns redelivery (hence commit() is a no-op, like the reference)."""
+
+    def __init__(self, runtime, topic: str, group: str) -> None:
+        self._inner = _GroupReader(runtime, topic, group)
+
+    async def start(self) -> None:
+        await self._inner.start()
+
+    async def read(
+        self, max_records: int = 100, timeout: float = 0.1
+    ) -> List[Record]:
+        return await self._inner.read(max_records, timeout)
+
+    async def commit(self, records: List[Record]) -> None:
+        return None
+
+    async def close(self) -> None:
+        await self._inner.close()
+
+    def total_out(self) -> int:
+        return self._inner.total
+
+
+class PravegaTopicReader(TopicReader):
+    """Ephemeral reader group — tails without a durable position."""
+
+    def __init__(self, runtime, topic: str,
+                 initial_position: OffsetPosition) -> None:
+        if initial_position is OffsetPosition.LATEST:
+            logger.warning(
+                "pravega reader: LATEST start is approximated by a fresh "
+                "reader group from the stream head (reference TODO: "
+                "PravegaTopicConnectionsRuntimeProvider.java:118)"
+            )
+        self._inner = _GroupReader(
+            runtime, topic, f"reader-{uuid.uuid4().hex[:16]}"
+        )
+
+    async def start(self) -> None:
+        await self._inner.start()
+
+    async def read(
+        self, max_records: int = 100, timeout: float = 0.1
+    ) -> List[Record]:
+        return await self._inner.read(max_records, timeout)
+
+    async def close(self) -> None:
+        await self._inner.close()
+
+
+class PravegaTopicAdmin(TopicAdmin):
+    def __init__(self, runtime: "PravegaTopicConnectionsRuntime") -> None:
+        self._runtime = runtime
+
+    @staticmethod
+    def _create_if_absent(fn, *args) -> None:
+        """Run a create call tolerating only the already-exists outcome
+        (the bindings either return False or raise with 'exists' in the
+        message); anything else — controller unreachable, auth — must
+        surface, not masquerade as success."""
+        try:
+            fn(*args)
+        except Exception as error:
+            if "exist" in str(error).lower():
+                return
+            raise
+
+    async def create_topic(self, spec: TopicSpec) -> None:
+        if spec.creation_mode != "create-if-not-exists":
+            return
+        manager = self._runtime.manager()
+        scope = self._runtime.scope
+
+        def create():
+            self._create_if_absent(manager.create_scope, scope)
+            self._create_if_absent(
+                manager.create_stream, scope, spec.name,
+                max(spec.partitions, 1),
+            )
+
+        await asyncio.to_thread(create)
+
+    async def delete_topic(self, name: str) -> None:
+        manager = self._runtime.manager()
+        scope = self._runtime.scope
+
+        def delete():
+            # broad tolerance IS the reference behavior here ("Topic
+            # didn't exit. Not a problem", provider:440-443)
+            try:
+                seal = getattr(manager, "seal_stream", None)
+                if seal is not None:
+                    seal(scope, name)
+                manager.delete_stream(scope, name)
+            except Exception:
+                logger.info("pravega stream %s didn't exist", name)
+
+        await asyncio.to_thread(delete)
+
+    async def close(self) -> None:
+        return None
+
+
+class PravegaTopicConnectionsRuntime(TopicConnectionsRuntime):
+    def __init__(self, configuration: Optional[Dict[str, Any]] = None,
+                 client_module: Any = None) -> None:
+        client = _config(configuration or {})
+        self.controller_uri = (
+            client.get("controller-uri")
+            or client.get("controllerUri")
+            or "tcp://localhost:9090"
+        )
+        self.scope = client.get("scope") or "langstream"
+        self._client_module = client_module
+        self._manager = None
+
+    def manager(self):
+        if self._manager is None:
+            module = _client_module(self._client_module)
+            self._manager = module.StreamManager(self.controller_uri)
+        return self._manager
+
+    def create_consumer(
+        self, agent_id: str, config: Dict[str, Any]
+    ) -> TopicConsumer:
+        return PravegaTopicConsumer(
+            self, config["topic"],
+            config.get("group") or agent_id or f"group-{uuid.uuid4().hex[:8]}",
+        )
+
+    def create_producer(
+        self, agent_id: str, config: Dict[str, Any]
+    ) -> TopicProducer:
+        return PravegaTopicProducer(self, config["topic"])
+
+    def create_reader(
+        self,
+        config: Dict[str, Any],
+        initial_position: OffsetPosition = OffsetPosition.LATEST,
+    ) -> TopicReader:
+        return PravegaTopicReader(self, config["topic"], initial_position)
+
+    def create_admin(self) -> TopicAdmin:
+        return PravegaTopicAdmin(self)
+
+    async def init(self, streaming_cluster_config: Dict[str, Any]) -> None:
+        return None
+
+    async def close(self) -> None:
+        self._manager = None

@@ -1,0 +1,134 @@
+"""In-memory fake of the ``pravega`` Python client bindings' API
+surface used by topics/pravega.py: StreamManager (scopes, streams,
+writers, reader groups), writers with routing keys, reader groups with
+shared per-group positions, and segment slices of events.
+
+Fidelity scope: enough to exercise the adapter's envelope codec, group
+naming, slice draining, and admin mapping lib-free — it is NOT a
+Pravega semantics simulator (no scaling, no checkpoints)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Tuple
+
+
+class _Event:
+    def __init__(self, data: bytes) -> None:
+        self._data = data
+
+    def data(self) -> bytes:
+        return self._data
+
+
+class _Slice:
+    def __init__(self, events: List[_Event]) -> None:
+        self._events = events
+
+    def __iter__(self):
+        return iter(self._events)
+
+
+class _Writer:
+    def __init__(self, store: "StreamManager", scope: str, stream: str) -> None:
+        self._store = store
+        self._key = (scope, stream)
+        self.flushed = 0
+
+    def write_event(self, event: str, routing_key: str = None) -> None:
+        with self._store.lock:
+            self._store.streams[self._key].append((routing_key, event))
+
+    def flush(self) -> None:
+        self.flushed += 1
+
+    def close(self) -> None:
+        pass
+
+
+class _Reader:
+    def __init__(self, store: "StreamManager", scope: str, stream: str,
+                 group: str) -> None:
+        self._store = store
+        self._stream_key = (scope, stream)
+        self._group_key = (scope, stream, group)
+        self.released: List[_Slice] = []
+
+    def get_segment_slice(self) -> _Slice:
+        with self._store.lock:
+            events = self._store.streams[self._stream_key]
+            position = self._store.groups[self._group_key]
+            pending = events[position:]
+            self._store.groups[self._group_key] = len(events)
+        return _Slice([_Event(event.encode()) for _, event in pending])
+
+    def release_segment(self, slice_) -> None:
+        self.released.append(slice_)
+
+    def reader_offline(self) -> None:
+        pass
+
+
+class _ReaderGroup:
+    def __init__(self, store: "StreamManager", scope: str, stream: str,
+                 group: str) -> None:
+        self._store = store
+        self._args = (scope, stream, group)
+
+    def create_reader(self, reader_id: str) -> _Reader:
+        scope, stream, group = self._args
+        return _Reader(self._store, scope, stream, group)
+
+
+class StreamManager:
+    def __init__(self, controller_uri: str) -> None:
+        self.controller_uri = controller_uri
+        self.lock = threading.Lock()
+        self.scopes: List[str] = []
+        self.streams: Dict[Tuple[str, str], List[Tuple[str, str]]] = {}
+        self.segments: Dict[Tuple[str, str], int] = {}
+        self.groups: Dict[Tuple[str, str, str], int] = {}
+        self.sealed: List[Tuple[str, str]] = []
+
+    def create_scope(self, scope: str) -> None:
+        if scope in self.scopes:
+            raise RuntimeError(f"scope {scope} exists")
+        self.scopes.append(scope)
+
+    def create_stream(self, scope: str, stream: str, segments: int) -> None:
+        key = (scope, stream)
+        if key in self.streams:
+            raise RuntimeError(f"stream {stream} exists")
+        self.streams[key] = []
+        self.segments[key] = segments
+
+    def seal_stream(self, scope: str, stream: str) -> None:
+        self.sealed.append((scope, stream))
+
+    def delete_stream(self, scope: str, stream: str) -> None:
+        del self.streams[(scope, stream)]
+
+    def create_writer(self, scope: str, stream: str) -> _Writer:
+        if (scope, stream) not in self.streams:
+            raise RuntimeError(f"no stream {stream}")
+        return _Writer(self, scope, stream)
+
+    def create_reader_group(self, group: str, scope: str,
+                            stream: str) -> _ReaderGroup:
+        if (scope, stream) not in self.streams:
+            raise RuntimeError(f"no stream {stream}")
+        self.groups.setdefault((scope, stream, group), 0)
+        return _ReaderGroup(self, scope, stream, group)
+
+
+class FakePravegaModule:
+    """Stands in for ``import pravega_client``; one shared manager per
+    module so producer/consumer runtimes see the same broker state."""
+
+    def __init__(self) -> None:
+        self._manager: StreamManager = None
+
+    def StreamManager(self, controller_uri: str) -> StreamManager:
+        if self._manager is None:
+            self._manager = StreamManager(controller_uri)
+        return self._manager

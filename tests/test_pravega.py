@@ -1,0 +1,185 @@
+"""Pravega topic runtime (topics/pravega.py): RecordWrapper wire-shape
+codec, SPI mapping against the in-memory fake client, the registry
+entry, and the lib-gated error. Reference:
+PravegaTopicConnectionsRuntimeProvider.java (see module docstring)."""
+
+import asyncio
+import json
+
+import pytest
+
+from langstream_tpu.api.records import Record
+from langstream_tpu.api.topics import OffsetPosition, TopicSpec
+from langstream_tpu.topics import create_topic_runtime
+from langstream_tpu.topics.pravega import (
+    PravegaTopicConnectionsRuntime,
+    decode_event,
+    encode_event,
+    serialise_key,
+)
+
+from tests.pravega_mock import FakePravegaModule
+
+
+def test_envelope_matches_recordwrapper_shape():
+    record = Record(
+        key="k1", value={"answer": 42}, timestamp=1234,
+        headers=(("trace", "abc"), ("n", 7)),
+    )
+    wire = json.loads(encode_event(record))
+    # exactly the reference RecordWrapper fields — its Jackson record
+    # deserializer rejects unknown properties
+    assert sorted(wire) == ["headers", "key", "timestamp", "value"]
+    assert wire["key"] == "k1"
+    assert wire["value"] == {"answer": 42}
+    assert wire["headers"] == {"trace": "abc", "n": 7}
+    assert wire["timestamp"] == 1234
+
+    back = decode_event(encode_event(record), "t")
+    assert back.key == "k1"
+    assert back.value == {"answer": 42}
+    assert dict(back.headers) == {"trace": "abc", "n": 7}
+    assert back.origin == "t"
+    assert back.timestamp == 1234
+
+
+def test_serialise_key_rules():
+    assert serialise_key(None) is None
+    assert serialise_key("route") == "route"
+    assert serialise_key(17) == "17"
+    assert serialise_key({"a": 1}) == '{"a": 1}'
+
+
+def test_produce_consume_through_fake_client():
+    fake = FakePravegaModule()
+    runtime = PravegaTopicConnectionsRuntime(
+        {"client": {"controller-uri": "tcp://ctrl:9090", "scope": "s"}},
+        client_module=fake,
+    )
+
+    async def main():
+        admin = runtime.create_admin()
+        await admin.create_topic(TopicSpec(name="events", partitions=2))
+        # idempotent second create (reference swallows "exists")
+        await admin.create_topic(TopicSpec(name="events", partitions=2))
+
+        producer = runtime.create_producer("agent-1", {"topic": "events"})
+        await producer.start()
+        await producer.write(Record(key="k", value="hello"))
+        await producer.write(Record(value={"x": 1}))
+        assert producer.total_in() == 2
+
+        consumer = runtime.create_consumer(
+            "agent-2", {"topic": "events", "group": "g1"}
+        )
+        await consumer.start()
+        records = await consumer.read(max_records=10)
+        assert [r.value for r in records] == ["hello", {"x": 1}]
+        assert records[0].key == "k" and records[0].origin == "events"
+        await consumer.commit(records)  # broker-side no-op
+        assert await consumer.read(max_records=10) == []
+        assert consumer.total_out() == 2
+
+        # same group resumes at the group position; a reader (fresh
+        # ephemeral group) sees the stream from the head
+        await producer.write(Record(value="late"))
+        assert [r.value for r in await consumer.read()] == ["late"]
+        reader = runtime.create_reader(
+            {"topic": "events"}, OffsetPosition.EARLIEST
+        )
+        await reader.start()
+        assert [r.value for r in await reader.read()] == [
+            "hello", {"x": 1}, "late",
+        ]
+        await reader.close()
+        await consumer.close()
+        await producer.close()
+
+        # routing key reached the fake writer
+        manager = fake.StreamManager("tcp://ctrl:9090")
+        assert manager.streams[("s", "events")][0][0] == "k"
+        assert manager.segments[("s", "events")] == 2
+
+        await admin.delete_topic("events")
+        assert ("s", "events") in manager.sealed
+        assert ("s", "events") not in manager.streams
+        await admin.delete_topic("events")  # idempotent
+        await admin.close()
+        await runtime.close()
+
+    asyncio.run(main())
+
+
+def test_dead_letter_producer_targets_suffixed_stream():
+    fake = FakePravegaModule()
+    runtime = PravegaTopicConnectionsRuntime({}, client_module=fake)
+    dlq = runtime.create_deadletter_producer("a", {"topic": "events"})
+    assert dlq.topic == "events-deadletter"
+
+
+def test_registry_and_import_gate():
+    runtime = create_topic_runtime({
+        "type": "pravega",
+        "configuration": {"client": {"scope": "x"}},
+    })
+    assert isinstance(runtime, PravegaTopicConnectionsRuntime)
+    assert runtime.scope == "x"
+    assert runtime.controller_uri == "tcp://localhost:9090"
+    # without the client library, first broker contact explains itself
+    with pytest.raises(RuntimeError, match="pip install pravega"):
+        runtime.manager()
+
+
+def test_create_topic_surfaces_real_failures():
+    """Only the already-exists outcome is tolerated; a dead controller
+    must fail deploy, not log 'exists' and continue."""
+
+    class BrokenManager:
+        def create_scope(self, scope):
+            raise ConnectionError("connection refused: tcp://ctrl:9090")
+
+    class BrokenModule:
+        def StreamManager(self, uri):
+            return BrokenManager()
+
+    runtime = PravegaTopicConnectionsRuntime({}, client_module=BrokenModule())
+    admin = runtime.create_admin()
+    with pytest.raises(ConnectionError, match="refused"):
+        asyncio.run(admin.create_topic(TopicSpec(name="t")))
+
+
+def test_read_timeout_does_not_drop_blocked_drain():
+    """A get_segment_slice that blocks past the poll timeout makes
+    read() return [] — and the drained events arrive on a LATER read
+    instead of being lost."""
+    import threading
+    import time as _time
+
+    fake = FakePravegaModule()
+    runtime = PravegaTopicConnectionsRuntime({}, client_module=fake)
+    gate = threading.Event()
+
+    async def main():
+        admin = runtime.create_admin()
+        await admin.create_topic(TopicSpec(name="slow"))
+        producer = runtime.create_producer("a", {"topic": "slow"})
+        await producer.write(Record(value="v1"))
+        consumer = runtime.create_consumer("b", {"topic": "slow", "group": "g"})
+        await consumer.start()
+        real_drain = consumer._inner._reader.get_segment_slice
+
+        def blocking_slice():
+            gate.wait(timeout=10)
+            return real_drain()
+
+        consumer._inner._reader.get_segment_slice = blocking_slice
+        assert await consumer.read(timeout=0.05) == []  # blocked -> empty
+        gate.set()
+        deadline = _time.monotonic() + 5
+        out = []
+        while not out and _time.monotonic() < deadline:
+            out = await consumer.read(timeout=0.2)
+        assert [r.value for r in out] == ["v1"]
+        await consumer.close()
+
+    asyncio.run(main())
