@@ -79,7 +79,8 @@ def main() -> None:
                 config, params, cache, tokens, lengths, freqs, write_mask
             )
             sampled, lp = _sample_with_logprob(
-                logits, temperature, top_k, key, top_p
+                logits, temperature, top_k,
+                jax.random.split(key, tokens.shape[0]), top_p
             )
             sampled = jnp.where(active, sampled, 0)
             lengths = jnp.where(active, lengths + 1, lengths)
@@ -253,7 +254,8 @@ def probe_tp8_70b(slots=8, chunk=16, seq=512) -> None:
                 config, params, cache, tokens, lengths, freqs, write_mask
             )
             sampled, lp = _sample_with_logprob(
-                logits, temperature, top_k, key, top_p
+                logits, temperature, top_k,
+                jax.random.split(key, tokens.shape[0]), top_p
             )
             lengths = jnp.where(active, lengths + 1, lengths)
             return (cache, sampled, lengths), (sampled, lp)
