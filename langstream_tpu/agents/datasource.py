@@ -30,9 +30,10 @@ import re
 from typing import Any, Dict, List, Optional
 
 # engines whose client protocol needs a library not in this image
-# (CQL / Milvus gRPC); REST-based engines are implemented natively in
-# ``external_stores.py``
-_GATED_SERVICES = {"cassandra", "milvus", "jdbc"}
+# (CQL; generic JDBC has no wire protocol at all); REST-based engines —
+# OpenSearch, Pinecone, Solr, Astra, Milvus — are implemented natively
+# in ``external_stores.py``
+_GATED_SERVICES = {"cassandra", "jdbc"}
 
 
 class DataSource:
@@ -182,6 +183,10 @@ class DataSourceRegistry:
             from langstream_tpu.agents.external_stores import AstraDataSource
 
             source = AstraDataSource(config)
+        elif service == "milvus":
+            from langstream_tpu.agents.external_stores import MilvusDataSource
+
+            source = MilvusDataSource(config)
         elif service in _GATED_SERVICES:
             raise ValueError(
                 f"datasource service {service!r} requires a client library "

@@ -363,3 +363,126 @@ class AstraDataSource(_RestDataSource):
                 )
             }
         raise ValueError(f"unsupported astra action {action!r}")
+
+
+class MilvusDataSource(_RestDataSource):
+    """Milvus / Zilliz over the v2 REST API (reference:
+    ``vector/milvus/MilvusDataSource.java:100-160``, which drives the
+    Java SDK's high-level ``SearchSimpleParam``; config keys mirror
+    ``MilvusDatasourceConfig.java``: ``url`` (Zilliz) OR ``host``+
+    ``port``, and ``token`` OR ``user``/``password`` — Milvus's REST
+    auth accepts ``user:password`` as a bearer token).
+
+    Query spec follows SearchSimpleParam's JSON spelling
+    (``collection-name``/``collectionName``, ``vectors``, ``limit`` or
+    ``top-k``, ``output-fields``, ``filter``, ``offset``); the sink's
+    generic ``{"action": "upsert"|"delete", id, vector, metadata}``
+    statements map onto ``/v2/vectordb/entities/{upsert,delete}``.
+    """
+
+    def __init__(self, config: Dict[str, Any]) -> None:
+        super().__init__()
+        url = config.get("url")
+        if not url:
+            host = config.get("host", "localhost")
+            port = int(config.get("port", 19530))
+            url = f"http://{host}:{port}"
+        self.base = str(url).rstrip("/")
+        token = config.get("token")
+        if not token and config.get("user"):
+            token = f"{config['user']}:{config.get('password', '')}"
+        self.token = token
+        self.collection = (
+            config.get("collection-name") or config.get("collection")
+        )
+        self.vector_field = config.get("vector-field", "vector")
+
+    def _headers(self) -> Dict[str, str]:
+        headers = {"Content-Type": "application/json"}
+        if self.token:
+            headers["Authorization"] = f"Bearer {self.token}"
+        return headers
+
+    async def _v2(self, op: str, body: Dict[str, Any]) -> Dict[str, Any]:
+        payload = await self._call(
+            "POST", f"{self.base}/v2/vectordb/entities/{op}", body
+        )
+        code = payload.get("code", 0)
+        # Milvus returns HTTP 200 with an error code in the body
+        if code not in (0, 200):
+            raise IOError(
+                f"milvus {op}: code {code}: {payload.get('message')}"
+            )
+        return payload
+
+    def _collection(self, spec: Dict[str, Any]) -> str:
+        collection = (
+            spec.get("collection-name")
+            or spec.get("collectionName")
+            or self.collection
+        )
+        if not collection:
+            raise ValueError(
+                "milvus spec needs 'collection-name' (or set it on the "
+                "datasource resource)"
+            )
+        return collection
+
+    async def query(self, query: str, params: List[Any]) -> List[Dict[str, Any]]:
+        spec = _fill(query, params)
+        vector = (
+            spec.get("vectors") or spec.get("vector") or spec.get("data")
+        )
+        if vector and not isinstance(vector[0], list):
+            vector = [vector]
+        body: Dict[str, Any] = {
+            "collectionName": self._collection(spec),
+            "data": vector,
+            "limit": int(spec.get("top-k", spec.get("limit", 10))),
+            "annsField": spec.get("anns-field", self.vector_field),
+        }
+        fields = spec.get("output-fields") or spec.get("outputFields")
+        body["outputFields"] = fields or ["*"]
+        for key in ("filter", "offset"):
+            if spec.get(key):
+                body[key] = spec[key]
+        rows = (await self._v2("search", body)).get("data") or []
+        out = []
+        for row in rows:
+            row = dict(row)
+            row.pop(self.vector_field, None)
+            out.append({
+                "id": row.pop("id", None),
+                "similarity": row.pop("distance", 0.0),
+                **row,
+            })
+        return out
+
+    async def execute(self, statement: str, params: List[Any]) -> Dict[str, Any]:
+        spec = _fill(statement, params)
+        action = spec.get("action")
+        collection = self._collection(spec)
+        if action == "upsert":
+            entity = {
+                "id": spec["id"],
+                self.vector_field: spec["vector"],
+                **(spec.get("metadata") or {}),
+            }
+            payload = await self._v2(
+                "upsert", {"collectionName": collection, "data": [entity]}
+            )
+            count = (payload.get("data") or {}).get("upsertCount", 1)
+            return {"rowcount": int(count)}
+        if action == "delete":
+            fltr = spec.get("filter")
+            if not fltr:
+                if spec.get("id") is None:
+                    raise ValueError(
+                        "milvus delete needs 'id' or 'filter'"
+                    )
+                fltr = f'id in [{json.dumps(spec["id"])}]'
+            await self._v2(
+                "delete", {"collectionName": collection, "filter": fltr}
+            )
+            return {"rowcount": 1}
+        raise ValueError(f"unsupported milvus action {action!r}")

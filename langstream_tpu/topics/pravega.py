@@ -71,14 +71,19 @@ def _jsonable(value: Any) -> Any:
 
 def serialise_key(key: Any) -> Optional[str]:
     """Routing key, reference ``serialiseKey``: None stays None,
-    strings/numbers stringify, anything else JSON-serializes."""
+    strings/numbers stringify, anything else JSON-serializes. Spellings
+    match the Java side exactly (``true``/``false``, compact JSON) so
+    mixed Java/Python producers route the same key to the same
+    segment."""
     if key is None:
         return None
     if isinstance(key, bytes):
         return base64.b64encode(key).decode()
-    if isinstance(key, (str, int, float, bool)):
+    if isinstance(key, bool):
+        return "true" if key else "false"
+    if isinstance(key, (str, int, float)):
         return str(key)
-    return json.dumps(key)
+    return json.dumps(key, separators=(",", ":"))
 
 
 def encode_event(record: Record) -> str:
@@ -215,6 +220,7 @@ class _GroupReader:
     async def read(self, max_records: int, timeout: float) -> List[Record]:
         if self._reader is None:
             await self.start()
+        started = asyncio.get_event_loop().time()
         if not self._buffer:
             if self._pending is None:
                 self._pending = asyncio.ensure_future(
@@ -229,6 +235,14 @@ class _GroupReader:
                 self._pending = None
             except asyncio.TimeoutError:
                 return []  # drain keeps running; next read() awaits it
+        if not self._buffer:
+            # empty slice returned instantly: spend the rest of the poll
+            # timeout idle, or the runner loop busy-spins (the other
+            # runtimes block inside their own wait_for_data)
+            remaining = timeout - (asyncio.get_event_loop().time() - started)
+            if remaining > 0:
+                await asyncio.sleep(remaining)
+            return []
         out, self._buffer = (
             self._buffer[:max_records], self._buffer[max_records:]
         )
@@ -237,7 +251,17 @@ class _GroupReader:
 
     async def close(self) -> None:
         if self._pending is not None:
-            self._pending.cancel()
+            # wait for the in-flight drain thread: cancelling cannot
+            # stop a to_thread worker, and taking the reader offline
+            # while the thread still uses it is undefined behavior in
+            # the native bindings. Bounded — a drain blocked past this
+            # is abandoned (best effort; the bindings own the socket).
+            try:
+                await asyncio.wait_for(asyncio.shield(self._pending), 5.0)
+            except (asyncio.TimeoutError, Exception):
+                logger.warning(
+                    "pravega: drain still in flight at close; abandoning"
+                )
             self._pending = None
         if self._reader is not None:
             offline = getattr(self._reader, "reader_offline", None)

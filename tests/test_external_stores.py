@@ -241,3 +241,75 @@ def test_astra_through_vector_agents():
         )
         assert find["json"]["find"]["sort"]["$vector"] == [0.1, 0.2]
         assert find["json"]["find"]["options"]["limit"] == 3
+
+
+def test_milvus_through_vector_agents():
+    def handler(request):
+        if request["path"].endswith("/entities/search"):
+            return web.json_response({"code": 0, "data": [
+                {"id": "m1", "distance": 0.91, "text": "milvus doc",
+                 "vector": [0, 0]},
+            ]})
+        return web.json_response({"code": 0, "data": {"upsertCount": 1}})
+
+    with _Server(handler) as server:
+        resources = {"mv": {"type": "datasource", "configuration": {
+            "service": "milvus",
+            "url": f"http://127.0.0.1:{server.port}",
+            "token": "root:Milvus",
+            "collection-name": "docs",
+        }}}
+        out = asyncio.run(_sink_and_query(
+            resources,
+            {"datasource": "mv", "vector.id": "value.id",
+             "vector.vector": "value.vec", "vector.text": "value.text"},
+            {"datasource": "mv",
+             "query": json.dumps(
+                 {"vectors": "?", "top-k": 4, "output-fields": ["text"]}
+             ),
+             "fields": ["value.qv"], "output-field": "value.hits"},
+            [Record(value={"id": "m1", "vec": [0.1, 0.2], "text": "milvus doc"})],
+        ))
+        # the stored vector field never leaks into results
+        assert out.value["hits"][0] == {
+            "id": "m1", "similarity": 0.91, "text": "milvus doc",
+        }
+        upsert = next(
+            r for r in server.requests
+            if r["path"].endswith("/entities/upsert")
+        )
+        assert upsert["headers"]["Authorization"] == "Bearer root:Milvus"
+        assert upsert["json"]["collectionName"] == "docs"
+        assert upsert["json"]["data"][0]["vector"] == [0.1, 0.2]
+        assert upsert["json"]["data"][0]["text"] == "milvus doc"
+        search = next(
+            r for r in server.requests
+            if r["path"].endswith("/entities/search")
+        )
+        assert search["json"]["limit"] == 4
+        assert search["json"]["data"] == [[0.1, 0.2]]
+        assert search["json"]["annsField"] == "vector"
+        assert search["json"]["outputFields"] == ["text"]
+
+
+def test_milvus_body_error_code_raises():
+    def handler(request):
+        return web.json_response(
+            {"code": 1100, "message": "collection not found"}
+        )
+
+    with _Server(handler) as server:
+        from langstream_tpu.agents.external_stores import MilvusDataSource
+
+        source = MilvusDataSource({
+            "url": f"http://127.0.0.1:{server.port}", "collection": "x",
+        })
+
+        async def go():
+            try:
+                await source.query(json.dumps({"vectors": [0.1]}), [])
+            finally:
+                await source.close()
+
+        with pytest.raises(IOError, match="1100"):
+            asyncio.run(go())

@@ -47,7 +47,8 @@ def test_serialise_key_rules():
     assert serialise_key(None) is None
     assert serialise_key("route") == "route"
     assert serialise_key(17) == "17"
-    assert serialise_key({"a": 1}) == '{"a": 1}'
+    assert serialise_key(True) == "true"  # Java String.valueOf(true)
+    assert serialise_key({"a": 1}) == '{"a":1}'  # Jackson-compact
 
 
 def test_produce_consume_through_fake_client():
