@@ -577,6 +577,16 @@ def build_parser() -> argparse.ArgumentParser:
     serve.add_argument("--max-seq-len", type=int, default=2048)
     serve.add_argument("--decode-chunk", type=int, default=16)
     serve.add_argument("--precompile", action="store_true")
+    # pipelined dispatch hides the host/tunnel gap between decode
+    # chunks (the bench's winning config); token-identical by test
+    serve.add_argument(
+        "--no-pipeline-decode", action="store_true",
+        help="disable pipelined decode dispatch (on by default)",
+    )
+    serve.add_argument(
+        "--no-prefix-cache", action="store_true",
+        help="disable cross-slot prompt-prefix KV reuse (on by default)",
+    )
     serve.add_argument("--embeddings-checkpoint", default=None)
     serve.add_argument("--host", default="0.0.0.0")
     serve.add_argument("--port", type=int, default=8000)

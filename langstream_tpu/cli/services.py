@@ -223,6 +223,8 @@ async def serve_main(args) -> None:
             "max-seq-len": args.max_seq_len,
             "decode-chunk": args.decode_chunk,
             "precompile": bool(args.precompile),
+            "pipeline-decode": not getattr(args, "no_pipeline_decode", False),
+            "prefix-cache": not getattr(args, "no_prefix_cache", False),
         },
     }
     from langstream_tpu.providers.jax_local.model import LlamaConfig

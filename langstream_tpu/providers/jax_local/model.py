@@ -506,12 +506,22 @@ def decode_step(
         x = x + delta
         return x, (kc, vc)
 
+    # unroll lets XLA software-pipeline the next layer's weight loads
+    # against the current layer's compute on the weights-bound decode
+    # path (measured via LS_DECODE_UNROLL; 1 = plain scan)
     x, (k_cache, v_cache) = jax.lax.scan(
-        layer_fn, x, (layer_inputs, k_cache, v_cache)
+        layer_fn, x, (layer_inputs, k_cache, v_cache),
+        unroll=_decode_unroll(),
     )
     x = rms_norm(x, params["final_norm"], config.norm_eps)
     logits = _logits(config, params, x)
     return {"k": k_cache, "v": v_cache}, logits
+
+
+def _decode_unroll() -> int:
+    import os
+
+    return max(1, int(os.environ.get("LS_DECODE_UNROLL", "1")))
 
 
 def apply_layers(
