@@ -403,15 +403,20 @@ class MilvusDataSource(_RestDataSource):
             headers["Authorization"] = f"Bearer {self.token}"
         return headers
 
-    async def _v2(self, op: str, body: Dict[str, Any]) -> Dict[str, Any]:
+    async def _v2(
+        self, op: str, body: Dict[str, Any], group: str = "entities"
+    ) -> Dict[str, Any]:
+        """POST a v2 REST command (``/v2/vectordb/{group}/{op}``) and
+        enforce Milvus's body-level error-code convention (HTTP 200
+        with a non-zero ``code`` on failure). Asset managers reuse this
+        with ``group="collections"``."""
         payload = await self._call(
-            "POST", f"{self.base}/v2/vectordb/entities/{op}", body
+            "POST", f"{self.base}/v2/vectordb/{group}/{op}", body
         )
         code = payload.get("code", 0)
-        # Milvus returns HTTP 200 with an error code in the body
         if code not in (0, 200):
             raise IOError(
-                f"milvus {op}: code {code}: {payload.get('message')}"
+                f"milvus {group}/{op}: code {code}: {payload.get('message')}"
             )
         return payload
 

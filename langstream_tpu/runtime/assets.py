@@ -101,3 +101,125 @@ class VectorCollectionAssetManager(AssetManager):
 register_asset_manager("jdbc-table", JdbcTableAssetManager)
 register_asset_manager("table", JdbcTableAssetManager)
 register_asset_manager("vector-collection", VectorCollectionAssetManager)
+
+
+class OpenSearchIndexAssetManager(AssetManager):
+    """``opensearch-index`` (reference: OpenSearchAssetsProvider —
+    ``datasource`` + optional ``mappings``/``settings`` JSON): create or
+    delete the datasource's index over the REST API."""
+
+    async def init(self, asset, resources) -> None:
+        await super().init(asset, resources)
+        self._registry = DataSourceRegistry(resources)
+        self._source = self._registry.resolve(_datasource_name(asset.config))
+
+    async def close(self) -> None:
+        await self._registry.close()
+
+    @staticmethod
+    def _absent(error: IOError) -> bool:
+        """Only a 404 means 'no such index'; auth/5xx/connection
+        failures must surface, not masquerade as absence."""
+        return "HTTP 404" in str(error)
+
+    async def asset_exists(self) -> bool:
+        try:
+            await self._source._call(
+                "GET", f"{self._source.endpoint}/{self._source.index}"
+            )
+            return True
+        except IOError as error:
+            if self._absent(error):
+                return False
+            raise
+
+    async def deploy_asset(self) -> None:
+        import json as _json
+
+        body: Dict[str, Any] = {}
+        for key in ("mappings", "settings"):
+            value = self.asset.config.get(key)
+            if value:
+                body[key] = (
+                    _json.loads(value) if isinstance(value, str) else value
+                )
+        await self._source._call(
+            "PUT", f"{self._source.endpoint}/{self._source.index}",
+            body or None,
+        )
+
+    async def delete_asset(self) -> bool:
+        try:
+            await self._source._call(
+                "DELETE", f"{self._source.endpoint}/{self._source.index}"
+            )
+            return True
+        except IOError as error:
+            if self._absent(error):
+                return False
+            raise
+
+
+class MilvusCollectionAssetManager(AssetManager):
+    """``milvus-collection`` (reference: MilvusAssetsProvider —
+    ``collection-name`` + ``create-statements``, each a JSON command for
+    the collection API; v2 REST spelling here)."""
+
+    async def init(self, asset, resources) -> None:
+        await super().init(asset, resources)
+        self._registry = DataSourceRegistry(resources)
+        self._source = self._registry.resolve(_datasource_name(asset.config))
+        self.collection = (
+            asset.config.get("collection-name") or asset.name
+        )
+
+    async def close(self) -> None:
+        await self._registry.close()
+
+    async def _collections(self, op: str, body: Dict[str, Any]):
+        return await self._source._v2(op, body, group="collections")
+
+    async def asset_exists(self) -> bool:
+        payload = await self._collections(
+            "has", {"collectionName": self.collection}
+        )
+        return bool((payload.get("data") or {}).get("has"))
+
+    async def deploy_asset(self) -> None:
+        import json as _json
+
+        statements = self.asset.config.get("create-statements") or []
+        if statements:
+            for statement in statements:
+                body = (
+                    _json.loads(statement)
+                    if isinstance(statement, str) else dict(statement)
+                )
+                body.setdefault("collectionName", self.collection)
+                await self._collections("create", body)
+            return
+        dimension = int(self.asset.config.get("dimensions", 0) or 0)
+        if not dimension:
+            raise ValueError(
+                f"asset {self.asset.name!r}: milvus-collection needs "
+                "create-statements or dimensions"
+            )
+        await self._collections("create", {
+            "collectionName": self.collection, "dimension": dimension,
+        })
+
+    async def delete_asset(self) -> bool:
+        try:
+            await self._collections(
+                "drop", {"collectionName": self.collection}
+            )
+            return True
+        except IOError:
+            # drop of a missing collection must not abort the cleanup
+            # loop over the remaining assets
+            logger.info("milvus collection %s not dropped", self.collection)
+            return False
+
+
+register_asset_manager("opensearch-index", OpenSearchIndexAssetManager)
+register_asset_manager("milvus-collection", MilvusCollectionAssetManager)

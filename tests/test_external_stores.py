@@ -313,3 +313,113 @@ def test_milvus_body_error_code_raises():
 
         with pytest.raises(IOError, match="1100"):
             asyncio.run(go())
+
+
+def test_opensearch_index_asset_lifecycle():
+    """`opensearch-index` asset (reference: OpenSearchAssetsProvider):
+    exists -> create with mappings/settings -> delete, over REST."""
+    from langstream_tpu.api.assets import create_asset_manager
+    from langstream_tpu.model.application import AssetDefinition
+
+    state = {"exists": False}
+
+    def handler(request):
+        if request["method"] == "GET":
+            if state["exists"]:
+                return web.json_response({"docs": {}})
+            return web.json_response({"error": "no such index"}, status=404)
+        if request["method"] == "PUT":
+            state["exists"] = True
+            return web.json_response({"acknowledged": True})
+        if request["method"] == "DELETE":
+            state["exists"] = False
+            return web.json_response({"acknowledged": True})
+        return web.json_response({}, status=405)
+
+    with _Server(handler) as server:
+        resources = {"os": {"configuration": {
+            "service": "opensearch",
+            "endpoint": f"http://127.0.0.1:{server.port}",
+            "index-name": "docs",
+        }}}
+        asset = AssetDefinition(
+            id="i", name="docs-index", asset_type="opensearch-index",
+            creation_mode="create-if-not-exists", deletion_mode="delete",
+            config={
+                "datasource": "os",
+                "mappings": json.dumps({"properties": {
+                    "embeddings": {"type": "knn_vector", "dimension": 4},
+                }}),
+                "settings": json.dumps({"index": {"knn": True}}),
+            },
+        )
+
+        async def go():
+            manager = create_asset_manager("opensearch-index")
+            await manager.init(asset, resources)
+            assert not await manager.asset_exists()
+            await manager.deploy_asset()
+            assert await manager.asset_exists()
+            assert await manager.delete_asset()
+            assert not await manager.asset_exists()
+
+        asyncio.run(go())
+        put = next(r for r in server.requests if r["method"] == "PUT")
+        assert put["json"]["mappings"]["properties"]["embeddings"]["dimension"] == 4
+        assert put["json"]["settings"]["index"]["knn"] is True
+
+
+def test_milvus_collection_asset_lifecycle():
+    """`milvus-collection` asset (reference: MilvusAssetsProvider):
+    has -> create (create-statements or plain dimensions) -> drop over
+    the v2 REST collections API."""
+    from langstream_tpu.api.assets import create_asset_manager
+    from langstream_tpu.model.application import AssetDefinition
+
+    state = {"has": False}
+
+    def handler(request):
+        path = request["path"]
+        if path.endswith("/collections/has"):
+            return web.json_response({"code": 0, "data": {"has": state["has"]}})
+        if path.endswith("/collections/create"):
+            state["has"] = True
+            return web.json_response({"code": 0, "data": {}})
+        if path.endswith("/collections/drop"):
+            state["has"] = False
+            return web.json_response({"code": 0, "data": {}})
+        return web.json_response({"code": 1, "message": "unexpected"})
+
+    with _Server(handler) as server:
+        resources = {"mv": {"configuration": {
+            "service": "milvus",
+            "url": f"http://127.0.0.1:{server.port}",
+        }}}
+        asset = AssetDefinition(
+            id="c", name="corpus", asset_type="milvus-collection",
+            creation_mode="create-if-not-exists", deletion_mode="delete",
+            config={
+                "datasource": "mv",
+                "collection-name": "corpus",
+                "create-statements": [json.dumps({
+                    "dimension": 8, "metricType": "COSINE",
+                })],
+            },
+        )
+
+        async def go():
+            manager = create_asset_manager("milvus-collection")
+            await manager.init(asset, resources)
+            assert not await manager.asset_exists()
+            await manager.deploy_asset()
+            assert await manager.asset_exists()
+            assert await manager.delete_asset()
+            assert not await manager.asset_exists()
+
+        asyncio.run(go())
+        create = next(
+            r for r in server.requests
+            if r["path"].endswith("/collections/create")
+        )
+        assert create["json"]["collectionName"] == "corpus"
+        assert create["json"]["dimension"] == 8
