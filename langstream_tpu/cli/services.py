@@ -256,12 +256,18 @@ async def serve_main(args) -> None:
     if getattr(args, "follower_of", None):
         # follower host of a multi-host replica: no HTTP surface — just
         # replay the leader's dispatch stream on this process's shard
-        from langstream_tpu.serving.mirror import FollowerExecutor
+        from langstream_tpu.serving.mirror import (
+            FollowerExecutor,
+            config_fingerprint,
+        )
 
         completions.engine.stop()  # executor owns the dispatches
         leader_host, _, leader_port = args.follower_of.rpartition(":")
         executor = FollowerExecutor(completions.engine)
-        executor.connect(leader_host or "127.0.0.1", int(leader_port))
+        executor.connect(
+            leader_host or "127.0.0.1", int(leader_port),
+            fingerprint=config_fingerprint(config),
+        )
         print(
             f"follower: replaying dispatch stream from {args.follower_of}",
             flush=True,
@@ -271,9 +277,15 @@ async def serve_main(args) -> None:
         return
     mirror = None
     if getattr(args, "followers", 0):
-        from langstream_tpu.serving.mirror import DispatchMirror
+        from langstream_tpu.serving.mirror import (
+            DispatchMirror,
+            config_fingerprint,
+        )
 
-        mirror = DispatchMirror(host=args.host, port=args.mirror_port)
+        mirror = DispatchMirror(
+            host=args.host, port=args.mirror_port,
+            fingerprint=config_fingerprint(config),
+        )
         print(
             f"mirror: waiting for {args.followers} follower(s) "
             f"on :{mirror.port}",

@@ -34,6 +34,7 @@ the global mesh exists on every process.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import logging
 import queue
@@ -46,7 +47,19 @@ import numpy as np
 
 logger = logging.getLogger(__name__)
 
+
+def config_fingerprint(config: Dict[str, Any]) -> bytes:
+    """16-byte digest of the serving config. Leader and followers must
+    run the SAME model/engine configuration — mismatched shapes would
+    not fail loudly (each process jit-compiles its own variants) but
+    would silently diverge. The handshake compares digests."""
+    canonical = json.dumps(config, sort_keys=True, default=str)
+    return hashlib.sha256(canonical.encode()).digest()[:16]
+
+
 _MAGIC = b"LSM1"
+_FINGERPRINT_LEN = 16
+_ANY_FINGERPRINT = bytes(_FINGERPRINT_LEN)  # all-zero = skip the check
 _HEADER = struct.Struct("!I")  # payload length
 # record payloads are NOT pickle: followers deserialize data from the
 # network, so the wire format is a JSON header (kind, meta, array
@@ -121,9 +134,15 @@ class DispatchMirror:
     next collective would deadlock anyway — so the error is raised into
     the engine thread via the queue."""
 
-    def __init__(self, host: str = "0.0.0.0", port: int = 0) -> None:
+    def __init__(
+        self,
+        host: str = "0.0.0.0",
+        port: int = 0,
+        fingerprint: bytes = _ANY_FINGERPRINT,
+    ) -> None:
         self._server = socket.create_server((host, port))
         self.port = self._server.getsockname()[1]
+        self._fingerprint = fingerprint
         self._followers: List[socket.socket] = []
         self._queue: "queue.Queue[Optional[bytes]]" = queue.Queue()
         self._writer: Optional[threading.Thread] = None
@@ -141,6 +160,7 @@ class DispatchMirror:
             conn.settimeout(10.0)
             try:
                 magic = _recv_exact(conn, len(_MAGIC))
+                theirs = _recv_exact(conn, _FINGERPRINT_LEN)
             except (socket.timeout, ConnectionError, OSError):
                 conn.close()
                 logger.warning("mirror: handshake timeout from %s", addr)
@@ -148,6 +168,18 @@ class DispatchMirror:
             if magic != _MAGIC:
                 conn.close()
                 logger.warning("mirror: bad handshake from %s", addr)
+                continue
+            if (
+                self._fingerprint != _ANY_FINGERPRINT
+                and theirs != _ANY_FINGERPRINT
+                and theirs != self._fingerprint
+            ):
+                conn.close()
+                logger.error(
+                    "mirror: follower %s runs a DIFFERENT serving config "
+                    "(fingerprint mismatch) — rejected; replay on "
+                    "mismatched shapes would silently diverge", addr,
+                )
                 continue
             conn.settimeout(None)
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -212,10 +244,16 @@ class FollowerExecutor:
         self._carry: Optional[Tuple[Any, Any, Any, tuple]] = None
         self.records = 0
 
-    def connect(self, host: str, port: int, timeout: float = 300.0) -> None:
+    def connect(
+        self,
+        host: str,
+        port: int,
+        timeout: float = 300.0,
+        fingerprint: bytes = _ANY_FINGERPRINT,
+    ) -> None:
         self._sock = socket.create_connection((host, port), timeout=timeout)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._sock.sendall(_MAGIC)
+        self._sock.sendall(_MAGIC + fingerprint)
 
     def run(self) -> int:
         """Replay records until a ``stop`` record or stream close.

@@ -105,3 +105,41 @@ def test_mirror_blocks_until_followers_join():
     assert joined.wait(timeout=10)
     thread.join(timeout=10)
     mirror.close()
+
+
+def test_mismatched_config_fingerprint_rejected():
+    """A follower running a different serving config is rejected at
+    handshake — mismatched shapes would not fail loudly (each side
+    compiles its own jit variants) but would silently diverge."""
+    from langstream_tpu.serving.mirror import config_fingerprint
+
+    leader_fp = config_fingerprint({"model": {"preset": "tiny"},
+                                    "engine": {"max-slots": 4}})
+    wrong_fp = config_fingerprint({"model": {"preset": "tiny"},
+                                   "engine": {"max-slots": 8}})
+    assert leader_fp != wrong_fp
+
+    mirror = DispatchMirror(host="127.0.0.1", port=0, fingerprint=leader_fp)
+    accepted = threading.Event()
+
+    def waiter():
+        mirror.wait_for_followers(1, timeout=30)
+        accepted.set()
+
+    thread = threading.Thread(target=waiter)
+    thread.start()
+
+    class _Engine:
+        pass
+
+    # wrong config: rejected (connection closed, waiter keeps waiting)
+    bad = FollowerExecutor(_Engine())
+    bad.connect("127.0.0.1", mirror.port, fingerprint=wrong_fp)
+    assert not accepted.wait(timeout=1.0)
+
+    # right config: accepted
+    good = FollowerExecutor(_Engine())
+    good.connect("127.0.0.1", mirror.port, fingerprint=leader_fp)
+    assert accepted.wait(timeout=10)
+    thread.join(timeout=10)
+    mirror.close()
