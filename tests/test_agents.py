@@ -303,8 +303,10 @@ def test_gated_datasource_errors():
     async def main():
         from langstream_tpu.agents.datasource import DataSourceRegistry
 
+        # cassandra (CQL) stays gated; milvus moved to the REST-native
+        # implementations in external_stores.py
         registry = DataSourceRegistry(
-            {"db": {"configuration": {"service": "milvus"}}}
+            {"db": {"configuration": {"service": "cassandra"}}}
         )
         with pytest.raises(ValueError, match="client library"):
             registry.resolve("db")
