@@ -6,8 +6,17 @@ LOG=${TPU_HEAL_LOG:-/tmp/tpu_heal.log}
 OUT=${TPU_HEAL_OUT:-/tmp/bench_heal.json}
 echo "$(date -u +%FT%TZ) watcher started" >> "$LOG"
 while true; do
-    if timeout 120 python -c "import jax, jax.numpy as jnp; jax.jit(lambda x: x*2)(jnp.ones(4)).block_until_ready()" 2>/dev/null; then
-        echo "$(date -u +%FT%TZ) TPU responsive — running bench" >> "$LOG"
+    # probe with a REAL transfer + matmul: the wedged-relay failure mode
+    # keeps tiny-op RTT at microseconds while bulk transfers hang (seen
+    # round 3: dispatch p50 0.1 ms, 8 GB weight init stuck >40 min), so
+    # a 4-element probe green-lights a dead window. 256 MB up + a
+    # [2048]^2 matmul must round-trip inside the timeout.
+    if timeout 120 python -c "
+import numpy as np, jax, jax.numpy as jnp
+x = jax.device_put(np.ones((8192, 8192), np.float32))  # 256 MB
+y = jax.jit(lambda a: (a[:2048, :2048] @ a[:2048, :2048]).sum())(x)
+y.block_until_ready()" 2>/dev/null; then
+        echo "$(date -u +%FT%TZ) TPU responsive (bulk probe) — running bench" >> "$LOG"
         # first post-change run pays every variant compile: raise the
         # deadline; the persistent compile cache makes later runs (and
         # the driver's own bench) fast
