@@ -63,6 +63,7 @@ _BUILTIN = {
     "flare-controller": ("langstream_tpu.agents.flare", "FlareControllerAgent"),
     # generic connector escape hatch (reference role: Camel / Kafka Connect)
     "exec-source": ("langstream_tpu.agents.connector", "ExecSource"),
+    "camel-source": ("langstream_tpu.agents.camel", "CamelSourceAgent"),
     "exec-sink": ("langstream_tpu.agents.connector", "ExecSink"),
     # Kafka Connect adapters (connector managed via the Connect REST
     # API; data rides the kafka topic runtime)

@@ -387,6 +387,14 @@ for doc in [
         _P("min-chunks-per-message", "integer", "chunk batching ramp", default=20),
         _P("headers", "object", "extra HTTP headers"),
     )),
+    AgentDoc("camel-source", "Consume a Camel endpoint URI (native "
+             "timer/file/http mappings; exec-source for the rest)", (
+        _P("component-uri", "string",
+           "Camel endpoint, e.g. timer:tick?period=1000", required=True),
+        _P("component-options", "object", "extra endpoint parameters"),
+        _P("key-header", "string", "header whose value becomes the key"),
+        _P("max-buffered-records", "integer", "read batch cap", default=100),
+    ), category="source"),
     AgentDoc("exec-source", "Run a command; stdout lines become records", (
         _P("command", "string", "command line to run", required=True),
         _P("parse-json", "boolean", "JSON-decode each line", default=True),

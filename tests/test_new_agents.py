@@ -333,3 +333,108 @@ def test_assets_parse_and_plan(tmp_path):
     assert len(plan.assets) == 1
     assert plan.assets[0].asset_type == "jdbc-table"
     assert plan.assets[0].creation_mode == "create-if-not-exists"
+
+
+# --------------------------- camel-source ------------------------------ #
+def test_camel_source_timer_uri():
+    """`camel-source` with a Camel timer endpoint: fires on the period
+    with the reference's timer/firedTime headers, key-header applies,
+    repeatCount bounds the count."""
+    from langstream_tpu.runtime.registry import create_agent
+
+    async def main():
+        agent = create_agent("camel-source")
+        await agent.init({
+            "component-uri": "timer:tick?period=10&repeatCount=2",
+            "key-header": "timer",
+        })
+        await agent.start()
+        records = []
+        for _ in range(200):
+            records.extend(await agent.read())
+            if len(records) >= 2:
+                break
+            await asyncio.sleep(0.01)
+        assert len(records) == 2
+        assert records[0].key == "tick"
+        headers = dict(records[0].headers)
+        assert headers["timer"] == "tick" and headers["firedTime"] > 0
+        # repeatCount exhausted
+        assert await agent.read() == []
+        await agent.close()
+
+    asyncio.run(main())
+
+
+def test_camel_source_file_uri(tmp_path):
+    from langstream_tpu.runtime.registry import create_agent
+
+    (tmp_path / "a.txt").write_bytes(b"hello camel")
+
+    async def main():
+        agent = create_agent("camel-source")
+        await agent.init({
+            "component-uri": f"file:{tmp_path}?fileExtensions=txt&delay=10",
+        })
+        await agent.start()
+        records = await agent.read()
+        assert records[0].value == b"hello camel"
+        assert dict(records[0].headers)["name"] == "a.txt"
+        await agent.commit(records)
+        await agent.close()
+
+    asyncio.run(main())
+
+
+def test_camel_source_unknown_component_gated():
+    from langstream_tpu.runtime.registry import create_agent
+
+    async def main():
+        agent = create_agent("camel-source")
+        with pytest.raises(ValueError, match="exec-source"):
+            await agent.init({"component-uri": "github:events/main"})
+
+    asyncio.run(main())
+
+
+def test_camel_uri_parsing_edge_cases():
+    """Duplicate query keys survive into the polled URL, valueless
+    boolean flags parse, and Camel duration suffixes work."""
+    from langstream_tpu.agents.camel import (
+        CamelSourceAgent,
+        _duration_ms,
+        _flag,
+        parse_component_uri,
+    )
+
+    scheme, path, pairs = parse_component_uri(
+        "https://api.example.com/x?ids=1&ids=2&delay=250ms"
+    )
+    assert pairs.count(("ids", "1")) == 1 and pairs.count(("ids", "2")) == 1
+    _, _, flag_pairs = parse_component_uri("file:/dir?delete")
+    assert _flag(flag_pairs, "delete") is True
+    assert _duration_ms("5s", "period") == 5000.0
+    assert _duration_ms("1m", "period") == 60000.0
+    assert _duration_ms("250ms", "delay") == 250.0
+    with pytest.raises(ValueError, match="duration"):
+        _duration_ms("fast", "period")
+
+    async def main():
+        agent = CamelSourceAgent()
+        await agent.init({
+            "component-uri": "https://api.example.com/x?ids=1&ids=2&delay=10",
+        })
+        assert agent.url == "https://api.example.com/x?ids=1&ids=2"
+        await agent.close()
+        # close() after a failed init must not mask the config error
+        broken = CamelSourceAgent()
+        with pytest.raises(ValueError):
+            await broken.init({"component-uri": "github:events"})
+        await broken.close()
+        # duration-suffixed timer period
+        timer = CamelSourceAgent()
+        await timer.init({"component-uri": "timer:t?period=5s"})
+        assert timer.period == 5.0
+        await timer.close()
+
+    asyncio.run(main())
