@@ -84,6 +84,7 @@ def engines_snapshot() -> Dict[str, float]:
     same way; here the runtime internal is the TPU engine)."""
     out: Dict[str, float] = {}
     tokens = steps = chunks = 0
+    session_hits = prefix_hits = prefix_tokens = 0
     decode_time = prefill_time = 0.0
     active_slot_steps = total_slot_steps = 0
     for engine in list(_LIVE_ENGINES):
@@ -95,8 +96,14 @@ def engines_snapshot() -> Dict[str, float]:
         prefill_time += stats["prefill_time"]
         active_slot_steps += stats["active_slot_steps"]
         total_slot_steps += stats["decode_steps"] * engine.max_slots
+        session_hits += stats["session_hits"]
+        prefix_hits += stats["prefix_hits"]
+        prefix_tokens += stats["prefix_tokens_reused"]
     if not (tokens or steps):
         return out
+    out["jax_engine_session_hits"] = float(session_hits)
+    out["jax_engine_prefix_hits"] = float(prefix_hits)
+    out["jax_engine_prefix_tokens_reused"] = float(prefix_tokens)
     out["jax_engine_tokens_generated"] = float(tokens)
     out["jax_engine_decode_steps"] = float(steps)
     out["jax_engine_decode_chunks"] = float(chunks)
