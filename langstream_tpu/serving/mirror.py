@@ -73,9 +73,12 @@ def _encode_record(kind: str, meta: Dict[str, Any], arrays: list) -> bytes:
     specs = []
     buffers: List[bytes] = []
     for array in arrays:
-        array = np.ascontiguousarray(array)
+        # np.asarray, NOT ascontiguousarray: the latter promotes 0-d
+        # scalars to shape (1,), and the copy-record jit needs true
+        # scalars for lax.dynamic_slice indices
+        array = np.asarray(array)
         specs.append({"dtype": array.dtype.name, "shape": list(array.shape)})
-        buffers.append(array.tobytes())
+        buffers.append(array.tobytes())  # tobytes is C-order regardless
     header = json.dumps(
         {"kind": kind, "meta": meta, "arrays": specs}
     ).encode()

@@ -143,3 +143,67 @@ def test_mismatched_config_fingerprint_rejected():
     assert accepted.wait(timeout=10)
     thread.join(timeout=10)
     mirror.close()
+
+
+def test_follower_survives_fuzzed_traffic():
+    """Adversarial mix on the leader — sessions racing slot pressure,
+    shared-template prefix copies, chunked long prompts, random sampling
+    params, cancellations racing admission — while a follower replays.
+    Every record kind interleaves arbitrarily; the follower must end
+    bit-identical anyway (cancellation is a host-side decision that
+    never enters the dispatch stream)."""
+    import random
+
+    rng = random.Random(20260731)
+    leader, follower = _engines()
+    mirror = DispatchMirror(host="127.0.0.1", port=0)
+    executor = FollowerExecutor(follower)
+    executor.connect("127.0.0.1", mirror.port)
+    replayed = threading.Thread(target=executor.run)
+    replayed.start()
+    mirror.wait_for_followers(1, timeout=30)
+    leader.mirror = mirror
+    leader.start()
+
+    template = [(29 * j) % 250 + 1 for j in range(20)]
+
+    async def one(i):
+        length = rng.choice([4, 12, 40])
+        prompt = [(i * 17 + j) % 250 + 1 for j in range(length)]
+        if rng.random() < 0.5:
+            prompt = template + prompt[: max(length - 18, 2)]
+        handle: list = []
+        await asyncio.sleep(rng.random() * 0.03)
+        task = asyncio.ensure_future(leader.generate(
+            prompt,
+            SamplingParams(
+                max_new_tokens=rng.choice([2, 5]),
+                temperature=rng.choice([0.0, 0.9]),
+                seed=i,
+            ),
+            session_id=rng.choice([None, f"s{i % 3}"]),
+            handle=handle,
+        ))
+        if rng.random() < 0.2:
+            await asyncio.sleep(rng.random() * 0.05)
+            if handle:
+                handle[0].cancel()
+        return await asyncio.wait_for(task, timeout=120)
+
+    async def drive():
+        return await asyncio.gather(*[one(i) for i in range(24)])
+
+    try:
+        results = asyncio.run(drive())
+        assert len(results) == 24
+    finally:
+        leader.stop()
+    replayed.join(timeout=120)
+    assert not replayed.is_alive()
+    for key in ("k", "v"):
+        assert np.array_equal(
+            np.asarray(leader.cache[key]), np.asarray(follower.cache[key])
+        ), f"cache[{key}] diverged under fuzzed traffic"
+    assert np.array_equal(
+        np.asarray(leader._counts), np.asarray(follower._counts)
+    )
