@@ -123,3 +123,91 @@ def _softmax(scores: jnp.ndarray) -> jnp.ndarray:
     scores = scores - jnp.max(scores, axis=-1, keepdims=True)
     exp = jnp.exp(scores)
     return exp / jnp.sum(exp, axis=-1, keepdims=True)
+
+
+# ---------------------------------------------------------------------- #
+# int8 KV-cache variants
+# ---------------------------------------------------------------------- #
+# The cache stores int8 values with a per-(position, kv-head) scale.
+# Per-row scales COMMUTE with both attention contractions, so the MXU
+# streams the bare int8 cache and the scales touch only
+# activation-sized arrays — the same algebra that fixed the weight
+# dequant in round 3 (quant.qeinsum):
+#   QK: q · (K_q * s)ᵀ  = (q · K_qᵀ) * s      (s indexes [pos, head] —
+#                                              the score layout)
+#   PV: p · (V_q * s)   = (p * s) · V_q       (s folds into the probs)
+
+
+def quantize_kv(x: jnp.ndarray):
+    """Per-row symmetric int8: x [..., D] → (int8 values, f32 scales
+    [...]) with scale = amax/127 over the head dim."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    values = jnp.round(
+        x.astype(jnp.float32) / scale[..., None]
+    ).astype(jnp.int8)
+    return values, scale
+
+
+def decode_attention_quant(
+    q: jnp.ndarray,
+    k_cache: jnp.ndarray,    # [B, S, KVH, D] int8
+    k_scale: jnp.ndarray,    # [B, S, KVH] f32
+    v_cache: jnp.ndarray,
+    v_scale: jnp.ndarray,
+    lengths: jnp.ndarray,
+) -> jnp.ndarray:
+    """:func:`decode_attention` over an int8 cache (see algebra above)."""
+    batch, heads, dim = q.shape
+    max_len = k_cache.shape[1]
+    kv_heads = k_cache.shape[2]
+    groups = heads // kv_heads
+    scale = dim ** -0.5
+    qg = q.reshape(batch, kv_heads, groups, dim)
+    scores = jnp.einsum(
+        "bkgd,bskd->bkgs", qg.astype(jnp.float32),
+        k_cache.astype(jnp.float32),
+    )
+    scores = scores * k_scale.transpose(0, 2, 1)[:, :, None, :] * scale
+    valid = jnp.arange(max_len)[None, :] < lengths[:, None]
+    scores = jnp.where(valid[:, None, None, :], scores, -1e30)
+    weights = _softmax(scores)
+    weights = weights * v_scale.transpose(0, 2, 1)[:, :, None, :]
+    out = jnp.einsum(
+        "bkgs,bskd->bkgd", weights, v_cache.astype(jnp.float32)
+    )
+    return out.reshape(batch, heads, dim).astype(q.dtype)
+
+
+def chunk_attention_quant(
+    q: jnp.ndarray,
+    k_cache: jnp.ndarray,    # [B, S, KVH, D] int8
+    k_scale: jnp.ndarray,    # [B, S, KVH] f32
+    v_cache: jnp.ndarray,
+    v_scale: jnp.ndarray,
+    starts: jnp.ndarray,
+    lengths: jnp.ndarray,
+) -> jnp.ndarray:
+    """:func:`chunk_attention` over an int8 cache."""
+    batch, seq, heads, dim = q.shape
+    max_len = k_cache.shape[1]
+    kv_heads = k_cache.shape[2]
+    scale = dim ** -0.5
+    qg = _group_query(q, kv_heads)
+    scores = jnp.einsum(
+        "bqkgd,bskd->bkgqs", qg.astype(jnp.float32),
+        k_cache.astype(jnp.float32),
+    )
+    scores = scores * k_scale.transpose(0, 2, 1)[:, :, None, None, :] * scale
+    pos_q = starts[:, None] + jnp.arange(seq)[None, :]
+    pos_s = jnp.arange(max_len)[None, None, :]
+    allowed = (pos_s <= pos_q[:, :, None]) & (
+        pos_s < lengths[:, None, None]
+    )
+    scores = jnp.where(allowed[:, None, None, :, :], scores, -1e30)
+    weights = _softmax(scores)
+    weights = weights * v_scale.transpose(0, 2, 1)[:, :, None, None, :]
+    out = jnp.einsum(
+        "bkgqs,bskd->bqkgd", weights, v_cache.astype(jnp.float32)
+    )
+    return out.reshape(batch, seq, heads, dim).astype(q.dtype)

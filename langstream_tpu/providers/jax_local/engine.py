@@ -225,6 +225,7 @@ class DecodeEngine:
         decode_chunk: int = 8,
         seed: int = 0,
         quantize: Optional[str] = None,  # "int8" = weight-only int8
+        kv_quant: Optional[str] = None,  # "int8" = int8 KV cache
         pipeline_decode: bool = False,
         prefix_cache: bool = True,
     ) -> None:
@@ -286,12 +287,18 @@ class DecodeEngine:
         self.freqs = rope_frequencies(
             config.dims_per_head, config.max_seq_len, config.rope_theta
         )
+        if kv_quant not in (None, "int8"):
+            raise ValueError(f"unknown kv cache quantization {kv_quant!r}")
+        self.kv_quant = kv_quant == "int8"
         cache_sharding = param_shardings(
-            model_lib.cache_logical_axes(), self.mesh
+            model_lib.cache_logical_axes(self.kv_quant), self.mesh
         )
         with self.mesh:
             self.cache = jax.device_put(
-                model_lib.init_cache(config, max_slots, self.max_seq_len),
+                model_lib.init_cache(
+                    config, max_slots, self.max_seq_len,
+                    kv_quant=self.kv_quant,
+                ),
                 cache_sharding,
             )
         self.slots = [_Slot() for _ in range(max_slots)]
@@ -509,13 +516,15 @@ class DecodeEngine:
                 del params
 
                 def move(c):
-                    layers, _, _, kv_heads, head_dim = c.shape
+                    # rank-agnostic: value leaves are 5-d, int8-KV scale
+                    # leaves 4-d — both are [layers, slot, seq, ...]
+                    tail = (0,) * (c.ndim - 3)
                     chunk = jax.lax.dynamic_slice(
-                        c, (0, src, offset, 0, 0),
-                        (layers, 1, bucket, kv_heads, head_dim),
+                        c, (0, src, offset) + tail,
+                        (c.shape[0], 1, bucket) + c.shape[3:],
                     )
                     return jax.lax.dynamic_update_slice(
-                        c, chunk, (0, dst, offset, 0, 0)
+                        c, chunk, (0, dst, offset) + tail
                     )
 
                 return (jax.tree_util.tree_map(move, cache),)

@@ -26,8 +26,11 @@ import numpy as np
 
 from langstream_tpu.ops.attention import (
     chunk_attention,
+    chunk_attention_quant,
     decode_attention,
+    decode_attention_quant,
     prefill_attention,
+    quantize_kv,
 )
 from langstream_tpu.ops.flash_attention import flash_prefill_attention, use_flash
 from langstream_tpu.ops.norms import rms_norm
@@ -221,22 +224,45 @@ def logical_axes(config: LlamaConfig) -> Dict[str, Any]:
 
 
 def init_cache(
-    config: LlamaConfig, batch: int, max_len: Optional[int] = None
+    config: LlamaConfig,
+    batch: int,
+    max_len: Optional[int] = None,
+    kv_quant: bool = False,
 ) -> Dict[str, jnp.ndarray]:
-    """KV cache: [layers, batch, max_len, kv_heads, head_dim]."""
+    """KV cache: [layers, batch, max_len, kv_heads, head_dim].
+
+    ``kv_quant`` stores int8 values plus per-(position, kv-head) f32
+    scales — halves the cache's HBM bytes on the weights+cache-bound
+    decode path (scales are 1/32 of the int8 bytes at head_dim 128).
+    The forward paths detect quantization by the ``k_scale`` key."""
     max_len = max_len or config.max_seq_len
     shape = (config.num_layers, batch, max_len, config.num_kv_heads, config.dims_per_head)
+    if kv_quant:
+        return {
+            "k": jnp.zeros(shape, dtype=jnp.int8),
+            "v": jnp.zeros(shape, dtype=jnp.int8),
+            "k_scale": jnp.zeros(shape[:-1], dtype=jnp.float32),
+            "v_scale": jnp.zeros(shape[:-1], dtype=jnp.float32),
+        }
     return {
         "k": jnp.zeros(shape, dtype=config.dtype),
         "v": jnp.zeros(shape, dtype=config.dtype),
     }
 
 
-def cache_logical_axes() -> Dict[str, Any]:
-    return {
+def cache_logical_axes(kv_quant: bool = False) -> Dict[str, Any]:
+    axes: Dict[str, Any] = {
         "k": L("layers", "cache_batch", "cache_sequence", "kv_heads", None),
         "v": L("layers", "cache_batch", "cache_sequence", "kv_heads", None),
     }
+    if kv_quant:
+        axes["k_scale"] = L(
+            "layers", "cache_batch", "cache_sequence", "kv_heads"
+        )
+        axes["v_scale"] = L(
+            "layers", "cache_batch", "cache_sequence", "kv_heads"
+        )
+    return axes
 
 
 def _stack_layer_params(params: Dict[str, jnp.ndarray]):
@@ -333,6 +359,7 @@ def prefill(
     x = params["embedding"][tokens].astype(config.dtype)  # [B, T, H]
 
     layer_inputs = _stack_layer_params(params)
+    quantized = "k_scale" in cache
 
     def layer_fn(x, layer):
         attn_norm, wq, wk, wv, wo, mlp_norm, mlp_weights = layer
@@ -348,7 +375,24 @@ def prefill(
         )
         q = apply_rope(q, freqs, positions)
         k = apply_rope(k, freqs, positions)
-        attn = _prefill_attn(config, q, k, v, mask, mesh=mesh)
+        if quantized:
+            # quantize ONCE and run the prompt's self-attention through
+            # the SAME f32 scale-folded math the warm/decode dispatches
+            # use (the just-written rows as the "cache", starts=0):
+            # identical formulas over identical row contents keep
+            # cold/warm/prefix-copy paths token-identical. The flash
+            # kernel is bf16-only, so quantized cold prefill takes this
+            # XLA path (int8 flash is future work — docs/perf.md).
+            k_q, k_s = quantize_kv(k)
+            v_q, v_s = quantize_kv(v)
+            attn = chunk_attention_quant(
+                q, k_q, k_s, v_q, v_s,
+                jnp.zeros_like(lengths), lengths,
+            )
+            layer_kv_out = (k_q, v_q, k_s, v_s)
+        else:
+            layer_kv_out = (k, v)
+            attn = _prefill_attn(config, q, k, v, mask, mesh=mesh)
         attn = qeinsum(
             "btd,dh->bth", attn.reshape(batch, seq, config.num_heads * hd), wo
         )
@@ -356,23 +400,38 @@ def prefill(
         normed = rms_norm(x, mlp_norm, config.norm_eps)
         delta, _ = _mlp_block(config, normed, mlp_weights, valid=mask, dropless=True)
         x = x + delta
-        return x, (k, v)
+        return x, layer_kv_out
 
     x, layer_kv = jax.lax.scan(layer_fn, x, layer_inputs)
-    # layer_kv: k/v each [L, B, T, KVH, hd] — scatter into cache slots
-    new_k, new_v = layer_kv
     max_len = cache["k"].shape[2]
     pad = max_len - seq
-    if pad > 0:
-        new_k = jnp.pad(new_k, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0)))
-        new_v = jnp.pad(new_v, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0)))
-    k_cache = cache["k"].at[:, slot_ids].set(new_k)
-    v_cache = cache["v"].at[:, slot_ids].set(new_v)
+
+    def pad_rows(array):
+        if pad <= 0:
+            return array
+        widths = [(0, 0), (0, 0), (0, pad)] + [(0, 0)] * (array.ndim - 3)
+        return jnp.pad(array, widths)
+
+    out = dict(cache)
+    if quantized:
+        # grouped (k, v, k_scale, v_scale) — the ordering every
+        # quantized scan in this module uses
+        new_k, new_v, k_scale, v_scale = layer_kv
+        out["k_scale"] = cache["k_scale"].at[:, slot_ids].set(pad_rows(k_scale))
+        out["v_scale"] = cache["v_scale"].at[:, slot_ids].set(pad_rows(v_scale))
+    else:
+        new_k, new_v = layer_kv
+    out["k"] = cache["k"].at[:, slot_ids].set(
+        pad_rows(new_k).astype(cache["k"].dtype)
+    )
+    out["v"] = cache["v"].at[:, slot_ids].set(
+        pad_rows(new_v).astype(cache["v"].dtype)
+    )
 
     x = rms_norm(x, params["final_norm"], config.norm_eps)
     last = x[jnp.arange(batch), (lengths - 1).astype(jnp.int32)]  # [B, H]
     logits = _logits(config, params, last)
-    return {"k": k_cache, "v": v_cache}, logits
+    return out, logits
 
 
 def prefill_at_offset(
@@ -402,22 +461,24 @@ def prefill_at_offset(
     x = params["embedding"][tokens].astype(config.dtype)     # [B, T, H]
 
     layer_inputs = _stack_layer_params(params)
-    k_cache, v_cache = cache["k"], cache["v"]
+    quantized = "k_scale" in cache
 
     def write_rows(kc, new, offs):
-        # kc: [S, max_len, KVH, hd]; new: [B, T, KVH, hd] — write each
-        # row's suffix window at its offset. Padding positions beyond the
-        # suffix length land past ``totals`` where cache content is dead.
+        # kc: [S, max_len, ...]; new: [B, T, ...] — write each row's
+        # suffix window at its offset (rank-agnostic: value leaves carry
+        # a head_dim axis, scale leaves don't). Padding positions beyond
+        # the suffix length land past ``totals`` where content is dead.
         def body(kc, args):
             row_new, off, slot = args
             row = jax.lax.dynamic_slice(
-                kc, (slot, 0, 0, 0), (1, kc.shape[1], kc.shape[2], kc.shape[3])
+                kc, (slot,) + (0,) * (kc.ndim - 1), (1,) + kc.shape[1:]
             )[0]
             row = jax.lax.dynamic_update_slice(
-                row, row_new.astype(row.dtype), (off, 0, 0)
+                row, row_new.astype(row.dtype),
+                (off,) + (0,) * (row.ndim - 1),
             )
             return jax.lax.dynamic_update_slice(
-                kc, row[None], (slot, 0, 0, 0)
+                kc, row[None], (slot,) + (0,) * (kc.ndim - 1)
             ), None
 
         kc, _ = jax.lax.scan(body, kc, (new, offs, slot_ids))
@@ -425,7 +486,11 @@ def prefill_at_offset(
 
     def layer_fn(carry, inputs):
         x = carry
-        (attn_norm, wq, wk, wv, wo, mlp_norm, mlp_weights), kc, vc = inputs
+        if quantized:
+            layer, kc, vc, ks, vs = inputs
+        else:
+            layer, kc, vc = inputs
+        attn_norm, wq, wk, wv, wo, mlp_norm, mlp_weights = layer
         normed = rms_norm(x, attn_norm, config.norm_eps)
         q = qeinsum("bth,hd->btd", normed, wq).reshape(
             batch, seq, config.num_heads, hd
@@ -438,24 +503,48 @@ def prefill_at_offset(
         )
         q = apply_rope(q, freqs, positions)
         k = apply_rope(k, freqs, positions)
-        kc = write_rows(kc, k, offsets)
-        vc = write_rows(vc, v, offsets)
-        attn = chunk_attention(q, kc[slot_ids], vc[slot_ids], offsets, totals)
+        if quantized:
+            k_q, k_s = quantize_kv(k)
+            v_q, v_s = quantize_kv(v)
+            kc = write_rows(kc, k_q, offsets)
+            ks = write_rows(ks, k_s, offsets)
+            vc = write_rows(vc, v_q, offsets)
+            vs = write_rows(vs, v_s, offsets)
+            attn = chunk_attention_quant(
+                q, kc[slot_ids], ks[slot_ids], vc[slot_ids],
+                vs[slot_ids], offsets, totals,
+            )
+            kv_out = (kc, vc, ks, vs)
+        else:
+            kc = write_rows(kc, k, offsets)
+            vc = write_rows(vc, v, offsets)
+            attn = chunk_attention(
+                q, kc[slot_ids], vc[slot_ids], offsets, totals
+            )
+            kv_out = (kc, vc)
         x = x + qeinsum(
             "btd,dh->bth", attn.reshape(batch, seq, config.num_heads * hd), wo
         )
         normed = rms_norm(x, mlp_norm, config.norm_eps)
         delta, _ = _mlp_block(config, normed, mlp_weights, valid=mask, dropless=True)
         x = x + delta
-        return x, (kc, vc)
+        return x, kv_out
 
-    x, (k_cache, v_cache) = jax.lax.scan(
-        layer_fn, x, (layer_inputs, k_cache, v_cache)
-    )
+    if quantized:
+        xs = (layer_inputs, cache["k"], cache["v"],
+              cache["k_scale"], cache["v_scale"])
+    else:
+        xs = (layer_inputs, cache["k"], cache["v"])
+    x, kv_caches = jax.lax.scan(layer_fn, x, xs)
+    out = dict(cache)
+    if quantized:
+        out["k"], out["v"], out["k_scale"], out["v_scale"] = kv_caches
+    else:
+        out["k"], out["v"] = kv_caches
     x = rms_norm(x, params["final_norm"], config.norm_eps)
     last = x[jnp.arange(batch), (lengths - 1).astype(jnp.int32)]  # [B, H]
     logits = _logits(config, params, last)
-    return {"k": k_cache, "v": v_cache}, logits
+    return out, logits
 
 
 def decode_step(
@@ -481,41 +570,63 @@ def decode_step(
     x = params["embedding"][tokens].astype(config.dtype)  # [S, H]
 
     layer_inputs = _stack_layer_params(params)
-    k_cache, v_cache = cache["k"], cache["v"]
+    quantized = "k_scale" in cache
 
     def write(c, pos, new, enabled):
         return c.at[pos].set(jnp.where(enabled, new, c[pos]))
 
     def layer_fn(carry, inputs):
         x = carry
-        (attn_norm, wq, wk, wv, wo, mlp_norm, mlp_weights), kc, vc = inputs
+        if quantized:
+            layer, kc, vc, ks, vs = inputs
+        else:
+            layer, kc, vc = inputs
+        attn_norm, wq, wk, wv, wo, mlp_norm, mlp_weights = layer
         normed = rms_norm(x, attn_norm, config.norm_eps)
         q = qeinsum("sh,hd->sd", normed, wq).reshape(slots, config.num_heads, hd)
         k = qeinsum("sh,hd->sd", normed, wk).reshape(slots, config.num_kv_heads, hd)
         v = qeinsum("sh,hd->sd", normed, wv).reshape(slots, config.num_kv_heads, hd)
         q = apply_rope(q[:, None], freqs, positions[:, None])[:, 0]
         k = apply_rope(k[:, None], freqs, positions[:, None])[:, 0]
-        kc = jax.vmap(write)(kc, positions, k, write_mask)
-        vc = jax.vmap(write)(vc, positions, v, write_mask)
-        attn = decode_attention(q, kc, vc, lengths)
+        if quantized:
+            k_q, k_s = quantize_kv(k)
+            v_q, v_s = quantize_kv(v)
+            kc = jax.vmap(write)(kc, positions, k_q, write_mask)
+            ks = jax.vmap(write)(ks, positions, k_s, write_mask)
+            vc = jax.vmap(write)(vc, positions, v_q, write_mask)
+            vs = jax.vmap(write)(vs, positions, v_s, write_mask)
+            attn = decode_attention_quant(q, kc, ks, vc, vs, lengths)
+            kv_out = (kc, vc, ks, vs)
+        else:
+            kc = jax.vmap(write)(kc, positions, k, write_mask)
+            vc = jax.vmap(write)(vc, positions, v, write_mask)
+            attn = decode_attention(q, kc, vc, lengths)
+            kv_out = (kc, vc)
         x = x + qeinsum("sd,dh->sh", attn.reshape(slots, config.num_heads * hd), wo)
         normed = rms_norm(x, mlp_norm, config.norm_eps)
         # decode groups are tiny (S = slots) so dropless capacity is cheap;
         # inactive slots can't evict anyone, so no valid mask is needed
         delta, _ = _mlp_block(config, normed, mlp_weights, dropless=True)
         x = x + delta
-        return x, (kc, vc)
+        return x, kv_out
 
+    if quantized:
+        xs = (layer_inputs, cache["k"], cache["v"],
+              cache["k_scale"], cache["v_scale"])
+    else:
+        xs = (layer_inputs, cache["k"], cache["v"])
     # unroll lets XLA software-pipeline the next layer's weight loads
     # against the current layer's compute on the weights-bound decode
     # path (measured via LS_DECODE_UNROLL; 1 = plain scan)
-    x, (k_cache, v_cache) = jax.lax.scan(
-        layer_fn, x, (layer_inputs, k_cache, v_cache),
-        unroll=_decode_unroll(),
-    )
+    x, kv_caches = jax.lax.scan(layer_fn, x, xs, unroll=_decode_unroll())
+    out = dict(cache)
+    if quantized:
+        out["k"], out["v"], out["k_scale"], out["v_scale"] = kv_caches
+    else:
+        out["k"], out["v"] = kv_caches
     x = rms_norm(x, params["final_norm"], config.norm_eps)
     logits = _logits(config, params, x)
-    return {"k": k_cache, "v": v_cache}, logits
+    return out, logits
 
 
 def _decode_unroll() -> int:
