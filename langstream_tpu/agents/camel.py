@@ -8,20 +8,29 @@ consumes any Camel ``component-uri`` and turns exchanges into records
 header used as the record key).
 
 The TPU build has no JVM, so the full Camel component zoo cannot run
-in-process. Instead the COMMON component URIs are executed natively by
-delegating to the framework's own sources, keeping pipeline definitions
-portable as-is:
+in-process. Instead the URI is dispatched through a **scheme registry**
+(:data:`CAMEL_SCHEMES`, extensible via :func:`register_camel_scheme` —
+plugin packages can map additional component families) and the COMMON
+component URIs are executed natively by delegating to the framework's
+own sources, keeping pipeline definitions portable as-is:
 
 - ``timer:name?period=1000&repeatCount=N`` — periodic records with
   Camel's ``timer``/``firedTime`` headers;
 - ``file:/dir?delete=true&fileExtensions=txt`` — directory source
   (delegates to :class:`agents.storage.FileSource`);
-- ``http://…`` / ``https://…?delay=500`` — polling HTTP consumer.
+- ``http://…`` / ``https://…?delay=500`` — polling HTTP consumer;
+- ``kafka:topic?brokers=host:port&groupId=g`` — consumes a Kafka topic
+  through the framework's own wire-protocol client (Camel's kafka
+  component options ``brokers``/``groupId``/``autoOffsetReset``);
+- ``netty-http:http://bind:port/path`` — embedded HTTP *server*
+  consumer (Camel's netty-http in ``from()`` position listens): every
+  incoming request becomes a record.
 
-Anything else raises with the honest escape hatch: run the real Camel
-route in its own process via ``exec-source`` (``agents/connector.py``).
-``component-options`` merge into the URI's query parameters, matching
-Camel's own config layering.
+Anything else raises with the honest escape hatch: register a scheme
+mapping from a plugin, or run the real Camel route in its own process
+via ``exec-source`` (``agents/connector.py``). ``component-options``
+merge into the URI's query parameters, matching Camel's own config
+layering.
 """
 
 from __future__ import annotations
@@ -29,7 +38,7 @@ from __future__ import annotations
 import asyncio
 import time
 import urllib.parse
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from langstream_tpu.api.agent import AgentSource
 from langstream_tpu.api.records import Record, now_millis
@@ -49,7 +58,10 @@ def parse_component_uri(
     pairs = urllib.parse.parse_qsl(query, keep_blank_values=True)
     for key, value in (options or {}).items():
         pairs.append((str(key), str(value)))
-    return scheme.lower(), path.strip("/") if scheme == "timer" else path, pairs
+    scheme = scheme.lower()
+    if scheme in ("timer", "kafka"):
+        path = path.strip("/")
+    return scheme, path, pairs
 
 
 def _last(pairs: List[Tuple[str, str]], key: str, default: str) -> str:
@@ -89,71 +101,21 @@ def _duration_ms(value: str, key: str) -> float:
         ) from None
 
 
-class CamelSourceAgent(AgentSource):
-    agent_type = "camel-source"
+# ------------------------------------------------------------------ #
+# per-scheme endpoints — each is a normal AgentSource the facade
+# delegates to, so read/commit/close flow uniformly
+# ------------------------------------------------------------------ #
 
-    async def init(self, configuration: Dict[str, Any]) -> None:
-        self._delegate = None
-        self._session = None
-        uri = configuration.get("component-uri") or ""
-        self.key_header = configuration.get("key-header") or ""
-        self.max_buffered = int(configuration.get("max-buffered-records", 100))
-        self.scheme, path, pairs = parse_component_uri(
-            uri, configuration.get("component-options")
-        )
-        if self.scheme == "timer":
-            self.timer_name = path
-            self.period = _duration_ms(
-                _last(pairs, "period", "1000"), "period"
-            ) / 1000.0
-            repeat = int(_last(pairs, "repeatCount", "0"))
-            self.remaining = repeat if repeat > 0 else None
-            self._next_fire = time.monotonic() + self.period
-        elif self.scheme == "file":
-            from langstream_tpu.agents.storage import FileSource
 
-            self._delegate = FileSource()
-            await self._delegate.init({
-                "path": path,
-                "delete-objects": _flag(pairs, "delete"),
-                "file-extensions": _last(pairs, "fileExtensions", ""),
-                "idle-time": _duration_ms(
-                    _last(pairs, "delay", "500"), "delay"
-                ) / 1000.0,
-            })
-        elif self.scheme in ("http", "https"):
-            # rebuild the URL from the pair list so duplicate keys
-            # (?ids=1&ids=2) survive; only the polling `delay` is ours
-            self.url = uri.split("?", 1)[0]
-            keep = [(k, v) for k, v in pairs if k != "delay"]
-            if keep:
-                self.url += "?" + urllib.parse.urlencode(keep)
-            self.poll_delay = _duration_ms(
-                _last(pairs, "delay", "500"), "delay"
-            ) / 1000.0
-        else:
-            raise ValueError(
-                f"camel-source component {self.scheme!r} has no native "
-                "mapping (supported: timer, file, http, https); run the "
-                "real Camel route in its own process and bridge it with "
-                "exec-source (agents/connector.py)"
-            )
-
-    # ---------------------------------------------------------------- #
-    async def start(self) -> None:
-        if self._delegate is not None:
-            await self._delegate.start()
+class _TimerEndpoint(AgentSource):
+    def __init__(self, path: str, pairs: List[Tuple[str, str]]) -> None:
+        self.timer_name = path
+        self.period = _duration_ms(_last(pairs, "period", "1000"), "period") / 1000.0
+        repeat = int(_last(pairs, "repeatCount", "0"))
+        self.remaining: Optional[int] = repeat if repeat > 0 else None
+        self._next_fire = time.monotonic() + self.period
 
     async def read(self, max_records: int = 100) -> List[Record]:
-        max_records = min(max_records, self.max_buffered)
-        if self._delegate is not None:
-            records = await self._delegate.read(max_records)
-            return [self._rekey(r) for r in records]
-        if self.scheme == "timer":
-            return await self._read_timer()
-        return await self._read_http()
-
-    async def _read_timer(self) -> List[Record]:
         if self.remaining is not None and self.remaining <= 0:
             # exhausted: yield so the runner's poll loop never busy-spins
             await asyncio.sleep(0.2)
@@ -167,32 +129,272 @@ class CamelSourceAgent(AgentSource):
         self._next_fire = time.monotonic() + self.period
         if self.remaining is not None:
             self.remaining -= 1
-        headers = (
-            ("timer", self.timer_name), ("firedTime", now_millis()),
-        )
-        return [self._rekey(Record(
-            value=None, headers=headers, timestamp=now_millis(),
-        ))]
+        headers = (("timer", self.timer_name), ("firedTime", now_millis()))
+        return [Record(value=None, headers=headers, timestamp=now_millis())]
 
-    async def _read_http(self) -> List[Record]:
+    async def commit(self, records: List[Record]) -> None:
+        pass
+
+
+class _HttpPollEndpoint(AgentSource):
+    def __init__(
+        self, uri: str, pairs: List[Tuple[str, str]]
+    ) -> None:
+        # fail at deploy time, not first read: a missing dependency or
+        # bad config should surface before the pipeline is running
+        import aiohttp  # noqa: F401
+
+        # rebuild the URL from the pair list so duplicate keys
+        # (?ids=1&ids=2) survive; only the polling `delay` is ours
+        self.url = uri.split("?", 1)[0]
+        keep = [(k, v) for k, v in pairs if k != "delay"]
+        if keep:
+            self.url += "?" + urllib.parse.urlencode(keep)
+        self.poll_delay = _duration_ms(_last(pairs, "delay", "500"), "delay") / 1000.0
+        self._session = None
+
+    async def read(self, max_records: int = 100) -> List[Record]:
         await asyncio.sleep(self.poll_delay)
         import aiohttp
 
         if self._session is None:
             self._session = aiohttp.ClientSession()
+        # non-2xx responses are still emitted as records — Camel's
+        # polling consumer does the same; consumers distinguish them via
+        # the CamelHttpResponseCode header
         async with self._session.get(self.url) as response:
             body = await response.read()
             record = Record(
                 value=body,
                 headers=(
                     ("CamelHttpResponseCode", response.status),
-                    ("Content-Type", response.headers.get(
-                        "Content-Type", "")),
+                    ("Content-Type", response.headers.get("Content-Type", "")),
                 ),
                 origin=self.url,
                 timestamp=now_millis(),
             )
-        return [self._rekey(record)]
+        return [record]
+
+    async def commit(self, records: List[Record]) -> None:
+        pass
+
+    async def close(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+
+
+class _KafkaEndpoint(AgentSource):
+    """``kafka:topic?brokers=host:port&groupId=g`` — Camel's kafka
+    component consumed through the framework's own Kafka runtime
+    (topics/kafka), so the wire protocol, watermark commit, and group
+    membership are the ones already tested by test_topic_contract."""
+
+    def __init__(self, path: str, pairs: List[Tuple[str, str]]) -> None:
+        from langstream_tpu.topics.kafka.runtime import (
+            KafkaTopicConnectionsRuntime,
+        )
+
+        if not path:
+            raise ValueError("camel-source: kafka URI needs a topic name")
+        self.topic = path
+        configuration: Dict[str, Any] = {
+            "bootstrapServers": _last(pairs, "brokers", "127.0.0.1:9092"),
+        }
+        reset = _last(pairs, "autoOffsetReset", "earliest")
+        configuration["autoOffsetReset"] = reset
+        self._runtime = KafkaTopicConnectionsRuntime(configuration)
+        self._consumer = self._runtime.create_consumer(
+            "camel-source",
+            {"topic": path, "group": _last(pairs, "groupId", "") or None},
+        )
+
+    async def start(self) -> None:
+        await self._consumer.start()
+
+    async def read(self, max_records: int = 100) -> List[Record]:
+        records = await self._consumer.read(max_records, timeout=0.5)
+        out = []
+        for record in records:
+            headers = tuple(record.headers or ()) + (
+                ("kafka.TOPIC", self.topic),
+            )
+            out.append(
+                Record(
+                    key=record.key,
+                    value=record.value,
+                    headers=headers,
+                    origin=self.topic,
+                    timestamp=record.timestamp,
+                )
+            )
+            self._raw = getattr(self, "_raw", {})
+            self._raw[id(out[-1])] = record
+        return out
+
+    async def commit(self, records: List[Record]) -> None:
+        raw = getattr(self, "_raw", {})
+        underlying = [raw.pop(id(r)) for r in records if id(r) in raw]
+        if underlying:
+            await self._consumer.commit(underlying)
+
+    async def close(self) -> None:
+        await self._consumer.close()
+        await self._runtime.close()
+
+
+class _NettyHttpEndpoint(AgentSource):
+    """``netty-http:http://bind:port/path`` — Camel's netty-http
+    component in consumer position is an embedded HTTP **server**:
+    every incoming request becomes one record (body → value, request
+    headers + method/path → headers). Responds 200 immediately —
+    ingestion is asynchronous from processing, like the reference's
+    Camel consumer handing exchanges to the LangStream buffer."""
+
+    def __init__(self, path: str, pairs: List[Tuple[str, str]]) -> None:
+        import aiohttp  # noqa: F401 — fail at deploy time if absent
+
+        inner = path if "://" in path else f"http://{path}"
+        parsed = urllib.parse.urlsplit(inner)
+        self.host = parsed.hostname or "127.0.0.1"
+        self.port = parsed.port or 0
+        self.path = parsed.path or "/"
+        self.bound_port: Optional[int] = None
+        self._queue: "asyncio.Queue[Record]" = asyncio.Queue(
+            maxsize=int(_last(pairs, "maxBuffered", "1000"))
+        )
+        self._runner = None
+
+    async def start(self) -> None:
+        from aiohttp import web
+
+        async def handle(request):
+            body = await request.read()
+            headers = [
+                ("CamelHttpMethod", request.method),
+                ("CamelHttpPath", request.path),
+                ("CamelHttpQuery", request.query_string),
+            ]
+            headers += [(k, v) for k, v in request.headers.items()]
+            await self._queue.put(
+                Record(
+                    value=body,
+                    headers=tuple(headers),
+                    origin=request.path,
+                    timestamp=now_millis(),
+                )
+            )
+            return web.Response(status=200)
+
+        app = web.Application()
+        # accept the configured path and everything under it
+        app.router.add_route("*", self.path, handle)
+        if self.path != "/":
+            app.router.add_route("*", self.path.rstrip("/") + "/{tail:.*}", handle)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        for s in self._runner.sites:
+            self.bound_port = s._server.sockets[0].getsockname()[1]  # noqa: SLF001
+
+    async def read(self, max_records: int = 100) -> List[Record]:
+        try:
+            first = await asyncio.wait_for(self._queue.get(), timeout=0.5)
+        except asyncio.TimeoutError:
+            return []
+        out = [first]
+        while len(out) < max_records:
+            try:
+                out.append(self._queue.get_nowait())
+            except asyncio.QueueEmpty:
+                break
+        return out
+
+    async def commit(self, records: List[Record]) -> None:
+        pass
+
+    async def close(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+
+def _file_endpoint(path: str, pairs: List[Tuple[str, str]]) -> AgentSource:
+    from langstream_tpu.agents.storage import FileSource
+
+    source = FileSource()
+    source._camel_init_config = {  # consumed by CamelSourceAgent.init
+        "path": path,
+        "delete-objects": _flag(pairs, "delete"),
+        "file-extensions": _last(pairs, "fileExtensions", ""),
+        "idle-time": _duration_ms(_last(pairs, "delay", "500"), "delay") / 1000.0,
+    }
+    return source
+
+
+# scheme → factory(path, pairs) -> AgentSource. Extensible: plugin
+# packages call register_camel_scheme to map more component families.
+CAMEL_SCHEMES: Dict[str, Callable[[str, List[Tuple[str, str]]], AgentSource]] = {
+    "timer": _TimerEndpoint,
+    "file": _file_endpoint,
+    "kafka": _KafkaEndpoint,
+    "netty-http": _NettyHttpEndpoint,
+}
+
+
+def register_camel_scheme(
+    scheme: str,
+    factory: Callable[[str, List[Tuple[str, str]]], AgentSource],
+) -> None:
+    """Map an additional Camel component scheme onto a native source.
+    Plugin packages (runtime/plugins.py) use this to extend the zoo."""
+    CAMEL_SCHEMES[scheme.lower()] = factory
+
+
+class CamelSourceAgent(AgentSource):
+    agent_type = "camel-source"
+
+    async def init(self, configuration: Dict[str, Any]) -> None:
+        uri = configuration.get("component-uri") or ""
+        self.key_header = configuration.get("key-header") or ""
+        self.max_buffered = int(configuration.get("max-buffered-records", 100))
+        self.scheme, path, pairs = parse_component_uri(
+            uri, configuration.get("component-options")
+        )
+        if self.scheme in ("http", "https"):
+            self._delegate: AgentSource = _HttpPollEndpoint(uri, pairs)
+        elif self.scheme in CAMEL_SCHEMES:
+            self._delegate = CAMEL_SCHEMES[self.scheme](path, pairs)
+        else:
+            raise ValueError(
+                f"camel-source component {self.scheme!r} has no native "
+                f"mapping (supported: "
+                f"{', '.join(sorted(CAMEL_SCHEMES) + ['http', 'https'])}); "
+                "register one with "
+                "langstream_tpu.agents.camel.register_camel_scheme from a "
+                "plugin package, or run the real Camel route in its own "
+                "process and bridge it with exec-source "
+                "(agents/connector.py)"
+            )
+        init_config = getattr(self._delegate, "_camel_init_config", None)
+        if init_config is not None:
+            await self._delegate.init(init_config)
+
+    def __getattr__(self, name: str):
+        # endpoint attributes (url, period, bound_port, …) read through
+        # the facade — the pre-registry API exposed them directly
+        delegate = self.__dict__.get("_delegate")
+        if delegate is not None and not name.startswith("_"):
+            return getattr(delegate, name)
+        raise AttributeError(name)
+
+    # ---------------------------------------------------------------- #
+    async def start(self) -> None:
+        await self._delegate.start()
+
+    async def read(self, max_records: int = 100) -> List[Record]:
+        max_records = min(max_records, self.max_buffered)
+        records = await self._delegate.read(max_records)
+        return [self._rekey(r) for r in records]
 
     _MISSING = object()
 
@@ -205,12 +407,8 @@ class CamelSourceAgent(AgentSource):
         return record if value is self._MISSING else record.with_key(value)
 
     async def commit(self, records: List[Record]) -> None:
-        if self._delegate is not None:
-            await self._delegate.commit(records)
+        await self._delegate.commit(records)
 
     async def close(self) -> None:
-        if self._delegate is not None:
+        if getattr(self, "_delegate", None) is not None:
             await self._delegate.close()
-        session = getattr(self, "_session", None)
-        if session is not None:
-            await session.close()

@@ -25,7 +25,9 @@ at >8-chip scale):
   from its OWN previous decode outputs, which hold identical values by
   SPMD determinism.
 
-Transport is a length-prefixed pickle stream over TCP: host 0 listens,
+Transport is a length-prefixed JSON-header + raw-array-bytes frame
+stream over TCP (deliberately NOT pickle — nothing executable crosses
+the wire): host 0 listens,
 followers connect before serving starts (`expected` blocks until all
 joined, because a follower joining mid-stream would miss cache state).
 jax.distributed.initialize (runtime/multihost.py) must already be up so
@@ -135,7 +137,19 @@ class DispatchMirror:
     network); a single writer thread preserves FIFO order. A follower
     that drops its connection mid-serve is fatal for the replica — the
     next collective would deadlock anyway — so the error is raised into
-    the engine thread via the queue."""
+    the engine thread via the queue. The queue is bounded: a follower
+    that falls persistently behind the leader's dispatch rate (records
+    are small, so the bound is generous) is the same fatal condition as
+    a dropped follower — without it the leader accumulates encoded
+    records without limit and the engine gets no backpressure signal
+    until memory pressure."""
+
+    # dispatch records are ~100 bytes + small host arrays; 65536 queued
+    # records is minutes of serving headroom, yet bounds leader memory
+    QUEUE_MAXSIZE = 65536
+    # how long publish() may block on a full queue before declaring the
+    # follower link dead
+    PUBLISH_TIMEOUT_S = 60.0
 
     def __init__(
         self,
@@ -147,7 +161,9 @@ class DispatchMirror:
         self.port = self._server.getsockname()[1]
         self._fingerprint = fingerprint
         self._followers: List[socket.socket] = []
-        self._queue: "queue.Queue[Optional[bytes]]" = queue.Queue()
+        self._queue: "queue.Queue[Optional[bytes]]" = queue.Queue(
+            maxsize=self.QUEUE_MAXSIZE
+        )
         self._writer: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
         self._closed = False
@@ -199,7 +215,17 @@ class DispatchMirror:
     def publish(self, kind: str, meta: Dict[str, Any], arrays: list) -> None:
         if self._error is not None:
             raise RuntimeError("mirror writer failed") from self._error
-        self._queue.put(_encode_record(kind, meta, arrays))
+        try:
+            self._queue.put(
+                _encode_record(kind, meta, arrays),
+                timeout=self.PUBLISH_TIMEOUT_S,
+            )
+        except queue.Full:
+            self._error = RuntimeError(
+                f"mirror publish queue full for {self.PUBLISH_TIMEOUT_S:.0f}s"
+                " — follower cannot keep up with the dispatch rate"
+            )
+            raise RuntimeError("mirror writer failed") from self._error
 
     def _write_loop(self) -> None:
         while True:
@@ -218,7 +244,10 @@ class DispatchMirror:
         if self._closed:
             return
         self._closed = True
-        self._queue.put(None)
+        try:
+            self._queue.put_nowait(None)
+        except queue.Full:
+            pass  # writer is wedged; the bounded join below handles it
         if self._writer is not None:
             self._writer.join(timeout=10)
         for follower in self._followers:

@@ -235,6 +235,13 @@ class _GroupReader:
                 self._pending = None
             except asyncio.TimeoutError:
                 return []  # drain keeps running; next read() awaits it
+            except BaseException:
+                # a failed drain task must not be re-awaited forever: a
+                # transient client error would wedge the consumer on the
+                # same stale exception. Drop it so the next read()
+                # starts a fresh drain.
+                self._pending = None
+                raise
         if not self._buffer:
             # empty slice returned instantly: spend the rest of the poll
             # timeout idle, or the runner loop busy-spins (the other

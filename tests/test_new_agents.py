@@ -438,3 +438,134 @@ def test_camel_uri_parsing_edge_cases():
         await timer.close()
 
     asyncio.run(main())
+
+
+def test_camel_source_kafka_uri():
+    """`camel-source` with Camel's kafka component URI consumes a topic
+    through the framework's own wire-protocol client (facade broker),
+    and commit flows through to the consumer group."""
+    from langstream_tpu.runtime.registry import create_agent
+    from langstream_tpu.topics.kafka.server import serve_kafka_facade
+
+    async def main():
+        facade = await serve_kafka_facade()
+        try:
+            from langstream_tpu.topics.kafka.runtime import (
+                KafkaTopicConnectionsRuntime,
+            )
+
+            runtime = KafkaTopicConnectionsRuntime(
+                {"bootstrapServers": facade.bootstrap}
+            )
+            from langstream_tpu.api.topics import TopicSpec
+
+            await runtime.create_admin().create_topic(
+                TopicSpec(name="camel-t", partitions=1)
+            )
+            producer = runtime.create_producer("p", {"topic": "camel-t"})
+            await producer.start()
+            await producer.write(SimpleRecord(key="k1", value="v1"))
+            await producer.write(SimpleRecord(value="v2"))
+            agent = create_agent("camel-source")
+            await agent.init({
+                "component-uri": (
+                    f"kafka:camel-t?brokers={facade.bootstrap}"
+                    "&groupId=cg&autoOffsetReset=earliest"
+                ),
+            })
+            await agent.start()
+            records = []
+            for _ in range(100):
+                records.extend(await agent.read())
+                if len(records) >= 2:
+                    break
+            assert [r.value for r in records] == ["v1", "v2"]
+            assert records[0].key == "k1"
+            assert dict(records[0].headers)["kafka.TOPIC"] == "camel-t"
+            await agent.commit(records)
+            await agent.close()
+            await producer.close()
+            await runtime.close()
+        finally:
+            await facade.close()
+
+    asyncio.run(main())
+
+
+def test_camel_source_netty_http_uri():
+    """`camel-source` with netty-http is an embedded HTTP *server*
+    consumer: incoming requests become records with Camel's method/path
+    headers."""
+    import aiohttp
+
+    from langstream_tpu.runtime.registry import create_agent
+
+    async def main():
+        agent = create_agent("camel-source")
+        await agent.init({
+            "component-uri": "netty-http:http://127.0.0.1:0/ingest",
+        })
+        await agent.start()
+        port = agent.bound_port
+        async with aiohttp.ClientSession() as session:
+            response = await session.post(
+                f"http://127.0.0.1:{port}/ingest/sub?x=1",
+                data=b"payload",
+                headers={"X-Custom": "yes"},
+            )
+            assert response.status == 200
+        records = await agent.read()
+        assert records[0].value == b"payload"
+        headers = dict(records[0].headers)
+        assert headers["CamelHttpMethod"] == "POST"
+        assert headers["CamelHttpPath"] == "/ingest/sub"
+        assert headers["CamelHttpQuery"] == "x=1"
+        assert headers["X-Custom"] == "yes"
+        await agent.close()
+
+    asyncio.run(main())
+
+
+def test_camel_scheme_registry_extensible():
+    """register_camel_scheme maps a new component family onto a native
+    source — the plugin extension point for the Camel zoo."""
+    from langstream_tpu.agents import camel
+    from langstream_tpu.api.agent import AgentSource
+    from langstream_tpu.api.records import Record, now_millis
+    from langstream_tpu.runtime.registry import create_agent
+
+    class FakeJms(AgentSource):
+        def __init__(self, path, pairs):
+            self.queue_name = path
+            self.sent = False
+
+        async def read(self, max_records=100):
+            if self.sent:
+                return []
+            self.sent = True
+            return [Record(
+                value=f"from {self.queue_name}",
+                headers=(("JMSDestination", self.queue_name),),
+                timestamp=now_millis(),
+            )]
+
+        async def commit(self, records):
+            pass
+
+    camel.register_camel_scheme("jms", FakeJms)
+    try:
+        async def main():
+            agent = create_agent("camel-source")
+            await agent.init({
+                "component-uri": "jms:orders?concurrentConsumers=2",
+                "key-header": "JMSDestination",
+            })
+            await agent.start()
+            records = await agent.read()
+            assert records[0].value == "from orders"
+            assert records[0].key == "orders"
+            await agent.close()
+
+        asyncio.run(main())
+    finally:
+        camel.CAMEL_SCHEMES.pop("jms", None)
