@@ -1,12 +1,17 @@
-"""User-custom Python agents, run in-process.
+"""User-custom Python agents: in-process by default, crash-isolated on
+request.
 
 The reference runs user Python code in a subprocess bridged over localhost
 gRPC (``langstream-agent-grpc/src/main/proto/langstream_grpc/proto/agent.proto:24-111``,
 ``PythonGrpcServer.java:31``) because its runtime is a JVM. This framework's
-runtime *is* Python, so user agents load in-process: the ``className``
-config names a ``module.Class`` importable from the application's
-``python/`` directory (added to ``sys.path`` by the planner, mirroring the
-reference's PYTHONPATH contract, ``PythonGrpcServer.java:54-91``).
+runtime *is* Python, so user agents load in-process by default: the
+``className`` config names a ``module.Class`` importable from the
+application's ``python/`` directory (added to ``sys.path`` by the planner,
+mirroring the reference's PYTHONPATH contract,
+``PythonGrpcServer.java:54-91``). Set ``isolation: process`` (or env
+``LS_PYTHON_ISOLATION=process`` to flip the default) to restore the
+reference's crash boundary for untrusted code — the agent then runs in a
+child process behind the socket contract in ``agents/isolation.py``.
 
 User classes follow the same duck-typed shape as the reference Python SDK
 (``langstream-runtime/langstream-runtime-impl/src/main/python/langstream_grpc/api.py:34-195``):
@@ -24,6 +29,7 @@ from __future__ import annotations
 
 import asyncio
 import inspect
+import os
 import sys
 from typing import Any, Dict, List, Optional
 
@@ -94,6 +100,19 @@ class _PythonAgentMixin:
         class_name = configuration.get("className")
         if not class_name:
             raise ValueError("python agent requires 'className' configuration")
+        isolation = configuration.get(
+            "isolation", os.environ.get("LS_PYTHON_ISOLATION", "none")
+        )
+        if isolation == "process":
+            # the reference's crash boundary (PythonGrpcServer.java:54-91):
+            # untrusted user code runs in a child; a crash kills the pod,
+            # not the runtime/engine. See agents/isolation.py.
+            from langstream_tpu.agents.isolation import RemoteUserAgent
+
+            self.user_agent = await RemoteUserAgent.spawn(
+                getattr(self, "agent_type", "python-agent"), configuration
+            )
+            return
         extra_path = configuration.get("pythonPath") or []
         cls = _load_user_class(class_name, extra_path)
         self.user_agent = cls()

@@ -1,0 +1,268 @@
+"""Process-isolated user Python agents (`isolation: process`).
+
+The reference's crash boundary: user code runs in a child process so a
+faulting agent kills its pod, not the runtime
+(PythonGrpcServer.java:54-91, grpc_service.py:359 `crash_process`).
+Here: RemoteUserAgent over a Unix socket (agents/isolation.py). These
+tests prove the four SPI kinds round-trip through the boundary, user
+exceptions feed the error policies, and a hard child death (os._exit)
+surfaces as AgentProcessCrashed while the parent process — where the
+TPU engine would live — keeps working.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import textwrap
+
+import pytest
+
+from langstream_tpu.api.records import Record
+from langstream_tpu.runtime.registry import create_agent
+
+
+def _write_agents(tmp_path):
+    (tmp_path / "iso_agents.py").write_text(textwrap.dedent("""
+        import os
+
+        class Doubler:
+            def init(self, config):
+                self.suffix = config.get("suffix", "")
+
+            def process(self, record):
+                if record.value == "boom":
+                    raise ValueError("user code exploded")
+                if record.value == "die":
+                    os._exit(7)
+                return [record.value * 2 + self.suffix]
+
+        class ByteSource:
+            def __init__(self):
+                self.sent = False
+                self.committed = []
+
+            def read(self):
+                if self.sent:
+                    return []
+                self.sent = True
+                return [(b"k\\x00", b"v\\x01\\x02")]
+
+            def commit(self, records):
+                self.committed.extend(records)
+
+        class StatefulSink:
+            def init(self, config):
+                self.path = config["spool"]
+
+            def write(self, record):
+                with open(self.path, "a") as fh:
+                    fh.write(str(record.value) + "\\n")
+
+        class ContextReader:
+            def set_context(self, context):
+                self.context = context
+
+            def process(self, record):
+                return [str(self.context.agent_id)]
+    """))
+    return str(tmp_path)
+
+
+def test_isolated_processor_roundtrip_and_user_error(tmp_path):
+    path = _write_agents(tmp_path)
+
+    async def main():
+        agent = create_agent("python-processor")
+        await agent.init({
+            "className": "iso_agents.Doubler",
+            "pythonPath": [path],
+            "isolation": "process",
+            "suffix": "!",
+        })
+        await agent.start()
+        out = await agent.process_record(Record(value="ab"))
+        assert [r.value for r in out] == ["abab!"]
+        # user exception crosses as a structured error and re-raises —
+        # that is what the record error policies consume
+        from langstream_tpu.agents.isolation import RemoteAgentError
+
+        with pytest.raises(RemoteAgentError, match="user code exploded") as info:
+            await agent.process_record(Record(value="boom"))
+        assert "ValueError" in info.value.remote_traceback
+        # the child survives user exceptions (only a crash kills it)
+        out = await agent.process_record(Record(value="cd"))
+        assert [r.value for r in out] == ["cdcd!"]
+        assert agent.agent_info()["user"]["isolation"] == "process"
+        await agent.close()
+
+    asyncio.run(main())
+
+
+def test_isolated_child_death_is_crash_not_hang(tmp_path):
+    """The kill test: the user agent calls os._exit mid-process. The
+    call (and any later call) raises AgentProcessCrashed; the parent
+    process keeps working — a fresh isolated agent spawns fine, which
+    is exactly the 'engine state intact, pod restart' contract."""
+    path = _write_agents(tmp_path)
+
+    async def main():
+        from langstream_tpu.agents.isolation import AgentProcessCrashed
+
+        agent = create_agent("python-processor")
+        await agent.init({
+            "className": "iso_agents.Doubler",
+            "pythonPath": [path],
+            "isolation": "process",
+        })
+        with pytest.raises(AgentProcessCrashed, match="exit code 7"):
+            await agent.process_record(Record(value="die"))
+        # every subsequent call fails fast, no hang
+        with pytest.raises(AgentProcessCrashed):
+            await agent.process_record(Record(value="ok"))
+        await agent.close()
+
+        # the parent (runner/engine process) is unharmed: a replacement
+        # agent spawns and serves
+        fresh = create_agent("python-processor")
+        await fresh.init({
+            "className": "iso_agents.Doubler",
+            "pythonPath": [path],
+            "isolation": "process",
+        })
+        out = await fresh.process_record(Record(value="x"))
+        assert [r.value for r in out] == ["xx"]
+        await fresh.close()
+
+    asyncio.run(main())
+
+
+def test_isolated_source_sink_and_context(tmp_path):
+    path = _write_agents(tmp_path)
+    spool = tmp_path / "spool.txt"
+
+    async def main():
+        source = create_agent("python-source")
+        await source.init({
+            "className": "iso_agents.ByteSource",
+            "pythonPath": [path],
+            "isolation": "process",
+        })
+        await source.start()
+        records = await source.read()
+        assert records[0].key == b"k\x00"
+        assert records[0].value == b"v\x01\x02"
+        await source.commit(records)
+        assert await source.read() == []
+        await source.close()
+
+        sink = create_agent("python-sink")
+        await sink.init({
+            "className": "iso_agents.StatefulSink",
+            "pythonPath": [path],
+            "isolation": "process",
+            "spool": str(spool),
+        })
+        await sink.start()
+        await sink.write(Record(value="one"))
+        await sink.write(Record(value="two"))
+        await sink.close()
+        assert spool.read_text().splitlines() == ["one", "two"]
+
+        # context subset crosses the boundary
+        import types
+
+        ctx_agent = create_agent("python-processor")
+        await ctx_agent.init({
+            "className": "iso_agents.ContextReader",
+            "pythonPath": [path],
+            "isolation": "process",
+        })
+        await ctx_agent.set_context(types.SimpleNamespace(
+            agent_id="agent-7", application_id="app",
+            persistent_state_directory=None,
+        ))
+        out = await ctx_agent.process_record(Record(value=None))
+        assert out[0].value == "agent-7"
+        await ctx_agent.close()
+
+    asyncio.run(main())
+
+
+def test_isolated_agent_in_runner_error_policy(tmp_path):
+    """A crashing isolated agent inside the real processor contract:
+    the crash lands as the per-record error result — exactly what the
+    fail policy consumes to end the pod — instead of wedging the
+    loop."""
+    path = _write_agents(tmp_path)
+
+    async def main():
+        from langstream_tpu.agents.isolation import AgentProcessCrashed
+        from langstream_tpu.runtime.runner import process_and_collect
+
+        agent = create_agent("python-processor")
+        await agent.init({
+            "className": "iso_agents.Doubler",
+            "pythonPath": [path],
+            "isolation": "process",
+        })
+        results = await process_and_collect(agent, [Record(value="die")])
+        assert len(results) == 1
+        assert isinstance(results[0].error, AgentProcessCrashed)
+        await agent.close()
+
+    asyncio.run(main())
+
+
+def test_isolation_codec_escapes_and_origin(tmp_path):
+    """Codec edge cases: a user dict literally shaped like an escape
+    marker survives the boundary, and bare return values inherit the
+    source record's origin exactly as in-process."""
+    from langstream_tpu.agents.isolation import _dec, _enc
+
+    tricky = {"payload": {"__b64__": "aGk="}, "n": [1, {"__record__": 2}]}
+    assert _dec(_enc(tricky)) == tricky
+    assert _dec(_enc(b"\x00\xff")) == b"\x00\xff"
+
+    (tmp_path / "echo_agent.py").write_text(
+        "class Echo:\n"
+        "    def process(self, record):\n"
+        "        return [record.value]\n"
+    )
+
+    async def main():
+        agent = create_agent("python-processor")
+        await agent.init({
+            "className": "echo_agent.Echo",
+            "pythonPath": [str(tmp_path)],
+            "isolation": "process",
+        })
+        out = await agent.process_record(
+            Record(value={"__b64__": "x"}, origin="in-topic")
+        )
+        assert out[0].value == {"__b64__": "x"}
+        assert out[0].origin == "in-topic"
+        await agent.close()
+
+    asyncio.run(main())
+
+
+def test_isolated_boot_failure_no_leak(tmp_path):
+    """A bad className fails the deploy cleanly: the error surfaces and
+    the child process + socket dir are cleaned up."""
+    import glob
+
+    async def main():
+        from langstream_tpu.agents.isolation import RemoteAgentError
+
+        before = set(glob.glob("/tmp/ls-agent-*"))
+        agent = create_agent("python-processor")
+        with pytest.raises(RemoteAgentError, match="no_such"):
+            await agent.init({
+                "className": "no_such.Missing",
+                "pythonPath": [str(tmp_path)],
+                "isolation": "process",
+            })
+        await asyncio.sleep(0.2)
+        assert set(glob.glob("/tmp/ls-agent-*")) == before
+
+    asyncio.run(main())
