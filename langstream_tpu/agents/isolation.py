@@ -46,7 +46,6 @@ every broker codec in this framework).
 from __future__ import annotations
 
 import asyncio
-import base64
 import json
 import logging
 import os
@@ -56,7 +55,9 @@ import tempfile
 import uuid
 from typing import Any, Dict, List, Optional
 
+from langstream_tpu.api.errors import FatalAgentError
 from langstream_tpu.api.records import Record, record_from_value
+from langstream_tpu.utils import wire_json
 
 logger = logging.getLogger(__name__)
 
@@ -64,8 +65,13 @@ _LEN = struct.Struct(">I")
 _MAX_FRAME = 256 * 1024 * 1024
 
 
-class AgentProcessCrashed(RuntimeError):
-    """The isolated agent process died (crash, exit, or kill)."""
+class AgentProcessCrashed(FatalAgentError):
+    """The isolated agent process died (crash, exit, or kill).
+
+    Subclasses :class:`FatalAgentError` so the record error policy can
+    NEVER consume it: with ``on-failure: skip`` a dead child would
+    otherwise silently drop every subsequent record instead of
+    restarting the pod (the reference's ``crash_process`` contract)."""
 
 
 class RemoteAgentError(RuntimeError):
@@ -81,38 +87,27 @@ class RemoteAgentError(RuntimeError):
 # value / record codec (JSON + base64 bytes; bijective for the types the
 # record model allows)
 # --------------------------------------------------------------------- #
-_MARKERS = ({"__b64__"}, {"__record__"}, {"__esc__"})
+_RECORD_TAG = "__record__"
+_RECORD_MARKERS = (frozenset((_RECORD_TAG,)),)
 
 
 def _enc(value: Any) -> Any:
-    if isinstance(value, (bytes, bytearray, memoryview)):
-        return {"__b64__": base64.b64encode(bytes(value)).decode()}
-    if isinstance(value, Record):
-        return {"__record__": _enc_record(value)}
-    if isinstance(value, dict):
-        encoded = {str(k): _enc(v) for k, v in value.items()}
-        if set(encoded.keys()) in _MARKERS:
-            # a literal user dict shaped like an escape marker must not
-            # decode as one
-            return {"__esc__": encoded}
-        return encoded
-    if isinstance(value, (list, tuple)):
-        return [_enc(v) for v in value]
-    return value
+    return wire_json.encode_value(
+        value,
+        extra_markers=_RECORD_MARKERS,
+        encode_special=lambda v: (
+            {_RECORD_TAG: _enc_record(v)} if isinstance(v, Record) else None
+        ),
+    )
 
 
 def _dec(value: Any) -> Any:
-    if isinstance(value, dict):
-        if set(value.keys()) == {"__b64__"}:
-            return base64.b64decode(value["__b64__"])
-        if set(value.keys()) == {"__record__"}:
-            return _dec_record(value["__record__"])
-        if set(value.keys()) == {"__esc__"}:
-            return {k: _dec(v) for k, v in value["__esc__"].items()}
-        return {k: _dec(v) for k, v in value.items()}
-    if isinstance(value, list):
-        return [_dec(v) for v in value]
-    return value
+    def decode_special(data: Dict[str, Any]):
+        if set(data.keys()) == {_RECORD_TAG}:
+            return _dec_record(data[_RECORD_TAG])
+        return NotImplemented
+
+    return wire_json.decode_value(value, decode_special=decode_special)
 
 
 def _enc_record(record: Record) -> Dict[str, Any]:
@@ -171,6 +166,7 @@ class RemoteUserAgent:
         self._reader_task: Optional[asyncio.Task] = None
         self._socket_path = ""
         self._crashed: Optional[AgentProcessCrashed] = None
+        self._closing = False
 
     # ---------------------------------------------------------------- #
     @classmethod
@@ -215,7 +211,7 @@ class RemoteUserAgent:
                 connected, connect_timeout
             )
         except asyncio.TimeoutError:
-            self._process.kill()
+            await self.close()  # kill + reap + remove the socket tempdir
             raise AgentProcessCrashed(
                 f"isolated agent worker did not connect within "
                 f"{connect_timeout:.0f}s"
@@ -245,6 +241,11 @@ class RemoteUserAgent:
         except asyncio.CancelledError:
             raise
         except BaseException as error:  # noqa: BLE001 — ANY reader death
+            if self._closing:
+                # the child's clean EOF after our close RPC is not a
+                # crash; marking it one would report crashed=true on
+                # /info for every normal shutdown
+                return
             # must fail fast: a decode error (oversized frame, bad JSON)
             # that killed only the reader task would leave every
             # in-flight and future call hanging forever
@@ -287,6 +288,12 @@ class RemoteUserAgent:
             raise self._crashed or AgentProcessCrashed(
                 f"isolated agent socket write failed: {error}"
             ) from error
+        except BaseException:
+            # e.g. oversize-frame ValueError: the request never went out,
+            # so its future must not linger in _pending (it would log
+            # 'exception was never retrieved' when the child later dies)
+            self._pending.pop(request_id, None)
+            raise
         response = await future
         if "error" in response:
             error = response["error"]
@@ -346,6 +353,7 @@ class RemoteUserAgent:
         return {"isolation": "process", "crashed": self._crashed is not None}
 
     async def close(self) -> None:
+        self._closing = True
         if self._crashed is None and self._writer is not None:
             try:
                 await asyncio.wait_for(self._call("close"), timeout=10.0)
@@ -462,6 +470,10 @@ async def _worker(socket_path: str) -> None:
                     await _maybe_await(agent.close())
                 await _send(writer, response)
                 writer.close()
+                # stdio is a block-buffered pipe into the pod log; flush
+                # or a short-lived agent loses its print() diagnostics
+                sys.stdout.flush()
+                sys.stderr.flush()
                 os._exit(0)
             else:
                 raise ValueError(f"unknown method {method!r}")
@@ -475,6 +487,8 @@ async def _worker(socket_path: str) -> None:
         try:
             await _send(writer, response)
         except (ConnectionError, OSError):
+            sys.stdout.flush()
+            sys.stderr.flush()
             os._exit(1)  # parent gone; nothing to serve
 
     while True:
@@ -492,4 +506,12 @@ async def _worker(socket_path: str) -> None:
 
 if __name__ == "__main__":
     logging.basicConfig(level=logging.INFO)
+    # the TPU plugin's sitecustomize force-selects its platform at
+    # interpreter start, overriding the JAX_PLATFORMS=cpu the parent set
+    # in our env — override it back BEFORE user code can import jax, or
+    # a user `import jax` grabs (and wedges) the parent's chip. Only
+    # needed when a sitecustomize already imported jax; otherwise the
+    # env var governs and jax-free agents skip the heavy import.
+    if "jax" in sys.modules:
+        sys.modules["jax"].config.update("jax_platforms", "cpu")
     asyncio.run(_worker(sys.argv[1]))

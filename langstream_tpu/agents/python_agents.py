@@ -103,6 +103,14 @@ class _PythonAgentMixin:
         isolation = configuration.get(
             "isolation", os.environ.get("LS_PYTHON_ISOLATION", "none")
         )
+        if isolation not in ("none", "process", "", None):
+            # a typo ('Process', 'true') must not silently run untrusted
+            # code in-process — the boundary the operator asked for
+            # would be absent with no signal
+            raise ValueError(
+                f"python agent isolation must be 'none' or 'process', "
+                f"got {isolation!r}"
+            )
         if isolation == "process":
             # the reference's crash boundary (PythonGrpcServer.java:54-91):
             # untrusted user code runs in a child; a crash kills the pod,

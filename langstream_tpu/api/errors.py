@@ -16,6 +16,15 @@ import enum
 from typing import Any, Dict, Optional
 
 
+class FatalAgentError(RuntimeError):
+    """Errors the record-level policy must NEVER consume: the agent
+    cannot make progress (dead child process, poisoned device state),
+    so retry/skip/dead-letter would silently drop every subsequent
+    record. The runner re-raises these fatally so the pod restarts —
+    the analogue of the reference's JVM-exit main error handler
+    (``AgentRunner.java:87-91`` ``mainErrorHandler``)."""
+
+
 class FailureAction(enum.Enum):
     FAIL = "fail"
     SKIP = "skip"

@@ -46,6 +46,7 @@ from langstream_tpu.api.agent import (
 from langstream_tpu.api.errors import (
     ErrorHandlingDecision,
     ErrorsSpec,
+    FatalAgentError,
     StandardErrorsHandler,
 )
 from langstream_tpu.api.metrics import MetricsReporter
@@ -418,6 +419,11 @@ class AgentRunner:
     ) -> None:
         """Apply the error policy to one failed source record
         (reference: ``AgentRunner.java:796-889``)."""
+        if isinstance(error, FatalAgentError):
+            # the agent is gone (e.g. its isolated child process died):
+            # retry would hit the same corpse, skip/dead-letter would
+            # silently drop every record after it — fail the pod
+            raise error
         self.stats.errors += 1
         self.metrics.counter("errors").count()
         attempts = self._attempts.get(id(source_record), 0) + 1

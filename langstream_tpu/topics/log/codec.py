@@ -8,33 +8,18 @@ self-describing JSON envelope is the portable choice).
 
 from __future__ import annotations
 
-import base64
 import json
 from typing import Any
 
 from langstream_tpu.api.records import Record
 
-_BYTES_TAG = "__b64__"
-
-
-def _encode_value(value: Any) -> Any:
-    if isinstance(value, bytes):
-        return {_BYTES_TAG: base64.b64encode(value).decode("ascii")}
-    if isinstance(value, dict):
-        return {k: _encode_value(v) for k, v in value.items()}
-    if isinstance(value, (list, tuple)):
-        return [_encode_value(v) for v in value]
-    return value
-
-
-def _decode_value(value: Any) -> Any:
-    if isinstance(value, dict):
-        if set(value.keys()) == {_BYTES_TAG}:
-            return base64.b64decode(value[_BYTES_TAG])
-        return {k: _decode_value(v) for k, v in value.items()}
-    if isinstance(value, list):
-        return [_decode_value(v) for v in value]
-    return value
+# shared escape-aware codec: a literal user dict {"__b64__": "x"} now
+# survives the round trip ({"__esc__": …} wrapping) instead of decoding
+# as bytes; values written by older builds decode identically
+from langstream_tpu.utils.wire_json import (  # noqa: E402
+    decode_value as _decode_value,
+    encode_value as _encode_value,
+)
 
 
 def encode_record(record: Record) -> bytes:

@@ -1,0 +1,83 @@
+"""Follower-side worker for the two-OS-process mirror test.
+
+Run as ``python tests/mirror_follower_worker.py <host> <port> <out>
+[fingerprint-hex]``: builds the SAME tiny engine as the leader process
+(deterministic init — same seed, same platform), replays the leader's
+dispatch stream over real TCP, then writes a JSON line with the digest
+of its final device state (cache + penalty counts + last decode carry
+tokens) to ``<out>``. The parent compares digests — SPMD determinism
+across real process separation, no jax.distributed required (each side
+runs its own 1-device CPU mesh).
+"""
+
+import hashlib
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# the TPU plugin's sitecustomize force-selects its platform at
+# interpreter start, overriding the env var — override it back before
+# any backend init (same dance as tests/conftest.py and bench.py), or
+# this worker hangs initializing a TPU it must never touch
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def state_digest(engine) -> str:
+    """Digest of cache + penalty counts. Bit-identical cache implies
+    token-identical decode history: every sampled token was written
+    back into the KV rows it attended from."""
+    import numpy as np
+
+    digest = hashlib.sha256()
+    for key in sorted(engine.cache.keys()):
+        digest.update(key.encode())
+        digest.update(np.ascontiguousarray(np.asarray(engine.cache[key])).tobytes())
+    digest.update(
+        np.ascontiguousarray(np.asarray(engine._counts)).tobytes()  # noqa: SLF001
+    )
+    return digest.hexdigest()
+
+
+def build_engine():
+    from langstream_tpu.providers.jax_local.engine import DecodeEngine
+    from langstream_tpu.providers.jax_local.model import (
+        LlamaConfig,
+        init_params,
+    )
+
+    config = LlamaConfig.tiny(max_seq_len=256)
+    params = init_params(config)
+    return DecodeEngine(
+        config, params, max_slots=3, max_seq_len=256,
+        prefill_buckets=[16, 32], decode_chunk=4,
+    )
+
+
+def main() -> int:
+    host, port, out_path = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+    fingerprint = (
+        bytes.fromhex(sys.argv[4]) if len(sys.argv) > 4 else b"\x00" * 16
+    )
+    from langstream_tpu.serving.mirror import FollowerExecutor
+
+    engine = build_engine()
+    executor = FollowerExecutor(engine)
+    executor.connect(host, port, timeout=120.0, fingerprint=fingerprint)
+    records = executor.run()
+    if records == 0:
+        # a rejected handshake closes the socket before any record —
+        # distinguish it for the mismatch test
+        with open(out_path, "w") as handle:
+            json.dump({"records": 0, "digest": None}, handle)
+        return 3
+    with open(out_path, "w") as handle:
+        json.dump({"records": records, "digest": state_digest(engine)}, handle)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,151 @@
+"""Two-OS-process mirror: the leader engine in THIS process, a follower
+in a REAL child process, connected over localhost TCP — the deployment
+shape of multi-host SPMD serving (each host is its own OS process on
+its own mesh; no jax.distributed needed for the contract itself).
+
+SURVEY §7 hard part (e); round-3 verdict weak #4: the single-process
+test proved replay algebra, not the transport + process separation.
+Asserts: fuzzed traffic replays to a bit-identical device state across
+the process boundary, and a follower with a mismatched serving-config
+fingerprint is rejected at handshake while a correct one still joins.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "mirror_follower_worker.py")
+
+
+def _spawn_follower(port: int, out_path: str, fingerprint: bytes):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.Popen(
+        [
+            sys.executable, WORKER, "127.0.0.1", str(port), out_path,
+            fingerprint.hex(),
+        ],
+        env=env,
+    )
+
+
+@pytest.mark.timeout(600)
+def test_two_process_replay_token_identical(tmp_path):
+    from langstream_tpu.providers.jax_local.engine import (
+        DecodeEngine,
+        SamplingParams,
+    )
+    from langstream_tpu.providers.jax_local.model import (
+        LlamaConfig,
+        init_params,
+    )
+    from langstream_tpu.serving.mirror import (
+        DispatchMirror,
+        config_fingerprint,
+    )
+
+    from tests.mirror_follower_worker import state_digest
+
+    fingerprint = config_fingerprint({"model": "tiny-twoproc"})
+    config = LlamaConfig.tiny(max_seq_len=256)
+    leader = DecodeEngine(
+        config, init_params(config), max_slots=3, max_seq_len=256,
+        prefill_buckets=[16, 32], decode_chunk=4, pipeline_decode=True,
+    )
+    mirror = DispatchMirror(
+        host="127.0.0.1", port=0, fingerprint=fingerprint
+    )
+    out_path = str(tmp_path / "follower.json")
+    follower = _spawn_follower(mirror.port, out_path, fingerprint)
+    try:
+        mirror.wait_for_followers(1, timeout=180)
+        leader.mirror = mirror
+        leader.start()
+
+        import random
+
+        rng = random.Random(20260730)
+        template = [(17 * j) % 250 + 1 for j in range(24)]
+
+        def prompt(i):
+            if i % 3 == 0:  # shared template -> cross-slot prefix copies
+                return template + [(i * 7 + j) % 250 + 1 for j in range(3)]
+            if i % 3 == 1:  # longer than the largest bucket -> chunked
+                return [(i * 13 + j) % 250 + 1 for j in range(50)]
+            return [(i * 11 + j) % 250 + 1 for j in range(10)]
+
+        async def drive():
+            async def late(i):
+                await asyncio.sleep(0.003 * rng.randrange(5))
+                return await leader.generate(
+                    prompt(i),
+                    SamplingParams(
+                        max_new_tokens=rng.randrange(3, 7),
+                        temperature=rng.choice([0.0, 0.8]),
+                        seed=i,
+                    ),
+                    session_id=f"s{i % 2}" if i % 3 == 2 else None,
+                )
+
+            return await asyncio.gather(*[late(i) for i in range(9)])
+
+        results = asyncio.run(drive())
+        assert all(r.tokens for r in results)
+    finally:
+        leader.stop()  # publishes the stop record and closes the mirror
+    assert follower.wait(timeout=300) == 0
+    with open(out_path) as handle:
+        report = json.load(handle)
+    assert report["records"] > 0
+    # bit-identical device state across a real process boundary —
+    # cache bits encode the full decode history, so this is
+    # token-identical replay
+    assert report["digest"] == state_digest(leader)
+
+
+@pytest.mark.timeout(300)
+def test_two_process_fingerprint_mismatch_rejected(tmp_path):
+    from langstream_tpu.serving.mirror import (
+        DispatchMirror,
+        config_fingerprint,
+    )
+
+    leader_fp = config_fingerprint({"engine": {"max-slots": 4}})
+    wrong_fp = config_fingerprint({"engine": {"max-slots": 8}})
+    mirror = DispatchMirror(host="127.0.0.1", port=0, fingerprint=leader_fp)
+    accepted = threading.Event()
+
+    def waiter():
+        mirror.wait_for_followers(1, timeout=120)
+        accepted.set()
+
+    thread = threading.Thread(target=waiter)
+    thread.start()
+    try:
+        bad_out = str(tmp_path / "bad.json")
+        bad = _spawn_follower(mirror.port, bad_out, wrong_fp)
+        # rejected at handshake: the worker sees its socket close before
+        # any record and exits 3; the leader keeps waiting
+        assert bad.wait(timeout=120) == 3
+        assert not accepted.is_set()
+
+        good = _spawn_follower(
+            mirror.port, str(tmp_path / "good.json"), leader_fp
+        )
+        try:
+            assert accepted.wait(timeout=120)
+        finally:
+            mirror.close()  # stream close -> follower run() returns
+            good.wait(timeout=60)
+    finally:
+        thread.join(timeout=10)
+        mirror.close()

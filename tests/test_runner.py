@@ -323,3 +323,29 @@ def test_backpressure_caps_pending():
         assert inflight["max"] <= 8
 
     run(main())
+
+
+def test_fatal_error_bypasses_skip_policy():
+    """FatalAgentError (e.g. a dead isolated-agent child) must never be
+    consumed by skip/dead-letter — the pod has to die or every record
+    after the crash is silently dropped."""
+    async def main():
+        from langstream_tpu.api.errors import FatalAgentError
+
+        broker = MemoryBroker()
+        rt = MemoryTopicConnectionsRuntime(broker)
+        producer = rt.create_producer("p", {"topic": "in"})
+        await producer.write(Record(value="v"))
+
+        class Crashed(SingleRecordProcessor):
+            async def process_record(self, record):
+                raise FatalAgentError("child process died")
+
+        runner = make_pipeline(
+            broker, Crashed(), ErrorsSpec(retries=5, on_failure="skip")
+        )
+        with pytest.raises(FatalAgentError):
+            await run_until(runner, lambda: False, timeout=5.0)
+        assert runner.stats.skipped == 0
+
+    run(main())
