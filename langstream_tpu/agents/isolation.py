@@ -243,8 +243,15 @@ class RemoteUserAgent:
         except BaseException as error:  # noqa: BLE001 — ANY reader death
             if self._closing:
                 # the child's clean EOF after our close RPC is not a
-                # crash; marking it one would report crashed=true on
-                # /info for every normal shutdown
+                # crash (marking it one would report crashed=true on
+                # /info for every normal shutdown) — but in-flight RPCs
+                # (a service join() blocking in the child) must still
+                # resolve or their awaiters hang forever
+                closed = RuntimeError("isolated agent closed")
+                for future in self._pending.values():
+                    if not future.done():
+                        future.set_exception(closed)
+                self._pending.clear()
                 return
             # must fail fast: a decode error (oversized frame, bad JSON)
             # that killed only the reader task would leave every

@@ -24,13 +24,44 @@ from langstream_tpu.topics.kafka.protocol import (
 logger = logging.getLogger(__name__)
 
 
+class KafkaVersionError(KafkaProtocolError):
+    """The broker does not serve the protocol versions this client
+    pins (broker older than ~0.11, or newer than the KIP-896 floor —
+    Kafka 4.0 removed pre-2.1 request versions). Raised at connect by
+    the ApiVersions handshake, never mid-traffic."""
+
+    def __init__(self, broker: str, problems: List[str]) -> None:
+        super().__init__(
+            proto.NONE,
+            f"broker {broker} does not support pinned protocol "
+            f"versions: {', '.join(problems)}. Supported broker range: "
+            "Apache Kafka 0.11 .. 3.x (KIP-896 removed these versions "
+            "in 4.0).",
+        )
+        self.problems = problems
+
+
 class KafkaConnection:
     """One framed request/response socket. Kafka guarantees in-order
-    responses per connection, so a FIFO of pending futures suffices."""
+    responses per connection, so a FIFO of pending futures suffices.
 
-    def __init__(self, host: str, port: int, client_id: str) -> None:
+    ``connect`` performs the ApiVersions handshake (v0 — the bootstrap
+    version every broker answers) and verifies each pinned API version
+    against the broker's advertised ranges, so version skew fails
+    loudly at connect (reference relies on the Apache client's
+    identical NetworkClient handshake)."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        client_id: str,
+        verify_versions: bool = True,
+    ) -> None:
         self.host, self.port = host, port
         self.client_id = client_id
+        self.verify_versions = verify_versions
+        self.api_versions: Optional[Dict[int, Tuple[int, int]]] = None
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._correlation = itertools.count(1)
@@ -42,6 +73,46 @@ class KafkaConnection:
         self._reader, self._writer = await asyncio.open_connection(
             self.host, self.port
         )
+        if self.verify_versions and self.api_versions is None:
+            try:
+                await self._version_handshake()
+            except BaseException:
+                await self.close()
+                raise
+
+    async def _version_handshake(self, timeout: float = 30.0) -> None:
+        """ApiVersions v0 round trip directly on the fresh socket (the
+        caller may already hold the request lock)."""
+        correlation_id = next(self._correlation)
+        frame = proto.encode_request(
+            proto.API_VERSIONS, 0, correlation_id, self.client_id, b""
+        )
+        self._writer.write(frame)
+        await self._writer.drain()
+        size_bytes = await asyncio.wait_for(
+            self._reader.readexactly(4), timeout
+        )
+        payload = await asyncio.wait_for(
+            self._reader.readexactly(int.from_bytes(size_bytes, "big")),
+            timeout,
+        )
+        reader = Reader(payload)
+        got = reader.int32()
+        if got != correlation_id:
+            raise KafkaProtocolError(
+                proto.NONE,
+                f"ApiVersions correlation mismatch {got} != {correlation_id}",
+            )
+        advertised = proto.decode_api_versions(reader)
+        error_code = advertised.pop(-1)[0]
+        if error_code != proto.NONE:
+            raise KafkaProtocolError(
+                error_code, "ApiVersions request rejected"
+            )
+        self.api_versions = advertised
+        problems = proto.unsupported_pinned_apis(advertised)
+        if problems:
+            raise KafkaVersionError(f"{self.host}:{self.port}", problems)
 
     async def close(self) -> None:
         if self._writer is not None:
@@ -52,6 +123,9 @@ class KafkaConnection:
                 pass
             self._writer = None
             self._reader = None
+            # re-handshake on reconnect: the broker behind this address
+            # may have been upgraded while we were away
+            self.api_versions = None
 
     async def call(
         self, api_key: int, api_version: int, body: bytes,
@@ -106,7 +180,9 @@ class KafkaClient:
         bootstrap_servers: str,
         *,
         client_id: str = "langstream-tpu",
+        verify_versions: bool = True,
     ) -> None:
+        self.verify_versions = verify_versions
         self.bootstrap: List[Tuple[str, int]] = []
         for part in bootstrap_servers.split(","):
             part = part.strip()
@@ -128,7 +204,8 @@ class KafkaClient:
         if key not in self._connections:
             host, port = self.bootstrap[0]
             self._connections[key] = KafkaConnection(
-                host, port, self.client_id
+                host, port, self.client_id,
+                verify_versions=self.verify_versions,
             )
         return self._connections[key]
 
@@ -136,7 +213,8 @@ class KafkaClient:
         broker = self.brokers[node_id]
         if node_id not in self._connections:
             self._connections[node_id] = KafkaConnection(
-                broker.host, broker.port, self.client_id
+                broker.host, broker.port, self.client_id,
+                verify_versions=self.verify_versions,
             )
         return self._connections[node_id]
 
@@ -146,7 +224,10 @@ class KafkaClient:
         broker's rebalance barrier) never serializes another member's —
         the same one-socket-per-consumer layout real clients use."""
         broker = self.brokers[node_id]
-        return KafkaConnection(broker.host, broker.port, self.client_id)
+        return KafkaConnection(
+            broker.host, broker.port, self.client_id,
+            verify_versions=self.verify_versions,
+        )
 
     async def close(self) -> None:
         for connection in self._connections.values():

@@ -58,6 +58,61 @@ RETRIABLE = {
     COORDINATOR_NOT_AVAILABLE, NOT_COORDINATOR, REBALANCE_IN_PROGRESS,
 }
 
+# every API version this client sends, in one place. The connection
+# handshake verifies each against the broker's advertised [min, max]
+# (ApiVersions), so "broker too new" (KIP-896: Kafka 4.0 removed
+# pre-2.1 protocol versions) or "broker too old" fails at connect with
+# a precise message instead of a mid-traffic decode error. ApiVersions
+# itself is the bootstrap: brokers answer it at v0 regardless of their
+# floor, exactly so old clients learn they are unsupported.
+PINNED_VERSIONS: Dict[int, int] = {
+    PRODUCE: 3, FETCH: 4, LIST_OFFSETS: 1, METADATA: 1,
+    OFFSET_COMMIT: 2, OFFSET_FETCH: 1, FIND_COORDINATOR: 0,
+    JOIN_GROUP: 1, HEARTBEAT: 0, LEAVE_GROUP: 0, SYNC_GROUP: 0,
+    API_VERSIONS: 0, CREATE_TOPICS: 0, DELETE_TOPICS: 0,
+}
+
+API_NAMES: Dict[int, str] = {
+    PRODUCE: "Produce", FETCH: "Fetch", LIST_OFFSETS: "ListOffsets",
+    METADATA: "Metadata", OFFSET_COMMIT: "OffsetCommit",
+    OFFSET_FETCH: "OffsetFetch", FIND_COORDINATOR: "FindCoordinator",
+    JOIN_GROUP: "JoinGroup", HEARTBEAT: "Heartbeat",
+    LEAVE_GROUP: "LeaveGroup", SYNC_GROUP: "SyncGroup",
+    API_VERSIONS: "ApiVersions", CREATE_TOPICS: "CreateTopics",
+    DELETE_TOPICS: "DeleteTopics",
+}
+
+
+def decode_api_versions(reader: "Reader") -> Dict[int, Tuple[int, int]]:
+    """ApiVersions v0 response body → {api_key: (min, max)}. The
+    leading error_code is returned under key -1 for the caller."""
+    error_code = reader.int16()
+    out: Dict[int, Tuple[int, int]] = {-1: (error_code, error_code)}
+    for _ in range(reader.int32()):
+        api_key = reader.int16()
+        out[api_key] = (reader.int16(), reader.int16())
+    return out
+
+
+def unsupported_pinned_apis(
+    advertised: Dict[int, Tuple[int, int]],
+) -> List[str]:
+    """Which pinned (api, version) pairs the broker does not serve."""
+    problems: List[str] = []
+    for api_key, version in sorted(PINNED_VERSIONS.items()):
+        if api_key == API_VERSIONS:
+            continue  # the handshake itself already round-tripped
+        if api_key not in advertised:
+            problems.append(f"{API_NAMES[api_key]} (not offered)")
+            continue
+        low, high = advertised[api_key]
+        if not low <= version <= high:
+            problems.append(
+                f"{API_NAMES[api_key]} v{version} (broker serves "
+                f"v{low}..v{high})"
+            )
+    return problems
+
 
 class KafkaProtocolError(RuntimeError):
     def __init__(self, code: int, context: str = "") -> None:

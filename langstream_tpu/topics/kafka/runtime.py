@@ -712,6 +712,12 @@ class KafkaTopicConnectionsRuntime(TopicConnectionsRuntime):
         self._client = KafkaClient(
             bootstrap,
             client_id=configuration.get("clientId", "langstream-tpu"),
+            # ApiVersions handshake on every new connection (KIP-896
+            # guard); `verifyApiVersions: false` opts out for brokers
+            # that firewall the API
+            verify_versions=bool(
+                configuration.get("verifyApiVersions", True)
+            ),
         )
         self._replication = int(configuration.get("replicationFactor", 1))
         registry_url = (

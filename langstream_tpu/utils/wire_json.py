@@ -80,7 +80,11 @@ def decode_value(
         keys = set(value.keys())
         if keys == {BYTES_TAG}:
             return base64.b64decode(value[BYTES_TAG])
-        if keys == {ESC_TAG}:
+        if keys == {ESC_TAG} and isinstance(value[ESC_TAG], dict):
+            # escaped marker-shaped user dict. The isinstance guard
+            # keeps pre-escape data readable: an OLD encoder passed a
+            # literal user {'__esc__': 'x'} through verbatim, and it
+            # must keep decoding as itself
             return {
                 k: decode_value(v, decode_special=decode_special)
                 for k, v in value[ESC_TAG].items()

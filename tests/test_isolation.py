@@ -266,3 +266,44 @@ def test_isolated_boot_failure_no_leak(tmp_path):
         assert set(glob.glob("/tmp/ls-agent-*")) == before
 
     asyncio.run(main())
+
+
+def test_legacy_log_values_still_decode():
+    """Pre-escape data written by the old log codec must keep decoding:
+    a literal user {'__esc__': 'x'} passed through verbatim then, and
+    must decode as itself now."""
+    from langstream_tpu.utils.wire_json import decode_value, encode_value
+
+    assert decode_value({"__esc__": "user-data"}) == {"__esc__": "user-data"}
+    round_trip = decode_value(encode_value({"__esc__": "user-data"}))
+    assert round_trip == {"__esc__": "user-data"}
+
+
+def test_service_join_resolves_on_close(tmp_path):
+    """A service agent's join() blocks in the child; close() while it is
+    in flight must resolve the awaiter (not hang) and not be reported
+    as a crash."""
+    (tmp_path / "svc_agent.py").write_text(
+        "import asyncio\n"
+        "class Forever:\n"
+        "    async def main(self):\n"
+        "        await asyncio.Event().wait()\n"
+    )
+
+    async def main():
+        agent = create_agent("python-service")
+        await agent.init({
+            "className": "svc_agent.Forever",
+            "pythonPath": [str(tmp_path)],
+            "isolation": "process",
+        })
+        await agent.start()
+        join_task = asyncio.ensure_future(agent.join())
+        await asyncio.sleep(0.3)
+        assert not join_task.done()
+        await agent.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            await asyncio.wait_for(join_task, timeout=10)
+        assert agent.agent_info()["user"]["crashed"] is False
+
+    asyncio.run(main())

@@ -459,3 +459,164 @@ def test_dp_fanout_app_on_kafka(tmp_path):
                 await facade.close()
 
     asyncio.run(main())
+
+
+# --------------------------------------------------------------------- #
+# golden bytes: spec-derived frames, field by field (the wire contract
+# is pinned independently of the Writer implementation)
+# --------------------------------------------------------------------- #
+def _crc32c_reference(data: bytes) -> int:
+    """Independent bitwise CRC-32C (Castagnoli, reflected 0x1EDC6F41 ->
+    0x82F63B78) — deliberately NOT the table-driven implementation under
+    test."""
+    crc = 0xFFFFFFFF
+    for byte in data:
+        crc ^= byte
+        for _ in range(8):
+            crc = (crc >> 1) ^ (0x82F63B78 if crc & 1 else 0)
+    return crc ^ 0xFFFFFFFF
+
+
+def test_golden_request_header_frame():
+    """Request frame: int32 size | int16 api_key | int16 api_version |
+    int32 correlation_id | nullable-string client_id | body
+    (kafka.apache.org/protocol: common request header v1)."""
+    import struct
+
+    frame = proto.encode_request(proto.API_VERSIONS, 0, 7, "ls", b"")
+    expected_payload = struct.pack(">hhih2s", 18, 0, 7, 2, b"ls")
+    assert frame == struct.pack(">i", len(expected_payload)) + expected_payload
+
+    # null client_id encodes as int16 -1
+    frame = proto.encode_request(proto.PRODUCE, 3, 1, None, b"\xab")
+    expected_payload = struct.pack(">hhih", 0, 3, 1, -1) + b"\xab"
+    assert frame == struct.pack(">i", len(expected_payload)) + expected_payload
+
+
+def test_golden_record_batch_v2_bytes():
+    """Record batch v2, hand-assembled from the published layout:
+    baseOffset(8) batchLength(4) partitionLeaderEpoch(4) magic(1)=2
+    crc(4) attributes(2) lastOffsetDelta(4) firstTimestamp(8)
+    maxTimestamp(8) producerId(8) producerEpoch(2) baseSequence(4)
+    numRecords(4) records(varint-framed)."""
+    import struct
+
+    batch = proto.encode_record_batch(
+        [(b"k", b"v", [], 1000)], base_offset=5
+    )
+
+    # inner record, varint-encoded (zigzag): attributes=0, tsDelta=0,
+    # offsetDelta=0, keyLen=1 'k', valueLen=1 'v', headerCount=0
+    record = bytes([0x00, 0x00, 0x00, 0x02]) + b"k" + bytes([0x02]) + b"v" + bytes([0x00])
+    records_section = bytes([0x10]) + record  # varint total length 8
+
+    after_crc = (
+        struct.pack(">hi", 0, 0)            # attributes, lastOffsetDelta
+        + struct.pack(">qq", 1000, 1000)    # first/max timestamp
+        + struct.pack(">qhi", -1, -1, -1)   # producerId/Epoch/baseSeq
+        + struct.pack(">i", 1)              # numRecords
+        + records_section
+    )
+    crc = _crc32c_reference(after_crc)
+    tail = struct.pack(">ib", -1, 2) + struct.pack(">I", crc) + after_crc
+    expected = struct.pack(">qi", 5, len(tail)) + tail
+    assert batch == expected
+
+    decoded = proto.decode_record_batches(batch)
+    assert decoded[0].offset == 5 and decoded[0].key == b"k"
+
+
+def test_golden_api_versions_response_decode():
+    """ApiVersions v0 response: int16 error_code | array of
+    (int16 api_key, int16 min, int16 max)."""
+    import struct
+
+    payload = struct.pack(">hihhh hhh", 0, 2, 0, 3, 9, 1, 4, 13)
+    versions = proto.decode_api_versions(proto.Reader(payload))
+    assert versions.pop(-1) == (0, 0)
+    assert versions == {0: (3, 9), 1: (4, 13)}
+
+
+# --------------------------------------------------------------------- #
+# ApiVersions negotiation (KIP-896 guard)
+# --------------------------------------------------------------------- #
+def test_unsupported_pinned_apis():
+    full = {k: (0, 15) for k in proto.PINNED_VERSIONS}
+    assert proto.unsupported_pinned_apis(full) == []
+    # a KIP-896-style broker that dropped Produce v3 and Fetch v4
+    narrowed = dict(full)
+    narrowed[proto.PRODUCE] = (9, 11)
+    narrowed[proto.FETCH] = (12, 16)
+    problems = proto.unsupported_pinned_apis(narrowed)
+    assert problems == [
+        "Produce v3 (broker serves v9..v11)",
+        "Fetch v4 (broker serves v12..v16)",
+    ]
+    missing = {k: v for k, v in full.items() if k != proto.JOIN_GROUP}
+    assert proto.unsupported_pinned_apis(missing) == [
+        "JoinGroup (not offered)"
+    ]
+
+
+def test_handshake_against_facade_populates_versions():
+    async def main():
+        async with kafka_runtime() as runtime:
+            producer = runtime.create_producer("p", {"topic": "t1"})
+            await producer.start()
+            await producer.write(Record(value="x"))
+            client = runtime._client  # noqa: SLF001
+            connection = client._bootstrap_connection()  # noqa: SLF001
+            assert connection.api_versions is not None
+            assert proto.PRODUCE in connection.api_versions
+            await producer.close()
+
+    asyncio.run(main())
+
+
+def test_handshake_rejects_kip896_broker():
+    """A broker advertising only post-KIP-896 versions is rejected at
+    connect with the exact unsupported list — not a mid-traffic decode
+    error."""
+    from langstream_tpu.topics.kafka.client import (
+        KafkaConnection,
+        KafkaVersionError,
+    )
+    from langstream_tpu.topics.kafka.protocol import Writer
+
+    async def main():
+        async def serve(reader, writer):
+            size = int.from_bytes(await reader.readexactly(4), "big")
+            payload = await reader.readexactly(size)
+            request = proto.Reader(payload)
+            request.int16(); request.int16()
+            correlation = request.int32()
+            body = Writer().int16(proto.NONE)
+            rows = []
+            for api, pinned in sorted(proto.PINNED_VERSIONS.items()):
+                if api == proto.PRODUCE:
+                    rows.append((api, 9, 12))   # v3 removed (KIP-896)
+                elif api == proto.API_VERSIONS:
+                    rows.append((api, 0, 4))
+                else:
+                    rows.append((api, pinned, pinned + 4))
+            body.array(rows, lambda w, r: (
+                w.int16(r[0]), w.int16(r[1]), w.int16(r[2]),
+            ))
+            response = Writer().int32(correlation).raw(body.build()).build()
+            import struct
+
+            writer.write(struct.pack(">i", len(response)) + response)
+            await writer.drain()
+            writer.close()  # or wait_closed() below hangs (3.12 waits
+            # for every handler transport)
+
+        server = await asyncio.start_server(serve, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        connection = KafkaConnection("127.0.0.1", port, "test")
+        with pytest.raises(KafkaVersionError, match=r"Produce v3.*KIP-896"):
+            await connection.connect()
+        assert connection._writer is None  # noqa: SLF001 — closed
+        server.close()
+        await server.wait_closed()
+
+    asyncio.run(main())
