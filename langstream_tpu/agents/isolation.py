@@ -107,7 +107,11 @@ def _dec(value: Any) -> Any:
             return _dec_record(data[_RECORD_TAG])
         return NotImplemented
 
-    return wire_json.decode_value(value, decode_special=decode_special)
+    return wire_json.decode_value(
+        value,
+        extra_markers=_RECORD_MARKERS,
+        decode_special=decode_special,
+    )
 
 
 def _enc_record(record: Record) -> Dict[str, Any]:
@@ -364,7 +368,11 @@ class RemoteUserAgent:
         if self._crashed is None and self._writer is not None:
             try:
                 await asyncio.wait_for(self._call("close"), timeout=10.0)
-            except (AgentProcessCrashed, RemoteAgentError, asyncio.TimeoutError):
+            except (Exception, asyncio.TimeoutError):
+                # includes the 'isolated agent closed' RuntimeError the
+                # read loop sets on pending futures when the child EOFs
+                # before the close response — cleanup below must run
+                # regardless
                 pass
         if self._writer is not None:
             self._writer.close()

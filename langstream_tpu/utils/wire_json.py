@@ -71,32 +71,36 @@ def encode_value(
 def decode_value(
     value: Any,
     *,
+    extra_markers: Tuple[frozenset, ...] = (),
     decode_special: Optional[Callable[[Dict[str, Any]], Any]] = None,
 ) -> Any:
-    """Inverse of :func:`encode_value`. ``decode_special(dict)`` may
-    claim a marker dict (returning the decoded object) or return the
-    sentinel ``NotImplemented`` to fall through."""
+    """Inverse of :func:`encode_value` (pass the same
+    ``extra_markers``). ``decode_special(dict)`` may claim a marker dict
+    (returning the decoded object) or return the sentinel
+    ``NotImplemented`` to fall through."""
+
+    def rec(v: Any) -> Any:
+        return decode_value(
+            v, extra_markers=extra_markers, decode_special=decode_special
+        )
+
     if isinstance(value, dict):
         keys = set(value.keys())
         if keys == {BYTES_TAG}:
             return base64.b64decode(value[BYTES_TAG])
         if keys == {ESC_TAG} and isinstance(value[ESC_TAG], dict):
-            # escaped marker-shaped user dict. The isinstance guard
-            # keeps pre-escape data readable: an OLD encoder passed a
-            # literal user {'__esc__': 'x'} through verbatim, and it
-            # must keep decoding as itself
-            return {
-                k: decode_value(v, decode_special=decode_special)
-                for k, v in value[ESC_TAG].items()
-            }
+            inner_keys = frozenset(value[ESC_TAG].keys())
+            # only unwrap what OUR encoder wraps: an inner dict whose
+            # key set is itself a marker set. Anything else is legacy
+            # data the pre-escape codec passed through verbatim — a
+            # user's literal {'__esc__': {...}} must decode as itself
+            if inner_keys in _BASE_MARKERS or inner_keys in extra_markers:
+                return {k: rec(v) for k, v in value[ESC_TAG].items()}
         if decode_special is not None:
             special = decode_special(value)
             if special is not NotImplemented:
                 return special
-        return {
-            k: decode_value(v, decode_special=decode_special)
-            for k, v in value.items()
-        }
+        return {k: rec(v) for k, v in value.items()}
     if isinstance(value, list):
-        return [decode_value(v, decode_special=decode_special) for v in value]
+        return [rec(v) for v in value]
     return value

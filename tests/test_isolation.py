@@ -307,3 +307,19 @@ def test_service_join_resolves_on_close(tmp_path):
         assert agent.agent_info()["user"]["crashed"] is False
 
     asyncio.run(main())
+
+
+def test_legacy_escape_dict_payload_not_unwrapped():
+    """Legacy verbatim {'__esc__': {...}} (old encoder, non-marker inner
+    keys) must decode as itself, while the new encoder's wrapping still
+    round-trips marker-shaped dicts."""
+    from langstream_tpu.utils.wire_json import decode_value, encode_value
+
+    legacy = {"__esc__": {"a": 1}}
+    assert decode_value(legacy) == legacy
+    for tricky in (
+        {"__b64__": "user-string"},
+        {"__esc__": {"__b64__": "x"}},
+        {"payload": {"__b64__": "aGk="}},
+    ):
+        assert decode_value(encode_value(tricky)) == tricky

@@ -1,0 +1,202 @@
+"""Render the langstream-tpu helm chart without helm.
+
+The chart (`helm/langstream-tpu/`) deliberately uses a small Go-template
+subset — value paths, ``{{- if <path> }} … {{- end }}`` guards, and the
+``quote``/``toJson`` filters — so it can be rendered and validated in
+environments without the helm binary (this CI, air-gapped operators,
+and tests/test_helm_chart.py, which fails on chart drift the way the
+reference's e2e tier catches broken charts by helm-installing them,
+``langstream-e2e-tests/.../BaseEndToEndTest.java:92,750-752``).
+
+CLI (helm-template flavoured)::
+
+    python tools/helm_render.py helm/langstream-tpu \
+        --name ls --namespace tenant-a --set operator.enabled=false
+
+When a real helm binary is available, ``helm template`` renders the
+same chart identically — this renderer implements the same semantics
+for the subset the chart uses and REJECTS constructs outside it, so the
+chart cannot silently grow beyond what's validated offline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import yaml
+
+_EXPR = re.compile(r"\{\{-?\s*(.*?)\s*-?\}\}")
+_IF = re.compile(r"^\s*\{\{-\s*if\s+(\S+)\s*\}\}\s*$")
+_END = re.compile(r"^\s*\{\{-\s*end\s*\}\}\s*$")
+
+
+class ChartError(ValueError):
+    pass
+
+
+def _lookup(context: Dict[str, Any], path: str) -> Any:
+    if not path.startswith("."):
+        raise ChartError(f"unsupported template expression: {path!r}")
+    node: Any = context
+    for part in path.strip(".").split("."):
+        if not isinstance(node, dict) or part not in node:
+            return None
+        node = node[part]
+    return node
+
+
+def _render_expr(expression: str, context: Dict[str, Any]) -> str:
+    parts = [p.strip() for p in expression.split("|")]
+    value = _lookup(context, parts[0])
+    for filter_name in parts[1:]:
+        if filter_name == "quote":
+            value = json.dumps("" if value is None else str(value))
+        elif filter_name == "toJson":
+            value = json.dumps(value)
+        else:
+            raise ChartError(f"unsupported template filter: {filter_name!r}")
+    if value is None:
+        return "null"
+    if value is True:
+        return "true"
+    if value is False:
+        return "false"
+    return str(value)
+
+
+def render_template(text: str, context: Dict[str, Any]) -> str:
+    """Render one template file. Line-oriented: ``{{- if }}``/``{{- end }}``
+    must be alone on their line (the only form the chart uses)."""
+    out_lines: List[str] = []
+    stack: List[bool] = []
+    for line_number, line in enumerate(text.splitlines(), 1):
+        if_match = _IF.match(line)
+        if if_match is not None:
+            stack.append(bool(_lookup(context, if_match.group(1))))
+            continue
+        if _END.match(line):
+            if not stack:
+                raise ChartError(f"unbalanced {{{{- end }}}} at line {line_number}")
+            stack.pop()
+            continue
+        if "{{" in line and ("{{- if" in line or "{{- end" in line):
+            raise ChartError(
+                f"inline if/end at line {line_number} is outside the "
+                "supported template subset"
+            )
+        # render (and thereby VALIDATE) every line, including those a
+        # false guard suppresses — an unsupported construct inside a
+        # disabled-by-default branch must still fail the offline check
+        rendered = _EXPR.sub(
+            lambda m: _render_expr(m.group(1), context), line
+        )
+        if not all(stack):
+            continue
+        out_lines.append(rendered)
+    if stack:
+        raise ChartError("unclosed {{- if }} block")
+    return "\n".join(out_lines) + "\n"
+
+
+def _deep_merge(base: Dict[str, Any], override: Dict[str, Any]) -> Dict[str, Any]:
+    merged = dict(base)
+    for key, value in override.items():
+        if (
+            key in merged
+            and isinstance(merged[key], dict)
+            and isinstance(value, dict)
+        ):
+            merged[key] = _deep_merge(merged[key], value)
+        else:
+            merged[key] = value
+    return merged
+
+
+def _apply_set(values: Dict[str, Any], assignment: str) -> None:
+    key, _, raw = assignment.partition("=")
+    if not _:
+        raise ChartError(f"--set needs key=value, got {assignment!r}")
+    node = values
+    parts = key.split(".")
+    for part in parts[:-1]:
+        node = node.setdefault(part, {})
+    node[parts[-1]] = yaml.safe_load(raw) if raw != "" else ""
+
+
+def render_chart(
+    chart_dir: str,
+    *,
+    release_name: str = "langstream-tpu",
+    namespace: str = "default",
+    values_override: Optional[Dict[str, Any]] = None,
+    include_crds: bool = True,
+) -> List[Tuple[str, Dict[str, Any]]]:
+    """Render every template (and optionally CRDs) to parsed manifests.
+    Returns [(source_file, manifest_dict)]; docs suppressed by guards
+    (empty render) are dropped."""
+    import os
+
+    with open(os.path.join(chart_dir, "Chart.yaml")) as handle:
+        chart = yaml.safe_load(handle)
+    with open(os.path.join(chart_dir, "values.yaml")) as handle:
+        values = yaml.safe_load(handle) or {}
+    if values_override:
+        values = _deep_merge(values, values_override)
+    context = {
+        "Values": values,
+        "Release": {"Name": release_name, "Namespace": namespace},
+        "Chart": chart,
+    }
+    out: List[Tuple[str, Dict[str, Any]]] = []
+    if include_crds:
+        crd_dir = os.path.join(chart_dir, "crds")
+        for name in sorted(os.listdir(crd_dir)) if os.path.isdir(crd_dir) else []:
+            with open(os.path.join(crd_dir, name)) as handle:
+                for doc in yaml.safe_load_all(handle):
+                    if doc:
+                        out.append((f"crds/{name}", doc))
+    template_dir = os.path.join(chart_dir, "templates")
+    for name in sorted(os.listdir(template_dir)):
+        if not name.endswith((".yaml", ".yml")):
+            continue
+        with open(os.path.join(template_dir, name)) as handle:
+            rendered = render_template(handle.read(), context)
+        for doc in yaml.safe_load_all(rendered):
+            if doc:
+                out.append((f"templates/{name}", doc))
+    return out
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("chart")
+    parser.add_argument("--name", default="langstream-tpu")
+    parser.add_argument("--namespace", default="default")
+    parser.add_argument("--set", action="append", default=[], dest="sets")
+    parser.add_argument("-f", "--values", action="append", default=[])
+    parser.add_argument("--skip-crds", action="store_true")
+    args = parser.parse_args()
+
+    override: Dict[str, Any] = {}
+    for path in args.values:
+        with open(path) as handle:
+            override = _deep_merge(override, yaml.safe_load(handle) or {})
+    for assignment in args.sets:
+        _apply_set(override, assignment)
+    manifests = render_chart(
+        args.chart,
+        release_name=args.name,
+        namespace=args.namespace,
+        values_override=override,
+        include_crds=not args.skip_crds,
+    )
+    print(yaml.safe_dump_all(
+        [doc for _, doc in manifests], sort_keys=False
+    ), end="")
+
+
+if __name__ == "__main__":
+    main()
