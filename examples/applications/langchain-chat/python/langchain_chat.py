@@ -1,0 +1,87 @@
+"""A LangChain retrieval chatbot as a langstream-tpu custom agent.
+
+Role analogue of the reference example
+(`/root/reference/examples/applications/langchain-chat/python/langchain_chat.py`)
+written fresh against the modern split packages (`langchain_core` /
+`langchain_openai`): a history-aware LCEL chain — retrieve context,
+build a grounded prompt, call the chat model — where the model endpoint
+is a langstream-tpu `serve` pod (OpenAI-compatible), so the chain's
+completions run on your own TPUs.
+
+The agent class only needs the duck-typed SDK surface (init/process);
+everything else is ordinary LangChain code.
+"""
+
+from typing import Any, Dict, List
+
+from langchain_core.documents import Document
+from langchain_core.output_parsers import StrOutputParser
+from langchain_core.prompts import ChatPromptTemplate
+from langchain_core.runnables import RunnableLambda, RunnablePassthrough
+from langchain_core.vectorstores import InMemoryVectorStore
+from langchain_openai import ChatOpenAI
+
+SYSTEM_TEMPLATE = """You are a helpful assistant. Answer ONLY from the
+context below; if the context is not relevant say "Hmm, I'm not sure.".
+
+<context>
+{context}
+</context>"""
+
+
+def _format_docs(docs: List[Document]) -> str:
+    return "\n\n".join(doc.page_content for doc in docs)
+
+
+class LangChainChat:
+    """questions-topic records in, answers out; chat history is kept
+    per `langstream-client-session-id` header (the gateway sets it)."""
+
+    def init(self, config: Dict[str, Any]):
+        self.llm = ChatOpenAI(
+            base_url=config.get("openai-base-url", "http://localhost:8100/v1"),
+            api_key=config.get("openai-api-key", "unused"),
+            model=config.get("model", "llama-3-8b"),
+            temperature=0.2,
+        )
+        self.history_size = int(config.get("history-size", 6))
+        self.histories: Dict[str, List] = {}
+        store = InMemoryVectorStore.from_texts(
+            config.get("seed-documents") or [
+                "langstream-tpu serves OpenAI-compatible chat completions "
+                "from TPU pods via the `serve` command.",
+                "Pipelines are YAML: agents reading and writing topics.",
+            ],
+        )
+        retriever = store.as_retriever()
+        prompt = ChatPromptTemplate.from_messages([
+            ("system", SYSTEM_TEMPLATE),
+            ("placeholder", "{chat_history}"),
+            ("human", "{question}"),
+        ])
+        self.chain = (
+            RunnablePassthrough.assign(
+                context=RunnableLambda(lambda x: x["question"])
+                | retriever
+                | _format_docs,
+            )
+            | prompt
+            | self.llm
+            | StrOutputParser()
+        )
+
+    async def process(self, record):
+        headers = dict(record.headers)
+        session = str(headers.get("langstream-client-session-id", ""))
+        history = self.histories.setdefault(session, [])
+        question = (
+            record.value if isinstance(record.value, str)
+            else str(record.value)
+        )
+        answer = await self.chain.ainvoke(
+            {"question": question, "chat_history": list(history)}
+        )
+        history.append(("human", question))
+        history.append(("ai", answer))
+        del history[: -2 * self.history_size]
+        return [(record.key, answer)]

@@ -1,0 +1,58 @@
+"""A LlamaIndex → Cassandra vector sink as a langstream-tpu custom agent.
+
+Role analogue of the reference example
+(`/root/reference/examples/applications/llamaindex-cassandra-sink/python/llamaindex_cassandra.py`)
+written fresh against the modern `llama_index.core` layout: each input
+record becomes a Document inserted into a VectorStoreIndex backed by
+CassandraVectorStore. Embeddings come from a langstream-tpu `serve`
+endpoint (OpenAI-compatible) instead of api.openai.com.
+"""
+
+from typing import Any, Dict
+
+from cassandra.auth import PlainTextAuthProvider
+from cassandra.cluster import Cluster
+from llama_index.core import Document, VectorStoreIndex
+from llama_index.vector_stores.cassandra import CassandraVectorStore
+
+
+class LlamaIndexCassandraSink:
+    def __init__(self):
+        self.config: Dict[str, Any] = {}
+        self.session = None
+        self.index = None
+
+    def init(self, config: Dict[str, Any]):
+        self.config = config
+
+    def start(self):
+        cassandra = self.config["cassandra"]
+        cluster = Cluster(
+            contact_points=str(
+                cassandra.get("contact-points", "127.0.0.1")
+            ).split(","),
+            auth_provider=PlainTextAuthProvider(
+                cassandra["username"], cassandra["password"]
+            ),
+        )
+        self.session = cluster.connect()
+        store = CassandraVectorStore(
+            session=self.session,
+            keyspace=cassandra["keyspace"],
+            table=cassandra["table"],
+            embedding_dimension=int(
+                self.config.get("embedding-dimension", 1536)
+            ),
+        )
+        self.index = VectorStoreIndex.from_vector_store(store)
+
+    def write(self, record):
+        text = (
+            record.value if isinstance(record.value, str)
+            else str(record.value)
+        )
+        self.index.insert(Document(text=text))
+
+    def close(self):
+        if self.session is not None:
+            self.session.shutdown()

@@ -398,10 +398,7 @@ class RemoteUserAgent:
 # child side (python -m langstream_tpu.agents.isolation <socket>)
 # --------------------------------------------------------------------- #
 async def _worker(socket_path: str) -> None:
-    from langstream_tpu.agents.python_agents import (
-        _load_user_class,
-        _maybe_await,
-    )
+    from langstream_tpu.agents.python_agents import _maybe_await
 
     reader, writer = await asyncio.open_unix_connection(socket_path)
     agent: Any = None
@@ -419,9 +416,20 @@ async def _worker(socket_path: str) -> None:
                     raise ValueError(
                         "python agent requires 'className' configuration"
                     )
-                cls = _load_user_class(
-                    class_name, configuration.get("pythonPath") or []
-                )
+                # this child belongs to ONE app, so the reference's flat
+                # PYTHONPATH semantics apply (PythonGrpcServer.java:81-85:
+                # python/ + python/lib, in that precedence, ahead of
+                # site-packages): user modules AND their third-party
+                # deps import absolutely — no namespacing needed here,
+                # the process IS the namespace
+                fresh = [
+                    str(p) for p in configuration.get("pythonPath") or []
+                    if p and str(p) not in sys.path
+                ]
+                sys.path[:0] = fresh
+                from langstream_tpu.runtime.registry import load_class
+
+                cls = load_class(class_name)
                 agent = cls()
                 if hasattr(agent, "init"):
                     await _maybe_await(agent.init(configuration))

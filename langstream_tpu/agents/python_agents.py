@@ -101,16 +101,26 @@ class _PythonAgentMixin:
         if not class_name:
             raise ValueError("python agent requires 'className' configuration")
         isolation = configuration.get(
-            "isolation", os.environ.get("LS_PYTHON_ISOLATION", "none")
+            "isolation", os.environ.get("LS_PYTHON_ISOLATION", "auto")
         )
-        if isolation not in ("none", "process", "", None):
+        if isolation not in ("auto", "none", "process", "", None):
             # a typo ('Process', 'true') must not silently run untrusted
             # code in-process — the boundary the operator asked for
             # would be absent with no signal
             raise ValueError(
-                f"python agent isolation must be 'none' or 'process', "
-                f"got {isolation!r}"
+                f"python agent isolation must be 'auto', 'none', or "
+                f"'process', got {isolation!r}"
             )
+        if isolation == "auto":
+            # apps that ship third-party deps in python/lib need the
+            # reference's flat PYTHONPATH semantics — one interpreter
+            # per app, i.e. the process boundary. Pure-app-code agents
+            # stay in-process (namespaced imports keep them collision
+            # -proof across apps).
+            isolation = "process" if any(
+                os.path.basename(str(p).rstrip("/")) == "lib"
+                for p in configuration.get("pythonPath") or []
+            ) else "none"
         if isolation == "process":
             # the reference's crash boundary (PythonGrpcServer.java:54-91):
             # untrusted user code runs in a child; a crash kills the pod,

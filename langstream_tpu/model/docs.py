@@ -355,19 +355,19 @@ for doc in [
     )),
     AgentDoc("python-source", "User Python source", (
         _P("className", "string", "python class path", required=True),
-        _P("isolation", "string", "none (in-process, trusted) or process (crash-isolated child process)", default="none"),
+        _P("isolation", "string", "auto (process when the app ships python/lib deps, else in-process), none, or process (crash-isolated child)", default="auto"),
     ), category="source", allow_unknown=True),
     AgentDoc("python-processor", "User Python processor", (
         _P("className", "string", "python class path", required=True),
-        _P("isolation", "string", "none (in-process, trusted) or process (crash-isolated child process)", default="none"),
+        _P("isolation", "string", "auto (process when the app ships python/lib deps, else in-process), none, or process (crash-isolated child)", default="auto"),
     ), allow_unknown=True),
     AgentDoc("python-sink", "User Python sink", (
         _P("className", "string", "python class path", required=True),
-        _P("isolation", "string", "none (in-process, trusted) or process (crash-isolated child process)", default="none"),
+        _P("isolation", "string", "auto (process when the app ships python/lib deps, else in-process), none, or process (crash-isolated child)", default="auto"),
     ), category="sink", allow_unknown=True),
     AgentDoc("python-service", "User Python service", (
         _P("className", "string", "python class path", required=True),
-        _P("isolation", "string", "none (in-process, trusted) or process (crash-isolated child process)", default="none"),
+        _P("isolation", "string", "auto (process when the app ships python/lib deps, else in-process), none, or process (crash-isolated child)", default="auto"),
     ), category="service", allow_unknown=True),
     AgentDoc("flare-controller", "FLARE iterative-retrieval loop controller", (
         _P("tokens-field", "string", "field with completion tokens", default="value.tokens"),

@@ -19,7 +19,6 @@ import subprocess
 import sys
 import threading
 
-import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "mirror_follower_worker.py")
@@ -38,7 +37,6 @@ def _spawn_follower(port: int, out_path: str, fingerprint: bytes):
     )
 
 
-@pytest.mark.timeout(600)
 def test_two_process_replay_token_identical(tmp_path):
     from langstream_tpu.providers.jax_local.engine import (
         DecodeEngine,
@@ -112,7 +110,6 @@ def test_two_process_replay_token_identical(tmp_path):
     assert report["digest"] == state_digest(leader)
 
 
-@pytest.mark.timeout(300)
 def test_two_process_fingerprint_mismatch_rejected(tmp_path):
     from langstream_tpu.serving.mirror import (
         DispatchMirror,

@@ -1,0 +1,3 @@
+"""Offline stand-in for the DataStax `cassandra` driver: Session.execute
+spools statements to the file named by LS_STUB_CASSANDRA_SPOOL so tests
+can assert what the app wrote (the real driver drops in unchanged)."""

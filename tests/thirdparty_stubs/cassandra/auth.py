@@ -1,0 +1,4 @@
+class PlainTextAuthProvider:
+    def __init__(self, username, password):
+        self.username = username
+        self.password = password

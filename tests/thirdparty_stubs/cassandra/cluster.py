@@ -1,0 +1,25 @@
+import json
+import os
+
+
+class Session:
+    def execute(self, statement, parameters=()):
+        spool = os.environ.get("LS_STUB_CASSANDRA_SPOOL")
+        if spool:
+            with open(spool, "a") as handle:
+                handle.write(json.dumps({
+                    "statement": statement,
+                    "parameters": [str(p) for p in parameters],
+                }) + "\n")
+
+    def shutdown(self):
+        pass
+
+
+class Cluster:
+    def __init__(self, contact_points=None, auth_provider=None, **_):
+        self.contact_points = contact_points or ["127.0.0.1"]
+        self.auth_provider = auth_provider
+
+    def connect(self):
+        return Session()

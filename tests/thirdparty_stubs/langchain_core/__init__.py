@@ -1,0 +1,9 @@
+"""Offline stand-in for `langchain_core` with the real import paths and
+call shapes (LCEL pipe composition, prompt templates, vector stores).
+
+The example apps import these ABSOLUTELY from python/lib — exactly how
+`langstream-tpu python load-pip-requirements` lays out real wheels — so
+running them against this stub proves the custom-agent SDK hosts
+LangChain-shaped third-party code without network access. The real
+packages drop in with no app change.
+"""

@@ -1,0 +1,17 @@
+class BaseMessage:
+    role = "user"
+
+    def __init__(self, content=""):
+        self.content = content
+
+
+class HumanMessage(BaseMessage):
+    role = "user"
+
+
+class AIMessage(BaseMessage):
+    role = "assistant"
+
+
+class SystemMessage(BaseMessage):
+    role = "system"

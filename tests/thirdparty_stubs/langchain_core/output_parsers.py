@@ -1,0 +1,6 @@
+from langchain_core.runnables import Runnable
+
+
+class StrOutputParser(Runnable):
+    async def ainvoke(self, value):
+        return getattr(value, "content", value if isinstance(value, str) else str(value))

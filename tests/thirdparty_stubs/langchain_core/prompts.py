@@ -1,0 +1,32 @@
+from langchain_core.messages import AIMessage, HumanMessage, SystemMessage
+from langchain_core.runnables import Runnable
+
+_ROLES = {"human": HumanMessage, "ai": AIMessage, "system": SystemMessage}
+
+
+class ChatPromptValue:
+    def __init__(self, messages):
+        self.messages = messages
+
+
+class ChatPromptTemplate(Runnable):
+    def __init__(self, message_specs):
+        self.message_specs = message_specs
+
+    @classmethod
+    def from_messages(cls, message_specs):
+        return cls(message_specs)
+
+    async def ainvoke(self, variables):
+        messages = []
+        for role, template in self.message_specs:
+            if role == "placeholder":
+                key = template.strip("{}")
+                for item in variables.get(key) or []:
+                    if isinstance(item, tuple):
+                        messages.append(_ROLES[item[0]](item[1]))
+                    else:
+                        messages.append(item)
+                continue
+            messages.append(_ROLES[role](template.format(**variables)))
+        return ChatPromptValue(messages)

@@ -1,0 +1,70 @@
+import asyncio
+import inspect
+
+
+def _coerce(value):
+    if isinstance(value, Runnable):
+        return value
+    if callable(value):
+        return RunnableLambda(value)
+    raise TypeError(f"not runnable: {value!r}")
+
+
+class Runnable:
+    def __or__(self, other):
+        return RunnableSequence(self, _coerce(other))
+
+    def __ror__(self, other):
+        return RunnableSequence(_coerce(other), self)
+
+    def invoke(self, value):
+        return asyncio.get_event_loop().run_until_complete(self.ainvoke(value))
+
+    async def ainvoke(self, value):
+        raise NotImplementedError
+
+
+class RunnableLambda(Runnable):
+    def __init__(self, fn):
+        self.fn = fn
+
+    async def ainvoke(self, value):
+        result = self.fn(value)
+        if inspect.isawaitable(result):
+            return await result
+        return result
+
+
+class RunnableSequence(Runnable):
+    def __init__(self, *steps):
+        self.steps = []
+        for step in steps:
+            if isinstance(step, RunnableSequence):
+                self.steps.extend(step.steps)
+            else:
+                self.steps.append(step)
+
+    async def ainvoke(self, value):
+        for step in self.steps:
+            value = await step.ainvoke(value)
+        return value
+
+
+class _Assign(Runnable):
+    def __init__(self, assignments):
+        self.assignments = {k: _coerce(v) for k, v in assignments.items()}
+
+    async def ainvoke(self, value):
+        out = dict(value)
+        for key, runnable in self.assignments.items():
+            out[key] = await runnable.ainvoke(value)
+        return out
+
+
+class RunnablePassthrough(Runnable):
+    @staticmethod
+    def assign(**assignments):
+        return _Assign(assignments)
+
+    async def ainvoke(self, value):
+        return value

@@ -1,0 +1,47 @@
+"""Offline stand-in for `langchain_openai.ChatOpenAI` that is a REAL
+minimal OpenAI-protocol client (aiohttp): it posts /chat/completions to
+`base_url` — in the tests, a live langstream-tpu `serve` endpoint — so
+the example app's chain exercises the genuine HTTP protocol end to end.
+"""
+
+from langchain_core.messages import AIMessage
+from langchain_core.runnables import Runnable
+
+
+class ChatOpenAI(Runnable):
+    def __init__(
+        self,
+        base_url="https://api.openai.com/v1",
+        api_key="",
+        model="gpt-4o-mini",
+        temperature=1.0,
+        max_tokens=64,
+        **_,
+    ):
+        self.base_url = base_url.rstrip("/")
+        self.api_key = api_key
+        self.model = model
+        self.temperature = temperature
+        self.max_tokens = max_tokens
+
+    async def ainvoke(self, value):
+        import aiohttp
+
+        messages = getattr(value, "messages", value)
+        payload = {
+            "model": self.model,
+            "temperature": self.temperature,
+            "max_tokens": self.max_tokens,
+            "messages": [
+                {"role": m.role, "content": m.content} for m in messages
+            ],
+        }
+        async with aiohttp.ClientSession() as session:
+            async with session.post(
+                f"{self.base_url}/chat/completions",
+                json=payload,
+                headers={"Authorization": f"Bearer {self.api_key}"},
+            ) as response:
+                response.raise_for_status()
+                data = await response.json()
+        return AIMessage(data["choices"][0]["message"]["content"])

@@ -1,0 +1,2 @@
+"""Offline stand-in for `llama_index` (modern core/vector_stores
+layout) — see langchain_core stub docstring for the contract."""

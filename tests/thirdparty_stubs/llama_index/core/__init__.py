@@ -1,0 +1,16 @@
+class Document:
+    def __init__(self, text="", metadata=None):
+        self.text = text
+        self.metadata = metadata or {}
+
+
+class VectorStoreIndex:
+    def __init__(self, vector_store):
+        self.vector_store = vector_store
+
+    @classmethod
+    def from_vector_store(cls, vector_store, **_):
+        return cls(vector_store)
+
+    def insert(self, document):
+        self.vector_store.add(document)

@@ -1,0 +1,15 @@
+class CassandraVectorStore:
+    def __init__(
+        self, session, keyspace, table, embedding_dimension=1536, **_
+    ):
+        self.session = session
+        self.keyspace = keyspace
+        self.table = table
+        self.embedding_dimension = embedding_dimension
+
+    def add(self, document):
+        self.session.execute(
+            f"INSERT INTO {self.keyspace}.{self.table} "
+            "(row_id, body_blob) VALUES (%s, %s)",
+            (id(document), document.text),
+        )
