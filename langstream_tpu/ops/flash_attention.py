@@ -114,45 +114,146 @@ def _flash_kernel(
         out_ref[0, 0] = (acc_scratch[:] / l_safe).astype(out_ref.dtype)
 
 
+def _flash_kernel_quant(
+    lengths_ref,  # SMEM [1, 1]
+    q_ref,        # VMEM [1, 1, block_q, d]
+    k_ref,        # VMEM [1, 1, block_k, d] int8
+    v_ref,        # VMEM [1, 1, block_k, d] int8
+    ks_ref,       # VMEM [1, 1, block_k] f32 — per-row k scales
+    vs_ref,       # VMEM [1, 1, block_k] f32 — per-row v scales
+    out_ref,      # VMEM [1, 1, block_q, d]
+    m_scratch,
+    l_scratch,
+    acc_scratch,
+    *,
+    scale: float,
+    block_q: int,
+    block_k: int,
+):
+    """Int8-cache flash: k/v tiles stream from HBM as int8 (half the
+    bandwidth of bf16 — the whole point), upcast in VMEM (int8 values
+    are EXACTLY representable in bf16, so the MXU sees the same values
+    the XLA quant path does), and the per-(position, head) scales fold
+    the way ``ops/attention.py`` folds them: k_scale multiplies the
+    score AFTER the q·kᵀ contraction, v_scale folds into the probs
+    BEFORE p·v — neither contraction ever touches a dequantized
+    cache-sized tensor."""
+    qi = pl.program_id(2)
+    kj = pl.program_id(3)
+    num_k = pl.num_programs(3)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_scratch[:] = jnp.full_like(m_scratch, NEG_INF)
+        l_scratch[:] = jnp.zeros_like(l_scratch)
+        acc_scratch[:] = jnp.zeros_like(acc_scratch)
+
+    q_start = qi * block_q
+    k_start = kj * block_k
+
+    @pl.when(k_start <= q_start + block_q - 1)
+    def _compute():
+        length = lengths_ref[0, 0]
+        q = q_ref[0, 0]
+        k = k_ref[0, 0].astype(q.dtype)   # int8 → exact in bf16
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        s = s * (ks_ref[0, 0][None, :] * scale)  # fold k scales per row
+
+        rows = q_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        cols = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        mask = jnp.logical_and(cols <= rows, cols < length)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_scratch[:, :1]
+        row_max = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, row_max)
+        p = jnp.exp(s - m_new) * mask.astype(jnp.float32)
+        alpha = jnp.exp(m_prev - m_new)
+
+        l_prev = l_scratch[:, :1]
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+
+        v = v_ref[0, 0].astype(q.dtype)
+        p_scaled = p * vs_ref[0, 0][None, :]  # fold v scales into probs
+        pv = jax.lax.dot_general(
+            p_scaled.astype(q.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_scratch[:] = acc_scratch[:] * alpha + pv
+        m_scratch[:] = jnp.broadcast_to(m_new, m_scratch.shape)
+        l_scratch[:] = jnp.broadcast_to(l_new, l_scratch.shape)
+
+    @pl.when(kj == num_k - 1)
+    def _finalize():
+        l = l_scratch[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        out_ref[0, 0] = (acc_scratch[:] / l_safe).astype(out_ref.dtype)
+
+
 def _pallas_flash(
     q: jnp.ndarray,        # [B, H, T, D]
-    k: jnp.ndarray,        # [B, KVH, T, D]
+    k: jnp.ndarray,        # [B, KVH, T, D] (bf16, or int8 with scales)
     v: jnp.ndarray,
     lengths: jnp.ndarray,  # [B] int32
     *,
     block_q: int,
     block_k: int,
     interpret: bool,
+    k_scale: Optional[jnp.ndarray] = None,  # [B, KVH, T] f32
+    v_scale: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     batch, heads, seq, dim = q.shape
     kv_heads = k.shape[1]
     group = heads // kv_heads
     scale = dim ** -0.5
     grid = (batch, heads, seq // block_q, seq // block_k)
+    quantized = k_scale is not None
 
-    kernel = functools.partial(
-        _flash_kernel, scale=scale, block_q=block_q, block_k=block_k
-    )
     lengths_2d = lengths.reshape(batch, 1).astype(jnp.int32)
+    in_specs = [
+        pl.BlockSpec(
+            (1, 1), lambda b, h, i, j: (b, 0),
+            memory_space=pltpu.SMEM,
+        ),
+        pl.BlockSpec(
+            (1, 1, block_q, dim), lambda b, h, i, j: (b, h, i, 0),
+        ),
+        pl.BlockSpec(
+            (1, 1, block_k, dim), lambda b, h, i, j: (b, h // group, j, 0),
+        ),
+        pl.BlockSpec(
+            (1, 1, block_k, dim), lambda b, h, i, j: (b, h // group, j, 0),
+        ),
+    ]
+    operands = [lengths_2d, q, k, v]
+    if quantized:
+        kernel = functools.partial(
+            _flash_kernel_quant, scale=scale,
+            block_q=block_q, block_k=block_k,
+        )
+        scale_spec = pl.BlockSpec(
+            (1, 1, block_k), lambda b, h, i, j: (b, h // group, j),
+        )
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scale, v_scale]
+        kv_bytes = k.size + v.size + k_scale.size * 4 + v_scale.size * 4
+    else:
+        kernel = functools.partial(
+            _flash_kernel, scale=scale, block_q=block_q, block_k=block_k
+        )
+        kv_bytes = (k.size + v.size) * k.dtype.itemsize
 
     return pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec(
-                (1, 1), lambda b, h, i, j: (b, 0),
-                memory_space=pltpu.SMEM,
-            ),
-            pl.BlockSpec(
-                (1, 1, block_q, dim), lambda b, h, i, j: (b, h, i, 0),
-            ),
-            pl.BlockSpec(
-                (1, 1, block_k, dim), lambda b, h, i, j: (b, h // group, j, 0),
-            ),
-            pl.BlockSpec(
-                (1, 1, block_k, dim), lambda b, h, i, j: (b, h // group, j, 0),
-            ),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, 1, block_q, dim), lambda b, h, i, j: (b, h, i, 0),
         ),
@@ -165,21 +266,23 @@ def _pallas_flash(
         cost_estimate=pl.CostEstimate(
             flops=4 * batch * heads * seq * seq * dim,
             bytes_accessed=(
-                q.size + k.size + v.size + q.size
-            ) * q.dtype.itemsize,
+                (q.size + q.size) * q.dtype.itemsize + kv_bytes
+            ),
             transcendentals=batch * heads * seq * seq,
         ),
         interpret=interpret,
-    )(lengths_2d, q, k, v)
+    )(*operands)
 
 
 def flash_prefill_attention(
     q: jnp.ndarray,  # [B, T, H, D]
-    k: jnp.ndarray,  # [B, T, KVH, D]
+    k: jnp.ndarray,  # [B, T, KVH, D] (bf16; int8 when scales given)
     v: jnp.ndarray,
     *,
     mask: Optional[jnp.ndarray] = None,   # [B, T] right-padded valid mask
     lengths: Optional[jnp.ndarray] = None,  # [B] (alternative to mask)
+    k_scale: Optional[jnp.ndarray] = None,  # [B, T, KVH] — int8-cache mode
+    v_scale: Optional[jnp.ndarray] = None,
     block_q: int = 256,
     block_k: int = 256,
     interpret: bool = False,
@@ -189,7 +292,11 @@ def flash_prefill_attention(
     first ``lengths[b]`` positions, False after) — it is collapsed to
     per-row lengths for the kernel's SMEM masking, so a non-contiguous
     (packed / loss-style) mask would be silently misapplied; use
-    :func:`langstream_tpu.ops.attention.prefill_attention` for those."""
+    :func:`langstream_tpu.ops.attention.prefill_attention` for those.
+
+    With ``k_scale``/``v_scale`` the kernel runs the int8-cache variant
+    (k/v int8, per-(position, kv-head) scales — see
+    :func:`_flash_kernel_quant`)."""
     batch, seq, heads, dim = q.shape
     if lengths is None:
         lengths = (
@@ -210,13 +317,43 @@ def flash_prefill_attention(
             x = jnp.pad(x, ((0, 0), (0, 0), (0, padded - seq), (0, 0)))
         return x
 
+    def scales_layout(s):
+        if s is None:
+            return None
+        s = jnp.swapaxes(s, 1, 2)  # [B, KVH, T]
+        if padded != seq:
+            s = jnp.pad(s, ((0, 0), (0, 0), (0, padded - seq)))
+        return s.astype(jnp.float32)
+
     out = _pallas_flash(
         to_kernel_layout(q), to_kernel_layout(k), to_kernel_layout(v),
         lengths,
         block_q=block_q, block_k=block_k, interpret=interpret,
+        k_scale=scales_layout(k_scale), v_scale=scales_layout(v_scale),
     )
     out = jnp.swapaxes(out, 1, 2)
     return out[:, :seq] if padded != seq else out
+
+
+def flash_prefill_attention_quant(
+    q: jnp.ndarray,        # [B, T, H, D]
+    k: jnp.ndarray,        # [B, T, KVH, D] int8
+    k_scale: jnp.ndarray,  # [B, T, KVH] f32
+    v: jnp.ndarray,        # [B, T, KVH, D] int8
+    v_scale: jnp.ndarray,  # [B, T, KVH] f32
+    **kwargs,
+) -> jnp.ndarray:
+    """Causal flash prefill over an int8-quantized window (the cold half
+    of `engine: {kv-quant: int8}`): same scale-folded algebra as
+    :func:`langstream_tpu.ops.attention.chunk_attention_quant` with
+    ``starts=0``, but the k/v tiles stream from HBM as int8 — quantized
+    cold prefill keeps the flash HBM profile instead of falling back to
+    the O(T²)-score XLA path (docs/perf.md round-3 follow-up). Thin
+    argument-ordering wrapper over :func:`flash_prefill_attention` —
+    its mask caveat (contiguous right-padding only) applies."""
+    return flash_prefill_attention(
+        q, k, v, k_scale=k_scale, v_scale=v_scale, **kwargs
+    )
 
 
 def flash_prefill_attention_sharded(
@@ -226,6 +363,9 @@ def flash_prefill_attention_sharded(
     mesh,
     *,
     mask: Optional[jnp.ndarray] = None,
+    lengths: Optional[jnp.ndarray] = None,
+    k_scale: Optional[jnp.ndarray] = None,  # [B, T, KVH] — int8 mode
+    v_scale: Optional[jnp.ndarray] = None,
     axis_name: str = "tp",
     interpret: bool = False,
 ) -> jnp.ndarray:
@@ -237,30 +377,59 @@ def flash_prefill_attention_sharded(
     heads, so no collective is needed (the same per-shard layout the tp
     attention einsums produce). GQA stays consistent because query and
     kv heads shard by the same factor (``validate_mesh`` enforces
-    divisibility).
+    divisibility). With ``k_scale``/``v_scale`` the int8-cache kernel
+    runs per shard, the scales sharded over their kv-head axis.
     """
     from jax.sharding import PartitionSpec as P
 
     batch = q.shape[0]
-    lengths = (
-        jnp.sum(mask.astype(jnp.int32), axis=-1)
-        if mask is not None
-        else jnp.full((batch,), q.shape[1], dtype=jnp.int32)
-    )
+    if lengths is None:
+        lengths = (
+            jnp.sum(mask.astype(jnp.int32), axis=-1)
+            if mask is not None
+            else jnp.full((batch,), q.shape[1], dtype=jnp.int32)
+        )
     head_spec = P(None, None, axis_name, None)
+    scale_spec = P(None, None, axis_name)
+    quantized = k_scale is not None
 
-    def local(q_l, k_l, v_l, lengths_l):
+    def local(q_l, k_l, v_l, lengths_l, *scales):
         return flash_prefill_attention(
-            q_l, k_l, v_l, lengths=lengths_l, interpret=interpret
+            q_l, k_l, v_l, lengths=lengths_l, interpret=interpret,
+            **(
+                {"k_scale": scales[0], "v_scale": scales[1]}
+                if scales else {}
+            ),
         )
 
+    in_specs = [head_spec, head_spec, head_spec, P(None)]
+    operands = [q, k, v, lengths]
+    if quantized:
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scale, v_scale]
     return jax.shard_map(
         local,
         mesh=mesh,
-        in_specs=(head_spec, head_spec, head_spec, P(None)),
+        in_specs=tuple(in_specs),
         out_specs=head_spec,
         check_vma=False,
-    )(q, k, v, lengths)
+    )(*operands)
+
+
+def flash_prefill_attention_quant_sharded(
+    q: jnp.ndarray,        # [B, T, H, D] — H sharded over ``axis_name``
+    k: jnp.ndarray,        # [B, T, KVH, D] int8
+    k_scale: jnp.ndarray,  # [B, T, KVH]
+    v: jnp.ndarray,
+    v_scale: jnp.ndarray,
+    mesh,
+    **kwargs,
+) -> jnp.ndarray:
+    """Int8 flash prefill under tensor parallelism — thin argument-
+    ordering wrapper over :func:`flash_prefill_attention_sharded`."""
+    return flash_prefill_attention_sharded(
+        q, k, v, mesh, k_scale=k_scale, v_scale=v_scale, **kwargs
+    )
 
 
 def _round_up(n: int, multiple: int) -> int:

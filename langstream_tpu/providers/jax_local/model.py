@@ -340,6 +340,33 @@ def _prefill_attn(config, q, k, v, mask, mesh=None):
     return prefill_attention(q, k, v, mask=mask)
 
 
+def _prefill_attn_quant(config, q, k_q, k_s, v_q, v_s, lengths, mesh=None):
+    """Quantized-cold-prefill twin of :func:`_prefill_attn`: int8 flash
+    kernel on TPU for long MXU-aligned prompts (same scale-folded
+    algebra, int8 HBM loads), XLA ``chunk_attention_quant`` otherwise."""
+    flash_ok = config.use_flash and (
+        use_flash(q.shape[1], q.shape[3]) or config.flash_interpret
+    )
+    if flash_ok:
+        from langstream_tpu.ops.flash_attention import (
+            flash_prefill_attention_quant,
+            flash_prefill_attention_quant_sharded,
+        )
+
+        if mesh is not None and dict(mesh.shape).get("tp", 1) > 1:
+            return flash_prefill_attention_quant_sharded(
+                q, k_q, k_s, v_q, v_s, mesh, lengths=lengths,
+                interpret=config.flash_interpret,
+            )
+        return flash_prefill_attention_quant(
+            q, k_q, k_s, v_q, v_s, lengths=lengths,
+            interpret=config.flash_interpret,
+        )
+    return chunk_attention_quant(
+        q, k_q, k_s, v_q, v_s, jnp.zeros_like(lengths), lengths
+    )
+
+
 def prefill(
     config: LlamaConfig,
     params: Dict[str, jnp.ndarray],
@@ -380,14 +407,16 @@ def prefill(
             # the SAME f32 scale-folded math the warm/decode dispatches
             # use (the just-written rows as the "cache", starts=0):
             # identical formulas over identical row contents keep
-            # cold/warm/prefix-copy paths token-identical. The flash
-            # kernel is bf16-only, so quantized cold prefill takes this
-            # XLA path (int8 flash is future work — docs/perf.md).
+            # cold/warm/prefix-copy paths token-identical. Long
+            # MXU-aligned prompts take the int8 flash kernel — identical
+            # scale-folded algebra, int8 HBM tile loads — so kv-quant
+            # keeps the flash HBM profile on cold prefill; block
+            # boundaries reassociate f32 sums exactly like the bf16
+            # flash path does.
             k_q, k_s = quantize_kv(k)
             v_q, v_s = quantize_kv(v)
-            attn = chunk_attention_quant(
-                q, k_q, k_s, v_q, v_s,
-                jnp.zeros_like(lengths), lengths,
+            attn = _prefill_attn_quant(
+                config, q, k_q, k_s, v_q, v_s, lengths, mesh=mesh
             )
             layer_kv_out = (k_q, v_q, k_s, v_s)
         else:

@@ -132,3 +132,94 @@ def test_flash_sharded_tp_matches_reference():
             np.asarray(out[b, :n]), np.asarray(ref[b, :n]),
             rtol=2e-5, atol=2e-5,
         )
+
+
+# --------------------------- int8 flash -------------------------------- #
+@pytest.mark.parametrize("heads,kv_heads", [(4, 4), (4, 2)])
+def test_flash_quant_matches_xla_quant(heads, kv_heads):
+    """Int8 flash (interpret) vs the XLA scale-folded reference
+    (chunk_attention_quant at starts=0): same algebra, block-tiled."""
+    from langstream_tpu.ops.attention import (
+        chunk_attention_quant,
+        quantize_kv,
+    )
+    from langstream_tpu.ops.flash_attention import (
+        flash_prefill_attention_quant,
+    )
+
+    batch, seq, dim = 2, 256, 128
+    q, k, v = _make_qkv(batch, seq, heads, kv_heads, dim)
+    lengths = jnp.array([256, 190], dtype=jnp.int32)
+    mask = jnp.arange(seq)[None, :] < lengths[:, None]
+    k_q, k_s = quantize_kv(k)
+    v_q, v_s = quantize_kv(v)
+
+    ref = chunk_attention_quant(
+        q, k_q, k_s, v_q, v_s, jnp.zeros_like(lengths), lengths
+    )
+    out = flash_prefill_attention_quant(
+        q, k_q, k_s, v_q, v_s, mask=mask,
+        block_q=128, block_k=128, interpret=True,
+    )
+    for b in range(batch):
+        n = int(lengths[b])
+        np.testing.assert_allclose(
+            np.asarray(out[b, :n]), np.asarray(ref[b, :n]),
+            rtol=2e-2, atol=2e-2,  # probs round through bf16 in-kernel
+        )
+
+
+def test_flash_quant_pads_non_multiple_seq():
+    from langstream_tpu.ops.attention import (
+        chunk_attention_quant,
+        quantize_kv,
+    )
+    from langstream_tpu.ops.flash_attention import (
+        flash_prefill_attention_quant,
+    )
+
+    batch, seq, dim = 1, 200, 128
+    q, k, v = _make_qkv(batch, seq, 4, 2, dim, seed=3)
+    lengths = jnp.array([200], dtype=jnp.int32)
+    k_q, k_s = quantize_kv(k)
+    v_q, v_s = quantize_kv(v)
+    ref = chunk_attention_quant(
+        q, k_q, k_s, v_q, v_s, jnp.zeros_like(lengths), lengths
+    )
+    out = flash_prefill_attention_quant(
+        q, k_q, k_s, v_q, v_s, lengths=lengths,
+        block_q=128, block_k=128, interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out[0]), np.asarray(ref[0]), rtol=2e-2, atol=2e-2
+    )
+
+
+def test_flash_quant_sharded_tp_matches_reference():
+    from langstream_tpu.ops.attention import (
+        chunk_attention_quant,
+        quantize_kv,
+    )
+    from langstream_tpu.ops.flash_attention import (
+        flash_prefill_attention_quant_sharded,
+    )
+
+    batch, seq, dim = 1, 256, 128
+    heads, kv_heads = 8, 4
+    q, k, v = _make_qkv(batch, seq, heads, kv_heads, dim, seed=5)
+    lengths = jnp.array([222], dtype=jnp.int32)
+    mask = jnp.arange(seq)[None, :] < lengths[:, None]
+    k_q, k_s = quantize_kv(k)
+    v_q, v_s = quantize_kv(v)
+    ref = chunk_attention_quant(
+        q, k_q, k_s, v_q, v_s, jnp.zeros_like(lengths), lengths
+    )
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(4), ("tp",))
+    out = flash_prefill_attention_quant_sharded(
+        q, k_q, k_s, v_q, v_s, mesh, mask=mask, interpret=True
+    )
+    n = int(lengths[0])
+    np.testing.assert_allclose(
+        np.asarray(out[0, :n]), np.asarray(ref[0, :n]),
+        rtol=2e-2, atol=2e-2,
+    )
