@@ -45,6 +45,9 @@ PROMPT_LEN = int(os.environ.get("BENCH_PROMPT_LEN", "128"))
 NEW_TOKENS = int(os.environ.get("BENCH_NEW_TOKENS", "128"))
 REQUESTS = int(os.environ.get("BENCH_REQUESTS", "96"))
 MODE = os.environ.get("BENCH_MODE", "e2e")          # e2e | engine
+# int8 KV cache ("int8" | "" = bf16 cache) — the e2e A/B knob for the
+# engine's kv-quant option
+KV_QUANT = os.environ.get("BENCH_KV_QUANT", "") or None
 # one closed-loop client per slot: oversubscribing evicts pinned
 # sessions (measured slower than the turnaround gaps it fills, now that
 # prefill overlaps decode), and 1:1 matches the BASELINE #5 session
@@ -84,7 +87,10 @@ def phase(name: str) -> None:
     log(f"[phase] {name} (t+{time.monotonic() - _START:.0f}s)")
 
 
-def roofline(config, quant, active_slots: float, mean_ctx: float) -> dict:
+def roofline(
+    config, quant, active_slots: float, mean_ctx: float,
+    kv_quant: bool = False,
+) -> dict:
     """Decode-step roofline from the model shape: FLOPs (matmul 2·P per
     token + attention QK+AV per layer) and HBM bytes (weights once per
     step + KV rows per active slot). Returns per-step numbers the
@@ -94,9 +100,16 @@ def roofline(config, quant, active_slots: float, mean_ctx: float) -> dict:
     bf16 one."""
     params = config.num_params()
     weight_bytes = params * (1 if quant == "int8" else 2)
-    kv_row_bytes = (
-        2 * config.num_layers * config.num_kv_heads * config.dims_per_head * 2
-    )  # k+v, bf16
+    if kv_quant:
+        # int8 values + one f32 scale per (layer, pos, kv_head) for k and v
+        kv_row_bytes = 2 * config.num_layers * config.num_kv_heads * (
+            config.dims_per_head + 4
+        )
+    else:
+        kv_row_bytes = (
+            2 * config.num_layers * config.num_kv_heads
+            * config.dims_per_head * 2
+        )  # k+v, bf16
     flops_per_token = 2 * params + (
         4 * mean_ctx * config.num_heads * config.dims_per_head
         * config.num_layers
@@ -212,7 +225,7 @@ async def run_bench():
     config = dataclasses.replace(config, max_seq_len=PROMPT_LEN + NEW_TOKENS + 64)
     log(
         f"model: {MODEL_PRESET}, {config.num_params() / 1e9:.2f}B params, "
-        f"quant={QUANT or 'bf16'}"
+        f"quant={QUANT or 'bf16'}, kv-cache={KV_QUANT or 'bf16'}"
     )
     t0 = time.perf_counter()
     if QUANT == "int8":
@@ -231,6 +244,7 @@ async def run_bench():
         prefill_buckets=[PROMPT_LEN],
         decode_chunk=DECODE_CHUNK,
         quantize=QUANT,
+        kv_quant=KV_QUANT,
         pipeline_decode=PIPELINE,
     )
     try:
@@ -343,6 +357,7 @@ async def run_bench_e2e():
                 # in one window
                 "prefill-buckets": [64, PROMPT_LEN + 64],
                 "precompile": True,
+                "kv-quant": KV_QUANT or "",
             },
         }
     }
@@ -458,7 +473,10 @@ async def _drive_e2e(runner, gateway, port, engine):
     # question_pad already sizes question+template to ~PROMPT_LEN
     mean_ctx = PROMPT_LEN + NEW_TOKENS / 2
     steps_per_s = steps / decode_time
-    roof = roofline(engine.config, QUANT, occupancy * MAX_SLOTS, mean_ctx)
+    roof = roofline(
+        engine.config, QUANT, occupancy * MAX_SLOTS, mean_ctx,
+        kv_quant=bool(KV_QUANT),
+    )
     # weight-only int8 still contracts in bf16 — bf16 peak always
     mfu = steps_per_s * roof["flops_per_step"] / PEAK_FLOPS["bf16"]
     hbm_pct = steps_per_s * roof["bytes_per_step"] / (PEAK_HBM_GBS * 1e9)
@@ -485,6 +503,7 @@ async def _drive_e2e(runner, gateway, port, engine):
     )
     return tok_s, {
         "broker": BROKER,
+        "kv_cache": KV_QUANT or "bf16",
         "raw_engine_tok_s": round(raw_tok_s, 1),
         "p50_rtt_ms": round(p50_rtt * 1e3, 1),
         "p95_rtt_ms": round(p95_rtt * 1e3, 1),
@@ -530,6 +549,8 @@ def main():
             MODE = "engine"
     if MODE != "e2e":
         failed = None
+        # engine-mode A/B artifacts must carry the KV-cache mode too
+        extras = {"kv_cache": KV_QUANT or "bf16"}
         try:
             tok_s = asyncio.run(run_bench())
         except Exception as error:  # noqa: BLE001 — e.g. OOM on a small chip

@@ -20,6 +20,10 @@ Config (both): ``connect-url``, ``connector-name``, ``connector-config``
 (the raw Connect config dict), ``bootstrapServers`` (for the data
 topics), ``topic`` (output/staging topic), ``delete-on-close`` (default
 false).
+
+Deployment: point ``connect-url`` at an existing Connect cluster, or
+enable the bundled distributed-mode worker the helm chart ships
+(``kafkaConnect.enabled=true`` → ``http://<release>-connect:8083``).
 """
 
 from __future__ import annotations

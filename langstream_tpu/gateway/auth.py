@@ -248,13 +248,26 @@ class GoogleAuthProvider(GatewayAuthProvider):
             async with session.get(
                 self.tokeninfo_url, params={"id_token": credentials}
             ) as response:
-                payload = await response.json(content_type=None)
+                # status first: a proxy 502 with an HTML body must fail
+                # as AuthenticationFailed, not a JSON decode traceback
                 if response.status >= 300:
                     raise AuthenticationFailed(
                         f"google tokeninfo HTTP {response.status}"
                     )
+                payload = await response.json(content_type=None)
         if self.client_id and payload.get("aud") != self.client_id:
             raise AuthenticationFailed("google token audience mismatch")
+        # tokeninfo always reports the issuer; Google's own verifier
+        # accepts exactly these two spellings (GoogleIdTokenVerifier
+        # semantics — the reference delegates to it). A payload WITHOUT
+        # iss is not a genuine tokeninfo response — fail closed.
+        if payload.get("iss") not in (
+            "accounts.google.com", "https://accounts.google.com"
+        ):
+            raise AuthenticationFailed(
+                f"google token issuer {payload.get('iss')!r} "
+                "not accounts.google.com"
+            )
         if "exp" in payload and float(payload["exp"]) < time.time():
             raise AuthenticationFailed("google token expired")
         subject = payload.get("email") or payload.get("sub")

@@ -312,6 +312,18 @@ def _logits(config: LlamaConfig, params, x):
     return qeinsum("...h,hv->...v", x, params["lm_head"]).astype(jnp.float32)
 
 
+def _flash_path(config, q, mesh):
+    """Shared gate for the bf16/int8 prefill twins: (use the flash
+    kernel?, dispatch through the tp shard_map wrapper?). One place for
+    the MXU-alignment heuristic and the SPMD rule so the two paths
+    cannot diverge."""
+    flash_ok = config.use_flash and (
+        use_flash(q.shape[1], q.shape[3]) or config.flash_interpret
+    )
+    tp_sharded = mesh is not None and dict(mesh.shape).get("tp", 1) > 1
+    return flash_ok, tp_sharded
+
+
 def _prefill_attn(config, q, k, v, mask, mesh=None):
     """Flash kernel on TPU for long MXU-aligned prompts, XLA einsum path
     otherwise (CPU tests, short prompts, odd head dims). Under tensor
@@ -322,15 +334,13 @@ def _prefill_attn(config, q, k, v, mask, mesh=None):
     :func:`forward` keeps the XLA formulation. Masks here are always
     right-padded (built from lengths), which is what the kernel's
     lengths-based masking assumes."""
-    flash_ok = config.use_flash and (
-        use_flash(q.shape[1], q.shape[3]) or config.flash_interpret
-    )
+    flash_ok, tp_sharded = _flash_path(config, q, mesh)
     if flash_ok:
         from langstream_tpu.ops.flash_attention import (
             flash_prefill_attention_sharded,
         )
 
-        if mesh is not None and dict(mesh.shape).get("tp", 1) > 1:
+        if tp_sharded:
             return flash_prefill_attention_sharded(
                 q, k, v, mesh, mask=mask, interpret=config.flash_interpret
             )
@@ -344,16 +354,14 @@ def _prefill_attn_quant(config, q, k_q, k_s, v_q, v_s, lengths, mesh=None):
     """Quantized-cold-prefill twin of :func:`_prefill_attn`: int8 flash
     kernel on TPU for long MXU-aligned prompts (same scale-folded
     algebra, int8 HBM loads), XLA ``chunk_attention_quant`` otherwise."""
-    flash_ok = config.use_flash and (
-        use_flash(q.shape[1], q.shape[3]) or config.flash_interpret
-    )
+    flash_ok, tp_sharded = _flash_path(config, q, mesh)
     if flash_ok:
         from langstream_tpu.ops.flash_attention import (
             flash_prefill_attention_quant,
             flash_prefill_attention_quant_sharded,
         )
 
-        if mesh is not None and dict(mesh.shape).get("tp", 1) > 1:
+        if tp_sharded:
             return flash_prefill_attention_quant_sharded(
                 q, k_q, k_s, v_q, v_s, mesh, lengths=lengths,
                 interpret=config.flash_interpret,

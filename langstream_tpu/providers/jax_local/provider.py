@@ -148,7 +148,7 @@ class JaxCompletionsService(CompletionsService):
             decode_chunk=int(engine_config.get("decode-chunk", 8)),
             seed=sampling_seed,
             quantize=config.get("quantization"),
-            kv_quant=engine_config.get("kv-quant"),
+            kv_quant=engine_config.get("kv-quant") or None,
             pipeline_decode=str(
                 engine_config.get("pipeline-decode", "")
             ).lower() in ("1", "true", "yes"),

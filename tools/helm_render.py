@@ -48,14 +48,33 @@ def _lookup(context: Dict[str, Any], path: str) -> Any:
     return node
 
 
-def _render_expr(expression: str, context: Dict[str, Any]) -> str:
+_REQUIRED = re.compile(r'^required\s+"([^"]*)"\s+(\S+)$')
+
+
+def _render_expr(
+    expression: str, context: Dict[str, Any], enforce_required: bool = True
+) -> str:
     parts = [p.strip() for p in expression.split("|")]
-    value = _lookup(context, parts[0])
+    required = _REQUIRED.match(parts[0])
+    if required is not None:
+        value = _lookup(context, required.group(2))
+        if enforce_required and (value is None or value == ""):
+            raise ChartError(f"required value missing: {required.group(1)}")
+    else:
+        value = _lookup(context, parts[0])
     for filter_name in parts[1:]:
         if filter_name == "quote":
             value = json.dumps("" if value is None else str(value))
         elif filter_name == "toJson":
-            value = json.dumps(value)
+            # match Go/helm's toJson byte-for-byte (sorted keys, no
+            # spaces) so checksum annotations agree with real helm
+            value = json.dumps(value, sort_keys=True, separators=(",", ":"))
+        elif filter_name == "sha256sum":
+            import hashlib
+
+            value = hashlib.sha256(
+                ("" if value is None else str(value)).encode()
+            ).hexdigest()
         else:
             raise ChartError(f"unsupported template filter: {filter_name!r}")
     if value is None:
@@ -89,11 +108,16 @@ def render_template(text: str, context: Dict[str, Any]) -> str:
             )
         # render (and thereby VALIDATE) every line, including those a
         # false guard suppresses — an unsupported construct inside a
-        # disabled-by-default branch must still fail the offline check
+        # disabled-by-default branch must still fail the offline check.
+        # `required`-emptiness only enforces on EMITTED lines (helm
+        # does not evaluate suppressed branches at all; we parse them
+        # for subset validation but must not fail a disabled feature's
+        # unset required values)
+        active = all(stack)
         rendered = _EXPR.sub(
-            lambda m: _render_expr(m.group(1), context), line
+            lambda m: _render_expr(m.group(1), context, active), line
         )
-        if not all(stack):
+        if not active:
             continue
         out_lines.append(rendered)
     if stack:
