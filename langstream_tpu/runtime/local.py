@@ -221,8 +221,27 @@ class LocalApplicationRunner:
             await self.topic_runtime.close()
             raise failure
         for runner in self.runners:
-            self._tasks.append(loop.create_task(runner.run()))
+            task = loop.create_task(runner.run())
+            # surface a crashed runner the moment it dies: without this
+            # the failure sits unretrieved until stop()/join(), and a
+            # gateway client whose pipeline just vanished hangs with no
+            # log line anywhere (seen: an over-long prompt rejected by
+            # the engine under the default fail policy)
+            task.add_done_callback(self._log_runner_exit)
+            self._tasks.append(task)
         self._started.set()
+
+    @staticmethod
+    def _log_runner_exit(task: "asyncio.Task") -> None:
+        if task.cancelled():
+            return
+        error = task.exception()
+        if error is not None:
+            logger.error(
+                "agent runner crashed — records in flight are lost and "
+                "gateway consumers of its topics will stall",
+                exc_info=error,
+            )
 
     async def stop(self, timeout: float = 30.0) -> None:
         for runner in self.runners:

@@ -227,6 +227,55 @@ def test_python_source_and_sink(tmp_path):
     asyncio.run(main())
 
 
+def test_runner_crash_is_logged_immediately(tmp_path, caplog):
+    """A runner that dies mid-pipeline must log the failure the moment
+    it happens — not sit silent until stop()/join() while gateway
+    clients hang (round-4 regression find: an over-long prompt rejected
+    under the fail policy killed the pipeline with no log line)."""
+    app_dir = write_app(
+        tmp_path,
+        {
+            "pipeline.yaml": """
+                topics:
+                  - name: "in"
+                    creation-mode: create-if-not-exists
+                pipeline:
+                  - id: "boom"
+                    type: "python-processor"
+                    input: "in"
+                    configuration: {className: "crashpy.Boom"}
+            """,
+            "python/crashpy.py": """
+                class Boom:
+                    def process(self, record):
+                        raise RuntimeError("kaboom-xyz")
+            """,
+        },
+    )
+
+    async def main():
+        import logging
+
+        runner = await run_application(app_dir)
+        caplog.set_level(logging.ERROR, "langstream_tpu.runtime.local")
+        await runner.producer("in").write(Record(value="x"))
+        deadline = asyncio.get_event_loop().time() + 5
+        while not any(
+            "runner crashed" in r.message for r in caplog.records
+        ):
+            if asyncio.get_event_loop().time() > deadline:
+                raise TimeoutError("no crash log within 5s")
+            await asyncio.sleep(0.02)
+        crash = next(
+            r for r in caplog.records if "runner crashed" in r.message
+        )
+        assert "kaboom-xyz" in str(crash.exc_info[1])
+        with pytest.raises(RuntimeError, match="kaboom-xyz"):
+            await runner.stop()
+
+    asyncio.run(main())
+
+
 def test_runner_info(tmp_path):
     app_dir = write_app(
         tmp_path,
