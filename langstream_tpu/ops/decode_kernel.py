@@ -1,0 +1,366 @@
+"""Length-aware Pallas TPU decode attention (flash-decode).
+
+The XLA decode attention (``ops/attention.py::decode_attention``) is an
+einsum over the cache's FULL static buffer ``[S, T, KVH, D]``: masking
+keeps invalid positions out of the softmax, but every decode step still
+streams all ``T`` allocated rows per slot from HBM. At serving contexts
+(T = 4-8k) with typical live lengths far below T, most of that traffic
+is dead rows — and decode is the HBM-bound hot loop, so dead traffic is
+lost tokens/sec.
+
+This kernel makes decode-attention HBM traffic proportional to the
+LIVE context instead of the allocated buffer:
+
+- grid = (slot, T/block_k); the kv-block axis is innermost/sequential,
+  so VMEM scratch carries the online-softmax state across a slot's
+  blocks (same recurrence as ``ops/flash_attention.py``).
+- per-slot lengths ride as a scalar-prefetch operand: they are
+  available to the BlockSpec index maps BEFORE the pipeline issues
+  each block's DMA. Blocks past a slot's last live block clamp their
+  index to that last block — Pallas elides the copy when the mapped
+  block indices repeat, so skipped blocks cost neither HBM reads nor
+  MXU time (their compute is ``pl.when``-gated off).
+- GQA runs as one small MXU matmul per kv head against the block's
+  ``[block_k, D]`` slab (a static python loop — KVH is a config
+  constant); q is tiny ([H, D]) and loaded once per slot.
+- the int8-cache twin streams int8 k/v tiles (half the bytes — the
+  kv-quant win compounds with block skipping) and folds the
+  per-(position, head) scales exactly like the XLA quant path:
+  k_scale AFTER q·kᵀ, v_scale into the probs BEFORE p·v.
+
+Reference parity: none to port — the reference's decode loop lives
+server-side behind provider HTTPS (SURVEY §2.4, `OpenAICompletionService
+.java:52`); this is the TPU-native interior of the `jax-local` engine's
+continuous-batching decode step (`providers/jax_local/engine.py`).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+# candidate kv-block sizes, largest first; the allocated cache length
+# must divide evenly (no padding — padding would copy the cache)
+_BLOCK_CANDIDATES = (512, 256, 128, 64, 32)
+
+
+def pick_block_k(max_len: int) -> Optional[int]:
+    for cand in _BLOCK_CANDIDATES:
+        if max_len % cand == 0 and max_len >= cand:
+            return cand
+    return None
+
+
+def _num_valid_blocks(length, block_k: int):
+    """Blocks holding live rows (≥1 so empty slots still touch block 0 —
+    their scores are fully masked and finalize emits zeros)."""
+    return jnp.maximum(1, (length + block_k - 1) // block_k)
+
+
+def _decode_kernel_body(
+    lens_ref,   # SMEM scalar-prefetch [S] int32
+    q_ref,      # VMEM [1, H, D]
+    k_ref,      # VMEM [1, block_k, KVH, D] (cache dtype, or int8)
+    v_ref,      # VMEM [1, block_k, KVH, D]
+    ks_ref,     # VMEM [1, block_k, KVH] f32, or None (bf16 cache)
+    vs_ref,     # VMEM [1, block_k, KVH] f32, or None
+    out_ref,    # VMEM [1, H, D]
+    m_scratch,  # VMEM [H, 128] f32 — running row max
+    l_scratch,  # VMEM [H, 128] f32 — running row sum
+    acc_scratch,  # VMEM [H, D] f32
+    *,
+    scale: float,
+    block_k: int,
+    kv_heads: int,
+    group: int,
+):
+    """One online-softmax recurrence for both cache dtypes. The int8
+    mode (``ks_ref``/``vs_ref`` present) streams int8 k/v from HBM (the
+    bandwidth halving is the whole point) and folds the scales exactly
+    like ``ops/attention.py::decode_attention_quant``: k_scale
+    multiplies the scores after q·kᵀ, v_scale folds into the probs
+    before p·v, and — matching the XLA quant path, which contracts
+    f32 probs against f32 values — the p·v dot runs in f32 (no bf16
+    round-trip on the scale-folded probs). The bf16 mode contracts
+    bf16 probs with the bf16 cache, matching ``decode_attention``'s
+    ``weights.astype(v_cache.dtype)``."""
+    quantized = ks_ref is not None
+    s_i = pl.program_id(0)
+    j = pl.program_id(1)
+    num_blocks = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scratch[:] = jnp.full_like(m_scratch, NEG_INF)
+        l_scratch[:] = jnp.zeros_like(l_scratch)
+        acc_scratch[:] = jnp.zeros_like(acc_scratch)
+
+    length = lens_ref[s_i]
+
+    @pl.when(j < _num_valid_blocks(length, block_k))
+    def _compute():
+        q = q_ref[0]  # [H, D]
+        # int8 values are exactly representable in bf16, so the MXU
+        # sees the same numbers the XLA quant path computes
+        k = k_ref[0].astype(q.dtype) if quantized else k_ref[0]
+        ks = ks_ref[0] if quantized else None  # [block_k, KVH] f32
+        parts = []
+        for h in range(kv_heads):
+            q_h = q[h * group:(h + 1) * group]  # [G, D]
+            k_h = k[:, h, :]                    # [block_k, D]
+            s_h = jax.lax.dot_general(
+                q_h, k_h, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale
+            if quantized:
+                s_h = s_h * ks[:, h][None, :]
+            parts.append(s_h)
+        s = jnp.concatenate(parts, axis=0)  # [H, block_k]
+
+        cols = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1
+        )
+        mask = cols < length
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_scratch[:, :1]
+        row_max = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, row_max)
+        p = jnp.exp(s - m_new) * mask.astype(jnp.float32)
+        alpha = jnp.exp(m_prev - m_new)
+        l_scratch[:] = jnp.broadcast_to(
+            l_scratch[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True),
+            l_scratch.shape,
+        )
+
+        if quantized:
+            v = v_ref[0].astype(jnp.float32)  # f32 contraction, as XLA
+            vs = vs_ref[0]                    # [block_k, KVH] f32
+        else:
+            v = v_ref[0]
+        pv_parts = []
+        for h in range(kv_heads):
+            p_h = p[h * group:(h + 1) * group]  # [G, block_k] f32
+            if quantized:
+                p_h = p_h * vs[:, h][None, :]
+            else:
+                p_h = p_h.astype(v.dtype)
+            v_h = v[:, h, :]                    # [block_k, D]
+            pv_parts.append(
+                jax.lax.dot_general(
+                    p_h, v_h, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+            )
+        pv = jnp.concatenate(pv_parts, axis=0)  # [H, D]
+        acc_scratch[:] = acc_scratch[:] * alpha + pv
+        m_scratch[:] = jnp.broadcast_to(m_new, m_scratch.shape)
+
+    @pl.when(j == num_blocks - 1)
+    def _finalize():
+        l = l_scratch[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        out_ref[0] = (acc_scratch[:] / l_safe).astype(out_ref.dtype)
+
+
+def _decode_kernel(lens_ref, q_ref, k_ref, v_ref, out_ref,
+                   m_scratch, l_scratch, acc_scratch, **kw):
+    _decode_kernel_body(
+        lens_ref, q_ref, k_ref, v_ref, None, None, out_ref,
+        m_scratch, l_scratch, acc_scratch, **kw,
+    )
+
+
+def _decode_kernel_quant(lens_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
+                         out_ref, m_scratch, l_scratch, acc_scratch, **kw):
+    _decode_kernel_body(
+        lens_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, out_ref,
+        m_scratch, l_scratch, acc_scratch, **kw,
+    )
+
+
+def flash_decode_attention(
+    q: jnp.ndarray,        # [S, H, D] — one new token per slot
+    k_cache: jnp.ndarray,  # [S, T, KVH, D] (bf16; int8 with scales)
+    v_cache: jnp.ndarray,
+    lengths: jnp.ndarray,  # [S] valid rows incl. the new token
+    *,
+    k_scale: Optional[jnp.ndarray] = None,  # [S, T, KVH] — int8 mode
+    v_scale: Optional[jnp.ndarray] = None,
+    block_k: Optional[int] = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Drop-in for :func:`langstream_tpu.ops.attention.decode_attention`
+    (or ``decode_attention_quant`` when scales are given) with HBM
+    traffic ∝ live context. Caller gates via :func:`use_flash_decode`;
+    shapes must satisfy D % 128 == 0, H % KVH == 0, and ``block_k`` must
+    divide T (``pick_block_k``)."""
+    slots, heads, dim = q.shape
+    max_len, kv_heads = k_cache.shape[1], k_cache.shape[2]
+    group = heads // kv_heads
+    scale = dim ** -0.5
+    block_k = block_k or pick_block_k(max_len)
+    if block_k is None:
+        raise ValueError(f"no kv block size divides max_len={max_len}")
+    num_blocks = max_len // block_k
+    quantized = k_scale is not None
+    lengths = lengths.astype(jnp.int32)
+
+    def kv_index(s, j, lens):
+        # clamp dead blocks to the slot's last live block: the mapped
+        # indices repeat, so the pipeline skips their DMA entirely
+        last = _num_valid_blocks(lens[s], block_k) - 1
+        return (s, jnp.minimum(j, last), 0, 0)
+
+    def scale_index(s, j, lens):
+        last = _num_valid_blocks(lens[s], block_k) - 1
+        return (s, jnp.minimum(j, last), 0)
+
+    in_specs = [
+        pl.BlockSpec((1, heads, dim), lambda s, j, lens: (s, 0, 0)),
+        pl.BlockSpec((1, block_k, kv_heads, dim), kv_index),
+        pl.BlockSpec((1, block_k, kv_heads, dim), kv_index),
+    ]
+    operands = [q, k_cache, v_cache]
+    if quantized:
+        kernel = functools.partial(
+            _decode_kernel_quant, scale=scale, block_k=block_k,
+            kv_heads=kv_heads, group=group,
+        )
+        in_specs += [
+            pl.BlockSpec((1, block_k, kv_heads), scale_index),
+            pl.BlockSpec((1, block_k, kv_heads), scale_index),
+        ]
+        operands += [k_scale.astype(jnp.float32), v_scale.astype(jnp.float32)]
+        kv_bytes = k_cache.size + v_cache.size + (k_scale.size + v_scale.size) * 4
+    else:
+        kernel = functools.partial(
+            _decode_kernel, scale=scale, block_k=block_k,
+            kv_heads=kv_heads, group=group,
+        )
+        kv_bytes = (k_cache.size + v_cache.size) * k_cache.dtype.itemsize
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(slots, num_blocks),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, heads, dim), lambda s, j, lens: (s, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((heads, 128), jnp.float32),
+            pltpu.VMEM((heads, 128), jnp.float32),
+            pltpu.VMEM((heads, dim), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((slots, heads, dim), q.dtype),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * slots * heads * max_len * dim,
+            # the whole point: the scheduler should expect live-context
+            # traffic, not the full buffer (estimate at half occupancy)
+            bytes_accessed=q.size * q.dtype.itemsize * 2 + kv_bytes // 2,
+            transcendentals=slots * heads * max_len,
+        ),
+        interpret=interpret,
+    )(lengths, *operands)
+
+
+def flash_decode_attention_quant(
+    q: jnp.ndarray,
+    k_cache: jnp.ndarray,   # int8
+    k_scale: jnp.ndarray,   # [S, T, KVH]
+    v_cache: jnp.ndarray,
+    v_scale: jnp.ndarray,
+    lengths: jnp.ndarray,
+    **kwargs,
+) -> jnp.ndarray:
+    """Argument-ordering twin of
+    :func:`langstream_tpu.ops.attention.decode_attention_quant`."""
+    return flash_decode_attention(
+        q, k_cache, v_cache, lengths,
+        k_scale=k_scale, v_scale=v_scale, **kwargs,
+    )
+
+
+def flash_decode_attention_sharded(
+    q: jnp.ndarray,        # [S, H, D] — H sharded over ``axis_name``
+    k_cache: jnp.ndarray,  # [S, T, KVH, D] — KVH sharded
+    v_cache: jnp.ndarray,
+    lengths: jnp.ndarray,
+    mesh,
+    *,
+    k_scale: Optional[jnp.ndarray] = None,
+    v_scale: Optional[jnp.ndarray] = None,
+    axis_name: str = "tp",
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Flash decode under tensor parallelism: one independent kernel per
+    head shard through ``shard_map`` (a Mosaic call has no SPMD
+    partitioning rule). Attention never mixes heads, so no collective;
+    query and kv heads shard by the same tp factor (``validate_mesh``
+    enforces divisibility)."""
+    from jax.sharding import PartitionSpec as P
+
+    head_spec = P(None, axis_name, None)
+    cache_spec = P(None, None, axis_name, None)
+    scale_spec = P(None, None, axis_name)
+    quantized = k_scale is not None
+
+    def local(q_l, k_l, v_l, lengths_l, *scales):
+        return flash_decode_attention(
+            q_l, k_l, v_l, lengths_l, interpret=interpret,
+            **(
+                {"k_scale": scales[0], "v_scale": scales[1]}
+                if scales else {}
+            ),
+        )
+
+    in_specs = [head_spec, cache_spec, cache_spec, P(None)]
+    operands = [q, k_cache, v_cache, lengths]
+    if quantized:
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scale, v_scale]
+    return jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=tuple(in_specs),
+        out_specs=head_spec,
+        check_vma=False,
+    )(*operands)
+
+
+def decode_shapes_ok(max_len: int, dim: int, heads: int, kv_heads: int) -> bool:
+    """Hard shape requirements of the kernel (hold on ANY backend)."""
+    return (
+        dim % 128 == 0
+        and heads % kv_heads == 0
+        and pick_block_k(max_len) is not None
+    )
+
+
+def use_flash_decode(max_len: int, dim: int, heads: int, kv_heads: int) -> bool:
+    """The kernel pays once dead-block skipping can actually drop HBM
+    traffic: a long allocated cache, MXU-aligned head_dim, a block size
+    that divides it, and a real TPU backend. ``LS_DECODE_FLASH=1/0``
+    overrides the auto policy (on-chip A/B knob) — shape requirements
+    still bind."""
+    import os
+
+    from langstream_tpu.ops.flash_attention import on_tpu
+
+    if not decode_shapes_ok(max_len, dim, heads, kv_heads):
+        return False
+    override = os.environ.get("LS_DECODE_FLASH", "")
+    if override == "1":
+        return on_tpu()
+    if override == "0":
+        return False
+    return on_tpu() and max_len >= 1024

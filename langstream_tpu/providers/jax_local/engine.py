@@ -375,6 +375,13 @@ class DecodeEngine:
     # ------------------------------------------------------------------ #
     # jitted device functions
     # ------------------------------------------------------------------ #
+    def _tp_mesh(self):
+        """The mesh iff tensor parallelism is actually on — the one rule
+        for whether model code routes Pallas kernels through their
+        shard_map wrappers (a bare Mosaic call has no SPMD partitioning
+        rule). Used by prefill AND decode jits; keep them in lockstep."""
+        return self.mesh if dict(self.mesh.shape).get("tp", 1) > 1 else None
+
     def _get_prefill(self, bucket: int):
         """Prefill + first-token sampling in ONE jit: the engine never
         blocks on prefill — sampling on-device means harvesting is a pure
@@ -383,9 +390,7 @@ class DecodeEngine:
         fn = self._compiled_prefill.get(bucket)
         if fn is None:
             config, freqs = self.config, self.freqs
-            mesh = (
-                self.mesh if dict(self.mesh.shape).get("tp", 1) > 1 else None
-            )
+            mesh = self._tp_mesh()
 
             @functools.partial(jax.jit, donate_argnums=(1, 5))
             def run(params, cache, tokens, lengths, slot_ids, counts,
@@ -449,6 +454,7 @@ class DecodeEngine:
         fn = self._decode_fns.get(steps)
         if fn is None:
             config, freqs = self.config, self.freqs
+            mesh = self._tp_mesh()
 
             @functools.partial(jax.jit, donate_argnums=(1, 6))
             def run(params, cache, tokens, lengths, active, write_mask,
@@ -459,7 +465,8 @@ class DecodeEngine:
                 def body(carry, _):
                     cache, tokens, lengths, counts = carry
                     cache, logits = model_lib.decode_step(
-                        config, params, cache, tokens, lengths, freqs, write_mask
+                        config, params, cache, tokens, lengths, freqs,
+                        write_mask, mesh=mesh,
                     )
                     # presence/frequency penalties over generated tokens
                     # (identity when both are 0 — exact float math)

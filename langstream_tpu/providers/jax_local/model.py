@@ -350,6 +350,69 @@ def _prefill_attn(config, q, k, v, mask, mesh=None):
     return prefill_attention(q, k, v, mask=mask)
 
 
+def _decode_flash_path(config, q, kc):
+    """Gate for the flash-decode kernel (the decode twin of
+    :func:`_flash_path`): True when the kernel should run. Shape
+    requirements bind even under the ``flash_interpret`` test hook; the
+    backend/length policy (incl. the ``LS_DECODE_FLASH`` A/B override)
+    only applies outside it. The tp-vs-single dispatch decision lives in
+    the callers (:func:`_decode_attn` / :func:`_decode_attn_quant`)."""
+    from langstream_tpu.ops.decode_kernel import (
+        decode_shapes_ok,
+        use_flash_decode,
+    )
+
+    heads, dim = q.shape[1], q.shape[2]
+    max_len, kv_heads = kc.shape[1], kc.shape[2]
+    shapes_ok = decode_shapes_ok(max_len, dim, heads, kv_heads)
+    flash_ok = config.use_flash and shapes_ok and (
+        use_flash_decode(max_len, dim, heads, kv_heads)
+        or config.flash_interpret
+    )
+    return flash_ok
+
+
+def _decode_attn(config, q, kc, vc, lengths, mesh=None):
+    """Decode attention: length-aware Pallas kernel on TPU for long
+    allocated caches (HBM traffic ∝ live context — the XLA einsum
+    streams the full static buffer), XLA path otherwise. Under tp the
+    kernel runs per head shard through shard_map
+    (``flash_decode_attention_sharded``)."""
+    if _decode_flash_path(config, q, kc):
+        from langstream_tpu.ops.decode_kernel import (
+            flash_decode_attention,
+            flash_decode_attention_sharded,
+        )
+
+        if mesh is not None and dict(mesh.shape).get("tp", 1) > 1:
+            return flash_decode_attention_sharded(
+                q, kc, vc, lengths, mesh, interpret=config.flash_interpret
+            )
+        return flash_decode_attention(
+            q, kc, vc, lengths, interpret=config.flash_interpret
+        )
+    return decode_attention(q, kc, vc, lengths)
+
+
+def _decode_attn_quant(config, q, kc, ks, vc, vs, lengths, mesh=None):
+    """Int8-cache twin of :func:`_decode_attn`."""
+    if _decode_flash_path(config, q, kc):
+        from langstream_tpu.ops.decode_kernel import (
+            flash_decode_attention_quant,
+            flash_decode_attention_sharded,
+        )
+
+        if mesh is not None and dict(mesh.shape).get("tp", 1) > 1:
+            return flash_decode_attention_sharded(
+                q, kc, vc, lengths, mesh, k_scale=ks, v_scale=vs,
+                interpret=config.flash_interpret,
+            )
+        return flash_decode_attention_quant(
+            q, kc, ks, vc, vs, lengths, interpret=config.flash_interpret
+        )
+    return decode_attention_quant(q, kc, ks, vc, vs, lengths)
+
+
 def _prefill_attn_quant(config, q, k_q, k_s, v_q, v_s, lengths, mesh=None):
     """Quantized-cold-prefill twin of :func:`_prefill_attn`: int8 flash
     kernel on TPU for long MXU-aligned prompts (same scale-folded
@@ -593,6 +656,8 @@ def decode_step(
     freqs: jnp.ndarray,
     write_mask: Optional[jnp.ndarray] = None,  # [S] bool; False = don't
                                                # touch this slot's cache
+    mesh=None,                                 # tp mesh for the sharded
+                                               # flash-decode kernel
 ) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
     """One decode step for every slot: write the new token's KV, attend
     over the cache, return next-token logits [S, V]. Cache is donated by
@@ -632,12 +697,14 @@ def decode_step(
             ks = jax.vmap(write)(ks, positions, k_s, write_mask)
             vc = jax.vmap(write)(vc, positions, v_q, write_mask)
             vs = jax.vmap(write)(vs, positions, v_s, write_mask)
-            attn = decode_attention_quant(q, kc, ks, vc, vs, lengths)
+            attn = _decode_attn_quant(
+                config, q, kc, ks, vc, vs, lengths, mesh=mesh
+            )
             kv_out = (kc, vc, ks, vs)
         else:
             kc = jax.vmap(write)(kc, positions, k, write_mask)
             vc = jax.vmap(write)(vc, positions, v, write_mask)
-            attn = decode_attention(q, kc, vc, lengths)
+            attn = _decode_attn(config, q, kc, vc, lengths, mesh=mesh)
             kv_out = (kc, vc)
         x = x + qeinsum("sd,dh->sh", attn.reshape(slots, config.num_heads * hd), wo)
         normed = rms_norm(x, mlp_norm, config.norm_eps)

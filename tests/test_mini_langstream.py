@@ -1,0 +1,65 @@
+"""Execute the mini-langstream-tpu shell harness (reference:
+mini-langstream/mini-langstream — k3d + helm + CLI): the orchestration
+plan runs under MINI_LANGSTREAM_DRY_RUN (no k3d/docker/helm needed) and
+must assemble the exact cluster→image→chart sequence against the real
+chart path; the `cli` passthrough executes the real CLI module."""
+
+import os
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "mini-langstream", "mini-langstream-tpu")
+
+
+def _run(args, **env):
+    return subprocess.run(
+        [SCRIPT, *args],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "MINI_LANGSTREAM_DRY_RUN": "1", **env},
+    )
+
+
+@pytest.mark.parametrize("tool", ["k3d", "kind"])
+def test_start_plan(tool):
+    result = _run(["start"], MINI_LANGSTREAM_TOOL=tool)
+    assert result.returncode == 0, result.stderr
+    plan = [
+        line[2:] for line in result.stdout.splitlines()
+        if line.startswith("+ ")
+    ]
+    # cluster create → image build → image load → helm install → pods
+    assert any(line.startswith(f"{tool} cluster create") or
+               line.startswith(f"{tool} create cluster") for line in plan)
+    assert any(line.startswith("docker build -t langstream-tpu/runtime")
+               for line in plan)
+    load_verb = "image import" if tool == "k3d" else "load docker-image"
+    assert any(load_verb in line for line in plan)
+    helm = [line for line in plan if line.startswith("helm upgrade")]
+    assert helm, plan
+    # the chart path handed to helm must be the real chart in this repo
+    chart = helm[0].split()[4]
+    assert os.path.isdir(chart) and os.path.isfile(
+        os.path.join(chart, "Chart.yaml")
+    )
+    assert plan[-1] == "kubectl get pods"
+
+
+def test_delete_plan():
+    result = _run(["delete"], MINI_LANGSTREAM_TOOL="kind")
+    assert result.returncode == 0, result.stderr
+    assert "+ kind delete cluster --name mini-langstream-tpu" in result.stdout
+
+
+def test_usage_exit_code():
+    result = _run([])
+    assert result.returncode == 64
+    assert "usage:" in result.stderr
+
+
+def test_cli_passthrough_runs_real_cli():
+    result = _run(["cli", "--help"])
+    assert result.returncode == 0, result.stderr
+    # the real CLI surface, not a stub
+    assert "apps" in result.stdout and "gateway" in result.stdout

@@ -17,7 +17,13 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_compile_cache")
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ".jax_compile_cache",
+    ),
+)
 
 PRESET = sys.argv[1] if len(sys.argv) > 1 else "llama-3-8b"
 QUANT = (sys.argv[2] if len(sys.argv) > 2 else "int8") or None
@@ -34,9 +40,19 @@ def main() -> None:
     # var; restore normal env semantics (JAX_PLATFORMS=cpu must work)
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-    jax.config.update("jax_compilation_cache_dir",
-                      os.environ["JAX_COMPILATION_CACHE_DIR"])
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    # PER-PLATFORM cache subdir, same reason as bench.py: the axon relay
+    # host writes XLA:CPU AOT entries compiled for ITS cpu; a local
+    # JAX_PLATFORMS=cpu run loading those risks SIGILL/hangs. Best
+    # effort — an unwritable path degrades to a cache-less run.
+    try:
+        base = os.environ["JAX_COMPILATION_CACHE_DIR"]
+        cache_dir = base.rstrip("/") + "/" + jax.devices()[0].platform
+        if "://" not in base:
+            os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except OSError as error:
+        print(f"compile cache disabled ({error})", file=sys.stderr)
     from langstream_tpu.providers.jax_local import model as model_lib
     from langstream_tpu.providers.jax_local.engine import (
         DecodeEngine,

@@ -22,13 +22,35 @@ y.block_until_ready()" 2>/dev/null; then
         # the driver's own bench) fast
         if BENCH_DEADLINE=3600 BENCH_INIT_TIMEOUT=600 python bench.py > "$OUT" 2>> "$LOG"; then
             echo "$(date -u +%FT%TZ) bench done: $(cat "$OUT")" >> "$LOG"
-            # same heal window: the int8-KV-cache A/B (separate jit
-            # graphs — this also pre-warms the disk cache for them)
+            # same heal window, in priority order (each leg non-fatal):
+            # 1) int8-KV-cache A/B (separate jit graphs — also pre-warms
+            #    the disk cache for them)
             if BENCH_KV_QUANT=int8 BENCH_DEADLINE=3600 BENCH_INIT_TIMEOUT=600 \
                 python bench.py > "${OUT%.json}_kvq.json" 2>> "$LOG"; then
                 echo "$(date -u +%FT%TZ) kv-quant A/B done: $(cat "${OUT%.json}_kvq.json")" >> "$LOG"
             else
                 echo "$(date -u +%FT%TZ) kv-quant A/B failed (non-fatal)" >> "$LOG"
+            fi
+            # 2) flash-decode kernel A/B: same 2048-slot cache, kernel
+            #    off vs on — the dead-block skipping only shows against
+            #    an over-allocated buffer (16 slots so 2048 ctx fits HBM)
+            for leg in 0 1; do
+                if LS_DECODE_FLASH=$leg BENCH_MAX_SEQ=2048 \
+                    BENCH_SLOTS=16 BENCH_CLIENTS=16 \
+                    BENCH_DEADLINE=3000 BENCH_INIT_TIMEOUT=600 \
+                    python bench.py > "${OUT%.json}_flashdec$leg.json" 2>> "$LOG"; then
+                    echo "$(date -u +%FT%TZ) flash-decode A/B leg $leg: $(cat "${OUT%.json}_flashdec$leg.json")" >> "$LOG"
+                else
+                    echo "$(date -u +%FT%TZ) flash-decode A/B leg $leg failed (non-fatal)" >> "$LOG"
+                fi
+            done
+            # 3) one traced decode profile for the step-time breakdown
+            if BENCH_TRACE=1 BENCH_ROUNDS=1 BENCH_DEADLINE=2400 \
+                BENCH_INIT_TIMEOUT=600 \
+                python bench.py > "${OUT%.json}_trace.json" 2>> "$LOG"; then
+                echo "$(date -u +%FT%TZ) traced run done (trace at /tmp/bench_e2e_trace.json)" >> "$LOG"
+            else
+                echo "$(date -u +%FT%TZ) traced run failed (non-fatal)" >> "$LOG"
             fi
             exit 0
         fi
