@@ -45,6 +45,13 @@ PROMPT_LEN = int(os.environ.get("BENCH_PROMPT_LEN", "128"))
 NEW_TOKENS = int(os.environ.get("BENCH_NEW_TOKENS", "128"))
 REQUESTS = int(os.environ.get("BENCH_REQUESTS", "96"))
 MODE = os.environ.get("BENCH_MODE", "e2e")          # e2e | engine
+# cache-warming mode: build the e2e engine with ZERO-filled params (same
+# pytree structure/avals as the real init, so the lowered HLO — and
+# therefore the persistent-cache keys — are identical) and run ONLY the
+# lower+compile phase. Skips the 8B weight init and all execution, so a
+# short healthy-relay window lands compile-cache entries incrementally;
+# dying mid-run keeps every compile that finished.
+COMPILE_ONLY = os.environ.get("BENCH_COMPILE_ONLY", "") not in ("", "0")
 # int8 KV cache ("int8" | "" = bf16 cache) — the e2e A/B knob for the
 # engine's kv-quant option
 KV_QUANT = os.environ.get("BENCH_KV_QUANT", "") or None
@@ -241,6 +248,72 @@ def _tunnel_monitor() -> None:
             return
 
 
+def e2e_engine_shape() -> tuple:
+    """The ONE definition of the e2e engine's compile-relevant shape —
+    shared by the real run and the compile-only cache warmer, so the
+    warmed cache keys are the keys the real run looks up.
+
+    max-seq floors at the template+prefix overhead so tiny PROMPT_LEN
+    configs still admit their prompts (see TEMPLATE_TOKENS); BENCH_MAX_SEQ
+    over-allocates the cache (long-context A/B: the flash-decode kernel's
+    dead-block skipping only shows against a big buffer). Bucket 64
+    serves warm-session suffixes; PROMPT_FLOOR+64 covers question +
+    chat-template overhead in one window."""
+    max_seq = max(
+        PROMPT_FLOOR + NEW_TOKENS + 96,
+        int(os.environ.get("BENCH_MAX_SEQ", "0")),
+    )
+    return max_seq, [64, PROMPT_FLOOR + 64]
+
+
+def run_compile_only() -> int:
+    """Populate the persistent compile cache for the e2e configuration
+    without weights or traffic: zero-filled params with the real init's
+    exact pytree structure/avals, engine constructed with the exact e2e
+    knobs, then ``precompile(execute=False)``. Returns the variant
+    count."""
+    import jax
+    import jax.numpy as jnp
+
+    from langstream_tpu.providers.jax_local import model as model_lib
+    from langstream_tpu.providers.jax_local.engine import DecodeEngine
+
+    max_seq, buckets = e2e_engine_shape()
+    # config EXACTLY as the e2e provider builds it (provider.py
+    # from_dict on the preset): max_seq reaches ONLY the engine arg —
+    # config.max_seq_len stays the preset's (freqs bake into every jit
+    # as an HLO constant, so replacing it here would warm cache keys
+    # the real run never looks up)
+    config = model_lib.LlamaConfig.from_dict({"preset": MODEL_PRESET})
+    if QUANT == "int8":
+        from langstream_tpu.providers.jax_local.quant import (
+            init_quantized_params,
+        )
+
+        spec = jax.eval_shape(lambda: init_quantized_params(config, seed=0))
+    else:
+        spec = jax.eval_shape(lambda: model_lib.init_params(config, seed=0))
+    params = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), spec
+    )
+    t0 = time.perf_counter()
+    engine = DecodeEngine(
+        config,
+        params,
+        max_slots=MAX_SLOTS,
+        max_seq_len=max_seq,
+        prefill_buckets=buckets,
+        decode_chunk=DECODE_CHUNK,
+        quantize=QUANT,
+        kv_quant=KV_QUANT,
+        pipeline_decode=PIPELINE,
+    )
+    variants = len(engine._variant_jobs())  # noqa: SLF001
+    engine.precompile(workers=8, execute=False)
+    log(f"compile-only: {variants} variants in {time.perf_counter() - t0:.1f}s")
+    return variants
+
+
 def probe_backend() -> str:
     """Initialize the JAX backend in a side thread with a hard bound, so
     a wedged device plugin can't eat the whole driver timeout. Returns
@@ -421,14 +494,7 @@ async def run_bench_e2e():
 
     repo = os.path.dirname(os.path.abspath(__file__))
     app_dir = os.path.join(repo, "examples", "applications", "jax-completions")
-    # floor at the template+prefix overhead so tiny PROMPT_LEN configs
-    # still admit their prompts (see TEMPLATE_TOKENS). BENCH_MAX_SEQ
-    # over-allocates the cache (long-context A/B: the flash-decode
-    # kernel's dead-block skipping only shows against a big buffer)
-    max_seq = max(
-        PROMPT_FLOOR + NEW_TOKENS + 96,
-        int(os.environ.get("BENCH_MAX_SEQ", "0")),
-    )
+    max_seq, prefill_buckets = e2e_engine_shape()
     # BENCH_BROKER=tpulog measures the same pipeline on the durable C++
     # segment-store broker instead of the in-memory one
     broker_dir = None
@@ -459,7 +525,7 @@ async def run_bench_e2e():
                 # for a full compile. 64 serves warm-session suffixes;
                 # PROMPT_LEN+64 covers question + chat template overhead
                 # in one window
-                "prefill-buckets": [64, PROMPT_FLOOR + 64],
+                "prefill-buckets": prefill_buckets,
                 "precompile": True,
                 "kv-quant": KV_QUANT or "",
             },
@@ -654,6 +720,20 @@ def main():
         # die with the tunnel
         threading.Thread(target=_tunnel_monitor, daemon=True).start()
 
+    if COMPILE_ONLY:
+        phase("compile-only")
+        try:
+            variants = run_compile_only()
+        except Exception as error:  # noqa: BLE001
+            log(f"compile-only failed: {error!r}")
+            failure(repr(error))
+        emit(
+            f"compile_cache_warm_{MODEL_PRESET.replace('-', '_')}",
+            float(variants), 0.0, unit="variants",
+            kv_cache=KV_QUANT or "bf16",
+        )
+        return
+
     extras: dict = {}
     if MODE == "e2e":
         try:
@@ -677,6 +757,12 @@ def main():
             tok_s = asyncio.run(run_bench())
         except Exception as error:  # noqa: BLE001 — e.g. OOM on a small chip
             failed = repr(error)
+        if failed is not None and _EMITTED.locked():
+            # the measurement already went out (emit_success fires
+            # before engine.stop()) — a teardown failure must not
+            # trigger a pointless 1B fallback rerun
+            log(f"teardown failed after emit ({failed}); result stands")
+            return
         if failed is not None:
             # retry outside the except block: no live traceback pinning the
             # failed attempt's frames (and device arrays) during the rerun

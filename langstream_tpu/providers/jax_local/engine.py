@@ -638,7 +638,7 @@ class DecodeEngine:
             )))
         return jobs
 
-    def precompile(self, workers: int = 4) -> None:
+    def precompile(self, workers: int = 4, execute: bool = True) -> None:
         """Compile-and-execute every (bucket, pow2-group-size) prefill
         variant and the decode chunks BEFORE serving traffic. Group sizes
         are timing-dependent (admission batching), so relying on warmup
@@ -682,6 +682,12 @@ class DecodeEngine:
             "precompiled %d variants in %.1fs",
             len(jobs), time.perf_counter() - started,
         )
+        if not execute:
+            # cache-warming mode (bench BENCH_COMPILE_ONLY): every
+            # variant's executable is in the persistent cache; skip the
+            # execute-once pass (callers that never serve don't need
+            # warm jit call caches or slot-0 garbage rows)
+            return
         with self.mesh:
             for fn, avals in jobs:
                 # real params + live cache (donated and rethreaded), zeros

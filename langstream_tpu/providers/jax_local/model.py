@@ -350,13 +350,12 @@ def _prefill_attn(config, q, k, v, mask, mesh=None):
     return prefill_attention(q, k, v, mask=mask)
 
 
-def _decode_flash_path(config, q, kc):
-    """Gate for the flash-decode kernel (the decode twin of
-    :func:`_flash_path`): True when the kernel should run. Shape
-    requirements bind even under the ``flash_interpret`` test hook; the
-    backend/length policy (incl. the ``LS_DECODE_FLASH`` A/B override)
-    only applies outside it. The tp-vs-single dispatch decision lives in
-    the callers (:func:`_decode_attn` / :func:`_decode_attn_quant`)."""
+def _decode_flash_path(config, q, kc, mesh):
+    """Gate + dispatch mode for the flash-decode kernel — the decode
+    twin of :func:`_flash_path`, same contract: returns (use the
+    kernel?, tp shard_map?). Shape requirements bind even under the
+    ``flash_interpret`` test hook; the backend/length policy (incl. the
+    ``LS_DECODE_FLASH`` A/B override) only applies outside it."""
     from langstream_tpu.ops.decode_kernel import (
         decode_shapes_ok,
         use_flash_decode,
@@ -364,12 +363,15 @@ def _decode_flash_path(config, q, kc):
 
     heads, dim = q.shape[1], q.shape[2]
     max_len, kv_heads = kc.shape[1], kc.shape[2]
-    shapes_ok = decode_shapes_ok(max_len, dim, heads, kv_heads)
-    flash_ok = config.use_flash and shapes_ok and (
+    flash_ok = config.use_flash and (
         use_flash_decode(max_len, dim, heads, kv_heads)
-        or config.flash_interpret
+        or (
+            config.flash_interpret
+            and decode_shapes_ok(max_len, dim, heads, kv_heads)
+        )
     )
-    return flash_ok
+    tp_sharded = mesh is not None and dict(mesh.shape).get("tp", 1) > 1
+    return flash_ok, tp_sharded
 
 
 def _decode_attn(config, q, kc, vc, lengths, mesh=None):
@@ -378,13 +380,14 @@ def _decode_attn(config, q, kc, vc, lengths, mesh=None):
     streams the full static buffer), XLA path otherwise. Under tp the
     kernel runs per head shard through shard_map
     (``flash_decode_attention_sharded``)."""
-    if _decode_flash_path(config, q, kc):
+    flash_ok, tp_sharded = _decode_flash_path(config, q, kc, mesh)
+    if flash_ok:
         from langstream_tpu.ops.decode_kernel import (
             flash_decode_attention,
             flash_decode_attention_sharded,
         )
 
-        if mesh is not None and dict(mesh.shape).get("tp", 1) > 1:
+        if tp_sharded:
             return flash_decode_attention_sharded(
                 q, kc, vc, lengths, mesh, interpret=config.flash_interpret
             )
@@ -396,13 +399,14 @@ def _decode_attn(config, q, kc, vc, lengths, mesh=None):
 
 def _decode_attn_quant(config, q, kc, ks, vc, vs, lengths, mesh=None):
     """Int8-cache twin of :func:`_decode_attn`."""
-    if _decode_flash_path(config, q, kc):
+    flash_ok, tp_sharded = _decode_flash_path(config, q, kc, mesh)
+    if flash_ok:
         from langstream_tpu.ops.decode_kernel import (
             flash_decode_attention_quant,
             flash_decode_attention_sharded,
         )
 
-        if mesh is not None and dict(mesh.shape).get("tp", 1) > 1:
+        if tp_sharded:
             return flash_decode_attention_sharded(
                 q, kc, vc, lengths, mesh, k_scale=ks, v_scale=vs,
                 interpret=config.flash_interpret,

@@ -16,10 +16,21 @@ import numpy as np, jax, jax.numpy as jnp
 x = jax.device_put(np.ones((8192, 8192), np.float32))  # 256 MB
 y = jax.jit(lambda a: (a[:2048, :2048] @ a[:2048, :2048]).sum())(x)
 y.block_until_ready()" 2>/dev/null; then
-        echo "$(date -u +%FT%TZ) TPU responsive (bulk probe) — running bench" >> "$LOG"
-        # first post-change run pays every variant compile: raise the
-        # deadline; the persistent compile cache makes later runs (and
-        # the driver's own bench) fast
+        echo "$(date -u +%FT%TZ) TPU responsive (bulk probe) — warming compile cache" >> "$LOG"
+        # compile-only first: no weight init, lower+compile every e2e
+        # variant with 8 workers — a short relay window lands cache
+        # entries incrementally (every finished compile is kept even if
+        # the window dies mid-run), so successive attempts converge on a
+        # warm cache and the full bench then fits a short window
+        if BENCH_COMPILE_ONLY=1 BENCH_DEADLINE=3000 BENCH_INIT_TIMEOUT=600 \
+            python bench.py > "${OUT%.json}_warm.json" 2>> "$LOG"; then
+            echo "$(date -u +%FT%TZ) cache warm: $(cat "${OUT%.json}_warm.json")" >> "$LOG"
+        else
+            echo "$(date -u +%FT%TZ) cache warm interrupted (entries kept); retrying in 5m" >> "$LOG"
+            sleep 300
+            continue
+        fi
+        echo "$(date -u +%FT%TZ) running full bench" >> "$LOG"
         if BENCH_DEADLINE=3600 BENCH_INIT_TIMEOUT=600 python bench.py > "$OUT" 2>> "$LOG"; then
             echo "$(date -u +%FT%TZ) bench done: $(cat "$OUT")" >> "$LOG"
             # same heal window, in priority order (each leg non-fatal):
@@ -33,11 +44,19 @@ y.block_until_ready()" 2>/dev/null; then
             fi
             # 2) flash-decode kernel A/B: same 2048-slot cache, kernel
             #    off vs on — the dead-block skipping only shows against
-            #    an over-allocated buffer (16 slots so 2048 ctx fits HBM)
+            #    an over-allocated buffer (16 slots so 2048 ctx fits
+            #    HBM). Each leg is its own jit-graph set: warm its
+            #    cache first, full 3600s deadline like the main bench
             for leg in 0 1; do
+                LS_DECODE_FLASH=$leg BENCH_MAX_SEQ=2048 \
+                    BENCH_SLOTS=16 BENCH_CLIENTS=16 \
+                    BENCH_COMPILE_ONLY=1 BENCH_DEADLINE=3000 \
+                    BENCH_INIT_TIMEOUT=600 \
+                    python bench.py > /dev/null 2>> "$LOG" \
+                    || echo "$(date -u +%FT%TZ) leg $leg warm interrupted (entries kept)" >> "$LOG"
                 if LS_DECODE_FLASH=$leg BENCH_MAX_SEQ=2048 \
                     BENCH_SLOTS=16 BENCH_CLIENTS=16 \
-                    BENCH_DEADLINE=3000 BENCH_INIT_TIMEOUT=600 \
+                    BENCH_DEADLINE=3600 BENCH_INIT_TIMEOUT=600 \
                     python bench.py > "${OUT%.json}_flashdec$leg.json" 2>> "$LOG"; then
                     echo "$(date -u +%FT%TZ) flash-decode A/B leg $leg: $(cat "${OUT%.json}_flashdec$leg.json")" >> "$LOG"
                 else
