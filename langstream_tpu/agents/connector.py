@@ -158,8 +158,17 @@ class ExecSink(AgentSink):
                 except Exception:  # noqa: BLE001
                     pass
             if self._process.returncode is None:
+                # stdin EOF is the drain signal: give the command real
+                # time to flush what it buffered (5 s lost records on a
+                # loaded host — a sink's close() must not drop data),
+                # and surface the kill instead of silently discarding
                 try:
-                    await asyncio.wait_for(self._process.wait(), timeout=5)
+                    await asyncio.wait_for(self._process.wait(), timeout=30)
                 except asyncio.TimeoutError:
+                    logger.warning(
+                        "exec-sink command did not exit after stdin EOF; "
+                        "terminating (buffered records may be lost): %s",
+                        self.command,
+                    )
                     self._process.terminate()
         self._process = None

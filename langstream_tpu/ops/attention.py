@@ -26,26 +26,47 @@ def _group_query(q: jnp.ndarray, kv_heads: int) -> jnp.ndarray:
     return q.reshape(batch, seq, kv_heads, groups, dim)
 
 
+def _cap_scores(scores: jnp.ndarray, softcap: Optional[float]) -> jnp.ndarray:
+    """Logit softcapping (Gemma-2): cap·tanh(s/cap), applied BEFORE
+    masking — matches the HF formulation."""
+    if softcap is not None:
+        scores = softcap * jnp.tanh(scores / softcap)
+    return scores
+
+
 def prefill_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
     v: jnp.ndarray,
     *,
     mask: Optional[jnp.ndarray] = None,
+    softcap: Optional[float] = None,
+    window: Optional[jnp.ndarray] = None,
+    scale: Optional[float] = None,
 ) -> jnp.ndarray:
     """Causal self-attention over a full (padded) prompt.
 
     q: [B, T, H, D], k/v: [B, T, KVH, D] → [B, T, H, D].
-    ``mask`` [B, T] marks valid tokens (padding excluded).
+    ``mask`` [B, T] marks valid tokens (padding excluded). ``softcap``
+    applies Gemma-style logit capping, ``window`` (traced scalar; 0 =
+    full) restricts each query to the last ``window`` positions, and
+    ``scale`` overrides the default head_dim**-0.5 (Gemma's
+    query_pre_attn_scalar).
     """
     batch, seq, heads, dim = q.shape
     kv_heads = k.shape[2]
-    scale = dim ** -0.5
+    scale = dim ** -0.5 if scale is None else scale
     qg = _group_query(q, kv_heads)  # [B, T, KVH, G, D]
     scores = jnp.einsum(
         "bqkgd,bskd->bkgqs", qg.astype(jnp.float32), k.astype(jnp.float32)
     ) * scale  # [B, KVH, G, Tq, Ts]
+    scores = _cap_scores(scores, softcap)
     causal = jnp.tril(jnp.ones((seq, seq), dtype=bool))
+    if window is not None:
+        rows = jnp.arange(seq)[:, None]
+        cols = jnp.arange(seq)[None, :]
+        in_window = (window <= 0) | (cols > rows - window)
+        causal = jnp.logical_and(causal, in_window)
     allowed = causal[None, None, None]
     if mask is not None:
         allowed = jnp.logical_and(allowed, mask[:, None, None, None, :])
@@ -55,11 +76,32 @@ def prefill_attention(
     return out.reshape(batch, seq, heads, dim)
 
 
+def _decode_valid(
+    max_len: int,
+    lengths: jnp.ndarray,
+    window: Optional[jnp.ndarray],
+) -> jnp.ndarray:
+    """[B, T] validity for one-token decode: live rows, optionally
+    restricted to the query's sliding window (query pos = lengths-1)."""
+    pos = jnp.arange(max_len)[None, :]
+    valid = pos < lengths[:, None]
+    if window is not None:
+        in_window = (window <= 0) | (
+            pos > (lengths[:, None] - 1) - window
+        )
+        valid = jnp.logical_and(valid, in_window)
+    return valid
+
+
 def decode_attention(
     q: jnp.ndarray,
     k_cache: jnp.ndarray,
     v_cache: jnp.ndarray,
     lengths: jnp.ndarray,
+    *,
+    softcap: Optional[float] = None,
+    window: Optional[jnp.ndarray] = None,
+    scale: Optional[float] = None,
 ) -> jnp.ndarray:
     """One-token decode attention against the cache.
 
@@ -71,12 +113,13 @@ def decode_attention(
     max_len = k_cache.shape[1]
     kv_heads = k_cache.shape[2]
     groups = heads // kv_heads
-    scale = dim ** -0.5
+    scale = dim ** -0.5 if scale is None else scale
     qg = q.reshape(batch, kv_heads, groups, dim)
     scores = jnp.einsum(
         "bkgd,bskd->bkgs", qg.astype(jnp.float32), k_cache.astype(jnp.float32)
     ) * scale  # [B, KVH, G, T]
-    valid = jnp.arange(max_len)[None, :] < lengths[:, None]  # [B, T]
+    scores = _cap_scores(scores, softcap)
+    valid = _decode_valid(max_len, lengths, window)
     scores = jnp.where(valid[:, None, None, :], scores, -1e30)
     weights = _softmax(scores)
     out = jnp.einsum("bkgs,bskd->bkgd", weights.astype(v_cache.dtype), v_cache)
@@ -89,6 +132,10 @@ def chunk_attention(
     v_cache: jnp.ndarray,
     starts: jnp.ndarray,
     lengths: jnp.ndarray,
+    *,
+    softcap: Optional[float] = None,
+    window: Optional[jnp.ndarray] = None,
+    scale: Optional[float] = None,
 ) -> jnp.ndarray:
     """Chunked prefill-at-offset attention against the cache.
 
@@ -103,16 +150,21 @@ def chunk_attention(
     batch, seq, heads, dim = q.shape
     max_len = k_cache.shape[1]
     kv_heads = k_cache.shape[2]
-    scale = dim ** -0.5
+    scale = dim ** -0.5 if scale is None else scale
     qg = _group_query(q, kv_heads)  # [B, Tq, KVH, G, D]
     scores = jnp.einsum(
         "bqkgd,bskd->bkgqs", qg.astype(jnp.float32), k_cache.astype(jnp.float32)
     ) * scale  # [B, KVH, G, Tq, S]
+    scores = _cap_scores(scores, softcap)
     pos_q = starts[:, None] + jnp.arange(seq)[None, :]       # [B, Tq]
     pos_s = jnp.arange(max_len)[None, None, :]               # [1, 1, S]
     allowed = (pos_s <= pos_q[:, :, None]) & (
         pos_s < lengths[:, None, None]
     )  # [B, Tq, S]
+    if window is not None:
+        allowed = allowed & (
+            (window <= 0) | (pos_s > pos_q[:, :, None] - window)
+        )
     scores = jnp.where(allowed[:, None, None, :, :], scores, -1e30)
     weights = _softmax(scores)
     out = jnp.einsum("bkgqs,bskd->bqkgd", weights.astype(v_cache.dtype), v_cache)
@@ -156,20 +208,25 @@ def decode_attention_quant(
     v_cache: jnp.ndarray,
     v_scale: jnp.ndarray,
     lengths: jnp.ndarray,
+    *,
+    softcap: Optional[float] = None,
+    window: Optional[jnp.ndarray] = None,
+    scale: Optional[float] = None,
 ) -> jnp.ndarray:
     """:func:`decode_attention` over an int8 cache (see algebra above)."""
     batch, heads, dim = q.shape
     max_len = k_cache.shape[1]
     kv_heads = k_cache.shape[2]
     groups = heads // kv_heads
-    scale = dim ** -0.5
+    scale = dim ** -0.5 if scale is None else scale
     qg = q.reshape(batch, kv_heads, groups, dim)
     scores = jnp.einsum(
         "bkgd,bskd->bkgs", qg.astype(jnp.float32),
         k_cache.astype(jnp.float32),
     )
     scores = scores * k_scale.transpose(0, 2, 1)[:, :, None, :] * scale
-    valid = jnp.arange(max_len)[None, :] < lengths[:, None]
+    scores = _cap_scores(scores, softcap)
+    valid = _decode_valid(max_len, lengths, window)
     scores = jnp.where(valid[:, None, None, :], scores, -1e30)
     weights = _softmax(scores)
     weights = weights * v_scale.transpose(0, 2, 1)[:, :, None, :]
@@ -187,23 +244,32 @@ def chunk_attention_quant(
     v_scale: jnp.ndarray,
     starts: jnp.ndarray,
     lengths: jnp.ndarray,
+    *,
+    softcap: Optional[float] = None,
+    window: Optional[jnp.ndarray] = None,
+    scale: Optional[float] = None,
 ) -> jnp.ndarray:
     """:func:`chunk_attention` over an int8 cache."""
     batch, seq, heads, dim = q.shape
     max_len = k_cache.shape[1]
     kv_heads = k_cache.shape[2]
-    scale = dim ** -0.5
+    scale = dim ** -0.5 if scale is None else scale
     qg = _group_query(q, kv_heads)
     scores = jnp.einsum(
         "bqkgd,bskd->bkgqs", qg.astype(jnp.float32),
         k_cache.astype(jnp.float32),
     )
     scores = scores * k_scale.transpose(0, 2, 1)[:, :, None, None, :] * scale
+    scores = _cap_scores(scores, softcap)
     pos_q = starts[:, None] + jnp.arange(seq)[None, :]
     pos_s = jnp.arange(max_len)[None, None, :]
     allowed = (pos_s <= pos_q[:, :, None]) & (
         pos_s < lengths[:, None, None]
     )
+    if window is not None:
+        allowed = allowed & (
+            (window <= 0) | (pos_s > pos_q[:, :, None] - window)
+        )
     scores = jnp.where(allowed[:, None, None, :, :], scores, -1e30)
     weights = _softmax(scores)
     weights = weights * v_scale.transpose(0, 2, 1)[:, :, None, None, :]

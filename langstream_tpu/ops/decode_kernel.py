@@ -64,8 +64,20 @@ def _num_valid_blocks(length, block_k: int):
     return jnp.maximum(1, (length + block_k - 1) // block_k)
 
 
+def _first_valid_block(length, window, block_k: int):
+    """First block inside the sliding window (0 when window is off or
+    wider than the live context): valid positions are
+    ``length - window .. length - 1``."""
+    return jnp.where(
+        window > 0,
+        jnp.maximum(0, (length - window) // block_k),
+        0,
+    )
+
+
 def _decode_kernel_body(
     lens_ref,   # SMEM scalar-prefetch [S] int32
+    win_ref,    # SMEM scalar-prefetch [1] int32 (0 = full attention)
     q_ref,      # VMEM [1, H, D]
     k_ref,      # VMEM [1, block_k, KVH, D] (cache dtype, or int8)
     v_ref,      # VMEM [1, block_k, KVH, D]
@@ -80,6 +92,7 @@ def _decode_kernel_body(
     block_k: int,
     kv_heads: int,
     group: int,
+    softcap: Optional[float],
 ):
     """One online-softmax recurrence for both cache dtypes. The int8
     mode (``ks_ref``/``vs_ref`` present) streams int8 k/v from HBM (the
@@ -90,7 +103,12 @@ def _decode_kernel_body(
     f32 probs against f32 values — the p·v dot runs in f32 (no bf16
     round-trip on the scale-folded probs). The bf16 mode contracts
     bf16 probs with the bf16 cache, matching ``decode_attention``'s
-    ``weights.astype(v_cache.dtype)``."""
+    ``weights.astype(v_cache.dtype)``.
+
+    A sliding window (Gemma-2) tightens the live block range from BOTH
+    ends — blocks below the window skip compute exactly like dead
+    blocks past the length (and their DMAs are clamp-elided by the
+    index maps); ``softcap`` caps the scores before masking."""
     quantized = ks_ref is not None
     s_i = pl.program_id(0)
     j = pl.program_id(1)
@@ -103,8 +121,10 @@ def _decode_kernel_body(
         acc_scratch[:] = jnp.zeros_like(acc_scratch)
 
     length = lens_ref[s_i]
+    window = win_ref[0]
+    first = _first_valid_block(length, window, block_k)
 
-    @pl.when(j < _num_valid_blocks(length, block_k))
+    @pl.when((j >= first) & (j < _num_valid_blocks(length, block_k)))
     def _compute():
         q = q_ref[0]  # [H, D]
         # int8 values are exactly representable in bf16, so the MXU
@@ -123,11 +143,16 @@ def _decode_kernel_body(
                 s_h = s_h * ks[:, h][None, :]
             parts.append(s_h)
         s = jnp.concatenate(parts, axis=0)  # [H, block_k]
+        if softcap is not None:
+            s = softcap * jnp.tanh(s / softcap)
 
         cols = j * block_k + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 1
         )
         mask = cols < length
+        mask = jnp.logical_and(
+            mask, (window <= 0) | (cols > (length - 1) - window)
+        )
         s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_scratch[:, :1]
@@ -170,18 +195,19 @@ def _decode_kernel_body(
         out_ref[0] = (acc_scratch[:] / l_safe).astype(out_ref.dtype)
 
 
-def _decode_kernel(lens_ref, q_ref, k_ref, v_ref, out_ref,
+def _decode_kernel(lens_ref, win_ref, q_ref, k_ref, v_ref, out_ref,
                    m_scratch, l_scratch, acc_scratch, **kw):
     _decode_kernel_body(
-        lens_ref, q_ref, k_ref, v_ref, None, None, out_ref,
+        lens_ref, win_ref, q_ref, k_ref, v_ref, None, None, out_ref,
         m_scratch, l_scratch, acc_scratch, **kw,
     )
 
 
-def _decode_kernel_quant(lens_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
-                         out_ref, m_scratch, l_scratch, acc_scratch, **kw):
+def _decode_kernel_quant(lens_ref, win_ref, q_ref, k_ref, v_ref, ks_ref,
+                         vs_ref, out_ref, m_scratch, l_scratch,
+                         acc_scratch, **kw):
     _decode_kernel_body(
-        lens_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, out_ref,
+        lens_ref, win_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, out_ref,
         m_scratch, l_scratch, acc_scratch, **kw,
     )
 
@@ -194,6 +220,9 @@ def flash_decode_attention(
     *,
     k_scale: Optional[jnp.ndarray] = None,  # [S, T, KVH] — int8 mode
     v_scale: Optional[jnp.ndarray] = None,
+    softcap: Optional[float] = None,
+    window: Optional[jnp.ndarray] = None,  # scalar; None/0 = full attn
+    scale: Optional[float] = None,
     block_k: Optional[int] = None,
     interpret: bool = False,
 ) -> jnp.ndarray:
@@ -201,30 +230,39 @@ def flash_decode_attention(
     (or ``decode_attention_quant`` when scales are given) with HBM
     traffic ∝ live context. Caller gates via :func:`use_flash_decode`;
     shapes must satisfy D % 128 == 0, H % KVH == 0, and ``block_k`` must
-    divide T (``pick_block_k``)."""
+    divide T (``pick_block_k``). A sliding ``window`` (Gemma-2) bounds
+    the traffic by the window instead — blocks below it clamp-elide
+    their DMA just like dead blocks past the length."""
     slots, heads, dim = q.shape
     max_len, kv_heads = k_cache.shape[1], k_cache.shape[2]
     group = heads // kv_heads
-    scale = dim ** -0.5
+    scale = dim ** -0.5 if scale is None else scale
     block_k = block_k or pick_block_k(max_len)
     if block_k is None:
         raise ValueError(f"no kv block size divides max_len={max_len}")
     num_blocks = max_len // block_k
     quantized = k_scale is not None
     lengths = lengths.astype(jnp.int32)
+    window_arr = jnp.reshape(
+        jnp.asarray(0 if window is None else window, dtype=jnp.int32), (1,)
+    )
 
-    def kv_index(s, j, lens):
-        # clamp dead blocks to the slot's last live block: the mapped
-        # indices repeat, so the pipeline skips their DMA entirely
+    def block_index(s, j, lens, win):
+        # clamp dead blocks (past the length OR below the sliding
+        # window) into the live range: the mapped indices repeat, so
+        # the pipeline skips their DMA entirely
+        first = _first_valid_block(lens[s], win[0], block_k)
         last = _num_valid_blocks(lens[s], block_k) - 1
-        return (s, jnp.minimum(j, last), 0, 0)
+        return jnp.clip(j, first, last)
 
-    def scale_index(s, j, lens):
-        last = _num_valid_blocks(lens[s], block_k) - 1
-        return (s, jnp.minimum(j, last), 0)
+    def kv_index(s, j, lens, win):
+        return (s, block_index(s, j, lens, win), 0, 0)
+
+    def scale_index(s, j, lens, win):
+        return (s, block_index(s, j, lens, win), 0)
 
     in_specs = [
-        pl.BlockSpec((1, heads, dim), lambda s, j, lens: (s, 0, 0)),
+        pl.BlockSpec((1, heads, dim), lambda s, j, lens, win: (s, 0, 0)),
         pl.BlockSpec((1, block_k, kv_heads, dim), kv_index),
         pl.BlockSpec((1, block_k, kv_heads, dim), kv_index),
     ]
@@ -232,7 +270,7 @@ def flash_decode_attention(
     if quantized:
         kernel = functools.partial(
             _decode_kernel_quant, scale=scale, block_k=block_k,
-            kv_heads=kv_heads, group=group,
+            kv_heads=kv_heads, group=group, softcap=softcap,
         )
         in_specs += [
             pl.BlockSpec((1, block_k, kv_heads), scale_index),
@@ -243,15 +281,17 @@ def flash_decode_attention(
     else:
         kernel = functools.partial(
             _decode_kernel, scale=scale, block_k=block_k,
-            kv_heads=kv_heads, group=group,
+            kv_heads=kv_heads, group=group, softcap=softcap,
         )
         kv_bytes = (k_cache.size + v_cache.size) * k_cache.dtype.itemsize
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
+        num_scalar_prefetch=2,
         grid=(slots, num_blocks),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, heads, dim), lambda s, j, lens: (s, 0, 0)),
+        out_specs=pl.BlockSpec(
+            (1, heads, dim), lambda s, j, lens, win: (s, 0, 0)
+        ),
         scratch_shapes=[
             pltpu.VMEM((heads, 128), jnp.float32),
             pltpu.VMEM((heads, 128), jnp.float32),
@@ -270,7 +310,7 @@ def flash_decode_attention(
             transcendentals=slots * heads * max_len,
         ),
         interpret=interpret,
-    )(lengths, *operands)
+    )(lengths, window_arr, *operands)
 
 
 def flash_decode_attention_quant(
@@ -299,6 +339,9 @@ def flash_decode_attention_sharded(
     *,
     k_scale: Optional[jnp.ndarray] = None,
     v_scale: Optional[jnp.ndarray] = None,
+    softcap: Optional[float] = None,
+    window: Optional[jnp.ndarray] = None,
+    scale: Optional[float] = None,
     axis_name: str = "tp",
     interpret: bool = False,
 ) -> jnp.ndarray:
@@ -306,25 +349,30 @@ def flash_decode_attention_sharded(
     head shard through ``shard_map`` (a Mosaic call has no SPMD
     partitioning rule). Attention never mixes heads, so no collective;
     query and kv heads shard by the same tp factor (``validate_mesh``
-    enforces divisibility)."""
+    enforces divisibility). The (traced) ``window`` scalar rides as a
+    replicated operand."""
     from jax.sharding import PartitionSpec as P
 
     head_spec = P(None, axis_name, None)
     cache_spec = P(None, None, axis_name, None)
     scale_spec = P(None, None, axis_name)
     quantized = k_scale is not None
+    window_arr = jnp.asarray(
+        0 if window is None else window, dtype=jnp.int32
+    )
 
-    def local(q_l, k_l, v_l, lengths_l, *scales):
+    def local(q_l, k_l, v_l, lengths_l, window_l, *scales):
         return flash_decode_attention(
             q_l, k_l, v_l, lengths_l, interpret=interpret,
+            softcap=softcap, window=window_l, scale=scale,
             **(
                 {"k_scale": scales[0], "v_scale": scales[1]}
                 if scales else {}
             ),
         )
 
-    in_specs = [head_spec, cache_spec, cache_spec, P(None)]
-    operands = [q, k_cache, v_cache, lengths]
+    in_specs = [head_spec, cache_spec, cache_spec, P(None), P()]
+    operands = [q, k_cache, v_cache, lengths, window_arr]
     if quantized:
         in_specs += [scale_spec, scale_spec]
         operands += [k_scale, v_scale]

@@ -43,6 +43,7 @@ NEG_INF = -1e30
 
 def _flash_kernel(
     lengths_ref,  # SMEM [1, 1] — valid length for this batch row
+    window_ref,   # SMEM [1, 1] — sliding window (0 = full attention)
     q_ref,        # VMEM [1, 1, block_q, d]
     k_ref,        # VMEM [1, 1, block_k, d]
     v_ref,        # VMEM [1, 1, block_k, d]
@@ -54,6 +55,7 @@ def _flash_kernel(
     scale: float,
     block_q: int,
     block_k: int,
+    softcap: Optional[float],
 ):
     qi = pl.program_id(2)
     kj = pl.program_id(3)
@@ -67,9 +69,18 @@ def _flash_kernel(
 
     q_start = qi * block_q
     k_start = kj * block_k
+    window = window_ref[0, 0]
+    # Causal: skip blocks entirely in the future of the q block; with a
+    # sliding window (Gemma-2) also skip blocks entirely BEFORE every
+    # row's window (earliest window start in the block is
+    # q_start - window + 1)
+    relevant = k_start <= q_start + block_q - 1
+    relevant = jnp.logical_and(
+        relevant,
+        (window <= 0) | (k_start + block_k - 1 >= q_start - window + 1),
+    )
 
-    # Causal: the whole k block is in the future of the whole q block.
-    @pl.when(k_start <= q_start + block_q - 1)
+    @pl.when(relevant)
     def _compute():
         length = lengths_ref[0, 0]
         q = q_ref[0, 0]
@@ -78,6 +89,8 @@ def _flash_kernel(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale  # [block_q, block_k]
+        if softcap is not None:
+            s = softcap * jnp.tanh(s / softcap)
 
         rows = q_start + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0
@@ -86,6 +99,9 @@ def _flash_kernel(
             jnp.int32, (block_q, block_k), 1
         )
         mask = jnp.logical_and(cols <= rows, cols < length)
+        mask = jnp.logical_and(
+            mask, (window <= 0) | (cols > rows - window)
+        )
         s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_scratch[:, :1]                      # [block_q, 1]
@@ -116,6 +132,7 @@ def _flash_kernel(
 
 def _flash_kernel_quant(
     lengths_ref,  # SMEM [1, 1]
+    window_ref,   # SMEM [1, 1] — sliding window (0 = full attention)
     q_ref,        # VMEM [1, 1, block_q, d]
     k_ref,        # VMEM [1, 1, block_k, d] int8
     v_ref,        # VMEM [1, 1, block_k, d] int8
@@ -129,6 +146,7 @@ def _flash_kernel_quant(
     scale: float,
     block_q: int,
     block_k: int,
+    softcap: Optional[float],
 ):
     """Int8-cache flash: k/v tiles stream from HBM as int8 (half the
     bandwidth of bf16 — the whole point), upcast in VMEM (int8 values
@@ -150,8 +168,14 @@ def _flash_kernel_quant(
 
     q_start = qi * block_q
     k_start = kj * block_k
+    window = window_ref[0, 0]
+    relevant = k_start <= q_start + block_q - 1
+    relevant = jnp.logical_and(
+        relevant,
+        (window <= 0) | (k_start + block_k - 1 >= q_start - window + 1),
+    )
 
-    @pl.when(k_start <= q_start + block_q - 1)
+    @pl.when(relevant)
     def _compute():
         length = lengths_ref[0, 0]
         q = q_ref[0, 0]
@@ -161,6 +185,8 @@ def _flash_kernel_quant(
             preferred_element_type=jnp.float32,
         )
         s = s * (ks_ref[0, 0][None, :] * scale)  # fold k scales per row
+        if softcap is not None:
+            s = softcap * jnp.tanh(s / softcap)
 
         rows = q_start + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0
@@ -169,6 +195,9 @@ def _flash_kernel_quant(
             jnp.int32, (block_q, block_k), 1
         )
         mask = jnp.logical_and(cols <= rows, cols < length)
+        mask = jnp.logical_and(
+            mask, (window <= 0) | (cols > rows - window)
+        )
         s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_scratch[:, :1]
@@ -208,18 +237,28 @@ def _pallas_flash(
     interpret: bool,
     k_scale: Optional[jnp.ndarray] = None,  # [B, KVH, T] f32
     v_scale: Optional[jnp.ndarray] = None,
+    softcap: Optional[float] = None,
+    window: Optional[jnp.ndarray] = None,   # scalar; None/0 = full
+    scale: Optional[float] = None,
 ) -> jnp.ndarray:
     batch, heads, seq, dim = q.shape
     kv_heads = k.shape[1]
     group = heads // kv_heads
-    scale = dim ** -0.5
+    scale = dim ** -0.5 if scale is None else scale
     grid = (batch, heads, seq // block_q, seq // block_k)
     quantized = k_scale is not None
 
     lengths_2d = lengths.reshape(batch, 1).astype(jnp.int32)
+    window_2d = jnp.reshape(
+        jnp.asarray(0 if window is None else window, dtype=jnp.int32), (1, 1)
+    )
+    scalar_spec = pl.BlockSpec(
+        (1, 1), lambda b, h, i, j: (b, 0), memory_space=pltpu.SMEM,
+    )
     in_specs = [
+        scalar_spec,
         pl.BlockSpec(
-            (1, 1), lambda b, h, i, j: (b, 0),
+            (1, 1), lambda b, h, i, j: (0, 0),
             memory_space=pltpu.SMEM,
         ),
         pl.BlockSpec(
@@ -232,11 +271,11 @@ def _pallas_flash(
             (1, 1, block_k, dim), lambda b, h, i, j: (b, h // group, j, 0),
         ),
     ]
-    operands = [lengths_2d, q, k, v]
+    operands = [lengths_2d, window_2d, q, k, v]
     if quantized:
         kernel = functools.partial(
             _flash_kernel_quant, scale=scale,
-            block_q=block_q, block_k=block_k,
+            block_q=block_q, block_k=block_k, softcap=softcap,
         )
         scale_spec = pl.BlockSpec(
             (1, 1, block_k), lambda b, h, i, j: (b, h // group, j),
@@ -246,7 +285,8 @@ def _pallas_flash(
         kv_bytes = k.size + v.size + k_scale.size * 4 + v_scale.size * 4
     else:
         kernel = functools.partial(
-            _flash_kernel, scale=scale, block_q=block_q, block_k=block_k
+            _flash_kernel, scale=scale, block_q=block_q, block_k=block_k,
+            softcap=softcap,
         )
         kv_bytes = (k.size + v.size) * k.dtype.itemsize
 
@@ -283,6 +323,9 @@ def flash_prefill_attention(
     lengths: Optional[jnp.ndarray] = None,  # [B] (alternative to mask)
     k_scale: Optional[jnp.ndarray] = None,  # [B, T, KVH] — int8-cache mode
     v_scale: Optional[jnp.ndarray] = None,
+    softcap: Optional[float] = None,
+    window: Optional[jnp.ndarray] = None,  # scalar; None/0 = full attn
+    scale: Optional[float] = None,
     block_q: int = 256,
     block_k: int = 256,
     interpret: bool = False,
@@ -296,7 +339,10 @@ def flash_prefill_attention(
 
     With ``k_scale``/``v_scale`` the kernel runs the int8-cache variant
     (k/v int8, per-(position, kv-head) scales — see
-    :func:`_flash_kernel_quant`)."""
+    :func:`_flash_kernel_quant`). ``softcap``/``window``/``scale`` carry
+    the Gemma-2 mechanisms: logit capping, a (traced, per-layer) sliding
+    window — blocks fully outside a row's window skip their compute —
+    and the query_pre_attn_scalar score scale."""
     batch, seq, heads, dim = q.shape
     if lengths is None:
         lengths = (
@@ -330,6 +376,7 @@ def flash_prefill_attention(
         lengths,
         block_q=block_q, block_k=block_k, interpret=interpret,
         k_scale=scales_layout(k_scale), v_scale=scales_layout(v_scale),
+        softcap=softcap, window=window, scale=scale,
     )
     out = jnp.swapaxes(out, 1, 2)
     return out[:, :seq] if padded != seq else out
@@ -366,6 +413,9 @@ def flash_prefill_attention_sharded(
     lengths: Optional[jnp.ndarray] = None,
     k_scale: Optional[jnp.ndarray] = None,  # [B, T, KVH] — int8 mode
     v_scale: Optional[jnp.ndarray] = None,
+    softcap: Optional[float] = None,
+    window: Optional[jnp.ndarray] = None,
+    scale: Optional[float] = None,
     axis_name: str = "tp",
     interpret: bool = False,
 ) -> jnp.ndarray:
@@ -378,7 +428,8 @@ def flash_prefill_attention_sharded(
     attention einsums produce). GQA stays consistent because query and
     kv heads shard by the same factor (``validate_mesh`` enforces
     divisibility). With ``k_scale``/``v_scale`` the int8-cache kernel
-    runs per shard, the scales sharded over their kv-head axis.
+    runs per shard, the scales sharded over their kv-head axis. The
+    (traced) ``window`` scalar rides as a replicated operand.
     """
     from jax.sharding import PartitionSpec as P
 
@@ -392,18 +443,22 @@ def flash_prefill_attention_sharded(
     head_spec = P(None, None, axis_name, None)
     scale_spec = P(None, None, axis_name)
     quantized = k_scale is not None
+    window_arr = jnp.asarray(
+        0 if window is None else window, dtype=jnp.int32
+    )
 
-    def local(q_l, k_l, v_l, lengths_l, *scales):
+    def local(q_l, k_l, v_l, lengths_l, window_l, *scales):
         return flash_prefill_attention(
             q_l, k_l, v_l, lengths=lengths_l, interpret=interpret,
+            softcap=softcap, window=window_l, scale=scale,
             **(
                 {"k_scale": scales[0], "v_scale": scales[1]}
                 if scales else {}
             ),
         )
 
-    in_specs = [head_spec, head_spec, head_spec, P(None)]
-    operands = [q, k, v, lengths]
+    in_specs = [head_spec, head_spec, head_spec, P(None), P()]
+    operands = [q, k, v, lengths, window_arr]
     if quantized:
         in_specs += [scale_spec, scale_spec]
         operands += [k_scale, v_scale]

@@ -6,11 +6,21 @@ import jax.lax
 import jax.numpy as jnp
 
 
-def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+def rms_norm(
+    x: jnp.ndarray,
+    weight: jnp.ndarray,
+    eps: float = 1e-5,
+    plus_one: bool = False,
+) -> jnp.ndarray:
     """RMSNorm in f32 accumulation regardless of input dtype (the TPU
-    recipe: keep reductions in f32, matmuls in bf16)."""
+    recipe: keep reductions in f32, matmuls in bf16). ``plus_one``
+    selects the zero-centered weight convention (Gemma: the checkpoint
+    stores w and the norm applies 1 + w)."""
     dtype = x.dtype
     x32 = x.astype(jnp.float32)
     variance = jnp.mean(x32 * x32, axis=-1, keepdims=True)
     normed = x32 * jax.lax.rsqrt(variance + eps)
-    return (normed * weight.astype(jnp.float32)).astype(dtype)
+    w32 = weight.astype(jnp.float32)
+    if plus_one:
+        w32 = 1.0 + w32
+    return (normed * w32).astype(dtype)

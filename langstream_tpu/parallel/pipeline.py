@@ -124,20 +124,31 @@ def pipelined_logits(
             f"(batch {batch} / microbatches {num_microbatches})"
         )
 
-    x = params["embedding"][tokens].astype(config.dtype)  # [B, T, H]
+    x = model_lib._embed(config, params, tokens)  # [B, T, H]
     xs = x.reshape(num_microbatches, mb, seq, config.hidden_size)
     if mask is None:
         mask = jnp.ones((batch, seq), dtype=bool)
     masks = mask.reshape(num_microbatches, mb, seq)
     layer_inputs = model_lib._stack_layer_params(params)
+    # per-layer sliding windows ride the SAME pp sharding as the layer
+    # stack, so each stage receives ITS layers' windows — a static
+    # offset cannot vary across SPMD stages (Gemma-2 alternates
+    # sliding/full per GLOBAL layer index). Zeros = full attention.
+    windows = model_lib.layer_windows(config)
+    if windows is None:
+        windows = jnp.zeros((config.num_layers,), dtype=jnp.int32)
 
-    def stage_fn_inner(stage_layers, x, mb_idx, masks, freqs):
+    def stage_fn_inner(stage_layers, stage_windows, x, mb_idx, masks, freqs):
         m = jax.lax.dynamic_index_in_dim(masks, mb_idx, 0, keepdims=False)
-        return model_lib.apply_layers(config, stage_layers, x, m, freqs)
+        return model_lib.apply_layers(
+            config, stage_layers, x, m, freqs, windows=stage_windows
+        )
 
-    def pipelined(stage_layers, xs, masks, freqs):
+    def pipelined(stage_layers, stage_windows, xs, masks, freqs):
         outs, aux = pipeline_apply(
-            lambda sp, x, i: stage_fn_inner(sp, x, i, masks, freqs),
+            lambda sp, x, i: stage_fn_inner(
+                sp, stage_windows, x, i, masks, freqs
+            ),
             stage_layers, xs, num_stages=num_stages,
         )
         # aux differs per dp group (different data): reduce it so the
@@ -149,16 +160,16 @@ def pipelined_logits(
     fn = shard_map(
         pipelined,
         mesh=mesh,
-        in_specs=(layer_specs, data_spec, data_spec, P()),
+        in_specs=(layer_specs, P("pp"), data_spec, data_spec, P()),
         out_specs=(data_spec, P()),
         check_vma=False,
     )
-    outs, aux = fn(layer_inputs, xs, masks, freqs)  # [M, mb, T, H], scalar
+    outs, aux = fn(
+        layer_inputs, windows, xs, masks, freqs
+    )  # [M, mb, T, H], scalar
 
     x = outs.reshape(batch, seq, config.hidden_size)
-    from langstream_tpu.ops.norms import rms_norm
-
-    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    x = model_lib._norm(config, x, params["final_norm"])
     logits = model_lib._logits(config, params, x)
     if with_aux:
         # aux was summed over layers × microbatches (and psum'd over dp

@@ -56,6 +56,17 @@ class LlamaConfig:
     num_experts: int = 0
     num_experts_per_tok: int = 2
     capacity_factor: float = 2.0
+    # Gemma-2 family extensions — every default is the Llama behavior.
+    attn_logit_softcap: Optional[float] = None   # cap·tanh(s/cap) on scores
+    final_logit_softcap: Optional[float] = None  # same on output logits
+    query_pre_attn_scalar: Optional[float] = None  # attn scale base (None → head_dim)
+    sliding_window: int = 0       # 0 = full attention on every layer;
+                                  # >0 = Gemma-2 alternating pattern
+                                  # (even layers slide, odd layers full)
+    norm_plus_one: bool = False   # RMSNorm applies (1 + w) (zero-centered w)
+    post_norms: bool = False      # sandwich norms after attn + mlp blocks
+    scale_embedding: bool = False  # x *= sqrt(hidden) after the lookup
+    act: str = "silu"             # MLP gate activation: silu | gelu_tanh
     dtype: Any = jnp.bfloat16
     # Pallas flash prefill (TPU only; tp-sharded meshes route it through
     # shard_map over the head axis — see _prefill_attn).
@@ -103,6 +114,44 @@ class LlamaConfig:
         )
 
     @classmethod
+    def gemma2_2b(cls, max_seq_len: int = 8192) -> "LlamaConfig":
+        """Gemma-2-2B (HF google/gemma-2-2b): GeGLU, sandwich norms,
+        zero-centered RMSNorm, logit softcapping, alternating sliding
+        window, scaled embeddings, tied head."""
+        return cls(
+            vocab_size=256000, hidden_size=2304, intermediate_size=9216,
+            num_layers=26, num_heads=8, num_kv_heads=4, head_dim=256,
+            rope_theta=10000.0, max_seq_len=max_seq_len, norm_eps=1e-6,
+            tie_embeddings=True, attn_logit_softcap=50.0,
+            final_logit_softcap=30.0, query_pre_attn_scalar=256.0,
+            sliding_window=4096, norm_plus_one=True, post_norms=True,
+            scale_embedding=True, act="gelu_tanh",
+        )
+
+    @classmethod
+    def gemma2_9b(cls, max_seq_len: int = 8192) -> "LlamaConfig":
+        return dataclasses.replace(
+            cls.gemma2_2b(max_seq_len), hidden_size=3584,
+            intermediate_size=14336, num_layers=42, num_heads=16,
+            num_kv_heads=8, head_dim=256,
+        )
+
+    @classmethod
+    def tiny_gemma2(cls, max_seq_len: int = 256) -> "LlamaConfig":
+        """Test-size Gemma-2 shape: every family mechanism on, window
+        smaller than typical test prompts so sliding layers actually
+        mask."""
+        return cls(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+            rope_theta=10000.0, max_seq_len=max_seq_len, norm_eps=1e-6,
+            tie_embeddings=True, attn_logit_softcap=50.0,
+            final_logit_softcap=30.0, query_pre_attn_scalar=16.0,
+            sliding_window=8, norm_plus_one=True, post_norms=True,
+            scale_embedding=True, act="gelu_tanh", dtype=jnp.float32,
+        )
+
+    @classmethod
     def tiny(cls, max_seq_len: int = 256) -> "LlamaConfig":
         """Test-size config for CPU runs."""
         return cls(
@@ -129,6 +178,8 @@ class LlamaConfig:
             "llama-3-8b": cls.llama3_8b, "llama-3-70b": cls.llama3_70b,
             "llama-3-1b": cls.llama3_1b, "tiny": cls.tiny,
             "mixtral-8x7b": cls.mixtral_8x7b, "tiny-moe": cls.tiny_moe,
+            "gemma-2-2b": cls.gemma2_2b, "gemma-2-9b": cls.gemma2_9b,
+            "tiny-gemma2": cls.tiny_gemma2,
         }
         preset = clean.pop("preset", None)
         if preset:
@@ -176,6 +227,12 @@ def init_params(config: LlamaConfig, seed: int = 0) -> Dict[str, jnp.ndarray]:
             "w_up": normal(keys[6], (layers, h, f), scale),
             "w_down": normal(keys[7], (layers, f, h), scale / math.sqrt(2 * layers)),
         }
+    # zero-centered convention (norm applies 1 + w): identity weight is 0
+    norm_fill = 0.0 if config.norm_plus_one else 1.0
+
+    def norm_init(shape):
+        return jnp.full(shape, norm_fill, dtype=jnp.float32)
+
     params = {
         "embedding": normal(keys[0], (v, h), 1.0 / math.sqrt(h)),
         "wq": normal(keys[1], (layers, h, nh * hd), scale),
@@ -183,10 +240,13 @@ def init_params(config: LlamaConfig, seed: int = 0) -> Dict[str, jnp.ndarray]:
         "wv": normal(keys[3], (layers, h, nkv * hd), scale),
         "wo": normal(keys[4], (layers, nh * hd, h), scale / math.sqrt(2 * layers)),
         **mlp_params,
-        "attn_norm": jnp.ones((layers, h), dtype=jnp.float32),
-        "mlp_norm": jnp.ones((layers, h), dtype=jnp.float32),
-        "final_norm": jnp.ones((h,), dtype=jnp.float32),
+        "attn_norm": norm_init((layers, h)),
+        "mlp_norm": norm_init((layers, h)),
+        "final_norm": norm_init((h,)),
     }
+    if config.post_norms:
+        params["post_attn_norm"] = norm_init((layers, h))
+        params["post_mlp_norm"] = norm_init((layers, h))
     if not config.tie_embeddings:
         params["lm_head"] = normal(keys[8], (h, v), scale)
     return params
@@ -218,6 +278,9 @@ def logical_axes(config: LlamaConfig) -> Dict[str, Any]:
         "mlp_norm": L("layers", None),
         "final_norm": L(None),
     }
+    if config.post_norms:
+        axes["post_attn_norm"] = L("layers", None)
+        axes["post_mlp_norm"] = L("layers", None)
     if not config.tie_embeddings:
         axes["lm_head"] = L("embed", "vocab")
     return axes
@@ -266,13 +329,50 @@ def cache_logical_axes(kv_quant: bool = False) -> Dict[str, Any]:
 
 
 def _stack_layer_params(params: Dict[str, jnp.ndarray]):
+    """Stacked per-layer tuple for the lax.scan layer loop. Post norms
+    (Gemma-2 sandwich) are None for families without them — None is an
+    empty pytree, so scan passes it through untouched."""
     mlp = (params["w_gate"], params["w_up"], params["w_down"])
     if "router" in params:
         mlp = mlp + (params["router"],)
     return (
         params["attn_norm"], params["wq"], params["wk"], params["wv"],
-        params["wo"], params["mlp_norm"], mlp,
+        params["wo"], params.get("post_attn_norm"), params["mlp_norm"],
+        params.get("post_mlp_norm"), mlp,
     )
+
+
+def _norm(config: LlamaConfig, x: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    return rms_norm(x, w, config.norm_eps, plus_one=config.norm_plus_one)
+
+
+def _attn_scale(config: LlamaConfig) -> float:
+    """Gemma scales scores by query_pre_attn_scalar**-0.5 instead of
+    head_dim**-0.5; None keeps the Llama default."""
+    return (config.query_pre_attn_scalar or config.dims_per_head) ** -0.5
+
+
+def layer_windows(config: LlamaConfig) -> Optional[jnp.ndarray]:
+    """Per-layer sliding-window sizes [L] (0 = full attention): Gemma-2
+    alternates sliding/full starting with sliding at layer 0 (HF
+    ``layer_types``). None when the family has no sliding window — the
+    attention ops skip the window masking entirely."""
+    if not config.sliding_window:
+        return None
+    return jnp.array(
+        [
+            config.sliding_window if i % 2 == 0 else 0
+            for i in range(config.num_layers)
+        ],
+        dtype=jnp.int32,
+    )
+
+
+def _embed(config: LlamaConfig, params, tokens: jnp.ndarray) -> jnp.ndarray:
+    x = params["embedding"][tokens].astype(config.dtype)
+    if config.scale_embedding:
+        x = x * jnp.asarray(math.sqrt(config.hidden_size), dtype=x.dtype)
+    return x
 
 
 def _mlp_block(
@@ -301,22 +401,34 @@ def _mlp_block(
     w_gate, w_up, w_down = mlp_weights
     gate = qeinsum("...h,hf->...f", normed, w_gate)
     up = qeinsum("...h,hf->...f", normed, w_up)
-    out = qeinsum("...f,fh->...h", jax.nn.silu(gate) * up, w_down)
+    if config.act == "gelu_tanh":  # GeGLU (Gemma): tanh-approx gelu gate
+        activated = jax.nn.gelu(gate, approximate=True)
+    else:
+        activated = jax.nn.silu(gate)
+    out = qeinsum("...f,fh->...h", activated * up, w_down)
     return out, jnp.zeros((), dtype=jnp.float32)
 
 
 def _logits(config: LlamaConfig, params, x):
     if config.tie_embeddings:
         head = params["embedding"].T.astype(x.dtype)
-        return jnp.einsum("...h,hv->...v", x, head).astype(jnp.float32)
-    return qeinsum("...h,hv->...v", x, params["lm_head"]).astype(jnp.float32)
+        logits = jnp.einsum("...h,hv->...v", x, head).astype(jnp.float32)
+    else:
+        logits = qeinsum(
+            "...h,hv->...v", x, params["lm_head"]
+        ).astype(jnp.float32)
+    cap = config.final_logit_softcap
+    if cap is not None:
+        logits = cap * jnp.tanh(logits / cap)
+    return logits
 
 
 def _flash_path(config, q, mesh):
     """Shared gate for the bf16/int8 prefill twins: (use the flash
     kernel?, dispatch through the tp shard_map wrapper?). One place for
     the MXU-alignment heuristic and the SPMD rule so the two paths
-    cannot diverge."""
+    cannot diverge. Softcap / sliding window (Gemma-2) ride INTO the
+    kernels as a static cap and a traced per-layer window scalar."""
     flash_ok = config.use_flash and (
         use_flash(q.shape[1], q.shape[3]) or config.flash_interpret
     )
@@ -324,9 +436,10 @@ def _flash_path(config, q, mesh):
     return flash_ok, tp_sharded
 
 
-def _prefill_attn(config, q, k, v, mask, mesh=None):
+def _prefill_attn(config, q, k, v, mask, mesh=None, window=None):
     """Flash kernel on TPU for long MXU-aligned prompts, XLA einsum path
-    otherwise (CPU tests, short prompts, odd head dims). Under tensor
+    otherwise (CPU tests, short prompts, odd head dims, softcap/window
+    families — see :func:`_flash_path`). Under tensor
     parallelism (``mesh`` with tp>1) the kernel runs through shard_map
     over the head axis — a bare Mosaic call has no SPMD partitioning
     rule (``flash_prefill_attention_sharded``). Only called from the
@@ -335,6 +448,10 @@ def _prefill_attn(config, q, k, v, mask, mesh=None):
     right-padded (built from lengths), which is what the kernel's
     lengths-based masking assumes."""
     flash_ok, tp_sharded = _flash_path(config, q, mesh)
+    family = dict(
+        softcap=config.attn_logit_softcap, window=window,
+        scale=_attn_scale(config),
+    )
     if flash_ok:
         from langstream_tpu.ops.flash_attention import (
             flash_prefill_attention_sharded,
@@ -342,12 +459,13 @@ def _prefill_attn(config, q, k, v, mask, mesh=None):
 
         if tp_sharded:
             return flash_prefill_attention_sharded(
-                q, k, v, mesh, mask=mask, interpret=config.flash_interpret
+                q, k, v, mesh, mask=mask, interpret=config.flash_interpret,
+                **family,
             )
         return flash_prefill_attention(
-            q, k, v, mask=mask, interpret=config.flash_interpret
+            q, k, v, mask=mask, interpret=config.flash_interpret, **family
         )
-    return prefill_attention(q, k, v, mask=mask)
+    return prefill_attention(q, k, v, mask=mask, **family)
 
 
 def _decode_flash_path(config, q, kc, mesh):
@@ -374,13 +492,18 @@ def _decode_flash_path(config, q, kc, mesh):
     return flash_ok, tp_sharded
 
 
-def _decode_attn(config, q, kc, vc, lengths, mesh=None):
+def _decode_attn(config, q, kc, vc, lengths, mesh=None, window=None):
     """Decode attention: length-aware Pallas kernel on TPU for long
     allocated caches (HBM traffic ∝ live context — the XLA einsum
     streams the full static buffer), XLA path otherwise. Under tp the
     kernel runs per head shard through shard_map
-    (``flash_decode_attention_sharded``)."""
+    (``flash_decode_attention_sharded``). ``window`` is this layer's
+    sliding-window size (Gemma-2; the gate already forces XLA then)."""
     flash_ok, tp_sharded = _decode_flash_path(config, q, kc, mesh)
+    family = dict(
+        softcap=config.attn_logit_softcap, window=window,
+        scale=_attn_scale(config),
+    )
     if flash_ok:
         from langstream_tpu.ops.decode_kernel import (
             flash_decode_attention,
@@ -389,17 +512,23 @@ def _decode_attn(config, q, kc, vc, lengths, mesh=None):
 
         if tp_sharded:
             return flash_decode_attention_sharded(
-                q, kc, vc, lengths, mesh, interpret=config.flash_interpret
+                q, kc, vc, lengths, mesh, interpret=config.flash_interpret,
+                **family,
             )
         return flash_decode_attention(
-            q, kc, vc, lengths, interpret=config.flash_interpret
+            q, kc, vc, lengths, interpret=config.flash_interpret, **family
         )
-    return decode_attention(q, kc, vc, lengths)
+    return decode_attention(q, kc, vc, lengths, **family)
 
 
-def _decode_attn_quant(config, q, kc, ks, vc, vs, lengths, mesh=None):
+def _decode_attn_quant(config, q, kc, ks, vc, vs, lengths, mesh=None,
+                       window=None):
     """Int8-cache twin of :func:`_decode_attn`."""
     flash_ok, tp_sharded = _decode_flash_path(config, q, kc, mesh)
+    family = dict(
+        softcap=config.attn_logit_softcap, window=window,
+        scale=_attn_scale(config),
+    )
     if flash_ok:
         from langstream_tpu.ops.decode_kernel import (
             flash_decode_attention_quant,
@@ -409,19 +538,25 @@ def _decode_attn_quant(config, q, kc, ks, vc, vs, lengths, mesh=None):
         if tp_sharded:
             return flash_decode_attention_sharded(
                 q, kc, vc, lengths, mesh, k_scale=ks, v_scale=vs,
-                interpret=config.flash_interpret,
+                interpret=config.flash_interpret, **family,
             )
         return flash_decode_attention_quant(
-            q, kc, ks, vc, vs, lengths, interpret=config.flash_interpret
+            q, kc, ks, vc, vs, lengths, interpret=config.flash_interpret,
+            **family,
         )
-    return decode_attention_quant(q, kc, ks, vc, vs, lengths)
+    return decode_attention_quant(q, kc, ks, vc, vs, lengths, **family)
 
 
-def _prefill_attn_quant(config, q, k_q, k_s, v_q, v_s, lengths, mesh=None):
+def _prefill_attn_quant(config, q, k_q, k_s, v_q, v_s, lengths, mesh=None,
+                        window=None):
     """Quantized-cold-prefill twin of :func:`_prefill_attn`: int8 flash
     kernel on TPU for long MXU-aligned prompts (same scale-folded
     algebra, int8 HBM loads), XLA ``chunk_attention_quant`` otherwise."""
     flash_ok, tp_sharded = _flash_path(config, q, mesh)
+    family = dict(
+        softcap=config.attn_logit_softcap, window=window,
+        scale=_attn_scale(config),
+    )
     if flash_ok:
         from langstream_tpu.ops.flash_attention import (
             flash_prefill_attention_quant,
@@ -431,14 +566,14 @@ def _prefill_attn_quant(config, q, k_q, k_s, v_q, v_s, lengths, mesh=None):
         if tp_sharded:
             return flash_prefill_attention_quant_sharded(
                 q, k_q, k_s, v_q, v_s, mesh, lengths=lengths,
-                interpret=config.flash_interpret,
+                interpret=config.flash_interpret, **family,
             )
         return flash_prefill_attention_quant(
             q, k_q, k_s, v_q, v_s, lengths=lengths,
-            interpret=config.flash_interpret,
+            interpret=config.flash_interpret, **family,
         )
     return chunk_attention_quant(
-        q, k_q, k_s, v_q, v_s, jnp.zeros_like(lengths), lengths
+        q, k_q, k_s, v_q, v_s, jnp.zeros_like(lengths), lengths, **family
     )
 
 
@@ -458,14 +593,17 @@ def prefill(
     hd = config.dims_per_head
     positions = jnp.arange(seq)[None, :].repeat(batch, 0)
     mask = positions < lengths[:, None]
-    x = params["embedding"][tokens].astype(config.dtype)  # [B, T, H]
+    x = _embed(config, params, tokens)  # [B, T, H]
 
     layer_inputs = _stack_layer_params(params)
+    windows = layer_windows(config)
     quantized = "k_scale" in cache
 
-    def layer_fn(x, layer):
-        attn_norm, wq, wk, wv, wo, mlp_norm, mlp_weights = layer
-        normed = rms_norm(x, attn_norm, config.norm_eps)
+    def layer_fn(x, inputs):
+        layer, win = inputs
+        (attn_norm, wq, wk, wv, wo, post_attn, mlp_norm, post_mlp,
+         mlp_weights) = layer
+        normed = _norm(config, x, attn_norm)
         q = qeinsum("bth,hd->btd", normed, wq).reshape(
             batch, seq, config.num_heads, hd
         )
@@ -491,22 +629,28 @@ def prefill(
             k_q, k_s = quantize_kv(k)
             v_q, v_s = quantize_kv(v)
             attn = _prefill_attn_quant(
-                config, q, k_q, k_s, v_q, v_s, lengths, mesh=mesh
+                config, q, k_q, k_s, v_q, v_s, lengths, mesh=mesh,
+                window=win,
             )
             layer_kv_out = (k_q, v_q, k_s, v_s)
         else:
             layer_kv_out = (k, v)
-            attn = _prefill_attn(config, q, k, v, mask, mesh=mesh)
+            attn = _prefill_attn(config, q, k, v, mask, mesh=mesh,
+                                 window=win)
         attn = qeinsum(
             "btd,dh->bth", attn.reshape(batch, seq, config.num_heads * hd), wo
         )
+        if post_attn is not None:
+            attn = _norm(config, attn, post_attn)
         x = x + attn
-        normed = rms_norm(x, mlp_norm, config.norm_eps)
+        normed = _norm(config, x, mlp_norm)
         delta, _ = _mlp_block(config, normed, mlp_weights, valid=mask, dropless=True)
+        if post_mlp is not None:
+            delta = _norm(config, delta, post_mlp)
         x = x + delta
         return x, layer_kv_out
 
-    x, layer_kv = jax.lax.scan(layer_fn, x, layer_inputs)
+    x, layer_kv = jax.lax.scan(layer_fn, x, (layer_inputs, windows))
     max_len = cache["k"].shape[2]
     pad = max_len - seq
 
@@ -532,7 +676,7 @@ def prefill(
         pad_rows(new_v).astype(cache["v"].dtype)
     )
 
-    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    x = _norm(config, x, params["final_norm"])
     last = x[jnp.arange(batch), (lengths - 1).astype(jnp.int32)]  # [B, H]
     logits = _logits(config, params, last)
     return out, logits
@@ -562,9 +706,10 @@ def prefill_at_offset(
     positions = offsets[:, None] + jnp.arange(seq)[None, :]  # [B, T] global
     mask = jnp.arange(seq)[None, :] < lengths[:, None]       # [B, T] valid
     totals = offsets + lengths                               # [B]
-    x = params["embedding"][tokens].astype(config.dtype)     # [B, T, H]
+    x = _embed(config, params, tokens)                       # [B, T, H]
 
     layer_inputs = _stack_layer_params(params)
+    windows = layer_windows(config)
     quantized = "k_scale" in cache
 
     def write_rows(kc, new, offs):
@@ -591,11 +736,12 @@ def prefill_at_offset(
     def layer_fn(carry, inputs):
         x = carry
         if quantized:
-            layer, kc, vc, ks, vs = inputs
+            layer, kc, vc, ks, vs, win = inputs
         else:
-            layer, kc, vc = inputs
-        attn_norm, wq, wk, wv, wo, mlp_norm, mlp_weights = layer
-        normed = rms_norm(x, attn_norm, config.norm_eps)
+            layer, kc, vc, win = inputs
+        (attn_norm, wq, wk, wv, wo, post_attn, mlp_norm, post_mlp,
+         mlp_weights) = layer
+        normed = _norm(config, x, attn_norm)
         q = qeinsum("bth,hd->btd", normed, wq).reshape(
             batch, seq, config.num_heads, hd
         )
@@ -607,6 +753,8 @@ def prefill_at_offset(
         )
         q = apply_rope(q, freqs, positions)
         k = apply_rope(k, freqs, positions)
+        softcap = config.attn_logit_softcap
+        scale = _attn_scale(config)
         if quantized:
             k_q, k_s = quantize_kv(k)
             v_q, v_s = quantize_kv(v)
@@ -617,35 +765,42 @@ def prefill_at_offset(
             attn = chunk_attention_quant(
                 q, kc[slot_ids], ks[slot_ids], vc[slot_ids],
                 vs[slot_ids], offsets, totals,
+                softcap=softcap, window=win, scale=scale,
             )
             kv_out = (kc, vc, ks, vs)
         else:
             kc = write_rows(kc, k, offsets)
             vc = write_rows(vc, v, offsets)
             attn = chunk_attention(
-                q, kc[slot_ids], vc[slot_ids], offsets, totals
+                q, kc[slot_ids], vc[slot_ids], offsets, totals,
+                softcap=softcap, window=win, scale=scale,
             )
             kv_out = (kc, vc)
-        x = x + qeinsum(
+        attn = qeinsum(
             "btd,dh->bth", attn.reshape(batch, seq, config.num_heads * hd), wo
         )
-        normed = rms_norm(x, mlp_norm, config.norm_eps)
+        if post_attn is not None:
+            attn = _norm(config, attn, post_attn)
+        x = x + attn
+        normed = _norm(config, x, mlp_norm)
         delta, _ = _mlp_block(config, normed, mlp_weights, valid=mask, dropless=True)
+        if post_mlp is not None:
+            delta = _norm(config, delta, post_mlp)
         x = x + delta
         return x, kv_out
 
     if quantized:
         xs = (layer_inputs, cache["k"], cache["v"],
-              cache["k_scale"], cache["v_scale"])
+              cache["k_scale"], cache["v_scale"], windows)
     else:
-        xs = (layer_inputs, cache["k"], cache["v"])
+        xs = (layer_inputs, cache["k"], cache["v"], windows)
     x, kv_caches = jax.lax.scan(layer_fn, x, xs)
     out = dict(cache)
     if quantized:
         out["k"], out["v"], out["k_scale"], out["v_scale"] = kv_caches
     else:
         out["k"], out["v"] = kv_caches
-    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    x = _norm(config, x, params["final_norm"])
     last = x[jnp.arange(batch), (lengths - 1).astype(jnp.int32)]  # [B, H]
     logits = _logits(config, params, last)
     return out, logits
@@ -673,9 +828,10 @@ def decode_step(
     positions = (lengths - 1).astype(jnp.int32)  # [S]
     if write_mask is None:
         write_mask = jnp.ones((slots,), dtype=bool)
-    x = params["embedding"][tokens].astype(config.dtype)  # [S, H]
+    x = _embed(config, params, tokens)  # [S, H]
 
     layer_inputs = _stack_layer_params(params)
+    windows = layer_windows(config)
     quantized = "k_scale" in cache
 
     def write(c, pos, new, enabled):
@@ -684,11 +840,12 @@ def decode_step(
     def layer_fn(carry, inputs):
         x = carry
         if quantized:
-            layer, kc, vc, ks, vs = inputs
+            layer, kc, vc, ks, vs, win = inputs
         else:
-            layer, kc, vc = inputs
-        attn_norm, wq, wk, wv, wo, mlp_norm, mlp_weights = layer
-        normed = rms_norm(x, attn_norm, config.norm_eps)
+            layer, kc, vc, win = inputs
+        (attn_norm, wq, wk, wv, wo, post_attn, mlp_norm, post_mlp,
+         mlp_weights) = layer
+        normed = _norm(config, x, attn_norm)
         q = qeinsum("sh,hd->sd", normed, wq).reshape(slots, config.num_heads, hd)
         k = qeinsum("sh,hd->sd", normed, wk).reshape(slots, config.num_kv_heads, hd)
         v = qeinsum("sh,hd->sd", normed, wv).reshape(slots, config.num_kv_heads, hd)
@@ -702,27 +859,36 @@ def decode_step(
             vc = jax.vmap(write)(vc, positions, v_q, write_mask)
             vs = jax.vmap(write)(vs, positions, v_s, write_mask)
             attn = _decode_attn_quant(
-                config, q, kc, ks, vc, vs, lengths, mesh=mesh
+                config, q, kc, ks, vc, vs, lengths, mesh=mesh, window=win
             )
             kv_out = (kc, vc, ks, vs)
         else:
             kc = jax.vmap(write)(kc, positions, k, write_mask)
             vc = jax.vmap(write)(vc, positions, v, write_mask)
-            attn = _decode_attn(config, q, kc, vc, lengths, mesh=mesh)
+            attn = _decode_attn(
+                config, q, kc, vc, lengths, mesh=mesh, window=win
+            )
             kv_out = (kc, vc)
-        x = x + qeinsum("sd,dh->sh", attn.reshape(slots, config.num_heads * hd), wo)
-        normed = rms_norm(x, mlp_norm, config.norm_eps)
+        attn = qeinsum(
+            "sd,dh->sh", attn.reshape(slots, config.num_heads * hd), wo
+        )
+        if post_attn is not None:
+            attn = _norm(config, attn, post_attn)
+        x = x + attn
+        normed = _norm(config, x, mlp_norm)
         # decode groups are tiny (S = slots) so dropless capacity is cheap;
         # inactive slots can't evict anyone, so no valid mask is needed
         delta, _ = _mlp_block(config, normed, mlp_weights, dropless=True)
+        if post_mlp is not None:
+            delta = _norm(config, delta, post_mlp)
         x = x + delta
         return x, kv_out
 
     if quantized:
         xs = (layer_inputs, cache["k"], cache["v"],
-              cache["k_scale"], cache["v_scale"])
+              cache["k_scale"], cache["v_scale"], windows)
     else:
-        xs = (layer_inputs, cache["k"], cache["v"])
+        xs = (layer_inputs, cache["k"], cache["v"], windows)
     # unroll lets XLA software-pipeline the next layer's weight loads
     # against the current layer's compute on the weights-bound decode
     # path (measured via LS_DECODE_UNROLL; 1 = plain scan)
@@ -732,7 +898,7 @@ def decode_step(
         out["k"], out["v"], out["k_scale"], out["v_scale"] = kv_caches
     else:
         out["k"], out["v"] = kv_caches
-    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    x = _norm(config, x, params["final_norm"])
     logits = _logits(config, params, x)
     return out, logits
 
@@ -751,6 +917,14 @@ def apply_layers(
     mask: Optional[jnp.ndarray],   # [B, T] valid-token mask or None
     freqs: jnp.ndarray,
     dropless: bool = False,
+    layer_offset: int = 0,  # global index of layer_inputs[0] — keeps the
+                            # sliding-window parity right for static
+                            # layer slices
+    windows: Optional[jnp.ndarray] = None,  # per-layer window sizes for
+                            # THESE layers (overrides the config-derived
+                            # slice — pipeline stages pass their pp-shard
+                            # of layer_windows(), since a static offset
+                            # cannot vary across SPMD stages)
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Scan the transformer layers over activations → (x, moe aux sum).
 
@@ -760,11 +934,18 @@ def apply_layers(
     batch, seq = x.shape[:2]
     hd = config.dims_per_head
     positions = jnp.arange(seq)[None, :].repeat(batch, 0)
+    if windows is None:
+        windows = layer_windows(config)
+        if windows is not None:
+            n = jax.tree_util.tree_leaves(layer_inputs)[0].shape[0]
+            windows = windows[layer_offset:layer_offset + n]
 
-    def layer_fn(carry, layer):
-        x, aux = carry
-        attn_norm, wq, wk, wv, wo, mlp_norm, mlp_weights = layer
-        normed = rms_norm(x, attn_norm, config.norm_eps)
+    def layer_fn(carry, inputs):
+        (x, aux) = carry
+        layer, win = inputs
+        (attn_norm, wq, wk, wv, wo, post_attn, mlp_norm, post_mlp,
+         mlp_weights) = layer
+        normed = _norm(config, x, attn_norm)
         q = qeinsum("bth,hd->btd", normed, wq).reshape(
             batch, seq, config.num_heads, hd
         )
@@ -776,19 +957,29 @@ def apply_layers(
         )
         q = apply_rope(q, freqs, positions)
         k = apply_rope(k, freqs, positions)
-        attn = prefill_attention(q, k, v, mask=mask)
-        x = x + qeinsum(
+        attn = prefill_attention(
+            q, k, v, mask=mask,
+            softcap=config.attn_logit_softcap, window=win,
+            scale=_attn_scale(config),
+        )
+        attn = qeinsum(
             "btd,dh->bth", attn.reshape(batch, seq, config.num_heads * hd), wo
         )
-        normed = rms_norm(x, mlp_norm, config.norm_eps)
+        if post_attn is not None:
+            attn = _norm(config, attn, post_attn)
+        x = x + attn
+        normed = _norm(config, x, mlp_norm)
         delta, layer_aux = _mlp_block(
             config, normed, mlp_weights, valid=mask, dropless=dropless
         )
+        if post_mlp is not None:
+            delta = _norm(config, delta, post_mlp)
         x = x + delta
         return (x, aux + layer_aux), None
 
     (x, aux), _ = jax.lax.scan(
-        layer_fn, (x, jnp.zeros((), dtype=jnp.float32)), layer_inputs
+        layer_fn, (x, jnp.zeros((), dtype=jnp.float32)),
+        (layer_inputs, windows),
     )
     return x, aux
 
@@ -812,10 +1003,10 @@ def forward(
         freqs = rope_frequencies(
             config.dims_per_head, config.max_seq_len, config.rope_theta
         )
-    x = params["embedding"][tokens].astype(config.dtype)
+    x = _embed(config, params, tokens)
     layer_inputs = _stack_layer_params(params)
     x, aux = apply_layers(config, layer_inputs, x, mask, freqs, dropless)
-    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    x = _norm(config, x, params["final_norm"])
     logits = _logits(config, params, x)
     if with_aux:
         return logits, aux / max(config.num_layers, 1)
@@ -826,6 +1017,38 @@ def forward(
 # HuggingFace checkpoint import
 # ---------------------------------------------------------------------- #
 def config_from_hf(hf_config) -> LlamaConfig:
+    gemma2 = getattr(hf_config, "model_type", "") == "gemma2"
+    if gemma2:
+        # Gemma-2 alternates sliding/full starting at layer 0; verify
+        # the checkpoint follows that pattern before baking it in
+        layer_types = getattr(hf_config, "layer_types", None)
+        if layer_types is not None:
+            expected = [
+                "sliding_attention" if i % 2 == 0 else "full_attention"
+                for i in range(hf_config.num_hidden_layers)
+            ]
+            if list(layer_types) != expected:
+                raise ValueError(
+                    f"unsupported gemma2 layer_types pattern: {layer_types}"
+                )
+    family = {}
+    if gemma2:
+        family = dict(
+            attn_logit_softcap=getattr(
+                hf_config, "attn_logit_softcapping", None
+            ),
+            final_logit_softcap=getattr(
+                hf_config, "final_logit_softcapping", None
+            ),
+            query_pre_attn_scalar=float(
+                getattr(hf_config, "query_pre_attn_scalar", 0) or 0
+            ) or None,
+            sliding_window=getattr(hf_config, "sliding_window", 0) or 0,
+            norm_plus_one=True,
+            post_norms=True,
+            scale_embedding=True,
+            act="gelu_tanh",
+        )
     return LlamaConfig(
         vocab_size=hf_config.vocab_size,
         hidden_size=hf_config.hidden_size,
@@ -840,6 +1063,7 @@ def config_from_hf(hf_config) -> LlamaConfig:
         tie_embeddings=getattr(hf_config, "tie_word_embeddings", False),
         num_experts=getattr(hf_config, "num_local_experts", 0) or 0,
         num_experts_per_tok=getattr(hf_config, "num_experts_per_tok", 2),
+        **family,
     )
 
 
@@ -909,6 +1133,38 @@ def load_hf_checkpoint(path_or_model, dtype=jnp.bfloat16):
             "w_up": stack("model.layers.{}.mlp.up_proj.weight"),
             "w_down": stack("model.layers.{}.mlp.down_proj.weight"),
         }
+    def stack_norm(pattern):
+        return jnp.asarray(
+            np.stack([
+                state[pattern.format(i)].to(torch.float32).numpy()
+                for i in range(config.num_layers)
+            ]), dtype=jnp.float32,
+        )
+
+    if config.post_norms:
+        # Gemma-2 sandwich norms: input_layernorm is the pre-attn norm,
+        # post_attention_layernorm the POST-attn one (applied to the
+        # block output before the residual add), and the feedforward
+        # pair wraps the MLP the same way
+        norms = {
+            "attn_norm": stack_norm("model.layers.{}.input_layernorm.weight"),
+            "post_attn_norm": stack_norm(
+                "model.layers.{}.post_attention_layernorm.weight"
+            ),
+            "mlp_norm": stack_norm(
+                "model.layers.{}.pre_feedforward_layernorm.weight"
+            ),
+            "post_mlp_norm": stack_norm(
+                "model.layers.{}.post_feedforward_layernorm.weight"
+            ),
+        }
+    else:
+        norms = {
+            "attn_norm": stack_norm("model.layers.{}.input_layernorm.weight"),
+            "mlp_norm": stack_norm(
+                "model.layers.{}.post_attention_layernorm.weight"
+            ),
+        }
     params = {
         "embedding": get("model.embed_tokens.weight"),
         "wq": stack("model.layers.{}.self_attn.q_proj.weight"),
@@ -916,20 +1172,10 @@ def load_hf_checkpoint(path_or_model, dtype=jnp.bfloat16):
         "wv": stack("model.layers.{}.self_attn.v_proj.weight"),
         "wo": stack("model.layers.{}.self_attn.o_proj.weight"),
         **mlp_weights,
-        "attn_norm": jnp.asarray(
-            np.stack([
-                state[f"model.layers.{i}.input_layernorm.weight"].numpy()
-                for i in range(config.num_layers)
-            ]), dtype=jnp.float32,
-        ),
-        "mlp_norm": jnp.asarray(
-            np.stack([
-                state[f"model.layers.{i}.post_attention_layernorm.weight"].numpy()
-                for i in range(config.num_layers)
-            ]), dtype=jnp.float32,
-        ),
+        **norms,
         "final_norm": jnp.asarray(
-            state["model.norm.weight"].numpy(), dtype=jnp.float32
+            state["model.norm.weight"].to(torch.float32).numpy(),
+            dtype=jnp.float32,
         ),
     }
     if not config.tie_embeddings:

@@ -142,6 +142,8 @@ def init_quantized_params(
     f, v, layers = config.intermediate_size, config.vocab_size, config.num_layers
     keys = jax.random.split(key, 10)
     dtype = config.dtype
+    # zero-centered norm convention (Gemma): identity weight is 0
+    norm_fill = 0.0 if config.norm_plus_one else 1.0
     out: Dict[str, Any] = {
         "embedding": (
             jax.random.normal(keys[0], (v, h), dtype=dtype) * (1.0 / math.sqrt(h))
@@ -153,10 +155,17 @@ def init_quantized_params(
         "w_gate": q_init(keys[5], (layers, h, f)),
         "w_up": q_init(keys[6], (layers, h, f)),
         "w_down": q_init(keys[7], (layers, f, h)),
-        "attn_norm": jnp.ones((layers, h), dtype=jnp.float32),
-        "mlp_norm": jnp.ones((layers, h), dtype=jnp.float32),
-        "final_norm": jnp.ones((h,), dtype=jnp.float32),
+        "attn_norm": jnp.full((layers, h), norm_fill, dtype=jnp.float32),
+        "mlp_norm": jnp.full((layers, h), norm_fill, dtype=jnp.float32),
+        "final_norm": jnp.full((h,), norm_fill, dtype=jnp.float32),
     }
+    if config.post_norms:
+        out["post_attn_norm"] = jnp.full(
+            (layers, h), norm_fill, dtype=jnp.float32
+        )
+        out["post_mlp_norm"] = jnp.full(
+            (layers, h), norm_fill, dtype=jnp.float32
+        )
     if not config.tie_embeddings:
         out["lm_head"] = q_init(keys[8], (h, v))
     return out

@@ -223,3 +223,30 @@ def test_flash_quant_sharded_tp_matches_reference():
         np.asarray(out[0, :n]), np.asarray(ref[0, :n]),
         rtol=2e-2, atol=2e-2,
     )
+
+
+def test_flash_prefill_window_softcap_matches_reference():
+    """Gemma-2 mechanisms in the prefill kernel: sliding-window masking
+    (+ out-of-window block compute skip), logit softcap, and the
+    query_pre_attn_scalar scale against the XLA reference."""
+    batch, seq, dim = 2, 256, 128
+    q, k, v = _make_qkv(batch, seq, 4, 2, dim, seed=9)
+    lengths = jnp.array([256, 170], dtype=jnp.int32)
+    mask = jnp.arange(seq)[None, :] < lengths[:, None]
+    window = jnp.asarray(48, dtype=jnp.int32)
+
+    from langstream_tpu.ops.attention import prefill_attention as xla_prefill
+
+    ref = xla_prefill(
+        q, k, v, mask=mask, softcap=30.0, window=window, scale=0.2
+    )
+    out = flash_prefill_attention(
+        q, k, v, mask=mask, softcap=30.0, window=window, scale=0.2,
+        block_q=64, block_k=64, interpret=True,
+    )
+    for b in range(batch):
+        n = int(lengths[b])
+        np.testing.assert_allclose(
+            np.asarray(out[b, :n]), np.asarray(ref[b, :n]),
+            rtol=2e-5, atol=2e-5,
+        )

@@ -201,3 +201,53 @@ def test_flash_decode_quant_bf16_matches_reference():
         np.asarray(out, dtype=np.float32), np.asarray(ref, dtype=np.float32),
         rtol=2e-2, atol=2e-2,
     )
+
+
+def test_flash_decode_window_softcap_matches_reference():
+    """Gemma-2 mechanisms in the kernel: sliding window (block skipping
+    from BOTH ends) + logit softcap + query_pre_attn_scalar scale must
+    match the XLA decode path bit-for-bit in masking semantics."""
+    slots, max_len, heads, kv_heads, dim = 3, 256, 8, 4, 128
+    q, k, v = _make_inputs(slots, max_len, heads, kv_heads, dim, seed=6)
+    lengths = jnp.array([256, 150, 9], dtype=jnp.int32)
+    window = jnp.asarray(40, dtype=jnp.int32)
+
+    ref = decode_attention(
+        q, k, v, lengths, softcap=30.0, window=window, scale=0.17
+    )
+    out = flash_decode_attention(
+        q, k, v, lengths, softcap=30.0, window=window, scale=0.17,
+        block_k=64, interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+    # window wider than the context ≡ full attention
+    ref_full = decode_attention(q, k, v, lengths)
+    out_wide = flash_decode_attention(
+        q, k, v, lengths, window=jnp.asarray(4096, dtype=jnp.int32),
+        block_k=64, interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_wide), np.asarray(ref_full), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_flash_decode_window_quant_matches_reference():
+    slots, max_len, heads, kv_heads, dim = 2, 128, 8, 4, 128
+    q, k, v = _make_inputs(slots, max_len, heads, kv_heads, dim, seed=7)
+    k_q, k_s = quantize_kv(k)
+    v_q, v_s = quantize_kv(v)
+    lengths = jnp.array([128, 70], dtype=jnp.int32)
+    window = jnp.asarray(24, dtype=jnp.int32)
+
+    ref = decode_attention_quant(
+        q, k_q, k_s, v_q, v_s, lengths, softcap=50.0, window=window
+    )
+    out = flash_decode_attention_quant(
+        q, k_q, k_s, v_q, v_s, lengths, softcap=50.0, window=window,
+        block_k=32, interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4
+    )

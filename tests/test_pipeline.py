@@ -168,3 +168,32 @@ def test_trainer_pp_converges(setup):
     losses = [trainer.train_step(tokens, mask) for _ in range(4)]
     assert all(np.isfinite(l) for l in losses)
     assert losses[-1] < losses[0]
+
+
+def test_pipelined_gemma2_matches_plain():
+    """Gemma-2 under pp: the per-layer sliding windows must follow the
+    GLOBAL layer index across stages (stage 1's first layer is layer
+    L/2, whose sliding/full parity differs from layer 0), and the
+    scaled embedding + zero-centered final norm must match forward()."""
+    config = dataclasses.replace(
+        model_lib.LlamaConfig.tiny_gemma2(), num_layers=4,
+        # prompt longer than the window so sliding layers actually mask
+        sliding_window=4,
+    )
+    params = model_lib.init_params(config, seed=3)
+    freqs = rope_frequencies(
+        config.dims_per_head, config.max_seq_len, config.rope_theta
+    )
+    tokens = jnp.arange(32, dtype=jnp.int32).reshape(4, 8) % config.vocab_size
+    mask = jnp.ones((4, 8), dtype=bool)
+    expected = model_lib.forward(config, params, tokens, mask=mask, freqs=freqs)
+    mesh = build_mesh(MeshConfig(pp=2), devices=jax.devices()[:2])
+    axes = model_lib.logical_axes(config)
+    with mesh:
+        sharded = shard_params(params, axes, mesh)
+        got = jax.jit(
+            lambda p, t, m: pipelined_logits(config, p, t, m, freqs, mesh, 2)
+        )(sharded, tokens, mask)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(expected), rtol=2e-3, atol=2e-3
+    )
