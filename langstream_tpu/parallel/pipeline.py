@@ -129,7 +129,7 @@ def pipelined_logits(
     if mask is None:
         mask = jnp.ones((batch, seq), dtype=bool)
     masks = mask.reshape(num_microbatches, mb, seq)
-    layer_inputs = model_lib._stack_layer_params(params)
+    layer_inputs = model_lib._stack_layer_params(params, config)
     # per-layer sliding windows ride the SAME pp sharding as the layer
     # stack, so each stage receives ITS layers' windows — a static
     # offset cannot vary across SPMD stages (Gemma-2 alternates

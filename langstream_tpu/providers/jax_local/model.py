@@ -67,6 +67,7 @@ class LlamaConfig:
     post_norms: bool = False      # sandwich norms after attn + mlp blocks
     scale_embedding: bool = False  # x *= sqrt(hidden) after the lookup
     act: str = "silu"             # MLP gate activation: silu | gelu_tanh
+    qkv_bias: bool = False        # q/k/v projection biases (Qwen-2 family)
     dtype: Any = jnp.bfloat16
     # Pallas flash prefill (TPU only; tp-sharded meshes route it through
     # shard_map over the head axis — see _prefill_attn).
@@ -152,6 +153,31 @@ class LlamaConfig:
         )
 
     @classmethod
+    def qwen25_7b(cls, max_seq_len: int = 8192) -> "LlamaConfig":
+        """Qwen-2.5-7B (HF Qwen/Qwen2.5-7B): Llama architecture plus
+        q/k/v projection biases."""
+        return cls(
+            vocab_size=152064, hidden_size=3584, intermediate_size=18944,
+            num_layers=28, num_heads=28, num_kv_heads=4, head_dim=128,
+            rope_theta=1e6, max_seq_len=max_seq_len, norm_eps=1e-6,
+            qkv_bias=True,
+        )
+
+    @classmethod
+    def qwen25_0_5b(cls, max_seq_len: int = 8192) -> "LlamaConfig":
+        return cls(
+            vocab_size=151936, hidden_size=896, intermediate_size=4864,
+            num_layers=24, num_heads=14, num_kv_heads=2, head_dim=64,
+            rope_theta=1e6, max_seq_len=max_seq_len, norm_eps=1e-6,
+            qkv_bias=True, tie_embeddings=True,
+        )
+
+    @classmethod
+    def tiny_qwen2(cls, max_seq_len: int = 256) -> "LlamaConfig":
+        """Test-size Qwen-2 shape (qkv biases on)."""
+        return dataclasses.replace(cls.tiny(max_seq_len), qkv_bias=True)
+
+    @classmethod
     def tiny(cls, max_seq_len: int = 256) -> "LlamaConfig":
         """Test-size config for CPU runs."""
         return cls(
@@ -180,6 +206,8 @@ class LlamaConfig:
             "mixtral-8x7b": cls.mixtral_8x7b, "tiny-moe": cls.tiny_moe,
             "gemma-2-2b": cls.gemma2_2b, "gemma-2-9b": cls.gemma2_9b,
             "tiny-gemma2": cls.tiny_gemma2,
+            "qwen-2.5-7b": cls.qwen25_7b, "qwen-2.5-0.5b": cls.qwen25_0_5b,
+            "tiny-qwen2": cls.tiny_qwen2,
         }
         preset = clean.pop("preset", None)
         if preset:
@@ -247,6 +275,10 @@ def init_params(config: LlamaConfig, seed: int = 0) -> Dict[str, jnp.ndarray]:
     if config.post_norms:
         params["post_attn_norm"] = norm_init((layers, h))
         params["post_mlp_norm"] = norm_init((layers, h))
+    if config.qkv_bias:
+        params["bq"] = jnp.zeros((layers, nh * hd), dtype=jnp.float32)
+        params["bk"] = jnp.zeros((layers, nkv * hd), dtype=jnp.float32)
+        params["bv"] = jnp.zeros((layers, nkv * hd), dtype=jnp.float32)
     if not config.tie_embeddings:
         params["lm_head"] = normal(keys[8], (h, v), scale)
     return params
@@ -281,6 +313,10 @@ def logical_axes(config: LlamaConfig) -> Dict[str, Any]:
     if config.post_norms:
         axes["post_attn_norm"] = L("layers", None)
         axes["post_mlp_norm"] = L("layers", None)
+    if config.qkv_bias:
+        axes["bq"] = L("layers", "heads")
+        axes["bk"] = L("layers", "heads")
+        axes["bv"] = L("layers", "heads")
     if not config.tie_embeddings:
         axes["lm_head"] = L("embed", "vocab")
     return axes
@@ -328,18 +364,64 @@ def cache_logical_axes(kv_quant: bool = False) -> Dict[str, Any]:
     return axes
 
 
-def _stack_layer_params(params: Dict[str, jnp.ndarray]):
+def validate_family_params(
+    config: LlamaConfig, params: Dict[str, Any]
+) -> None:
+    """Fail fast when a checkpoint/loader dropped family-specific
+    tensors: the layer stack's None fallbacks (post norms, qkv biases)
+    would otherwise run a qkv_bias/post_norms config silently WITHOUT
+    them — wrong logits, no error."""
+    required = []
+    if config.qkv_bias:
+        required += ["bq", "bk", "bv"]
+    if config.post_norms:
+        required += ["post_attn_norm", "post_mlp_norm"]
+    if not config.tie_embeddings:
+        required += ["lm_head"]
+    if config.num_experts:
+        required += ["router"]
+    missing = [name for name in required if name not in params]
+    if missing:
+        raise ValueError(
+            f"params missing {missing}, required by the model config — "
+            "the checkpoint or loader dropped family-specific tensors"
+        )
+
+
+def _stack_layer_params(params: Dict[str, jnp.ndarray], config=None):
     """Stacked per-layer tuple for the lax.scan layer loop. Post norms
-    (Gemma-2 sandwich) are None for families without them — None is an
-    empty pytree, so scan passes it through untouched."""
+    (Gemma-2 sandwich) and qkv biases (Qwen-2) are None for families
+    without them — None is an empty pytree, so scan passes it through
+    untouched. With ``config`` given, validates the family tensors are
+    actually present first (see :func:`validate_family_params`)."""
+    if config is not None:
+        validate_family_params(config, params)
     mlp = (params["w_gate"], params["w_up"], params["w_down"])
     if "router" in params:
         mlp = mlp + (params["router"],)
+    biases = (
+        (params["bq"], params["bk"], params["bv"])
+        if "bq" in params else None
+    )
     return (
         params["attn_norm"], params["wq"], params["wk"], params["wv"],
-        params["wo"], params.get("post_attn_norm"), params["mlp_norm"],
-        params.get("post_mlp_norm"), mlp,
+        biases, params["wo"], params.get("post_attn_norm"),
+        params["mlp_norm"], params.get("post_mlp_norm"), mlp,
     )
+
+
+def _project_qkv(normed, wq, wk, wv, biases):
+    """q/k/v projections with optional biases (Qwen-2); returns flat
+    [..., H*D] / [..., KVH*D] arrays — callers reshape to heads."""
+    q = qeinsum("...h,hd->...d", normed, wq)
+    k = qeinsum("...h,hd->...d", normed, wk)
+    v = qeinsum("...h,hd->...d", normed, wv)
+    if biases is not None:
+        bq, bk, bv = biases
+        q = q + bq.astype(q.dtype)
+        k = k + bk.astype(k.dtype)
+        v = v + bv.astype(v.dtype)
+    return q, k, v
 
 
 def _norm(config: LlamaConfig, x: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
@@ -595,24 +677,19 @@ def prefill(
     mask = positions < lengths[:, None]
     x = _embed(config, params, tokens)  # [B, T, H]
 
-    layer_inputs = _stack_layer_params(params)
+    layer_inputs = _stack_layer_params(params, config)
     windows = layer_windows(config)
     quantized = "k_scale" in cache
 
     def layer_fn(x, inputs):
         layer, win = inputs
-        (attn_norm, wq, wk, wv, wo, post_attn, mlp_norm, post_mlp,
+        (attn_norm, wq, wk, wv, biases, wo, post_attn, mlp_norm, post_mlp,
          mlp_weights) = layer
         normed = _norm(config, x, attn_norm)
-        q = qeinsum("bth,hd->btd", normed, wq).reshape(
-            batch, seq, config.num_heads, hd
-        )
-        k = qeinsum("bth,hd->btd", normed, wk).reshape(
-            batch, seq, config.num_kv_heads, hd
-        )
-        v = qeinsum("bth,hd->btd", normed, wv).reshape(
-            batch, seq, config.num_kv_heads, hd
-        )
+        q, k, v = _project_qkv(normed, wq, wk, wv, biases)
+        q = q.reshape(batch, seq, config.num_heads, hd)
+        k = k.reshape(batch, seq, config.num_kv_heads, hd)
+        v = v.reshape(batch, seq, config.num_kv_heads, hd)
         q = apply_rope(q, freqs, positions)
         k = apply_rope(k, freqs, positions)
         if quantized:
@@ -708,7 +785,7 @@ def prefill_at_offset(
     totals = offsets + lengths                               # [B]
     x = _embed(config, params, tokens)                       # [B, T, H]
 
-    layer_inputs = _stack_layer_params(params)
+    layer_inputs = _stack_layer_params(params, config)
     windows = layer_windows(config)
     quantized = "k_scale" in cache
 
@@ -739,18 +816,13 @@ def prefill_at_offset(
             layer, kc, vc, ks, vs, win = inputs
         else:
             layer, kc, vc, win = inputs
-        (attn_norm, wq, wk, wv, wo, post_attn, mlp_norm, post_mlp,
+        (attn_norm, wq, wk, wv, biases, wo, post_attn, mlp_norm, post_mlp,
          mlp_weights) = layer
         normed = _norm(config, x, attn_norm)
-        q = qeinsum("bth,hd->btd", normed, wq).reshape(
-            batch, seq, config.num_heads, hd
-        )
-        k = qeinsum("bth,hd->btd", normed, wk).reshape(
-            batch, seq, config.num_kv_heads, hd
-        )
-        v = qeinsum("bth,hd->btd", normed, wv).reshape(
-            batch, seq, config.num_kv_heads, hd
-        )
+        q, k, v = _project_qkv(normed, wq, wk, wv, biases)
+        q = q.reshape(batch, seq, config.num_heads, hd)
+        k = k.reshape(batch, seq, config.num_kv_heads, hd)
+        v = v.reshape(batch, seq, config.num_kv_heads, hd)
         q = apply_rope(q, freqs, positions)
         k = apply_rope(k, freqs, positions)
         softcap = config.attn_logit_softcap
@@ -830,7 +902,7 @@ def decode_step(
         write_mask = jnp.ones((slots,), dtype=bool)
     x = _embed(config, params, tokens)  # [S, H]
 
-    layer_inputs = _stack_layer_params(params)
+    layer_inputs = _stack_layer_params(params, config)
     windows = layer_windows(config)
     quantized = "k_scale" in cache
 
@@ -843,12 +915,13 @@ def decode_step(
             layer, kc, vc, ks, vs, win = inputs
         else:
             layer, kc, vc, win = inputs
-        (attn_norm, wq, wk, wv, wo, post_attn, mlp_norm, post_mlp,
+        (attn_norm, wq, wk, wv, biases, wo, post_attn, mlp_norm, post_mlp,
          mlp_weights) = layer
         normed = _norm(config, x, attn_norm)
-        q = qeinsum("sh,hd->sd", normed, wq).reshape(slots, config.num_heads, hd)
-        k = qeinsum("sh,hd->sd", normed, wk).reshape(slots, config.num_kv_heads, hd)
-        v = qeinsum("sh,hd->sd", normed, wv).reshape(slots, config.num_kv_heads, hd)
+        q, k, v = _project_qkv(normed, wq, wk, wv, biases)
+        q = q.reshape(slots, config.num_heads, hd)
+        k = k.reshape(slots, config.num_kv_heads, hd)
+        v = v.reshape(slots, config.num_kv_heads, hd)
         q = apply_rope(q[:, None], freqs, positions[:, None])[:, 0]
         k = apply_rope(k[:, None], freqs, positions[:, None])[:, 0]
         if quantized:
@@ -943,18 +1016,13 @@ def apply_layers(
     def layer_fn(carry, inputs):
         (x, aux) = carry
         layer, win = inputs
-        (attn_norm, wq, wk, wv, wo, post_attn, mlp_norm, post_mlp,
+        (attn_norm, wq, wk, wv, biases, wo, post_attn, mlp_norm, post_mlp,
          mlp_weights) = layer
         normed = _norm(config, x, attn_norm)
-        q = qeinsum("bth,hd->btd", normed, wq).reshape(
-            batch, seq, config.num_heads, hd
-        )
-        k = qeinsum("bth,hd->btd", normed, wk).reshape(
-            batch, seq, config.num_kv_heads, hd
-        )
-        v = qeinsum("bth,hd->btd", normed, wv).reshape(
-            batch, seq, config.num_kv_heads, hd
-        )
+        q, k, v = _project_qkv(normed, wq, wk, wv, biases)
+        q = q.reshape(batch, seq, config.num_heads, hd)
+        k = k.reshape(batch, seq, config.num_kv_heads, hd)
+        v = v.reshape(batch, seq, config.num_kv_heads, hd)
         q = apply_rope(q, freqs, positions)
         k = apply_rope(k, freqs, positions)
         attn = prefill_attention(
@@ -1004,7 +1072,7 @@ def forward(
             config.dims_per_head, config.max_seq_len, config.rope_theta
         )
     x = _embed(config, params, tokens)
-    layer_inputs = _stack_layer_params(params)
+    layer_inputs = _stack_layer_params(params, config)
     x, aux = apply_layers(config, layer_inputs, x, mask, freqs, dropless)
     x = _norm(config, x, params["final_norm"])
     logits = _logits(config, params, x)
@@ -1032,6 +1100,8 @@ def config_from_hf(hf_config) -> LlamaConfig:
                     f"unsupported gemma2 layer_types pattern: {layer_types}"
                 )
     family = {}
+    if getattr(hf_config, "model_type", "") == "qwen2":
+        family = dict(qkv_bias=True)
     if gemma2:
         family = dict(
             attn_logit_softcap=getattr(
@@ -1173,6 +1243,14 @@ def load_hf_checkpoint(path_or_model, dtype=jnp.bfloat16):
         "wo": stack("model.layers.{}.self_attn.o_proj.weight"),
         **mlp_weights,
         **norms,
+        **(
+            {
+                "bq": stack_norm("model.layers.{}.self_attn.q_proj.bias"),
+                "bk": stack_norm("model.layers.{}.self_attn.k_proj.bias"),
+                "bv": stack_norm("model.layers.{}.self_attn.v_proj.bias"),
+            }
+            if config.qkv_bias else {}
+        ),
         "final_norm": jnp.asarray(
             state["model.norm.weight"].to(torch.float32).numpy(),
             dtype=jnp.float32,

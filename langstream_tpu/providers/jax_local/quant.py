@@ -166,6 +166,10 @@ def init_quantized_params(
         out["post_mlp_norm"] = jnp.full(
             (layers, h), norm_fill, dtype=jnp.float32
         )
+    if config.qkv_bias:
+        out["bq"] = jnp.zeros((layers, nh * hd), dtype=jnp.float32)
+        out["bk"] = jnp.zeros((layers, nkv * hd), dtype=jnp.float32)
+        out["bv"] = jnp.zeros((layers, nkv * hd), dtype=jnp.float32)
     if not config.tie_embeddings:
         out["lm_head"] = q_init(keys[8], (h, v))
     return out

@@ -1,4 +1,5 @@
-"""Direct safetensors → stacked-params loader for HF Llama/Mixtral dirs.
+"""Direct safetensors → stacked-params loader for HF checkpoint dirs
+(Llama, Mixtral, Qwen-2, Gemma-2).
 
 Unlike :func:`model.load_hf_checkpoint` (which instantiates the torch
 model — fine for small models, prohibitive for 8B+ since the whole
@@ -94,6 +95,12 @@ def load_config(path: str) -> LlamaConfig:
         hf = json.load(fh)
     hf.setdefault("rms_norm_eps", 1e-5)
     hf.setdefault("max_position_embeddings", 4096)
+    # save_pretrained omits keys equal to the ARCHITECTURE default, so a
+    # raw-JSON load must re-apply the per-family defaults transformers
+    # would (Gemma ties embeddings by default; Llama/Qwen do not)
+    hf.setdefault(
+        "tie_word_embeddings", hf.get("model_type") in ("gemma", "gemma2")
+    )
     return config_from_hf(types.SimpleNamespace(**hf))
 
 
@@ -152,6 +159,40 @@ def load_safetensors_checkpoint(
                 "w_down": stack("model.layers.{}.mlp.down_proj.weight"),
             }
 
+        def stack_f32(pattern):
+            return jnp.stack([
+                get(pattern.format(i), cast_dtype=jnp.float32)
+                for i in range(config.num_layers)
+            ])
+
+        if config.post_norms:
+            # Gemma-2 sandwich layout: post_attention_layernorm is the
+            # POST-attn norm, the feedforward pair wraps the MLP (same
+            # mapping as model.load_hf_checkpoint)
+            norms = {
+                "attn_norm": stack_f32(
+                    "model.layers.{}.input_layernorm.weight"
+                ),
+                "post_attn_norm": stack_f32(
+                    "model.layers.{}.post_attention_layernorm.weight"
+                ),
+                "mlp_norm": stack_f32(
+                    "model.layers.{}.pre_feedforward_layernorm.weight"
+                ),
+                "post_mlp_norm": stack_f32(
+                    "model.layers.{}.post_feedforward_layernorm.weight"
+                ),
+            }
+        else:
+            norms = {
+                "attn_norm": stack_f32(
+                    "model.layers.{}.input_layernorm.weight"
+                ),
+                "mlp_norm": stack_f32(
+                    "model.layers.{}.post_attention_layernorm.weight"
+                ),
+            }
+
         params = {
             "embedding": get("model.embed_tokens.weight"),
             "wq": stack("model.layers.{}.self_attn.q_proj.weight"),
@@ -159,22 +200,14 @@ def load_safetensors_checkpoint(
             "wv": stack("model.layers.{}.self_attn.v_proj.weight"),
             "wo": stack("model.layers.{}.self_attn.o_proj.weight"),
             **mlp_weights,
-            "attn_norm": jnp.stack([
-                get(
-                    f"model.layers.{i}.input_layernorm.weight",
-                    cast_dtype=jnp.float32,
-                )
-                for i in range(config.num_layers)
-            ]),
-            "mlp_norm": jnp.stack([
-                get(
-                    f"model.layers.{i}.post_attention_layernorm.weight",
-                    cast_dtype=jnp.float32,
-                )
-                for i in range(config.num_layers)
-            ]),
+            **norms,
             "final_norm": get("model.norm.weight", cast_dtype=jnp.float32),
         }
+        if config.qkv_bias:
+            # Qwen-2 q/k/v projection biases
+            params["bq"] = stack_f32("model.layers.{}.self_attn.q_proj.bias")
+            params["bk"] = stack_f32("model.layers.{}.self_attn.k_proj.bias")
+            params["bv"] = stack_f32("model.layers.{}.self_attn.v_proj.bias")
         if not config.tie_embeddings:
             params["lm_head"] = get("lm_head.weight", transpose=True)
         return config, params

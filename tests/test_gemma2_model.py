@@ -134,3 +134,29 @@ def test_gemma2_engine_generates():
         assert all(len(r.tokens) == 8 for r in results)
     finally:
         engine.stop()
+
+
+def test_gemma2_safetensors_roundtrip(tmp_path):
+    """The safetensors loader must map the four-norm sandwich layout —
+    it used to map post_attention_layernorm to the pre-MLP norm (the
+    Llama layout), silently mis-normalizing every block."""
+    import torch
+
+    from langstream_tpu.providers.jax_local.weights import (
+        load_safetensors_checkpoint,
+    )
+
+    hf_model = _hf_gemma2()
+    hf_model.save_pretrained(tmp_path, safe_serialization=True)
+    config, params = load_safetensors_checkpoint(
+        str(tmp_path), dtype=jnp.float32
+    )
+    assert config.post_norms and "post_attn_norm" in params
+
+    prompt = [3, 17, 9, 40, 2, 77, 101, 5, 63, 8, 21, 90]
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor([prompt])).logits[0].numpy()
+    logits = forward(config, params, jnp.array([prompt], dtype=jnp.int32))
+    np.testing.assert_allclose(
+        np.asarray(logits)[0], hf_logits, rtol=2e-3, atol=2e-3
+    )
