@@ -7,17 +7,56 @@ from typing import Optional
 import jax.numpy as jnp
 
 
+def _llama3_scale_inv_freq(
+    inv_freq: jnp.ndarray,
+    factor: float,
+    low_freq_factor: float,
+    high_freq_factor: float,
+    original_max_positions: float,
+) -> jnp.ndarray:
+    """Llama-3.1 NTK-by-parts frequency scaling (HF
+    ``_compute_llama3_parameters``): high-frequency components keep
+    their wavelength, low-frequency ones stretch by ``factor``, and the
+    band between interpolates smoothly."""
+    import math
+
+    low_wavelen = original_max_positions / low_freq_factor
+    high_wavelen = original_max_positions / high_freq_factor
+    wavelen = 2.0 * math.pi / inv_freq
+    scaled = inv_freq / factor
+    smooth = (
+        original_max_positions / wavelen - low_freq_factor
+    ) / (high_freq_factor - low_freq_factor)
+    smoothed = (1.0 - smooth) * scaled + smooth * inv_freq
+    return jnp.where(
+        wavelen < high_wavelen,
+        inv_freq,
+        jnp.where(wavelen > low_wavelen, scaled, smoothed),
+    )
+
+
 def rope_frequencies(
     head_dim: int,
     max_positions: int,
     theta: float = 500000.0,
     dtype=jnp.float32,
+    scaling: Optional[tuple] = None,
 ) -> jnp.ndarray:
     """Precomputed [max_positions, head_dim//2] complex angles as (cos, sin)
-    stacked on a leading axis of size 2."""
+    stacked on a leading axis of size 2.
+
+    ``scaling`` is the config's hashable rope-scaling tuple
+    ``("llama3", factor, low_freq_factor, high_freq_factor,
+    original_max_position_embeddings)`` — the Llama-3.1/3.2 long-context
+    recipe. None = plain RoPE."""
     inv_freq = 1.0 / (
         theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
     )
+    if scaling is not None:
+        kind = scaling[0]
+        if kind != "llama3":
+            raise ValueError(f"unsupported rope scaling type: {kind!r}")
+        inv_freq = _llama3_scale_inv_freq(inv_freq, *scaling[1:])
     positions = jnp.arange(max_positions, dtype=jnp.float32)
     angles = jnp.outer(positions, inv_freq)
     return jnp.stack([jnp.cos(angles), jnp.sin(angles)]).astype(dtype)

@@ -43,7 +43,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from langstream_tpu.ops.rope import rope_frequencies
 from langstream_tpu.parallel.mesh import (
     MeshConfig,
     build_mesh,
@@ -284,9 +283,7 @@ class DecodeEngine:
             axes = quantize_logical_axes(axes, params)
         with self.mesh:
             self.params = shard_params(params, axes, self.mesh)
-        self.freqs = rope_frequencies(
-            config.dims_per_head, config.max_seq_len, config.rope_theta
-        )
+        self.freqs = model_lib.model_freqs(config)
         if kv_quant not in (None, "int8"):
             raise ValueError(f"unknown kv cache quantization {kv_quant!r}")
         self.kv_quant = kv_quant == "int8"

@@ -68,6 +68,10 @@ class LlamaConfig:
     scale_embedding: bool = False  # x *= sqrt(hidden) after the lookup
     act: str = "silu"             # MLP gate activation: silu | gelu_tanh
     qkv_bias: bool = False        # q/k/v projection biases (Qwen-2 family)
+    # RoPE frequency scaling as a HASHABLE tuple ("llama3", factor,
+    # low_freq_factor, high_freq_factor, original_max_positions) — the
+    # Llama-3.1/3.2 long-context recipe (ops/rope.py). None = plain.
+    rope_scaling: Optional[Tuple] = None
     dtype: Any = jnp.bfloat16
     # Pallas flash prefill (TPU only; tp-sharded meshes route it through
     # shard_map over the head axis — see _prefill_attn).
@@ -98,11 +102,21 @@ class LlamaConfig:
 
     @classmethod
     def llama3_1b(cls, max_seq_len: int = 8192) -> "LlamaConfig":
-        # Llama-3.2-1B shape
+        # Llama-3.2-1B shape (incl. its 32x llama3 rope scaling)
         return cls(
             vocab_size=128256, hidden_size=2048, intermediate_size=8192,
             num_layers=16, num_heads=32, num_kv_heads=8, head_dim=64,
             rope_theta=500000.0, max_seq_len=max_seq_len, tie_embeddings=True,
+            rope_scaling=("llama3", 32.0, 1.0, 4.0, 8192.0),
+        )
+
+    @classmethod
+    def llama31_8b(cls, max_seq_len: int = 8192) -> "LlamaConfig":
+        """Llama-3.1-8B: the 3.0 shape + llama3 rope scaling (the
+        128k-context recipe)."""
+        return dataclasses.replace(
+            cls.llama3_8b(max_seq_len),
+            rope_scaling=("llama3", 8.0, 1.0, 4.0, 8192.0),
         )
 
     @classmethod
@@ -200,8 +214,13 @@ class LlamaConfig:
         if isinstance(clean.get("dtype"), str):
             # checkpoints serialize the dtype by name ("bfloat16")
             clean["dtype"] = jnp.dtype(clean["dtype"])
+        if clean.get("rope_scaling") is not None:
+            clean["rope_scaling"] = normalize_rope_scaling(
+                clean["rope_scaling"]
+            )
         presets = {
             "llama-3-8b": cls.llama3_8b, "llama-3-70b": cls.llama3_70b,
+            "llama-3.1-8b": cls.llama31_8b,
             "llama-3-1b": cls.llama3_1b, "tiny": cls.tiny,
             "mixtral-8x7b": cls.mixtral_8x7b, "tiny-moe": cls.tiny_moe,
             "gemma-2-2b": cls.gemma2_2b, "gemma-2-9b": cls.gemma2_9b,
@@ -362,6 +381,54 @@ def cache_logical_axes(kv_quant: bool = False) -> Dict[str, Any]:
             "layers", "cache_batch", "cache_sequence", "kv_heads"
         )
     return axes
+
+
+def normalize_rope_scaling(value: Any) -> Optional[Tuple]:
+    """HF configs carry rope scaling as a dict; the config field is a
+    hashable tuple ("llama3", factor, low, high, original_max). Accepts
+    either spelling; only the llama3 (3.1/3.2 long-context) type is
+    supported — anything else raises rather than silently degrading."""
+    if value is None or isinstance(value, tuple):
+        return value
+    if isinstance(value, (list,)):
+        return tuple(value)
+    # YAML configs spell keys with dashes; HF JSON with underscores
+    value = {k.replace("-", "_"): v for k, v in value.items()}
+    kind = value.get("rope_type") or value.get("type")
+    if kind == "default":
+        return None
+    if kind != "llama3":
+        raise ValueError(f"unsupported rope scaling type: {kind!r}")
+    # all four parameters are REQUIRED (as in HF's validation): assumed
+    # defaults would silently build wrong long-context RoPE angles
+    missing = [
+        key
+        for key in (
+            "factor", "low_freq_factor", "high_freq_factor",
+            "original_max_position_embeddings",
+        )
+        if key not in value
+    ]
+    if missing:
+        raise ValueError(f"llama3 rope_scaling missing {missing}")
+    return (
+        "llama3",
+        float(value["factor"]),
+        float(value["low_freq_factor"]),
+        float(value["high_freq_factor"]),
+        float(value["original_max_position_embeddings"]),
+    )
+
+
+def model_freqs(config: LlamaConfig, dtype=jnp.float32) -> jnp.ndarray:
+    """The ONE way to build this config's RoPE table — theta AND the
+    rope-scaling recipe (engine, trainer, forward, and the graft entry
+    all route through here so a scaled checkpoint can never silently
+    get plain frequencies)."""
+    return rope_frequencies(
+        config.dims_per_head, config.max_seq_len, config.rope_theta,
+        dtype=dtype, scaling=config.rope_scaling,
+    )
 
 
 def validate_family_params(
@@ -1068,9 +1135,7 @@ def forward(
     use it when scoring a dropless-trained checkpoint; training keeps the
     capacity regime so the router feels the balance pressure."""
     if freqs is None:
-        freqs = rope_frequencies(
-            config.dims_per_head, config.max_seq_len, config.rope_theta
-        )
+        freqs = model_freqs(config)
     x = _embed(config, params, tokens)
     layer_inputs = _stack_layer_params(params, config)
     x, aux = apply_layers(config, layer_inputs, x, mask, freqs, dropless)
@@ -1085,6 +1150,9 @@ def forward(
 # HuggingFace checkpoint import
 # ---------------------------------------------------------------------- #
 def config_from_hf(hf_config) -> LlamaConfig:
+    rope_scaling = normalize_rope_scaling(
+        getattr(hf_config, "rope_scaling", None)
+    )
     gemma2 = getattr(hf_config, "model_type", "") == "gemma2"
     if gemma2:
         # Gemma-2 alternates sliding/full starting at layer 0; verify
@@ -1133,6 +1201,7 @@ def config_from_hf(hf_config) -> LlamaConfig:
         tie_embeddings=getattr(hf_config, "tie_word_embeddings", False),
         num_experts=getattr(hf_config, "num_local_experts", 0) or 0,
         num_experts_per_tok=getattr(hf_config, "num_experts_per_tok", 2),
+        rope_scaling=rope_scaling,
         **family,
     )
 

@@ -76,8 +76,6 @@ class Trainer:
         mesh_config: Optional[MeshConfig] = None,
         train_config: Optional[TrainConfig] = None,
     ) -> None:
-        from langstream_tpu.ops.rope import rope_frequencies
-
         self.model_config = model_config
         self.train_config = train_config or TrainConfig()
         validate_mesh(
@@ -97,11 +95,7 @@ class Trainer:
         with self.mesh:
             self.params = shard_params(params, axes, self.mesh)
         self._param_shardings = param_shardings(axes, self.mesh)
-        self.freqs = rope_frequencies(
-            model_config.dims_per_head,
-            model_config.max_seq_len,
-            model_config.rope_theta,
-        )
+        self.freqs = model_lib.model_freqs(model_config)
 
         tc = self.train_config
         self.optimizer = optax.chain(

@@ -160,3 +160,44 @@ def test_gemma2_safetensors_roundtrip(tmp_path):
     np.testing.assert_allclose(
         np.asarray(logits)[0], hf_logits, rtol=2e-3, atol=2e-3
     )
+
+
+def test_gemma2_engine_tp4_matches_single_device():
+    """Gemma-2 under tensor parallelism: the family's extra params
+    (sandwich norms) shard replicated, the window/softcap paths ride
+    the sharded jits — tokens must match the unsharded engine."""
+    import asyncio
+    import dataclasses
+
+    from langstream_tpu.parallel.mesh import MeshConfig
+    from langstream_tpu.providers.jax_local.engine import (
+        DecodeEngine,
+        SamplingParams,
+    )
+
+    async def main():
+        config = dataclasses.replace(
+            LlamaConfig.tiny_gemma2(max_seq_len=64),
+            num_heads=4, num_kv_heads=4,
+        )
+        params = init_params(config, seed=5)
+        solo = DecodeEngine(config, params, max_slots=2, max_seq_len=64,
+                            prefill_buckets=[16])
+        solo.start()
+        r1 = await solo.generate(
+            [1, 2, 3, 4], SamplingParams(max_new_tokens=6)
+        )
+        solo.stop()
+
+        sharded = DecodeEngine(
+            config, params, max_slots=2, max_seq_len=64,
+            prefill_buckets=[16], mesh_config=MeshConfig(tp=4),
+        )
+        sharded.start()
+        r2 = await sharded.generate(
+            [1, 2, 3, 4], SamplingParams(max_new_tokens=6)
+        )
+        sharded.stop()
+        assert r1.tokens == r2.tokens
+
+    asyncio.run(main())

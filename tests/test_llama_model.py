@@ -147,3 +147,38 @@ def test_sharded_params_on_mesh():
 def test_num_params_estimate():
     config = LlamaConfig.llama3_8b()
     assert 7.5e9 < config.num_params() < 8.5e9
+
+
+def test_rope_scaling_matches_hf_llama31():
+    """Llama-3.1-style rope_scaling (NTK-by-parts) must match
+    transformers exactly — positions BEYOND original_max stress the
+    stretched low-frequency band."""
+    import torch
+    from transformers import LlamaConfig as HFLlamaConfig
+    from transformers import LlamaForCausalLM
+
+    hf_config = HFLlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rms_norm_eps=1e-6, rope_theta=10000.0,
+        rope_scaling={
+            "rope_type": "llama3", "factor": 8.0,
+            "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+            "original_max_position_embeddings": 16,
+        },
+        attn_implementation="eager", tie_word_embeddings=False,
+    )
+    torch.manual_seed(4)
+    hf_model = LlamaForCausalLM(hf_config).eval()
+    config, params = load_hf_checkpoint(hf_model, dtype=jnp.float32)
+    assert config.rope_scaling == ("llama3", 8.0, 1.0, 4.0, 16.0)
+
+    prompt = list(range(3, 43))  # 40 tokens >> original_max 16
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor([prompt])).logits[0].numpy()
+    from langstream_tpu.providers.jax_local.model import forward
+
+    logits = forward(config, params, jnp.array([prompt], dtype=jnp.int32))
+    np.testing.assert_allclose(
+        np.asarray(logits)[0], hf_logits, rtol=2e-3, atol=2e-3
+    )

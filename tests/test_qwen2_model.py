@@ -131,3 +131,40 @@ def test_missing_family_params_fail_fast():
     with _pytest.raises(ValueError, match="bq"):
         forward(config, params, jnp.zeros((1, 4), dtype=jnp.int32),
                 freqs=freqs)
+
+
+def test_qwen2_engine_tp2_matches_single_device():
+    """Qwen-2 under tensor parallelism: the q/k/v biases shard over the
+    head axis in lockstep with their projections."""
+    import asyncio
+
+    from langstream_tpu.parallel.mesh import MeshConfig
+    from langstream_tpu.providers.jax_local.engine import (
+        DecodeEngine,
+        SamplingParams,
+    )
+
+    async def main():
+        config = LlamaConfig.tiny_qwen2(max_seq_len=64)
+        params = init_params(config, seed=6)
+        params = dict(params, bq=params["bq"] + 0.2, bk=params["bk"] - 0.1)
+        solo = DecodeEngine(config, params, max_slots=2, max_seq_len=64,
+                            prefill_buckets=[16])
+        solo.start()
+        r1 = await solo.generate(
+            [1, 2, 3, 4], SamplingParams(max_new_tokens=6)
+        )
+        solo.stop()
+
+        sharded = DecodeEngine(
+            config, params, max_slots=2, max_seq_len=64,
+            prefill_buckets=[16], mesh_config=MeshConfig(tp=2),
+        )
+        sharded.start()
+        r2 = await sharded.generate(
+            [1, 2, 3, 4], SamplingParams(max_new_tokens=6)
+        )
+        sharded.stop()
+        assert r1.tokens == r2.tokens
+
+    asyncio.run(main())
