@@ -23,11 +23,36 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+def _intish(v: Any) -> bool:
+    # numeric STRINGS pass: placeholder defaults (`${globals.x:-256}`)
+    # always substitute as strings, and the reference's Jackson-backed
+    # validation (ClassConfigValidator.java:60) coerces them the same way
+    if isinstance(v, str):
+        try:
+            int(v)
+            return True
+        except ValueError:
+            return False
+    return isinstance(v, int) and not isinstance(v, bool)
+
+
+def _numberish(v: Any) -> bool:
+    if isinstance(v, str):
+        try:
+            float(v)
+            return True
+        except ValueError:
+            return False
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
 _TYPE_CHECKS = {
     "string": lambda v: isinstance(v, str),
-    "boolean": lambda v: isinstance(v, bool),
-    "integer": lambda v: isinstance(v, int) and not isinstance(v, bool),
-    "number": lambda v: isinstance(v, (int, float)) and not isinstance(v, bool),
+    "boolean": lambda v: isinstance(v, bool) or (
+        isinstance(v, str) and v.lower() in ("true", "false", "1", "0", "")
+    ),
+    "integer": _intish,
+    "number": _numberish,
     "object": lambda v: isinstance(v, dict),
     "list": lambda v: isinstance(v, (list, tuple)),
     "any": lambda v: True,

@@ -143,7 +143,13 @@ class JaxCompletionsService(CompletionsService):
             params,
             mesh_config=mesh_config,
             max_slots=int(engine_config.get("max-slots", 8)),
-            max_seq_len=engine_config.get("max-seq-len"),
+            # coerce like every other engine knob: placeholder defaults
+            # (`${globals.x:-4096}`) arrive as STRINGS
+            max_seq_len=(
+                int(engine_config["max-seq-len"])
+                if engine_config.get("max-seq-len") is not None
+                else None
+            ),
             prefill_buckets=buckets,
             decode_chunk=int(engine_config.get("decode-chunk", 8)),
             seed=sampling_seed,
