@@ -59,6 +59,10 @@ class ChatCompletionResult:
     # (reference: FlareControllerAgent.java tokens/logprobs fields).
     tokens: Optional[List[str]] = None
     logprobs: Optional[List[float]] = None
+    # per-token top-K alternatives (OpenAI `top_logprobs`): one list of
+    # (token text, logprob) pairs per generated token. Needs the
+    # jax-local engine's `logprobs-top-k` config > 0.
+    top_logprobs: Optional[List[List[tuple]]] = None
 
 
 class StreamingChunksConsumer(abc.ABC):

@@ -587,6 +587,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--no-prefix-cache", action="store_true",
         help="disable cross-slot prompt-prefix KV reuse (on by default)",
     )
+    serve.add_argument(
+        "--logprobs-top-k", type=int, default=0,
+        help="enable OpenAI top_logprobs up to K alternatives per token "
+             "(static — adds a top_k to the serving jits; 0 = off)",
+    )
     serve.add_argument("--embeddings-checkpoint", default=None)
     serve.add_argument("--host", default="0.0.0.0")
     serve.add_argument("--port", type=int, default=8000)

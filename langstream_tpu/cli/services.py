@@ -225,6 +225,7 @@ async def serve_main(args) -> None:
             "precompile": bool(args.precompile),
             "pipeline-decode": not getattr(args, "no_pipeline_decode", False),
             "prefix-cache": not getattr(args, "no_prefix_cache", False),
+            "logprobs-top-k": getattr(args, "logprobs_top_k", 0),
         },
     }
     from langstream_tpu.providers.jax_local.model import LlamaConfig

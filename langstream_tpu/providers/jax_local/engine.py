@@ -176,6 +176,10 @@ class GenerationResult:
     # aligned 1:1 with ``tokens`` (consumed by the FLARE controller;
     # reference: FlareControllerAgent.java logprobs field)
     logprobs: List[float] = dataclasses.field(default_factory=list)
+    # per-token top-K alternatives (OpenAI `top_logprobs`): one
+    # (token_ids, logprobs) pair per generated token, or None when the
+    # engine runs with logprobs_topk=0
+    top_logprobs: Optional[List[Tuple[List[int], List[float]]]] = None
 
 
 @dataclasses.dataclass
@@ -184,6 +188,8 @@ class _Slot:
     length: int = 0                 # valid cache length
     generated: Optional[List[int]] = None
     logprobs: Optional[List[float]] = None  # parallel to ``generated``
+    tops: Optional[List[Tuple[List[int], List[float]]]] = None  # top-K
+                                            # alternatives per token
     history: Optional[List[int]] = None  # full token history in cache
     session_id: Optional[str] = None     # pinned session (slot free but warm)
     last_used: float = 0.0               # monotonic; drives LRU eviction
@@ -227,10 +233,16 @@ class DecodeEngine:
         kv_quant: Optional[str] = None,  # "int8" = int8 KV cache
         pipeline_decode: bool = False,
         prefix_cache: bool = True,
+        logprobs_topk: int = 0,
     ) -> None:
         self.config = config
         self.max_slots = max_slots
         self.decode_chunk = max(1, decode_chunk)
+        # top-K alternative logprobs per generated token (OpenAI
+        # `top_logprobs`). STATIC — it shapes the jit outputs, so 0
+        # (off) keeps the serving graphs byte-identical to a build
+        # without the feature; >0 adds a top_k over the logits per step
+        self.logprobs_topk = max(0, int(logprobs_topk))
         # pipelined decode: dispatch chunk N+1 from chunk N's on-device
         # carry BEFORE host-processing N's tokens, hiding the host (and
         # tunnel) round trip between chunks. Finished slots may burn up
@@ -388,6 +400,7 @@ class DecodeEngine:
         if fn is None:
             config, freqs = self.config, self.freqs
             mesh = self._tp_mesh()
+            topk = self.logprobs_topk
 
             @functools.partial(jax.jit, donate_argnums=(1, 5))
             def run(params, cache, tokens, lengths, slot_ids, counts,
@@ -402,11 +415,12 @@ class DecodeEngine:
                 adjusted = logits.at[rows, bias_ids].add(bias_vals)
                 sampled = _sample(adjusted, temperature, top_k, keys, top_p)
                 lp = _token_logprob(logits, sampled)
+                tops = _top_logprobs(logits, topk) if topk else None
                 # fresh request: reset the slot's penalty counts, then
                 # count the first sampled token
                 counts = counts.at[slot_ids].set(0)
                 counts = counts.at[slot_ids, sampled].add(1)
-                return cache, counts, sampled, lp
+                return cache, counts, sampled, lp, tops
 
             fn = run
             self._compiled_prefill[bucket] = fn
@@ -416,6 +430,7 @@ class DecodeEngine:
         fn = self._prefill_offset_fns.get(bucket)
         if fn is None:
             config, freqs = self.config, self.freqs
+            topk = self.logprobs_topk
 
             @functools.partial(jax.jit, donate_argnums=(1, 6))
             def run(params, cache, tokens, lengths, offsets, slot_ids,
@@ -433,9 +448,10 @@ class DecodeEngine:
                 adjusted = logits.at[rows, bias_ids].add(bias_vals)
                 sampled = _sample(adjusted, temperature, top_k, keys, top_p)
                 lp = _token_logprob(logits, sampled)
+                tops = _top_logprobs(logits, topk) if topk else None
                 counts = counts.at[slot_ids].set(0)
                 counts = counts.at[slot_ids, sampled].add(1)
-                return cache, counts, sampled, lp
+                return cache, counts, sampled, lp, tops
 
             fn = run
             self._prefill_offset_fns[bucket] = fn
@@ -452,6 +468,7 @@ class DecodeEngine:
         if fn is None:
             config, freqs = self.config, self.freqs
             mesh = self._tp_mesh()
+            topk = self.logprobs_topk
 
             @functools.partial(jax.jit, donate_argnums=(1, 6))
             def run(params, cache, tokens, lengths, active, write_mask,
@@ -487,17 +504,29 @@ class DecodeEngine:
                         active.astype(jnp.int32)
                     )
                     lengths = jnp.where(active, lengths + 1, lengths)
-                    return (cache, sampled, lengths, counts), (sampled, lp)
+                    ys = (sampled, lp)
+                    if topk:
+                        ys = ys + _top_logprobs(logits, topk)
+                    return (cache, sampled, lengths, counts), ys
 
                 (
                     (cache, final_tokens, final_lengths, counts),
-                    (out, lps),
+                    ys,
                 ) = jax.lax.scan(
                     body, (cache, tokens, lengths, counts), None, length=steps
                 )
+                out, lps = ys[0], ys[1]
+                # [steps, S, K] -> [S, steps, K] to match out.T's layout
+                tops = (
+                    (ys[2].transpose(1, 0, 2), ys[3].transpose(1, 0, 2))
+                    if topk else None
+                )
                 # final carry is returned ON DEVICE so a pipelined next
                 # chunk can chain without a host round trip
-                return cache, counts, out.T, lps.T, final_tokens, final_lengths
+                return (
+                    cache, counts, out.T, lps.T, tops,
+                    final_tokens, final_lengths,
+                )
 
             fn = run
             self._decode_fns[steps] = fn
@@ -1240,6 +1269,7 @@ class DecodeEngine:
         slot = self.slots[index]
         slot.generated = []
         slot.logprobs = []
+        slot.tops = [] if self.logprobs_topk else None
         slot.history = list(request.prompt_tokens)
         slot.session_id = None
         slot.length = len(request.prompt_tokens)
@@ -1339,7 +1369,7 @@ class DecodeEngine:
             ]
             if self.mirror is not None:
                 self.mirror.publish("prefill", {"bucket": bucket}, host_args)
-            self.cache, self._counts, sampled, lps = run(
+            self.cache, self._counts, sampled, lps, tops = run(
                 self.params, self.cache, *host_args[:3],
                 self._counts, *host_args[3:],
             )
@@ -1349,6 +1379,7 @@ class DecodeEngine:
                 "group": [(index, request) for index, request in group],
                 "sampled": sampled,
                 "lps": lps,
+                "tops": tops,
                 "started": started,
             })
 
@@ -1393,7 +1424,7 @@ class DecodeEngine:
                 self.mirror.publish(
                     "prefill_offset", {"bucket": bucket}, host_args
                 )
-            self.cache, self._counts, sampled, lps = run(
+            self.cache, self._counts, sampled, lps, tops = run(
                 self.params, self.cache, *host_args[:4],
                 self._counts, *host_args[4:],
             )
@@ -1403,6 +1434,7 @@ class DecodeEngine:
                 "group": [(index, request) for index, request, _ in group],
                 "sampled": sampled,
                 "lps": lps,
+                "tops": tops,
                 "started": started,
             })
 
@@ -1451,7 +1483,7 @@ class DecodeEngine:
                 self.mirror.publish(
                     "prefill_offset", {"bucket": bucket}, host_args
                 )
-            self.cache, self._counts, sampled, lps = run(
+            self.cache, self._counts, sampled, lps, tops = run(
                 self.params, self.cache, *host_args[:4],
                 self._counts, *host_args[4:],
             )
@@ -1462,6 +1494,7 @@ class DecodeEngine:
                     "group": [(index, request)],
                     "sampled": sampled,
                     "lps": lps,
+                    "tops": tops,
                     "started": started,
                 })
         self.stats["warm_prefill_calls" if reused else "prefill_calls"] += 1
@@ -1483,11 +1516,20 @@ class DecodeEngine:
             wait_started = time.perf_counter()
             firsts = np.asarray(sampled)
             lps = np.asarray(record["lps"])
+            tops = record.get("tops")
+            if tops is not None:
+                tops = (np.asarray(tops[0]), np.asarray(tops[1]))
             self.stats["prefill_time"] += time.perf_counter() - wait_started
             age = time.perf_counter() - record["started"]
             for row, (index, request) in enumerate(record["group"]):
                 self.slots[index].prefilling = False
-                self._emit_token(index, int(firsts[row]), float(lps[row]))
+                self._emit_token(
+                    index, int(firsts[row]), float(lps[row]),
+                    top=(
+                        (tops[0][row].tolist(), tops[1][row].tolist())
+                        if tops is not None else None
+                    ),
+                )
                 request._prefill_time = age  # type: ignore[attr-defined]
             self._prefill_inflight.pop(0)
             block = False  # only the oldest is worth waiting for
@@ -1587,7 +1629,7 @@ class DecodeEngine:
             active_arg = jnp.asarray(active)
         run = self._get_decode(steps)
         (
-            self.cache, self._counts, out_tokens, out_lps,
+            self.cache, self._counts, out_tokens, out_lps, out_tops,
             final_tokens, final_lengths,
         ) = run(
             self.params, self.cache, tokens_arg, lengths_arg,
@@ -1598,6 +1640,7 @@ class DecodeEngine:
         return {
             "out_tokens": out_tokens,
             "out_lps": out_lps,
+            "out_tops": out_tops,
             "final_tokens": final_tokens,
             "final_lengths": final_lengths,
             "active": active,
@@ -1616,6 +1659,9 @@ class DecodeEngine:
         active = inflight["active"]
         out_host = np.asarray(inflight["out_tokens"])  # [S, steps]
         lps_host = np.asarray(inflight["out_lps"])
+        tops = inflight.get("out_tops")
+        if tops is not None:  # ([S, steps, K] ids, [S, steps, K] lps)
+            tops = (np.asarray(tops[0]), np.asarray(tops[1]))
         ended = time.perf_counter()
         wall = ended - inflight["started"]
         n_active = int(active.sum())
@@ -1649,15 +1695,25 @@ class DecodeEngine:
                     # garbage cache rows beyond it are dead
                     break
                 slot.length += 1
-                self._emit_token(i, int(out_host[i, j]), float(lps_host[i, j]))
+                self._emit_token(
+                    i, int(out_host[i, j]), float(lps_host[i, j]),
+                    top=(
+                        (tops[0][i, j].tolist(), tops[1][i, j].tolist())
+                        if tops is not None else None
+                    ),
+                )
         self.stats["emit_time"] += time.perf_counter() - emit_started
 
-    def _emit_token(self, index: int, token: int, logprob: float = 0.0) -> None:
+    def _emit_token(
+        self, index: int, token: int, logprob: float = 0.0, top=None
+    ) -> None:
         """Record a newly generated token for a slot; finish if stopping."""
         slot = self.slots[index]
         request = slot.request
         slot.generated.append(token)
         slot.logprobs.append(logprob)
+        if slot.tops is not None:
+            slot.tops.append(top if top is not None else ([], []))
         hit_stop = token in request.stop_tokens
         if not hit_stop:
             # stop tokens stay out of the history so a session follow-up
@@ -1687,15 +1743,19 @@ class DecodeEngine:
         request = slot.request
         generated = list(slot.generated)
         logprobs = list(slot.logprobs)
+        tops = list(slot.tops) if slot.tops is not None else None
         if generated and generated[-1] in request.stop_tokens:
             generated = generated[:-1]
             logprobs = logprobs[:-1]
+            if tops is not None:
+                tops = tops[:-1]
         result = GenerationResult(
             tokens=generated,
             prompt_tokens=len(request.prompt_tokens),
             finish_reason=reason,
             prefill_time=getattr(request, "_prefill_time", 0.0),
             logprobs=logprobs,
+            top_logprobs=tops,
         )
         self.stats["requests"] += 1
         # pin the slot for session reuse; otherwise free it fully
@@ -1703,6 +1763,7 @@ class DecodeEngine:
         slot.epoch += 1
         slot.generated = None
         slot.logprobs = None
+        slot.tops = None
         if request.session_id is not None:
             slot.session_id = request.session_id
             slot.last_used = time.monotonic()
@@ -1896,3 +1957,14 @@ def _token_logprob(logits: jnp.ndarray, token: jnp.ndarray) -> jnp.ndarray:
     logits32 = logits.astype(jnp.float32)
     picked = jnp.take_along_axis(logits32, token[:, None], axis=-1)[:, 0]
     return picked - jax.scipy.special.logsumexp(logits32, axis=-1)
+
+
+def _top_logprobs(logits: jnp.ndarray, k: int):
+    """Top-k alternative tokens + logprobs under the RAW untruncated
+    distribution (OpenAI ``top_logprobs``): top_k commutes with the
+    monotonic log_softmax, so rank on logits and normalize the k
+    winners only."""
+    logits32 = logits.astype(jnp.float32)
+    vals, ids = jax.lax.top_k(logits32, k)
+    lse = jax.scipy.special.logsumexp(logits32, axis=-1, keepdims=True)
+    return ids.astype(jnp.int32), vals - lse

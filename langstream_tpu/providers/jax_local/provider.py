@@ -161,6 +161,9 @@ class JaxCompletionsService(CompletionsService):
             prefix_cache=str(
                 engine_config.get("prefix-cache", "true")
             ).lower() not in ("0", "false", "no"),
+            # OpenAI `top_logprobs`: static K per engine (shapes the jit
+            # outputs); requests may ask for any n <= K
+            logprobs_topk=int(engine_config.get("logprobs-top-k", 0) or 0),
         )
         if str(engine_config.get("precompile", "")).lower() in (
             "1", "true", "yes",
@@ -329,6 +332,7 @@ class JaxCompletionsService(CompletionsService):
                     stop_trimmed = True
         kept_tokens = result.tokens
         kept_logprobs = result.logprobs
+        kept_tops = result.top_logprobs
         if stop_cut or stop_trimmed:
             # drop the tokens past the stop so per-token data (logprobs,
             # completion_tokens) aligns with the trimmed content — the
@@ -344,6 +348,8 @@ class JaxCompletionsService(CompletionsService):
                 kept += 1
             kept_tokens = result.tokens[:kept]
             kept_logprobs = result.logprobs[:kept]
+            if kept_tops is not None:
+                kept_tops = kept_tops[:kept]
         if stream_consumer is not None and not last_sent[0]:
             # terminal marker for chunk batchers when the stop token arrived
             # without a trailing streamed delta (on_token is not called for
@@ -370,6 +376,21 @@ class JaxCompletionsService(CompletionsService):
                 if want_logprobs else None
             ),
             logprobs=list(kept_logprobs) if want_logprobs else None,
+            # K × tokens single-token decodes: only when the request
+            # actually asked for alternatives (top-logprobs > 0), not
+            # for every logprobs:true call on an enabled engine
+            top_logprobs=(
+                [
+                    [
+                        (self.tokenizer.decode([int(tid)]), float(tlp))
+                        for tid, tlp in zip(ids, lps)
+                    ]
+                    for ids, lps in kept_tops
+                ]
+                if want_logprobs and kept_tops is not None
+                and int(options.get("top-logprobs") or 0) > 0
+                else None
+            ),
         )
 
     async def close(self) -> None:

@@ -313,13 +313,13 @@ class FollowerExecutor:
         with engine.mesh:
             if kind == "prefill":
                 run = engine._get_prefill(meta["bucket"])
-                engine.cache, engine._counts, _, _ = run(
+                engine.cache, engine._counts, _, _, _ = run(
                     engine.params, engine.cache, *arrays[:3],
                     engine._counts, *arrays[3:],
                 )
             elif kind == "prefill_offset":
                 run = engine._get_prefill_offset(meta["bucket"])
-                engine.cache, engine._counts, _, _ = run(
+                engine.cache, engine._counts, _, _, _ = run(
                     engine.params, engine.cache, *arrays[:4],
                     engine._counts, *arrays[4:],
                 )
@@ -343,7 +343,8 @@ class FollowerExecutor:
         engine = self.engine
         run = engine._get_decode(steps)
         (
-            engine.cache, engine._counts, _, _, final_tokens, final_lengths,
+            engine.cache, engine._counts, _, _, _,
+            final_tokens, final_lengths,
         ) = run(
             engine.params, engine.cache, tokens, lengths, active, active,
             engine._counts, *sampling,

@@ -48,9 +48,27 @@ def _error(status: int, message: str) -> web.Response:
     )
 
 
-def _options_from_request(body: Dict[str, Any], model: str) -> Dict[str, Any]:
-    """OpenAI request params → ServiceProvider option names."""
+def _options_from_request(
+    body: Dict[str, Any], model: str, topk_limit: int = 0
+) -> Dict[str, Any]:
+    """OpenAI request params → ServiceProvider option names. Raises
+    ValueError (→ HTTP 400) on malformed values so bad requests fail
+    BEFORE burning a generation."""
     options: Dict[str, Any] = {"model": body.get("model") or model}
+    if body.get("top_logprobs") is not None:
+        try:
+            n_top = int(body["top_logprobs"])
+        except (TypeError, ValueError):
+            raise ValueError("top_logprobs must be an integer") from None
+        if not 0 <= n_top <= 20:
+            raise ValueError("top_logprobs must be between 0 and 20")
+        if n_top > topk_limit:
+            raise ValueError(
+                f"top_logprobs={n_top} exceeds this server's limit of "
+                f"{topk_limit} (start the server with --logprobs-top-k "
+                f">= {n_top})"
+            )
+        body = dict(body, top_logprobs=n_top)
     mapping = {
         "temperature": "temperature",
         "top_p": "top-p",
@@ -61,6 +79,7 @@ def _options_from_request(body: Dict[str, Any], model: str) -> Dict[str, Any]:
         "presence_penalty": "presence-penalty",
         "frequency_penalty": "frequency-penalty",
         "logprobs": "logprobs",
+        "top_logprobs": "top-logprobs",
         "seed": "seed",
     }
     if body.get("logit_bias") is not None:
@@ -97,6 +116,13 @@ class OpenAIApiServer:
     ) -> None:
         self.completions = completions
         self.embeddings = embeddings
+        # the engine's static top-K ceiling (0 = feature off): requests
+        # asking for more are rejected with 400 up front instead of
+        # silently truncated after a full generation
+        self._topk_limit = int(
+            getattr(getattr(completions, "engine", None), "logprobs_topk", 0)
+            or 0
+        )
         self.model = model
         self.host = host
         self.port = port
@@ -193,7 +219,9 @@ class OpenAIApiServer:
             prompt_texts = [str(prompt)]
             messages = []
         try:
-            options = _options_from_request(body, self.model)
+            options = _options_from_request(
+                body, self.model, topk_limit=self._topk_limit
+            )
         except (ValueError, TypeError) as error:
             return _error(400, f"invalid request parameter: {error}")
 
@@ -265,10 +293,40 @@ class OpenAIApiServer:
                 else:
                     choice["text"] = result.content
                 if result.logprobs is not None:
-                    choice["logprobs"] = {
+                    logprobs_block: Dict[str, Any] = {
                         "tokens": result.tokens,
                         "token_logprobs": result.logprobs,
                     }
+                    n_top = int(options.get("top-logprobs") or 0)
+                    if result.top_logprobs is not None and n_top > 0:
+                        if chat:
+                            # chat.completion format: content entries
+                            # with ranked alternatives per position
+                            logprobs_block["content"] = [
+                                {
+                                    "token": tok,
+                                    "logprob": lp,
+                                    "top_logprobs": [
+                                        {"token": t2, "logprob": lp2}
+                                        for t2, lp2 in tops[:n_top]
+                                    ],
+                                }
+                                for tok, lp, tops in zip(
+                                    result.tokens, result.logprobs,
+                                    result.top_logprobs,
+                                )
+                            ]
+                        else:
+                            # legacy text_completion format: a
+                            # {token: logprob} dict per position,
+                            # parallel to `tokens`
+                            logprobs_block["top_logprobs"] = [
+                                {
+                                    t2: lp2 for t2, lp2 in tops[:n_top]
+                                }
+                                for tops in result.top_logprobs
+                            ]
+                    choice["logprobs"] = logprobs_block
                 choices.append(choice)
             completion_tokens = sum(r.completion_tokens for r in results)
             return web.json_response({

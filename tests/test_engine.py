@@ -1372,3 +1372,54 @@ def test_prefix_copy_from_actively_decoding_slot():
             engine.stop()
 
     asyncio.run(main())
+
+
+def test_top_logprobs_greedy():
+    """logprobs_topk=K returns K ranked alternatives per generated token
+    (prefill first token AND decode steps); under greedy sampling the
+    emitted token must be rank 1 with its logprob matching, and an
+    engine without the knob returns None (and unchanged jit arity)."""
+
+    async def main():
+        config = LlamaConfig.tiny(max_seq_len=64)
+        params = init_params(config, seed=11)
+        engine = DecodeEngine(
+            config, params, max_slots=2, max_seq_len=64,
+            prefill_buckets=[16], decode_chunk=4, logprobs_topk=3,
+        )
+        engine.start()
+        try:
+            result = await engine.generate(
+                [1, 2, 3, 4, 5], SamplingParams(
+                    temperature=0.0, max_new_tokens=6
+                ),
+            )
+        finally:
+            engine.stop()
+        assert result.top_logprobs is not None
+        assert len(result.top_logprobs) == len(result.tokens)
+        for token, logprob, (ids, lps) in zip(
+            result.tokens, result.logprobs, result.top_logprobs
+        ):
+            assert len(ids) == 3 and len(lps) == 3
+            assert ids[0] == token          # greedy -> rank 1
+            assert abs(lps[0] - logprob) < 1e-4
+            assert lps[0] >= lps[1] >= lps[2]
+
+        plain = DecodeEngine(
+            config, params, max_slots=2, max_seq_len=64,
+            prefill_buckets=[16], decode_chunk=4,
+        )
+        plain.start()
+        try:
+            result2 = await plain.generate(
+                [1, 2, 3, 4, 5], SamplingParams(
+                    temperature=0.0, max_new_tokens=6
+                ),
+            )
+        finally:
+            plain.stop()
+        assert result2.top_logprobs is None
+        assert result2.tokens == result.tokens  # knob is observability-only
+
+    asyncio.run(main())

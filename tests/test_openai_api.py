@@ -19,7 +19,8 @@ def server_port():
     loop = asyncio.new_event_loop()
     completions = JaxCompletionsService({
         "model": {"preset": "tiny", "max_seq_len": 256},
-        "engine": {"max-slots": 2, "max-seq-len": 256},
+        "engine": {"max-slots": 2, "max-seq-len": 256,
+                   "logprobs-top-k": 3},
     })
     embeddings = JaxEmbeddingsService({}, None)
     from langstream_tpu.providers.jax_local.engine import (
@@ -329,3 +330,57 @@ def test_bad_requests(server_port):
     assert status == 400
     status, _ = _call(loop, _post(port, "/v1/completions", {}))
     assert status == 400
+
+
+def test_chat_top_logprobs(server_port):
+    """OpenAI `top_logprobs`: chat-style content entries with up to N
+    ranked alternatives per token (engine runs with logprobs-top-k=3,
+    the request asks for 2)."""
+    loop, port = server_port
+    status, body = _call(loop, _post(port, "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "hello"}],
+        "max_tokens": 5, "temperature": 0.0,
+        "logprobs": True, "top_logprobs": 2,
+    }))
+    assert status == 200, body
+    lp = body["choices"][0]["logprobs"]
+    content = lp["content"]
+    assert len(content) == 5
+    for entry in content:
+        assert isinstance(entry["token"], str)
+        assert entry["logprob"] <= 0
+        tops = entry["top_logprobs"]
+        assert len(tops) == 2
+        # rank 1 is the greedy-sampled token itself
+        assert abs(tops[0]["logprob"] - entry["logprob"]) < 1e-4
+        assert tops[0]["logprob"] >= tops[1]["logprob"]
+
+
+def test_top_logprobs_validation_and_legacy_format(server_port):
+    loop, port = server_port
+    # over the server's static K -> 400 BEFORE generating
+    status, body = _call(loop, _post(port, "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "x"}],
+        "max_tokens": 2, "logprobs": True, "top_logprobs": 5,
+    }))
+    assert status == 400 and "logprobs-top-k" in body["error"]["message"]
+    # non-integer -> 400
+    status, body = _call(loop, _post(port, "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "x"}],
+        "max_tokens": 2, "logprobs": True, "top_logprobs": "two",
+    }))
+    assert status == 400
+    # legacy /v1/completions: list of {token: logprob} dicts per position
+    status, body = _call(loop, _post(port, "/v1/completions", {
+        "prompt": "hi", "max_tokens": 3, "temperature": 0.0,
+        "logprobs": True, "top_logprobs": 2,
+    }))
+    assert status == 200, body
+    lp = body["choices"][0]["logprobs"]
+    assert "content" not in lp
+    assert len(lp["top_logprobs"]) == 3
+    assert all(
+        isinstance(d, dict) and len(d) <= 2 and
+        all(isinstance(v, float) for v in d.values())
+        for d in lp["top_logprobs"]
+    )
