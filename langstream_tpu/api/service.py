@@ -77,6 +77,11 @@ class StreamingChunksConsumer(abc.ABC):
 class CompletionsService(abc.ABC):
     """Chat + text completions (``CompletionsService.java:22``)."""
 
+    # max top_logprobs alternatives this service can return per token
+    # (0 = unsupported). Implementations that support the feature set
+    # it; the OpenAI HTTP layer validates requests against it.
+    top_logprobs_limit: int = 0
+
     @abc.abstractmethod
     async def get_chat_completions(
         self,

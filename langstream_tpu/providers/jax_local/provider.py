@@ -165,6 +165,7 @@ class JaxCompletionsService(CompletionsService):
             # outputs); requests may ask for any n <= K
             logprobs_topk=int(engine_config.get("logprobs-top-k", 0) or 0),
         )
+        self.top_logprobs_limit = self.engine.logprobs_topk
         if str(engine_config.get("precompile", "")).lower() in (
             "1", "true", "yes",
         ):

@@ -62,6 +62,10 @@ def _options_from_request(
             raise ValueError("top_logprobs must be an integer") from None
         if not 0 <= n_top <= 20:
             raise ValueError("top_logprobs must be between 0 and 20")
+        if n_top > 0 and not body.get("logprobs"):
+            # OpenAI 400s this combination; silently generating and
+            # returning no logprobs block would waste the whole request
+            raise ValueError("top_logprobs requires logprobs: true")
         if n_top > topk_limit:
             raise ValueError(
                 f"top_logprobs={n_top} exceeds this server's limit of "
@@ -116,12 +120,13 @@ class OpenAIApiServer:
     ) -> None:
         self.completions = completions
         self.embeddings = embeddings
-        # the engine's static top-K ceiling (0 = feature off): requests
+        # the service's static top-K ceiling (0 = feature off): requests
         # asking for more are rejected with 400 up front instead of
-        # silently truncated after a full generation
+        # silently truncated after a full generation. The limit lives on
+        # the CompletionsService interface (top_logprobs_limit) so any
+        # implementation can advertise it — not a provider-private attr.
         self._topk_limit = int(
-            getattr(getattr(completions, "engine", None), "logprobs_topk", 0)
-            or 0
+            getattr(completions, "top_logprobs_limit", 0) or 0
         )
         self.model = model
         self.host = host

@@ -1,6 +1,10 @@
 #!/bin/bash
 # Watch the axon relay; when the TPU comes back, re-run the bench and
 # store the result. Safe to leave running — exits after one success.
+# Every bench here runs with BENCH_YIELD=1: if the driver's own
+# end-of-round bench starts, it takes the chip over (kills our run);
+# our runs never preempt it.
+export BENCH_YIELD=1
 cd "$(dirname "$0")/.." || exit 1
 LOG=${TPU_HEAL_LOG:-/tmp/tpu_heal.log}
 OUT=${TPU_HEAL_OUT:-/tmp/bench_heal.json}
