@@ -349,6 +349,13 @@ def claim_chip() -> None:
     import fcntl
     import signal
 
+    if os.environ.get("JAX_PLATFORMS") and not any(
+        name in os.environ["JAX_PLATFORMS"] for name in ("tpu", "axon")
+    ):
+        # CPU smoke runs never touch the chip: they must neither hold
+        # the lock nor preempt a live TPU bench
+        return
+
     global _CHIP_LOCK_FD
     fd = os.open(_CHIP_LOCK_FILE, os.O_RDWR | os.O_CREAT, 0o666)
 
@@ -373,23 +380,16 @@ def claim_chip() -> None:
         return
     except OSError:
         pass
-    holder = read_holder()
     if YIELD:
+        holder = read_holder()
         log(f"chip busy (held by {holder}); yielding")
         emit_failure(f"yielded the chip to {holder}")
         sys.exit(5)
-    # a non-yield (driver) bench preempts a yield holder
-    if holder and holder[2] == "yield":
-        pid = int(holder[0])
-        if _proc_start_token(pid) == holder[1]:
-            log(f"taking the chip over from watcher bench pid {pid}")
-            try:
-                os.kill(pid, signal.SIGTERM)
-            except OSError:
-                pass
-    # wait for the lock to release (yield holder dying frees it
-    # atomically); a main-vs-main conflict also resolves here
-    deadline = time.monotonic() + 60
+    # non-yield (driver) bench: preempt yield holders until the lock is
+    # ours. The kill is re-evaluated EVERY iteration — the watcher runs
+    # its bench legs back to back, so a fresh yield holder can appear
+    # right after the previous one dies and must also be preempted.
+    deadline = time.monotonic() + 180
     while time.monotonic() < deadline:
         try:
             fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
@@ -397,7 +397,17 @@ def claim_chip() -> None:
             _CHIP_LOCK_FD = fd
             return
         except OSError:
-            time.sleep(0.5)
+            pass
+        holder = read_holder()
+        if holder and holder[2] == "yield":
+            pid = int(holder[0])
+            if _proc_start_token(pid) == holder[1]:
+                log(f"taking the chip over from watcher bench pid {pid}")
+                try:
+                    os.kill(pid, signal.SIGTERM)
+                except OSError:
+                    pass
+        time.sleep(0.5)
     log("chip lock never released; proceeding anyway (best effort)")
 
 

@@ -9,13 +9,24 @@ cd "$(dirname "$0")/.." || exit 1
 LOG=${TPU_HEAL_LOG:-/tmp/tpu_heal.log}
 OUT=${TPU_HEAL_OUT:-/tmp/bench_heal.json}
 echo "$(date -u +%FT%TZ) watcher started" >> "$LOG"
+LOCKFILE=/tmp/langstream_bench_chip.lock
 while true; do
+    # never probe while a bench holds the chip (the driver's
+    # end-of-round run must not share HBM with even a 256 MB probe)
+    if [ -e "$LOCKFILE" ] && ! flock -n "$LOCKFILE" true 2>/dev/null; then
+        echo "$(date -u +%FT%TZ) chip held by a bench; skipping probe" >> "$LOG"
+        sleep 300
+        continue
+    fi
     # probe with a REAL transfer + matmul: the wedged-relay failure mode
     # keeps tiny-op RTT at microseconds while bulk transfers hang (seen
     # round 3: dispatch p50 0.1 ms, 8 GB weight init stuck >40 min), so
     # a 4-element probe green-lights a dead window. 256 MB up + a
     # [2048]^2 matmul must round-trip inside the timeout.
-    if timeout 120 python -c "
+    # the probe HOLDS the chip lock for its duration (flock runs the
+    # child under the lock) — a driver bench starting mid-probe waits
+    # in claim_chip instead of sharing HBM with it
+    if flock -n "$LOCKFILE" timeout 120 python -c "
 import numpy as np, jax, jax.numpy as jnp
 x = jax.device_put(np.ones((8192, 8192), np.float32))  # 256 MB
 y = jax.jit(lambda a: (a[:2048, :2048] @ a[:2048, :2048]).sum())(x)
