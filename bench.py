@@ -60,7 +60,12 @@ COMPILE_ONLY = os.environ.get("BENCH_COMPILE_ONLY", "") not in ("", "0")
 # any live yield run at startup; a yield bench refuses to start while a
 # non-yield one is alive.
 YIELD = os.environ.get("BENCH_YIELD", "") not in ("", "0")
-_CHIP_LOCK_FILE = "/tmp/langstream_bench_chip.lock"
+# single source shared with tools/tpu_heal_watch.sh via the env var —
+# two hardcoded copies of this path would drift and silently disable
+# the mutual exclusion
+_CHIP_LOCK_FILE = os.environ.get(
+    "LANGSTREAM_CHIP_LOCK", "/tmp/langstream_bench_chip.lock"
+)
 # int8 KV cache ("int8" | "" = bf16 cache) — the e2e A/B knob for the
 # engine's kv-quant option
 KV_QUANT = os.environ.get("BENCH_KV_QUANT", "") or None

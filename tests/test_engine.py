@@ -400,12 +400,15 @@ def test_provider_end_to_end():
 
 
 @pytest.mark.slow
-def test_engine_fuzz_interleavings():
+@pytest.mark.parametrize("topk", [0, 2])
+def test_engine_fuzz_interleavings(topk):
     """Soak the whole loop at once: pipelined dispatch, staggered
     arrivals, session reuse under slot pressure, long prompts through
     chunked prefill, random sampling params, and cancellations racing
-    admission. Every future must resolve; every uncancelled result must
-    be non-empty and within budget; the engine must stay serviceable."""
+    admission — with and without logprobs_topk, whose extra jit
+    outputs must survive every path. Every future must resolve; every
+    uncancelled result must be non-empty and within budget; the engine
+    must stay serviceable."""
     import random
 
     config = LlamaConfig.tiny(max_seq_len=192)
@@ -416,7 +419,7 @@ def test_engine_fuzz_interleavings():
         engine = DecodeEngine(
             config, params, max_slots=3, max_seq_len=192,
             prefill_buckets=[16, 32], decode_chunk=4,
-            pipeline_decode=True,
+            pipeline_decode=True, logprobs_topk=topk,
         )
         engine.start()
 
@@ -454,6 +457,14 @@ def test_engine_fuzz_interleavings():
             if result.finish_reason != "cancelled":
                 assert 0 < len(result.tokens) <= sampling.max_new_tokens
                 assert len(result.logprobs) == len(result.tokens)
+                if topk:
+                    assert len(result.top_logprobs) == len(result.tokens)
+                    assert all(
+                        len(ids) == topk and len(lps) == topk
+                        for ids, lps in result.top_logprobs
+                    )
+                else:
+                    assert result.top_logprobs is None
             return result
 
         try:

@@ -5,19 +5,15 @@
 # end-of-round bench starts, it takes the chip over (kills our run);
 # our runs never preempt it.
 export BENCH_YIELD=1
+# single source of truth for the chip lock path (bench.py reads the
+# same env var; drift would silently disable the mutual exclusion)
+export LANGSTREAM_CHIP_LOCK=${LANGSTREAM_CHIP_LOCK:-/tmp/langstream_bench_chip.lock}
 cd "$(dirname "$0")/.." || exit 1
 LOG=${TPU_HEAL_LOG:-/tmp/tpu_heal.log}
 OUT=${TPU_HEAL_OUT:-/tmp/bench_heal.json}
 echo "$(date -u +%FT%TZ) watcher started" >> "$LOG"
-LOCKFILE=/tmp/langstream_bench_chip.lock
+LOCKFILE=$LANGSTREAM_CHIP_LOCK
 while true; do
-    # never probe while a bench holds the chip (the driver's
-    # end-of-round run must not share HBM with even a 256 MB probe)
-    if [ -e "$LOCKFILE" ] && ! flock -n "$LOCKFILE" true 2>/dev/null; then
-        echo "$(date -u +%FT%TZ) chip held by a bench; skipping probe" >> "$LOG"
-        sleep 300
-        continue
-    fi
     # probe with a REAL transfer + matmul: the wedged-relay failure mode
     # keeps tiny-op RTT at microseconds while bulk transfers hang (seen
     # round 3: dispatch p50 0.1 ms, 8 GB weight init stuck >40 min), so
@@ -25,12 +21,23 @@ while true; do
     # [2048]^2 matmul must round-trip inside the timeout.
     # the probe HOLDS the chip lock for its duration (flock runs the
     # child under the lock) — a driver bench starting mid-probe waits
-    # in claim_chip instead of sharing HBM with it
-    if flock -n "$LOCKFILE" timeout 120 python -c "
+    # in claim_chip instead of sharing HBM with it. -E 247
+    # distinguishes "chip held by a bench" from a dead TPU (no TOCTOU
+    # pre-check); timeout -k SIGKILLs a probe stuck in an
+    # uninterruptible transfer so a wedged probe can't pin the lock
+    # and wedge the watcher forever
+    flock -n -E 247 "$LOCKFILE" timeout -k 10 120 python -c "
 import numpy as np, jax, jax.numpy as jnp
 x = jax.device_put(np.ones((8192, 8192), np.float32))  # 256 MB
 y = jax.jit(lambda a: (a[:2048, :2048] @ a[:2048, :2048]).sum())(x)
-y.block_until_ready()" 2>/dev/null; then
+y.block_until_ready()" 2>/dev/null
+    PROBE_RC=$?
+    if [ "$PROBE_RC" = 247 ]; then
+        echo "$(date -u +%FT%TZ) chip held by a bench; skipping probe" >> "$LOG"
+        sleep 300
+        continue
+    fi
+    if [ "$PROBE_RC" = 0 ]; then
         echo "$(date -u +%FT%TZ) TPU responsive (bulk probe) — warming compile cache" >> "$LOG"
         # compile-only first: no weight init, lower+compile every e2e
         # variant with 8 workers — a short relay window lands cache
