@@ -9,8 +9,13 @@ export BENCH_YIELD=1
 # same env var; drift would silently disable the mutual exclusion)
 export LANGSTREAM_CHIP_LOCK=${LANGSTREAM_CHIP_LOCK:-/tmp/langstream_bench_chip.lock}
 cd "$(dirname "$0")/.." || exit 1
-LOG=${TPU_HEAL_LOG:-/tmp/tpu_heal.log}
-OUT=${TPU_HEAL_OUT:-/tmp/bench_heal.json}
+# artifacts live IN THE REPO: /tmp dies with the machine, but the
+# driver auto-commits uncommitted work at round end, so results landing
+# after the build session's last turn still reach the next round
+ARTDIR=$(pwd)/bench_artifacts
+mkdir -p "$ARTDIR"
+LOG=${TPU_HEAL_LOG:-$ARTDIR/tpu_heal.log}
+OUT=${TPU_HEAL_OUT:-$ARTDIR/bench_heal.json}
 echo "$(date -u +%FT%TZ) watcher started" >> "$LOG"
 LOCKFILE=$LANGSTREAM_CHIP_LOCK
 while true; do
@@ -96,7 +101,8 @@ y.block_until_ready()" 2>/dev/null
             if BENCH_TRACE=1 BENCH_ROUNDS=1 BENCH_DEADLINE=2400 \
                 BENCH_INIT_TIMEOUT=600 \
                 python bench.py > "${OUT%.json}_trace.json" 2>> "$LOG"; then
-                echo "$(date -u +%FT%TZ) traced run done (trace at /tmp/bench_e2e_trace.json)" >> "$LOG"
+                cp /tmp/bench_e2e_trace.json "$ARTDIR/" 2>/dev/null
+                echo "$(date -u +%FT%TZ) traced run done (trace in $ARTDIR)" >> "$LOG"
             else
                 echo "$(date -u +%FT%TZ) traced run failed (non-fatal)" >> "$LOG"
             fi
