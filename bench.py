@@ -1,6 +1,11 @@
 """Benchmark: pipeline tokens/sec through runner + broker + gateway.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+Prints JSON result lines; **the LAST line is the result**. A healthy run
+ends with exactly one final line {"metric", "value", "unit",
+"vs_baseline", ...}. Before that, the bench may print ``provisional``
+lines (warmup-derived engine rate, mid-measure e2e estimates) so an
+attempt killed mid-window still leaves a nonzero artifact as its last
+stdout line; failure records never print after any provisional success.
 Runs on whatever accelerator JAX finds (the driver runs it on one real TPU
 chip).
 
@@ -109,12 +114,30 @@ def log(*args):
 # infra hang (backend-init) is distinguishable from a code failure
 # (measure) in the driver artifact alone
 _PHASE = "start"
+_PHASE_T0 = _START
+# per-phase wall-clock (seconds), carried in every emitted record: the
+# warm-attempt critical path is an explicit engineering target (≤3 min
+# to first emitted number), so the artifact itself must show where the
+# seconds went
+_TIMINGS: dict = {}
 
 
 def phase(name: str) -> None:
-    global _PHASE
+    global _PHASE, _PHASE_T0
+    now = time.monotonic()
+    _TIMINGS[_PHASE] = round(_TIMINGS.get(_PHASE, 0.0) + (now - _PHASE_T0), 1)
     _PHASE = name
-    log(f"[phase] {name} (t+{time.monotonic() - _START:.0f}s)")
+    _PHASE_T0 = now
+    log(f"[phase] {name} (t+{now - _START:.0f}s)")
+
+
+def timings() -> dict:
+    """Snapshot of per-phase seconds including the in-flight phase."""
+    out = dict(_TIMINGS)
+    out[_PHASE] = round(
+        out.get(_PHASE, 0.0) + (time.monotonic() - _PHASE_T0), 1
+    )
+    return out
 
 
 def roofline(
@@ -150,6 +173,13 @@ def roofline(
     }
 
 
+def metric_suffix() -> str:
+    """Model/quant suffix shared by every metric id builder — the
+    suffix scheme must never be able to drift between the final line,
+    failure records, and provisional lines."""
+    return MODEL_PRESET.replace("-", "_") + (f"_{QUANT}" if QUANT else "")
+
+
 def metric_name() -> str:
     """One place for the artifact's metric id: mode-correct prefix +
     model/quant suffix (three emit sites used to rebuild it by hand)."""
@@ -157,8 +187,13 @@ def metric_name() -> str:
         "e2e_gateway_output_tok_per_s_per_chip"
         if MODE == "e2e" else "decode_output_tok_per_s_per_chip"
     )
-    suffix = MODEL_PRESET.replace("-", "_") + (f"_{QUANT}" if QUANT else "")
-    return f"{prefix}_{suffix}"
+    return f"{prefix}_{metric_suffix()}"
+
+
+# any nonzero result already on stdout? Provisional successes count:
+# once one is out, a failure record must never follow it (the driver
+# parses the LAST line — a trailing zero would clobber a real number)
+_EMITTED_SUCCESS = False
 
 
 def emit_failure(reason: str) -> bool:
@@ -172,11 +207,40 @@ def emit_failure(reason: str) -> bool:
     )
 
 
+def emit_provisional(metric: str, tok_s: float, **extra) -> None:
+    """Incremental result line BEFORE the measurement is final: a relay
+    window that dies mid-measure still leaves a nonzero artifact as the
+    last stdout line (VERDICT r4 #1c). Marked ``provisional`` so a
+    driver-captured partial is distinguishable from a finished run.
+    Repeatable — each call refreshes the estimate; the final
+    emit_success supersedes them all as the true last line."""
+    global _EMITTED_SUCCESS
+    if _EMITTED.locked():  # a final line is already out — never follow it
+        return
+    if tok_s <= 0:
+        return
+    line = {
+        "metric": metric,
+        "value": round(tok_s, 1),
+        "unit": "tok/s",
+        "vs_baseline": round(tok_s / BASELINE_TOK_S, 3),
+        "provisional": True,
+        "phase": _PHASE,
+        "timings_s": timings(),
+        # same identifying field as emit_failure: a dead A/B leg whose
+        # last line is a provisional must stay attributable to its leg
+        "decode_kernel": os.environ.get("LS_DECODE_FLASH", "") or "auto",
+    }
+    line.update(extra)
+    print(json.dumps(line), flush=True)
+    _EMITTED_SUCCESS = True
+
+
 def emit_success(tok_s: float, extras: dict) -> None:
     """Emit the result THE MOMENT the measurement is final: teardown
     after this point can hang on a dead tunnel without costing the
-    number (emit is once-per-process, so the late call in main() and
-    any monitor/watchdog failure record become no-ops)."""
+    number (the final emit is once-per-process, so the late call in
+    main() and any monitor/watchdog failure record become no-ops)."""
     emit(
         metric_name(),
         round(tok_s, 1),
@@ -186,7 +250,13 @@ def emit_success(tok_s: float, extras: dict) -> None:
 
 
 def emit(metric: str, value: float, vs_baseline: float, **extra) -> bool:
-    """Print the single JSON result line (at most once per process)."""
+    """Print the final JSON result line (at most once per process).
+    Failure records (value 0) additionally refuse to print after any
+    provisional success — the last stdout line must stay nonzero."""
+    global _EMITTED_SUCCESS
+    if value <= 0 and _EMITTED_SUCCESS:
+        log(f"suppressing zero record after provisional success: {extra}")
+        return False
     if not _EMITTED.acquire(blocking=False):
         return False
     line = {
@@ -194,9 +264,12 @@ def emit(metric: str, value: float, vs_baseline: float, **extra) -> bool:
         "value": value,
         "unit": "tok/s",
         "vs_baseline": vs_baseline,
+        "timings_s": timings(),
     }
     line.update(extra)
     print(json.dumps(line), flush=True)
+    if value > 0:
+        _EMITTED_SUCCESS = True
     return True
 
 
@@ -255,10 +328,16 @@ def _tunnel_monitor() -> None:
                 "immediately closes for 120s — upstream pool "
                 "connection down (infra)"
             )
-            if emitted:
+            if emitted or not _EMITTED.locked():
+                # either the failure record went out, or it was
+                # suppressed because a PROVISIONAL success is already
+                # the last stdout line — in both cases the process is
+                # wedged on a dead tunnel and must die now, not at the
+                # watchdog deadline (the provisional stands as the
+                # artifact)
                 os._exit(4)
-            # the result line already went out — the run succeeded;
-            # never clobber its exit status from this thread
+            # the FINAL result line already went out — the run
+            # succeeded; never clobber its exit status from this thread
             return
 
 
@@ -413,7 +492,64 @@ def claim_chip() -> None:
                 except OSError:
                     pass
         time.sleep(0.5)
-    log("chip lock never released; proceeding anyway (best effort)")
+    # the holder is another NON-yield bench (or a kill-immune process):
+    # proceeding would put two 8B engines on one 16 GB chip and OOM the
+    # very driver run this protocol protects — fail fast with the holder
+    # identified instead (ADVICE r4)
+    holder = read_holder()
+    emit_failure(
+        f"chip lock held by non-yield process {holder} after 180s; "
+        "refusing to share the chip"
+    )
+    sys.exit(6)
+
+
+def prune_compile_cache(cache_dir: str) -> None:
+    """Drop corrupt persistent-cache entries before JAX reads them.
+
+    A bench attempt killed mid-write (relay death, watchdog, chip
+    preemption) leaves a truncated zstd frame; JAX then logs
+    ``ZstdError: did not decompress full frame`` and silently
+    RE-COMPILES exactly the big graphs the warm-first strategy exists
+    to protect (VERDICT r4 weak #2, bench_artifacts/tpu_heal_early.log).
+    Read-test every entry end to end and unlink the ones that fail —
+    losing one entry costs one compile; keeping it costs the warm path."""
+    try:
+        import zstandard
+    except ImportError:  # cache then stores raw bytes; nothing to verify
+        return
+    t0 = time.perf_counter()
+    pruned = total = 0
+    try:
+        names = os.listdir(cache_dir)
+    except OSError:
+        return
+    for name in names:
+        path = os.path.join(cache_dir, name)
+        if not os.path.isfile(path):
+            continue
+        total += 1
+        try:
+            # streaming decompressobj + eof check: read_to_iter treats a
+            # TRUNCATED frame as "awaiting more data" and ends cleanly,
+            # which is exactly the corruption mode to catch
+            obj = zstandard.ZstdDecompressor().decompressobj()
+            with open(path, "rb") as handle:
+                while chunk := handle.read(1 << 20):
+                    obj.decompress(chunk)
+            if not obj.eof:
+                raise ValueError("truncated zstd frame (no end-of-frame)")
+        except Exception as error:  # noqa: BLE001 — any failure = corrupt
+            pruned += 1
+            log(f"pruning corrupt cache entry {name}: {error!r}")
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+    log(
+        f"compile cache verified: {total - pruned}/{total} entries good"
+        f" ({time.perf_counter() - t0:.1f}s)"
+    )
 
 
 def probe_backend() -> str:
@@ -452,6 +588,8 @@ def probe_backend() -> str:
                 cache_dir = base.rstrip("/") + "/" + result["platform"]
                 if "://" not in base:  # gs:// etc: no local mkdir
                     os.makedirs(cache_dir, exist_ok=True)
+                    # interrupted attempts must not poison the warm path
+                    prune_compile_cache(cache_dir)
                 jax.config.update("jax_compilation_cache_dir", cache_dir)
                 jax.config.update(
                     "jax_persistent_cache_min_compile_time_secs", 1.0
@@ -496,10 +634,10 @@ async def run_bench():
     t0 = time.perf_counter()
     if QUANT == "int8":
         from langstream_tpu.providers.jax_local.quant import (
-            init_quantized_params,
+            init_quantized_params_cached,
         )
 
-        params = init_quantized_params(config, seed=0)
+        params = init_quantized_params_cached(config, seed=0)
     else:
         params = model_lib.init_params(config, seed=0)
     engine = DecodeEngine(
@@ -696,7 +834,7 @@ async def _drive_e2e(runner, gateway, port, engine):
     # pipeline — the round-4 smoke hang)
     question_pad = "x" * max(1, PROMPT_LEN - TEMPLATE_TOKENS)
 
-    async def client(index: int, rounds: int, rtts: list) -> None:
+    async def client(index: int, rounds: int, rtts: list, ttfts: list) -> None:
         url = (
             f"ws://127.0.0.1:{port}/v1/chat/default/{app_id}/chat"
             f"?param:session-id=bench-{index}"
@@ -704,30 +842,68 @@ async def _drive_e2e(runner, gateway, port, engine):
         async with websockets.connect(url, max_size=None) as ws:
             for round_index in range(rounds):
                 started = time.perf_counter()
+                first_chunk = None
                 await ws.send(json.dumps(
                     {"value": f"q{index}-{round_index} {question_pad}"}
                 ))
                 async for frame in ws:
+                    if first_chunk is None:
+                        first_chunk = time.perf_counter() - started
                     message = json.loads(frame)
                     headers = message.get("record", {}).get("headers", {})
                     if headers.get("stream-last-message") == "true":
                         break
                 rtts.append(time.perf_counter() - started)
+                if first_chunk is not None:
+                    ttfts.append(first_chunk)
 
     t0 = time.perf_counter()
     warm_rtts: list = []
+    warm_ttfts: list = []
     await asyncio.gather(
-        *[client(i, 2, warm_rtts) for i in range(CLIENTS)]
+        *[client(i, 2, warm_rtts, warm_ttfts) for i in range(CLIENTS)]
     )
     log(f"warmup (compile): {time.perf_counter() - t0:.1f}s")
+    # first nonzero artifact of the attempt: the engine's raw decode
+    # capability measured by the warmup itself — a window that dies in
+    # the measured phase still lands this line (VERDICT r4 #1c)
+    warm_stats = dict(engine.stats)
+    if warm_stats.get("decode_time"):
+        emit_provisional(
+            f"raw_engine_decode_tok_per_s_per_chip_{metric_suffix()}",
+            warm_stats["tokens_generated"] / warm_stats["decode_time"],
+            kv_cache=KV_QUANT or "bf16",
+            note="warmup-derived raw decode rate; e2e measurement follows",
+        )
 
     phase("e2e-measure")
     engine.reset_stats()
     rtts: list = []
+    ttfts: list = []
     t0 = time.perf_counter()
-    await asyncio.gather(
-        *[client(i, ROUNDS, rtts) for i in range(CLIENTS)]
-    )
+
+    async def provisional_sampler() -> None:
+        # refresh a provisional e2e estimate every 30 s of measurement:
+        # tokens emitted so far over wall time so far — each line
+        # supersedes the last; the final emit supersedes them all
+        while True:
+            await asyncio.sleep(30)
+            seen = engine.stats["tokens_generated"]
+            wall = time.perf_counter() - t0
+            if seen and wall > 5:
+                emit_provisional(
+                    metric_name(), seen / wall,
+                    kv_cache=KV_QUANT or "bf16",
+                    note=f"mid-measure estimate at t+{wall:.0f}s",
+                )
+
+    sampler = asyncio.ensure_future(provisional_sampler())
+    try:
+        await asyncio.gather(
+            *[client(i, ROUNDS, rtts, ttfts) for i in range(CLIENTS)]
+        )
+    finally:
+        sampler.cancel()
     elapsed = time.perf_counter() - t0
     stats = dict(engine.stats)
     # measurement captured: from here the tunnel monitor must not
@@ -747,6 +923,19 @@ async def _drive_e2e(runner, gateway, port, engine):
         sorted_rtts[min(len(sorted_rtts) - 1, int(len(sorted_rtts) * 0.95))]
         if sorted_rtts else 0.0
     )
+    p50_ttft = statistics.median(ttfts) if ttfts else 0.0
+    # RTT is a first-class SLO, not a footnote (VERDICT r4 #3): the
+    # baseline metric is "tok/s/chip + p50 gateway RTT". Closed-loop at
+    # full occupancy RTT is decode-bound (≈ NEW_TOKENS × ms/step), so
+    # the budget is the roofline target, and a violation rides the
+    # artifact so the driver/judge see it without reading stderr.
+    rtt_budget_s = float(os.environ.get("BENCH_RTT_BUDGET_MS", "1500")) / 1e3
+    rtt_slo_ok = bool(rtts) and p50_rtt <= rtt_budget_s
+    if not rtt_slo_ok:
+        log(
+            f"RTT SLO VIOLATION: p50 {p50_rtt * 1e3:.0f} ms > budget "
+            f"{rtt_budget_s * 1e3:.0f} ms"
+        )
     # decode roofline → MFU / HBM-BW% in the driver artifact itself
     # (VERDICT r3 weak #7). mean context ≈ prompt + half the answer,
     # occupancy-weighted slots; prompts floor at the shared
@@ -775,7 +964,8 @@ async def _drive_e2e(runner, gateway, port, engine):
         f"(+{stats['session_hits']} session hits)\n"
         f"  engine thread: idle {stats['idle_time']:.2f}s, "
         f"host emit {stats['emit_time']:.2f}s\n"
-        f"  p50 RTT {p50_rtt * 1e3:.0f} ms / p95 {p95_rtt * 1e3:.0f} ms "
+        f"  p50 RTT {p50_rtt * 1e3:.0f} ms / p95 {p95_rtt * 1e3:.0f} ms, "
+        f"p50 TTFT {p50_ttft * 1e3:.0f} ms "
         f"over {len(rtts)} requests ({CLIENTS} clients x {ROUNDS} rounds)\n"
         f"  roofline: MFU {mfu * 100:.1f}%, HBM-BW {hbm_pct * 100:.1f}% "
         f"({roof['bytes_per_step'] / 1e9:.2f} GB/step, "
@@ -788,6 +978,9 @@ async def _drive_e2e(runner, gateway, port, engine):
         "raw_engine_tok_s": round(raw_tok_s, 1),
         "p50_rtt_ms": round(p50_rtt * 1e3, 1),
         "p95_rtt_ms": round(p95_rtt * 1e3, 1),
+        "p50_ttft_ms": round(p50_ttft * 1e3, 1),
+        "rtt_budget_ms": round(rtt_budget_s * 1e3, 1),
+        "rtt_slo_ok": rtt_slo_ok,
         "decode_ms_per_step": round(decode_time / steps * 1e3, 3),
         "occupancy": round(occupancy, 3),
         "requests": len(rtts),

@@ -93,10 +93,10 @@ class JaxCompletionsService(CompletionsService):
             # random weights + int8: init directly in int8 on device — an
             # 8B model inits in ~9 GB instead of peaking at 24 GB
             from langstream_tpu.providers.jax_local.quant import (
-                init_quantized_params,
+                init_quantized_params_cached,
             )
 
-            params = init_quantized_params(
+            params = init_quantized_params_cached(
                 model_config, seed=int(config.get("seed", 0))
             )
             logger.warning(
