@@ -47,6 +47,9 @@ MODEL_PRESET = os.environ.get("BENCH_MODEL", "llama-3-8b")
 QUANT = os.environ.get("BENCH_QUANT", "int8") or None
 MAX_SLOTS = int(os.environ.get("BENCH_SLOTS", "32"))
 DECODE_CHUNK = int(os.environ.get("BENCH_DECODE_CHUNK", "32"))
+# TTFT/RTT A/B lever: cap the decode chunk while admissions wait
+# (0/empty = off). Costs one extra compiled decode variant.
+ADMISSION_CHUNK = int(os.environ.get("BENCH_ADMISSION_CHUNK", "0") or "0")
 PROMPT_LEN = int(os.environ.get("BENCH_PROMPT_LEN", "128"))
 NEW_TOKENS = int(os.environ.get("BENCH_NEW_TOKENS", "128"))
 REQUESTS = int(os.environ.get("BENCH_REQUESTS", "96"))
@@ -397,6 +400,7 @@ def run_compile_only() -> int:
         max_seq_len=max_seq,
         prefill_buckets=buckets,
         decode_chunk=DECODE_CHUNK,
+        admission_chunk=ADMISSION_CHUNK or None,
         quantize=QUANT,
         kv_quant=KV_QUANT,
         pipeline_decode=PIPELINE,
@@ -647,6 +651,7 @@ async def run_bench():
         max_seq_len=config.max_seq_len,
         prefill_buckets=[PROMPT_LEN],
         decode_chunk=DECODE_CHUNK,
+        admission_chunk=ADMISSION_CHUNK or None,
         quantize=QUANT,
         kv_quant=KV_QUANT,
         pipeline_decode=PIPELINE,
@@ -758,6 +763,7 @@ async def run_bench_e2e():
                 "max-tokens": NEW_TOKENS,
                 "quantization": QUANT or "",
                 "decode-chunk": DECODE_CHUNK,
+                "admission-chunk": ADMISSION_CHUNK or "",
                 "pipeline-decode": PIPELINE,
                 # deterministic compile coverage: admission group sizes
                 # are timing-dependent, so without this a (bucket, size)
@@ -974,6 +980,7 @@ async def _drive_e2e(runner, gateway, port, engine):
     extras = {
         "broker": BROKER,
         "kv_cache": KV_QUANT or "bf16",
+        "admission_chunk": ADMISSION_CHUNK,
         "decode_kernel": os.environ.get("LS_DECODE_FLASH", "") or "auto",
         "raw_engine_tok_s": round(raw_tok_s, 1),
         "p50_rtt_ms": round(p50_rtt * 1e3, 1),

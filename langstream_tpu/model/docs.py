@@ -48,8 +48,11 @@ def _numberish(v: Any) -> bool:
 
 _TYPE_CHECKS = {
     "string": lambda v: isinstance(v, str),
+    # the empty string is NOT a boolean: a blank placeholder
+    # substitution must fall back to the property default at the
+    # validation layer, not pass through with ambiguous truthiness
     "boolean": lambda v: isinstance(v, bool) or (
-        isinstance(v, str) and v.lower() in ("true", "false", "1", "0", "")
+        isinstance(v, str) and v.lower() in ("true", "false", "1", "0")
     ),
     "integer": _intish,
     "number": _numberish,
@@ -146,6 +149,18 @@ def validate_agent_config(
                 )
             continue
         if value is None:
+            continue
+        if value == "" and prop.type != "string":
+            # a blank placeholder substitution (`${globals.x:-}`) means
+            # "not set": the consumer applies the property default. It
+            # is NOT a valid boolean/number/list literal (ADVICE r4) —
+            # and a REQUIRED property has no default to fall back to,
+            # so blank there is a plan-time error, not a skip.
+            if prop.required:
+                errors.append(
+                    f"{agent_type}: required property '{key}' is blank "
+                    f"(placeholder substituted to \"\")"
+                )
             continue
         check = _TYPE_CHECKS.get(prop.type, _TYPE_CHECKS["any"])
         if not check(value):

@@ -228,6 +228,7 @@ class DecodeEngine:
         max_seq_len: Optional[int] = None,
         prefill_buckets: Optional[List[int]] = None,
         decode_chunk: int = 8,
+        admission_chunk: Optional[int] = None,
         seed: int = 0,
         quantize: Optional[str] = None,  # "int8" = weight-only int8
         kv_quant: Optional[str] = None,  # "int8" = int8 KV cache
@@ -238,6 +239,17 @@ class DecodeEngine:
         self.config = config
         self.max_slots = max_slots
         self.decode_chunk = max(1, decode_chunk)
+        # TTFT lever: when admissions are waiting at dispatch time, cap
+        # the chunk at this many steps so the freshly-prefilled request
+        # joins the batch sooner — a full 32-step chunk makes a new
+        # arrival wait ~chunk×ms_step before its first token. Costs one
+        # extra compiled decode variant and more host round trips while
+        # the queue is non-empty (chaining is already off then), so it
+        # is an A/B knob, default off until measured on-chip.
+        self.admission_chunk = (
+            min(int(admission_chunk), self.decode_chunk)
+            if admission_chunk and int(admission_chunk) > 0 else None
+        )
         # top-K alternative logprobs per generated token (OpenAI
         # `top_logprobs`). STATIC — it shapes the jit outputs, so 0
         # (off) keeps the serving graphs byte-identical to a build
@@ -646,7 +658,10 @@ class DecodeEngine:
                     params_aval, cache_aval, scalar, scalar, scalar,
                 )))
         slots = self.max_slots
-        for steps in {self.decode_chunk, 1}:
+        step_variants = {self.decode_chunk, 1}
+        if self.admission_chunk:
+            step_variants.add(self.admission_chunk)
+        for steps in step_variants:
             jobs.append((self._get_decode(steps), (
                 params_aval, cache_aval,
                 vec(slots, jnp.int32), vec(slots, jnp.int32),
@@ -1587,6 +1602,10 @@ class DecodeEngine:
             seeds_host = np.zeros((self.max_slots,), dtype=np.uint32)
             epochs = [0] * self.max_slots
             steps = self.decode_chunk
+            if self.admission_chunk and (self._pending or self._prefill_inflight):
+                # someone is waiting to join: run a short chunk so the
+                # next dispatch picks them up (see admission_chunk)
+                steps = self.admission_chunk
             for i, slot in enumerate(self.slots):
                 lengths[i] = slot.length
                 epochs[i] = slot.epoch

@@ -647,7 +647,10 @@ def _decode_attn(config, q, kc, vc, lengths, mesh=None, window=None):
     streams the full static buffer), XLA path otherwise. Under tp the
     kernel runs per head shard through shard_map
     (``flash_decode_attention_sharded``). ``window`` is this layer's
-    sliding-window size (Gemma-2; the gate already forces XLA then)."""
+    sliding-window size (Gemma-2) and rides into the flash-decode
+    kernel as a traced scalar, like softcap and scale — the kernel
+    handles windowed layers itself; only non-shape-compatible configs
+    gate off to XLA (see ``_decode_flash_path``)."""
     flash_ok, tp_sharded = _decode_flash_path(config, q, kc, mesh)
     family = dict(
         softcap=config.attn_logit_softcap, window=window,

@@ -223,6 +223,21 @@ class OpenAIApiServer:
             # service's get_text_completions path — no chat template)
             prompt_texts = [str(prompt)]
             messages = []
+            # legacy completions spell "top-K logprobs" as an INTEGER
+            # `logprobs: K` (the chat API splits it into logprobs: true
+            # + top_logprobs: K) — normalize so the K actually reaches
+            # the top-logprobs option instead of silently meaning only
+            # "include the sampled token's logprob"
+            lp = body.get("logprobs")
+            if (
+                isinstance(lp, int) and not isinstance(lp, bool)
+                and lp > 0 and body.get("top_logprobs") is None
+                # feature off (limit 0): keep the pre-existing behavior
+                # — sampled-token logprobs only — instead of 400ing
+                # every legacy client that sends an integer
+                and self._topk_limit > 0
+            ):
+                body = dict(body, top_logprobs=lp)
         try:
             options = _options_from_request(
                 body, self.model, topk_limit=self._topk_limit
@@ -324,13 +339,20 @@ class OpenAIApiServer:
                         else:
                             # legacy text_completion format: a
                             # {token: logprob} dict per position,
-                            # parallel to `tokens`
-                            logprobs_block["top_logprobs"] = [
-                                {
-                                    t2: lp2 for t2, lp2 in tops[:n_top]
-                                }
-                                for tops in result.top_logprobs
-                            ]
+                            # parallel to `tokens`. Distinct token ids
+                            # can decode to the same text; keep the
+                            # FIRST (highest-ranked) logprob instead of
+                            # letting later duplicates overwrite it —
+                            # the dict may then hold fewer than n_top
+                            # keys, which is inherent to the legacy
+                            # text-keyed format
+                            legacy = []
+                            for tops in result.top_logprobs:
+                                row: dict = {}
+                                for t2, lp2 in tops[:n_top]:
+                                    row.setdefault(t2, lp2)
+                                legacy.append(row)
+                            logprobs_block["top_logprobs"] = legacy
                     choice["logprobs"] = logprobs_block
                 choices.append(choice)
             completion_tokens = sum(r.completion_tokens for r in results)

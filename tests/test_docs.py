@@ -117,3 +117,22 @@ def test_cli_docs_command():
     )
     assert out.returncode == 0, out.stderr
     assert json.loads(out.stdout)["cast"]["properties"]
+
+
+def test_blank_placeholder_values():
+    """`${globals.x:-}` substitutes to "": optional non-string
+    properties treat it as unset (consumer default applies), REQUIRED
+    ones fail at plan time, and "" is not a valid boolean literal."""
+    from langstream_tpu.model.docs import validate_agent_config
+
+    # optional boolean blank -> unset, no error
+    assert validate_agent_config(
+        "query-vector-db", {"datasource": "db", "query": "q",
+                            "output-field": "o", "only-first": ""}
+    ) == []
+    # required list blank -> plan-time error, not silent pass-through
+    errors = validate_agent_config("drop-fields", {"fields": ""})
+    assert any("required property 'fields' is blank" in e for e in errors)
+    # non-blank wrong type still caught
+    errors = validate_agent_config("drop-fields", {"fields": "a,b"})
+    assert any("expects list" in e for e in errors)

@@ -1434,3 +1434,52 @@ def test_top_logprobs_greedy():
         assert result2.tokens == result.tokens  # knob is observability-only
 
     asyncio.run(main())
+
+
+def test_admission_chunk_shortens_chunks_and_matches_serial():
+    """admission_chunk: while admissions wait, dispatched chunks shrink
+    to the cap (TTFT lever) — and tokens stay identical to a plain
+    engine. The chunk log proves short chunks actually ran."""
+    config = LlamaConfig.tiny(max_seq_len=128)
+    params = init_params(config)
+    sampling = SamplingParams(max_new_tokens=12)
+
+    def prompt(i):
+        return [(11 * i + j) % 250 + 1 for j in range(8 + i % 3)]
+
+    async def staggered(engine):
+        async def late(i):
+            await asyncio.sleep(0.004 * i)
+            return await engine.generate(prompt(i), sampling)
+
+        return await asyncio.gather(*[late(i) for i in range(8)])
+
+    async def main():
+        adaptive = DecodeEngine(
+            config, params, max_slots=2, max_seq_len=128,
+            prefill_buckets=[16], decode_chunk=8, admission_chunk=2,
+        )
+        assert adaptive.admission_chunk == 2
+        adaptive.start()
+        try:
+            results = await staggered(adaptive)
+            chunk_sizes = {steps for steps, _, _ in adaptive.chunk_log}
+        finally:
+            adaptive.stop()
+        # with 8 requests over 2 slots, admissions queue behind running
+        # decodes — short chunks must have been dispatched
+        assert 2 in chunk_sizes, chunk_sizes
+        assert 8 in chunk_sizes, chunk_sizes
+        serial = DecodeEngine(
+            config, params, max_slots=2, max_seq_len=128,
+            prefill_buckets=[16], decode_chunk=8,
+        )
+        serial.start()
+        try:
+            for i in range(8):
+                expected = await serial.generate(prompt(i), sampling)
+                assert results[i].tokens == expected.tokens, f"request {i}"
+        finally:
+            serial.stop()
+
+    asyncio.run(main())

@@ -384,3 +384,67 @@ def test_top_logprobs_validation_and_legacy_format(server_port):
         all(isinstance(v, float) for v in d.values())
         for d in lp["top_logprobs"]
     )
+
+
+def test_legacy_int_logprobs_means_topk(server_port):
+    """OpenAI's legacy /v1/completions spells "top-K logprobs" as an
+    INTEGER `logprobs: K` — it must reach the top-logprobs option, and
+    K over the server's static limit must 400 with guidance."""
+    loop, port = server_port
+    status, body = _call(loop, _post(port, "/v1/completions", {
+        "prompt": "hello", "max_tokens": 3, "temperature": 0.0,
+        "logprobs": 2,
+    }))
+    assert status == 200, body
+    lp = body["choices"][0]["logprobs"]
+    assert len(lp["top_logprobs"]) == 3
+    assert all(isinstance(d, dict) and 0 < len(d) <= 2
+               for d in lp["top_logprobs"])
+    # duplicate decoded text keeps the FIRST (highest-ranked) logprob:
+    # every dict value must equal the max of candidates sharing its key,
+    # which setdefault guarantees structurally; spot-check types only
+    status, body = _call(loop, _post(port, "/v1/completions", {
+        "prompt": "hello", "max_tokens": 2, "logprobs": 9,
+    }))
+    assert status == 400
+    assert "exceeds this server's limit" in body["error"]["message"]
+    # boolean True stays "sampled-token logprob only" (no top_logprobs)
+    status, body = _call(loop, _post(port, "/v1/completions", {
+        "prompt": "hello", "max_tokens": 2, "logprobs": True,
+    }))
+    assert status == 200, body
+    assert "top_logprobs" not in body["choices"][0]["logprobs"]
+
+
+def test_legacy_int_logprobs_with_feature_off():
+    """With the server's static top-k OFF (limit 0, the default), a
+    legacy integer `logprobs: K` must keep returning 200 with
+    sampled-token logprobs only — not 400 (pre-normalization behavior
+    preserved for legacy clients)."""
+    from langstream_tpu.providers.jax_local.provider import (
+        JaxCompletionsService,
+    )
+    from langstream_tpu.serving.openai_api import OpenAIApiServer
+
+    loop = asyncio.new_event_loop()
+    completions = JaxCompletionsService({
+        "model": {"preset": "tiny", "max_seq_len": 128},
+        "engine": {"max-slots": 2, "max-seq-len": 128},
+    })
+    server = OpenAIApiServer(
+        completions, None, model="tiny", host="127.0.0.1", port=0,
+    )
+    try:
+        loop.run_until_complete(server.start())
+        port = server.addresses[0][1]
+        status, body = loop.run_until_complete(_post(port, "/v1/completions", {
+            "prompt": "hi", "max_tokens": 3, "logprobs": 2,
+        }))
+        assert status == 200, body
+        lp = body["choices"][0]["logprobs"]
+        assert "top_logprobs" not in lp
+        assert len(lp["token_logprobs"]) == 3
+    finally:
+        loop.run_until_complete(server.stop())
+        loop.run_until_complete(completions.close())
+        loop.close()

@@ -49,7 +49,11 @@ y.block_until_ready()" 2>/dev/null
         # entries incrementally (every finished compile is kept even if
         # the window dies mid-run), so successive attempts converge on a
         # warm cache and the full bench then fits a short window
-        if BENCH_COMPILE_ONLY=1 BENCH_DEADLINE=3000 BENCH_INIT_TIMEOUT=600 \
+        # BENCH_ADMISSION_CHUNK=8 warms a superset: the one extra decode
+        # variant the admission-chunk A/B leg needs, all other keys
+        # identical to the main run's
+        if BENCH_COMPILE_ONLY=1 BENCH_ADMISSION_CHUNK=8 BENCH_DEADLINE=3000 \
+            BENCH_INIT_TIMEOUT=600 \
             python bench.py > "${OUT%.json}_warm.json" 2>> "$LOG"; then
             echo "$(date -u +%FT%TZ) cache warm: $(cat "${OUT%.json}_warm.json")" >> "$LOG"
         else
@@ -97,7 +101,17 @@ y.block_until_ready()" 2>/dev/null
                     echo "$(date -u +%FT%TZ) flash-decode A/B leg $leg failed (non-fatal)" >> "$LOG"
                 fi
             done
-            # 3) one traced decode profile for the step-time breakdown
+            # 3) admission-chunk A/B: short chunks while admissions
+            #    wait (TTFT/p50-RTT lever; compare p50_rtt_ms +
+            #    p50_ttft_ms against the main run's at equal tok/s)
+            if BENCH_ADMISSION_CHUNK=8 BENCH_DEADLINE=3600 \
+                BENCH_INIT_TIMEOUT=600 \
+                python bench.py > "${OUT%.json}_admis.json" 2>> "$LOG"; then
+                echo "$(date -u +%FT%TZ) admission-chunk A/B done: $(cat "${OUT%.json}_admis.json")" >> "$LOG"
+            else
+                echo "$(date -u +%FT%TZ) admission-chunk A/B failed (non-fatal)" >> "$LOG"
+            fi
+            # 4) one traced decode profile for the step-time breakdown
             if BENCH_TRACE=1 BENCH_ROUNDS=1 BENCH_DEADLINE=2400 \
                 BENCH_INIT_TIMEOUT=600 \
                 python bench.py > "${OUT%.json}_trace.json" 2>> "$LOG"; then
