@@ -151,16 +151,17 @@ def validate_agent_config(
         if value is None:
             continue
         if value == "" and prop.type != "string":
-            # a blank placeholder substitution (`${globals.x:-}`) means
-            # "not set": the consumer applies the property default. It
-            # is NOT a valid boolean/number/list literal (ADVICE r4) —
-            # and a REQUIRED property has no default to fall back to,
-            # so blank there is a plan-time error, not a skip.
-            if prop.required:
-                errors.append(
-                    f"{agent_type}: required property '{key}' is blank "
-                    f"(placeholder substituted to \"\")"
-                )
+            # "" is not a valid boolean/number/list literal (ADVICE r4),
+            # and consumers read `config.get(key, default)` — a PRESENT
+            # blank key would bypass the default and crash (int(""))
+            # or silently flip (bool("")) at runtime. Fail at plan time
+            # with the fix spelled out.
+            errors.append(
+                f"{agent_type}: property '{key}' is blank "
+                f"(placeholder substituted to \"\") but expects "
+                f"{prop.type} — give the placeholder a non-blank "
+                f"default (`${{globals.x:-42}}`) or omit the key"
+            )
             continue
         check = _TYPE_CHECKS.get(prop.type, _TYPE_CHECKS["any"])
         if not check(value):

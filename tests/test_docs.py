@@ -125,14 +125,23 @@ def test_blank_placeholder_values():
     ones fail at plan time, and "" is not a valid boolean literal."""
     from langstream_tpu.model.docs import validate_agent_config
 
-    # optional boolean blank -> unset, no error
-    assert validate_agent_config(
+    # blank non-string -> plan-time error with guidance (consumers use
+    # config.get(key, default): a PRESENT blank key would bypass the
+    # default and crash/flip at runtime)
+    errors = validate_agent_config(
         "query-vector-db", {"datasource": "db", "query": "q",
                             "output-field": "o", "only-first": ""}
-    ) == []
-    # required list blank -> plan-time error, not silent pass-through
+    )
+    assert any("'only-first' is blank" in e and "non-blank default" in e
+               for e in errors)
+    # blank on a required property errors too
     errors = validate_agent_config("drop-fields", {"fields": ""})
-    assert any("required property 'fields' is blank" in e for e in errors)
+    assert any("'fields' is blank" in e for e in errors)
+    # blank STRING properties stay valid ("" is a legitimate string)
+    assert validate_agent_config(
+        "ai-chat-completions",
+        {"model": "m", "messages": [], "completion-field": ""},
+    ) == []
     # non-blank wrong type still caught
     errors = validate_agent_config("drop-fields", {"fields": "a,b"})
     assert any("expects list" in e for e in errors)

@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""CI test sharding: one place that maps shard names to test files.
+
+The reference splits its 17-minute suite across a CI matrix
+(`/root/reference/.github/workflows/ci.yml:28-91` — Runtime / Deployer /
+Api Gateway / Control plane / Other); this is the analogue for the
+pytest suite. `.github/workflows/ci.yml` runs one job per shard with
+``python tools/ci_shard.py <shard> | xargs python -m pytest``, and
+tests/test_ci_shards.py asserts the partition is total and disjoint —
+a new test file that matches no shard fails CI wiring at test time, not
+by silently never running.
+
+Assignment is by filename prefix list (explicit beats glob-clever):
+the first shard whose prefix matches claims the file.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Dict, List
+
+# ordered: first match wins
+SHARDS: Dict[str, List[str]] = {
+    # models, kernels, engine, parallelism — the JAX-heavy, compile-bound
+    # shard
+    "kernels-engine": [
+        "test_engine",
+        "test_attention_kernels",
+        "test_decode_kernel",
+        "test_kv_quant",
+        "test_quant",
+        "test_llama_model",
+        "test_gemma2_model",
+        "test_qwen2_model",
+        "test_moe",
+        "test_pipeline",
+        "test_multihost",
+        "test_mirror",
+        "test_checkpoint",
+        "test_openai_api",
+        "test_e2e_jax",
+    ],
+    # control plane, deployer, k8s storage, gateway, auth, CLI
+    "k8s-gateway": [
+        "test_controlplane",
+        "test_deployer",
+        "test_kube_app_store",
+        "test_helm_chart",
+        "test_s3_codestorage",
+        "test_cli_admin",
+        "test_gateway",
+        "test_jwt_auth",
+        "test_auth_identity_providers",
+        "test_service_commands",
+        "test_mini_langstream",
+    ],
+    # agents and topic runtimes
+    "agents-topics": [
+        "test_agents",
+        "test_new_agents",
+        "test_genai",
+        "test_external_stores",
+        "test_external_providers",
+        "test_kafka",
+        "test_pulsar",
+        "test_pravega",
+        "test_avro",
+        "test_el",
+        "test_topic_contract",
+        "test_memory_broker",
+        "test_log_broker",
+        "test_tpulog_app",
+        "test_azure_blob",
+        "test_isolation",
+        "test_plugins",
+    ],
+    # compiler, runner, examples, docs — everything else lands here via
+    # the catch-all marker (must stay LAST)
+    "core-runner": ["*"],
+}
+
+
+def test_files(tests_dir: str) -> List[str]:
+    return sorted(
+        name for name in os.listdir(tests_dir)
+        if name.startswith("test_") and name.endswith(".py")
+    )
+
+
+def assign(name: str) -> str:
+    """Shard for a test filename (first prefix match; '*' catches all)."""
+    stem = name[: -len(".py")] if name.endswith(".py") else name
+    for shard, prefixes in SHARDS.items():
+        for prefix in prefixes:
+            if prefix == "*" or stem == prefix or stem.startswith(prefix + "_"):
+                return shard
+    raise LookupError(f"no shard matches {name}")
+
+
+def files_for(shard: str, tests_dir: str) -> List[str]:
+    if shard not in SHARDS:
+        raise SystemExit(
+            f"unknown shard {shard!r}; known: {', '.join(SHARDS)}"
+        )
+    return [
+        os.path.join(tests_dir, name)
+        for name in test_files(tests_dir)
+        if assign(name) == shard
+    ]
+
+
+def main() -> None:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tests_dir = os.path.join(repo, "tests")
+    if len(sys.argv) != 2:
+        raise SystemExit("usage: ci_shard.py <shard>|--list")
+    if sys.argv[1] == "--list":
+        for shard in SHARDS:
+            print(shard)
+        return
+    for path in files_for(sys.argv[1], tests_dir):
+        print(path)
+
+
+if __name__ == "__main__":
+    main()
