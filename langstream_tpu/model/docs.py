@@ -459,6 +459,15 @@ for doc in [
         _P("bootstrapServers", "string", "Kafka bootstrap for the data topic"),
         _P("delete-on-close", "boolean", "delete the connector on shutdown",
            default=False),
+        _P("rebalance-timeout", "number",
+           "seconds to retry 409s while the worker group rebalances",
+           default=30),
+        _P("restart-failed-tasks", "boolean",
+           "auto-restart FAILED connector tasks via the REST API",
+           default=True),
+        _P("health-check-interval", "number",
+           "seconds between connector status polls (0 disables)",
+           default=30),
     ), category="source"),
     AgentDoc("kafka-connect-sink", "Run a Kafka Connect sink connector", (
         _P("connect-url", "string", "Connect worker REST URL", required=True),
@@ -470,6 +479,15 @@ for doc in [
         _P("bootstrapServers", "string", "Kafka bootstrap for the data topic"),
         _P("delete-on-close", "boolean", "delete the connector on shutdown",
            default=False),
+        _P("rebalance-timeout", "number",
+           "seconds to retry 409s while the worker group rebalances",
+           default=30),
+        _P("restart-failed-tasks", "boolean",
+           "auto-restart FAILED connector tasks via the REST API",
+           default=True),
+        _P("health-check-interval", "number",
+           "seconds between connector status polls (0 disables)",
+           default=30),
     ), category="sink"),
     AgentDoc("identity", "Pass records through unchanged", ()),
     AgentDoc("ai-tools", "GenAI toolkit executor (compiled steps)", (),

@@ -1,0 +1,255 @@
+"""Mock Kafka Connect distributed-mode worker (REST).
+
+Implements the lifecycle slice of the Connect REST API that
+``agents/kafka_connect.py`` and the helm bundled-worker option
+(`helm/langstream-tpu/templates/kafka-connect.yaml`) depend on —
+connector create → task assignment → rebalance → task restart → config
+update → pause/resume → delete — including the failure surfaces a real
+distributed worker exposes:
+
+- **409 during rebalance**: every config-mutating and status endpoint
+  answers ``409 {"message": "Cannot complete request momentarily due to
+  stale configuration (typically caused by a rebalance)"}`` while a
+  rebalance window is open (``start_rebalance()`` / ``end_rebalance()``).
+- **Task failure**: ``fail_task(name, task_id, trace)`` flips a task to
+  FAILED with a stack trace in status, exactly the shape
+  ``GET /connectors/{name}/status`` returns; ``POST
+  /connectors/{name}/tasks/{id}/restart`` clears it.
+- **Config update**: PUT on an existing connector bumps the config
+  version and re-creates the task list (tasks.max honored), the way a
+  worker rebalances tasks after a config change.
+
+Reference behavior being modeled: the reference runs connectors
+in-process (`KafkaConnectSinkAgent.java:65`); this framework drives a
+worker over REST, so the mock stands in for that worker in tests.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, Optional
+
+from aiohttp import web
+
+REBALANCE_MESSAGE = (
+    "Cannot complete request momentarily due to stale configuration "
+    "(typically caused by a rebalance)"
+)
+
+
+class MockConnectWorker:
+    def __init__(self, port: int = 0, worker_id: str = "mock:8083") -> None:
+        self.connectors: Dict[str, dict] = {}
+        self.port: Optional[int] = port or None
+        self.worker_id = worker_id
+        self.rebalancing = False
+        self.requests: list = []  # (method, path) audit trail
+        self._runner = None
+        self._requested_port = port
+
+    # -- lifecycle controls (test-side) --------------------------------
+    def start_rebalance(self) -> None:
+        self.rebalancing = True
+
+    def end_rebalance(self) -> None:
+        self.rebalancing = False
+
+    def fail_task(self, name: str, task_id: int, trace: str = "boom") -> None:
+        self.connectors[name]["tasks"][task_id] = {
+            "state": "FAILED", "trace": trace,
+        }
+
+    def task_states(self, name: str) -> list:
+        return [t["state"] for t in self.connectors[name]["tasks"]]
+
+    # -- server --------------------------------------------------------
+    async def start(self) -> "MockConnectWorker":
+        app = web.Application()
+        add = app.router
+        add.add_get("/connectors", self._list)
+        add.add_put("/connectors/{name}/config", self._put_config)
+        add.add_get("/connectors/{name}/config", self._get_config)
+        add.add_get("/connectors/{name}/status", self._status)
+        add.add_get("/connectors/{name}", self._info)
+        add.add_delete("/connectors/{name}", self._delete)
+        add.add_put("/connectors/{name}/pause", self._pause)
+        add.add_put("/connectors/{name}/resume", self._resume)
+        add.add_post("/connectors/{name}/restart", self._restart)
+        add.add_post(
+            "/connectors/{name}/tasks/{task}/restart", self._restart_task
+        )
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", self._requested_port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]  # noqa: SLF001
+        return self
+
+    async def close(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    # -- handlers ------------------------------------------------------
+    def _guard(self, request) -> Optional[web.Response]:
+        self.requests.append((request.method, request.path))
+        if self.rebalancing:
+            return web.json_response(
+                {"error_code": 409, "message": REBALANCE_MESSAGE}, status=409
+            )
+        return None
+
+    def _missing(self, name: str) -> web.Response:
+        return web.json_response(
+            {"error_code": 404, "message": f"Connector {name} not found"},
+            status=404,
+        )
+
+    async def _list(self, request):
+        blocked = self._guard(request)
+        if blocked:
+            return blocked
+        return web.json_response(sorted(self.connectors))
+
+    async def _put_config(self, request):
+        blocked = self._guard(request)
+        if blocked:
+            return blocked
+        name = request.match_info["name"]
+        config = json.loads(await request.read())
+        created = name not in self.connectors
+        tasks_max = int(config.get("tasks.max", 1))
+        # a config update re-creates the task assignment, like the
+        # worker's post-update rebalance does
+        self.connectors[name] = {
+            "config": config,
+            "state": "RUNNING",
+            "version": (
+                1 if created else self.connectors[name]["version"] + 1
+            ),
+            "tasks": [{"state": "RUNNING"} for _ in range(tasks_max)],
+        }
+        return web.json_response(
+            {
+                "name": name,
+                "config": config,
+                "tasks": [
+                    {"connector": name, "task": i} for i in range(tasks_max)
+                ],
+            },
+            status=201 if created else 200,
+        )
+
+    async def _get_config(self, request):
+        blocked = self._guard(request)
+        if blocked:
+            return blocked
+        name = request.match_info["name"]
+        if name not in self.connectors:
+            return self._missing(name)
+        return web.json_response(self.connectors[name]["config"])
+
+    async def _info(self, request):
+        blocked = self._guard(request)
+        if blocked:
+            return blocked
+        name = request.match_info["name"]
+        if name not in self.connectors:
+            return self._missing(name)
+        entry = self.connectors[name]
+        return web.json_response({
+            "name": name,
+            "config": entry["config"],
+            "tasks": [
+                {"connector": name, "task": i}
+                for i in range(len(entry["tasks"]))
+            ],
+        })
+
+    async def _status(self, request):
+        blocked = self._guard(request)
+        if blocked:
+            return blocked
+        name = request.match_info["name"]
+        if name not in self.connectors:
+            return self._missing(name)
+        entry = self.connectors[name]
+        return web.json_response({
+            "name": name,
+            "connector": {
+                "state": entry["state"], "worker_id": self.worker_id,
+            },
+            "tasks": [
+                {
+                    "id": i, "state": task["state"],
+                    "worker_id": self.worker_id,
+                    **({"trace": task["trace"]} if "trace" in task else {}),
+                }
+                for i, task in enumerate(entry["tasks"])
+            ],
+        })
+
+    async def _delete(self, request):
+        blocked = self._guard(request)
+        if blocked:
+            return blocked
+        name = request.match_info["name"]
+        if name not in self.connectors:
+            return self._missing(name)
+        del self.connectors[name]
+        return web.Response(status=204)
+
+    async def _pause(self, request):
+        blocked = self._guard(request)
+        if blocked:
+            return blocked
+        name = request.match_info["name"]
+        if name not in self.connectors:
+            return self._missing(name)
+        entry = self.connectors[name]
+        entry["state"] = "PAUSED"
+        for task in entry["tasks"]:
+            if task["state"] == "RUNNING":
+                task["state"] = "PAUSED"
+        return web.Response(status=202)
+
+    async def _resume(self, request):
+        blocked = self._guard(request)
+        if blocked:
+            return blocked
+        name = request.match_info["name"]
+        if name not in self.connectors:
+            return self._missing(name)
+        entry = self.connectors[name]
+        entry["state"] = "RUNNING"
+        for task in entry["tasks"]:
+            if task["state"] == "PAUSED":
+                task["state"] = "RUNNING"
+        return web.Response(status=202)
+
+    async def _restart(self, request):
+        blocked = self._guard(request)
+        if blocked:
+            return blocked
+        name = request.match_info["name"]
+        if name not in self.connectors:
+            return self._missing(name)
+        self.connectors[name]["state"] = "RUNNING"
+        return web.Response(status=204)
+
+    async def _restart_task(self, request):
+        blocked = self._guard(request)
+        if blocked:
+            return blocked
+        name = request.match_info["name"]
+        if name not in self.connectors:
+            return self._missing(name)
+        task_id = int(request.match_info["task"])
+        tasks = self.connectors[name]["tasks"]
+        if not 0 <= task_id < len(tasks):
+            return self._missing(f"{name} task {task_id}")
+        tasks[task_id] = {"state": "RUNNING"}
+        return web.Response(status=204)
