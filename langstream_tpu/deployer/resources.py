@@ -107,15 +107,20 @@ def generate_statefulset(
         volume_mounts.append(
             {"name": "state", "mountPath": "/app/state"}
         )
+        claim_spec: Dict[str, Any] = {
+            "accessModes": ["ReadWriteOnce"],
+            "resources": {
+                "requests": {"storage": agent.disk.get("size", "1Gi")}
+            },
+        }
+        # omit, don't null: storageClassName: null means "delete the
+        # field" in a strategic merge and fails schema validation —
+        # absence is how "cluster default storage class" is spelled
+        if agent.disk.get("type"):
+            claim_spec["storageClassName"] = str(agent.disk["type"])
         volume_claims.append({
             "metadata": {"name": "state"},
-            "spec": {
-                "accessModes": ["ReadWriteOnce"],
-                "storageClassName": agent.disk.get("type") or None,
-                "resources": {
-                    "requests": {"storage": agent.disk.get("size", "1Gi")}
-                },
-            },
+            "spec": claim_spec,
         })
 
     container_resources: Dict[str, Any] = {}

@@ -29,53 +29,24 @@ from helm_render import ChartError, render_chart, render_template  # noqa: E402
 
 
 # --------------------------------------------------------------------- #
-# minimal k8s structural validation (apiVersion/kind per object family)
+# structural validation: the generic layer (apiVersion/kind, schema,
+# name pattern, selector/label coherence, mount resolution) is the
+# vendored-schema validator — ONE implementation, shared with
+# tests/test_k8s_schema_validation.py so the two can't drift. This file
+# keeps only chart-policy assertions the schemas can't know about.
 # --------------------------------------------------------------------- #
-KNOWN_API = {
-    "Deployment": "apps/v1",
-    "StatefulSet": "apps/v1",
-    "Job": "batch/v1",
-    "Service": "v1",
-    "Secret": "v1",
-    "ConfigMap": "v1",
-    "PersistentVolumeClaim": "v1",
-    "ServiceAccount": "v1",
-    "Role": "rbac.authorization.k8s.io/v1",
-    "RoleBinding": "rbac.authorization.k8s.io/v1",
-    "ClusterRole": "rbac.authorization.k8s.io/v1",
-    "ClusterRoleBinding": "rbac.authorization.k8s.io/v1",
-    "CustomResourceDefinition": "apiextensions.k8s.io/v1",
-}
-
-_NAME_RE = r"^[a-z0-9]([-a-z0-9.]*[a-z0-9])?$"
+from k8s_validate import validate_manifest as _schema_validate  # noqa: E402
 
 
 def validate_manifest(doc: dict, source: str = "?") -> None:
-    import re
-
-    assert isinstance(doc, dict), f"{source}: not a mapping"
+    errors = _schema_validate(doc)
+    assert not errors, f"{source}: " + "; ".join(errors)
     kind = doc.get("kind")
-    assert kind in KNOWN_API, f"{source}: unknown kind {kind!r}"
-    assert doc.get("apiVersion") == KNOWN_API[kind], (
-        f"{source}: {kind} has apiVersion {doc.get('apiVersion')!r}, "
-        f"expected {KNOWN_API[kind]!r}"
-    )
     name = (doc.get("metadata") or {}).get("name")
-    assert name and re.match(_NAME_RE, name), (
-        f"{source}: invalid metadata.name {name!r}"
-    )
 
     if kind in ("Deployment", "StatefulSet"):
         spec = doc["spec"]
-        selector = spec["selector"]["matchLabels"]
-        pod_labels = spec["template"]["metadata"]["labels"]
-        for key, value in selector.items():
-            assert pod_labels.get(key) == value, (
-                f"{source}: selector {key}={value} not in pod labels "
-                f"{pod_labels}"
-            )
         containers = spec["template"]["spec"]["containers"]
-        assert containers, f"{source}: no containers"
         for container in containers:
             assert container.get("image"), f"{source}: container w/o image"
             declared_ports = {
@@ -89,25 +60,6 @@ def validate_manifest(doc: dict, source: str = "?") -> None:
                         f"{probe['httpGet']['port']} not declared in "
                         f"{sorted(declared_ports)}"
                     )
-        if kind == "StatefulSet":
-            assert spec.get("serviceName"), f"{source}: no serviceName"
-        # every volumeMount resolves to a declared volume or claim
-        volumes = {
-            v["name"] for v in spec["template"]["spec"].get("volumes", [])
-        }
-        volumes |= {
-            c["metadata"]["name"]
-            for c in spec.get("volumeClaimTemplates", [])
-        }
-        all_containers = containers + spec["template"]["spec"].get(
-            "initContainers", []
-        )
-        for container in all_containers:
-            for mount in container.get("volumeMounts", []):
-                assert mount["name"] in volumes, (
-                    f"{source}: mount {mount['name']} has no volume "
-                    f"(declared: {sorted(volumes)})"
-                )
 
     if kind == "Service":
         spec = doc["spec"]
