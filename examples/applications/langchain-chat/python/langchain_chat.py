@@ -33,6 +33,36 @@ def _format_docs(docs: List[Document]) -> str:
     return "\n\n".join(doc.page_content for doc in docs)
 
 
+class _HashEmbeddings:
+    """Tiny deterministic embedding (hashing trick) satisfying the
+    `langchain_core.embeddings.Embeddings` protocol — InMemoryVectorStore
+    REQUIRES an embedding (`from_texts(texts, embedding)`); relying on a
+    default does not exist in the real API. Swap for an API-backed
+    embedding (e.g. a langstream-tpu `serve` embeddings endpoint) in
+    production."""
+
+    def __init__(self, dim: int = 128):
+        self.dim = dim
+
+    def _one(self, text: str) -> List[float]:
+        import zlib
+
+        vec = [0.0] * self.dim
+        for token in text.lower().split():
+            # crc32, not hash(): str hash is salted per process, which
+            # would embed queries under a different seed than stored
+            # documents once the store is persistent
+            vec[zlib.crc32(token.encode()) % self.dim] += 1.0
+        norm = sum(v * v for v in vec) ** 0.5 or 1.0
+        return [v / norm for v in vec]
+
+    def embed_documents(self, texts: List[str]) -> List[List[float]]:
+        return [self._one(t) for t in texts]
+
+    def embed_query(self, text: str) -> List[float]:
+        return self._one(text)
+
+
 class LangChainChat:
     """questions-topic records in, answers out; chat history is kept
     per `langstream-client-session-id` header (the gateway sets it)."""
@@ -52,6 +82,7 @@ class LangChainChat:
                 "from TPU pods via the `serve` command.",
                 "Pipelines are YAML: agents reading and writing topics.",
             ],
+            _HashEmbeddings(),
         )
         retriever = store.as_retriever()
         prompt = ChatPromptTemplate.from_messages([

@@ -1,0 +1,241 @@
+"""Pin the third-party stubs — and the example ports' usage — to the
+RECORDED real APIs (VERDICT r4 weak #5).
+
+tests/thirdparty_stubs/{langchain_core,langchain_openai,llama_index,
+cassandra} encode the builder's belief about those libraries; nothing
+previously tied that belief to the real packages, so the ports could be
+green and wrong. MANIFEST.json records the real public signatures at
+the pinned versions (regenerable/checkable against the live packages by
+tools/gen_thirdparty_manifest.py wherever they are installed). Here:
+
+1. every stub symbol exists and its signature accepts every call shape
+   the real signature accepts for the shapes the ports use;
+2. every call the ports make (extracted from the port SOURCE by AST for
+   constructors/classmethods, plus the curated instance-method list)
+   binds against the REAL recorded signature — a port drifting onto a
+   stub-only calling convention fails here even though the stub would
+   happily accept it.
+"""
+
+from __future__ import annotations
+
+import ast
+import inspect
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+STUBS = REPO / "tests" / "thirdparty_stubs"
+MANIFEST = json.loads((STUBS / "MANIFEST.json").read_text())
+
+PORT_FILES = [
+    REPO / "examples/applications/langchain-chat/python/langchain_chat.py",
+    REPO / "examples/applications/llamaindex-cassandra-sink/python/"
+           "llamaindex_cassandra.py",
+]
+
+_KIND = {
+    "pos": inspect.Parameter.POSITIONAL_OR_KEYWORD,
+    "kwonly": inspect.Parameter.KEYWORD_ONLY,
+    "var_pos": inspect.Parameter.VAR_POSITIONAL,
+    "var_kw": inspect.Parameter.VAR_KEYWORD,
+}
+
+
+def _signature(params) -> inspect.Signature:
+    out = []
+    for param in params:
+        kind = _KIND[param["kind"]]
+        default = (
+            inspect.Parameter.empty
+            if param["required"] or kind in (
+                inspect.Parameter.VAR_POSITIONAL,
+                inspect.Parameter.VAR_KEYWORD,
+            )
+            else None
+        )
+        out.append(inspect.Parameter(param["name"], kind, default=default))
+    return inspect.Signature(out)
+
+
+def _stub_import(module: str):
+    sys.path.insert(0, str(STUBS))
+    try:
+        __import__(module)
+        return sys.modules[module]
+    finally:
+        sys.path.pop(0)
+
+
+def _resolve(target: str):
+    """'pkg.mod.Class.method' | 'pkg.mod.Class' -> (obj, real_params)."""
+    parts = target.split(".")
+    for split in range(len(parts), 0, -1):
+        symbol = ".".join(parts[:split])
+        if symbol in MANIFEST["symbols"]:
+            entry = MANIFEST["symbols"][symbol]
+            module, cls_name = symbol.rsplit(".", 1)
+            stub_cls = getattr(_stub_import(module), cls_name)
+            rest = parts[split:]
+            if not rest:  # constructor
+                return stub_cls, entry.get("init", [{
+                    "name": "args", "kind": "var_pos", "required": False,
+                }, {"name": "kwargs", "kind": "var_kw", "required": False}])
+            method = entry["methods"][rest[0]]
+            stub_attr = getattr(stub_cls, rest[0])
+            # manifest params already omit self/cls for all method kinds
+            return stub_attr, method["params"]
+    raise KeyError(f"{target} not in manifest")
+
+
+def _bind(params, n_args: int, kwargs: list):
+    signature = _signature(params)
+    signature.bind(*([object()] * n_args), **{k: object() for k in kwargs})
+
+
+def _stub_bind(stub, n_args: int, kwargs: list, *, is_method=False):
+    """Bind the call shape against the STUB's actual signature."""
+    if inspect.isclass(stub):
+        signature = inspect.signature(stub)  # __init__ minus self
+    else:
+        signature = inspect.signature(stub)
+        if is_method:
+            # unbound function from the class: skip self
+            params = list(signature.parameters.values())
+            if params and params[0].name in ("self",):
+                signature = signature.replace(parameters=params[1:])
+    signature.bind(*([object()] * n_args), **{k: object() for k in kwargs})
+
+
+# ------------------------------------------------------------------ #
+# 1. stub surface: every manifest symbol exists in the stubs with the
+#    recorded attributes
+# ------------------------------------------------------------------ #
+@pytest.mark.parametrize("symbol", sorted(MANIFEST["symbols"]))
+def test_stub_symbol_exists_and_matches(symbol):
+    entry = MANIFEST["symbols"][symbol]
+    module, name = symbol.rsplit(".", 1)
+    stub_mod = _stub_import(module)
+    assert hasattr(stub_mod, name), f"stub missing {symbol}"
+    stub = getattr(stub_mod, name)
+    if entry["kind"] == "class":
+        assert inspect.isclass(stub), f"{symbol} is not a class in the stub"
+    for method, spec in (entry.get("methods") or {}).items():
+        assert hasattr(stub, method), f"stub {symbol} missing .{method}"
+        if spec.get("classmethod"):
+            raw = inspect.getattr_static(stub, method)
+            assert isinstance(raw, (classmethod, staticmethod)), (
+                f"{symbol}.{method} must be a class/static method"
+            )
+    # attribute contract: instantiable symbols expose the recorded
+    # attributes after construction with minimal string args
+    attributes = entry.get("attributes") or []
+    if attributes and entry.get("init"):
+        required = [
+            p for p in entry["init"]
+            if p["required"] and p["kind"] in ("pos",)
+        ]
+        known = entry.get("init_known_kwargs") or []
+        try:
+            if required:
+                instance = stub(*["x"] * len(required))
+            elif "text" in known:
+                instance = stub(text="x")
+            else:
+                instance = stub()
+        except Exception as error:  # noqa: BLE001
+            raise AssertionError(
+                f"stub {symbol} not constructible with recorded shape: "
+                f"{error!r}"
+            ) from None
+        for attribute in attributes:
+            assert hasattr(instance, attribute), (
+                f"stub {symbol} instance lacks .{attribute}"
+            )
+
+
+# ------------------------------------------------------------------ #
+# 2. curated instance-method call shapes bind against real AND stub
+# ------------------------------------------------------------------ #
+@pytest.mark.parametrize(
+    "call", MANIFEST["port_calls"], ids=lambda c: c["target"]
+)
+def test_port_call_shape_binds(call):
+    stub, params = _resolve(call["target"])
+    # against the recorded REAL signature
+    _bind(params, call["args"], call["kwargs"])
+    # against the stub as shipped
+    is_method = (
+        "." in call["target"]
+        and call["target"].rsplit(".", 1)[0] in MANIFEST["symbols"]
+        and not inspect.isclass(stub)
+        and not inspect.ismethod(stub)  # classmethods arrive bound
+    )
+    _stub_bind(stub, call["args"], call["kwargs"], is_method=is_method)
+
+
+# ------------------------------------------------------------------ #
+# 3. AST sweep of the port sources: every direct constructor /
+#    classmethod call on an imported third-party symbol must bind
+#    against the recorded real signature (catches a port drifting onto
+#    a stub-only lax signature — the from_texts(texts)-without-
+#    embedding class of bug)
+# ------------------------------------------------------------------ #
+def _port_calls_from_source(path: Path):
+    tree = ast.parse(path.read_text())
+    imported = {}  # local name -> fq symbol
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module and (
+            node.module.split(".")[0] in (
+                "langchain_core", "langchain_openai", "llama_index",
+                "cassandra",
+            )
+        ):
+            for alias in node.names:
+                imported[alias.asname or alias.name] = (
+                    f"{node.module}.{alias.name}"
+                )
+    calls = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        target = None
+        if isinstance(func, ast.Name) and func.id in imported:
+            target = imported[func.id]
+        elif (
+            isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+            and func.value.id in imported
+        ):
+            target = f"{imported[func.value.id]}.{func.attr}"
+        if target is None:
+            continue
+        n_args = len([a for a in node.args if not isinstance(a, ast.Starred)])
+        kwargs = [k.arg for k in node.keywords if k.arg is not None]
+        calls.append((target, n_args, kwargs))
+    return calls
+
+
+@pytest.mark.parametrize("path", PORT_FILES, ids=lambda p: p.parent.parent.name)
+def test_port_source_calls_bind_against_real_api(path):
+    calls = _port_calls_from_source(path)
+    assert calls, f"no third-party calls found in {path} (AST sweep broken?)"
+    failures = []
+    for target, n_args, kwargs in calls:
+        try:
+            _stub_resolved, params = _resolve(target)
+        except KeyError:
+            failures.append(f"{target}: symbol not recorded in MANIFEST.json")
+            continue
+        try:
+            _bind(params, n_args, kwargs)
+        except TypeError as error:
+            failures.append(
+                f"{target}({n_args} args, kwargs={kwargs}): does not bind "
+                f"against the recorded real signature: {error}"
+            )
+    assert not failures, "\n".join(failures)

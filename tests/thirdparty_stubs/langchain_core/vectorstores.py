@@ -21,7 +21,9 @@ class InMemoryVectorStore:
         self.texts = list(texts or [])
 
     @classmethod
-    def from_texts(cls, texts, embedding=None, **_):
+    def from_texts(cls, texts, embedding, metadatas=None, **_):
+        # embedding is REQUIRED in the real API; the stub's retrieval is
+        # word-overlap so the embedding itself is unused here
         return cls(texts)
 
     def as_retriever(self, **_):

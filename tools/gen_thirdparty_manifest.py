@@ -1,0 +1,224 @@
+#!/usr/bin/env python
+"""Cross-check (or regenerate) tests/thirdparty_stubs/MANIFEST.json
+against the REAL third-party packages.
+
+This build environment has no network, so the manifest is a checked-in
+recording of the public APIs at the pinned versions and the stub-pin
+suite validates against the recording. Anywhere the real packages ARE
+installed (CI with `pip install langchain-core langchain-openai
+llama-index cassandra-driver`, a developer laptop), this script closes
+the loop with reality:
+
+    python tools/gen_thirdparty_manifest.py --check   # exit 1 on drift
+    python tools/gen_thirdparty_manifest.py --update  # rewrite manifest
+
+For each symbol recorded in the manifest it imports the real object and
+compares the recorded parameters against ``inspect.signature`` — names,
+kinds, and requiredness for the parameters the manifest records (the
+real signature may have MORE optional parameters; that is not drift).
+Symbols whose packages are not installed are reported and skipped.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import inspect
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+MANIFEST_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tests", "thirdparty_stubs", "MANIFEST.json",
+)
+
+_KIND_NAMES = {
+    inspect.Parameter.POSITIONAL_ONLY: "pos",
+    inspect.Parameter.POSITIONAL_OR_KEYWORD: "pos",
+    inspect.Parameter.KEYWORD_ONLY: "kwonly",
+    inspect.Parameter.VAR_POSITIONAL: "var_pos",
+    inspect.Parameter.VAR_KEYWORD: "var_kw",
+}
+
+
+def _real_params(obj) -> Optional[List[Dict[str, Any]]]:
+    try:
+        signature = inspect.signature(obj)
+    except (TypeError, ValueError):
+        return None
+    out = []
+    for param in signature.parameters.values():
+        if param.name in ("self", "cls"):
+            continue
+        out.append({
+            "name": param.name,
+            "kind": _KIND_NAMES[param.kind],
+            "required": (
+                param.default is inspect.Parameter.empty
+                and param.kind in (
+                    inspect.Parameter.POSITIONAL_ONLY,
+                    inspect.Parameter.POSITIONAL_OR_KEYWORD,
+                    inspect.Parameter.KEYWORD_ONLY,
+                )
+            ),
+        })
+    return out
+
+
+def _compare(recorded: List[dict], real: List[dict], where: str) -> List[str]:
+    """Recorded params must be a compatible subset of the real ones:
+    same name and requiredness, and a recorded 'pos' (callable
+    positionally) must not have become keyword-only in the real API —
+    that breaks positional call sites even though the name survives.
+    Extra OPTIONAL real params are fine, extra REQUIRED ones are drift."""
+    problems = []
+    real_by_name = {p["name"]: p for p in real}
+    for param in recorded:
+        if param["kind"] in ("var_pos", "var_kw"):
+            continue  # placeholders for "accepts more"
+        actual = real_by_name.get(param["name"])
+        if actual is None:
+            problems.append(f"{where}: param {param['name']!r} not in real API")
+            continue
+        if bool(param["required"]) != bool(actual["required"]):
+            problems.append(
+                f"{where}: param {param['name']!r} required="
+                f"{actual['required']} in real API, recorded "
+                f"{param['required']}"
+            )
+        if param["kind"] == "pos" and actual["kind"] == "kwonly":
+            problems.append(
+                f"{where}: param {param['name']!r} is keyword-only in the "
+                f"real API but recorded as positional-capable"
+            )
+    recorded_names = {p["name"] for p in recorded}
+    for param in real:
+        if param["required"] and param["name"] not in recorded_names:
+            problems.append(
+                f"{where}: real API REQUIRES {param['name']!r}, "
+                f"not recorded"
+            )
+    return problems
+
+
+def check(manifest: Dict[str, Any]) -> Tuple[List[str], List[str]]:
+    problems: List[str] = []
+    skipped: List[str] = []
+    for symbol, entry in manifest["symbols"].items():
+        module_name, attr = symbol.rsplit(".", 1)
+        try:
+            module = importlib.import_module(module_name)
+        except ImportError:
+            skipped.append(symbol)
+            continue
+        obj = getattr(module, attr, None)
+        if obj is None:
+            problems.append(f"{symbol}: missing from real package")
+            continue
+        if entry.get("init"):
+            real = _real_params(obj)
+            if real is not None:
+                problems.extend(_compare(entry["init"], real, symbol))
+        for method, spec in (entry.get("methods") or {}).items():
+            real_method = inspect.getattr_static(obj, method, None)
+            if real_method is None:
+                problems.append(f"{symbol}.{method}: missing from real API")
+                continue
+            func = (
+                real_method.__func__
+                if isinstance(real_method, (classmethod, staticmethod))
+                else real_method
+            )
+            real = _real_params(func)
+            if real is not None:
+                problems.extend(
+                    _compare(spec["params"], real, f"{symbol}.{method}")
+                )
+        for attribute in entry.get("attributes") or []:
+            # presence is checked on instances by the stub-pin suite;
+            # here just require the real class to know the name somewhere
+            if not any(
+                attribute in getattr(klass, "__annotations__", {})
+                or hasattr(klass, attribute)
+                for klass in getattr(obj, "__mro__", (obj,))
+            ):
+                problems.append(
+                    f"{symbol}: attribute {attribute!r} not found on real "
+                    f"class"
+                )
+    return problems, skipped
+
+
+def update(manifest: Dict[str, Any]) -> int:
+    """Rewrite importable symbols' recorded params from the real
+    signatures (attributes and classmethod flags are kept). Returns the
+    number of symbols refreshed; unimportable entries stay as recorded."""
+    refreshed = 0
+    for symbol, entry in manifest["symbols"].items():
+        module_name, attr = symbol.rsplit(".", 1)
+        try:
+            obj = getattr(importlib.import_module(module_name), attr)
+        except (ImportError, AttributeError):
+            continue
+        if entry.get("init"):
+            real = _real_params(obj)
+            if real is not None:
+                entry["init"] = real
+        for method, spec in (entry.get("methods") or {}).items():
+            real_method = inspect.getattr_static(obj, method, None)
+            if real_method is None:
+                continue
+            func = (
+                real_method.__func__
+                if isinstance(real_method, (classmethod, staticmethod))
+                else real_method
+            )
+            real = _real_params(func)
+            if real is not None:
+                spec["params"] = real
+        refreshed += 1
+    with open(MANIFEST_PATH, "w") as fh:
+        json.dump(manifest, fh, indent=1)
+        fh.write("\n")
+    return refreshed
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument(
+        "--check", action="store_true",
+        help="exit 1 on drift OR on any unimportable package (a check "
+             "that validated nothing must not pass)",
+    )
+    parser.add_argument(
+        "--update", action="store_true",
+        help="rewrite the manifest's recorded params from the installed "
+             "real packages (git diff is the review artifact)",
+    )
+    args = parser.parse_args()
+    with open(MANIFEST_PATH) as fh:
+        manifest = json.load(fh)
+    if args.update:
+        refreshed = update(manifest)
+        print(f"refreshed {refreshed}/{len(manifest['symbols'])} symbols "
+              f"from installed packages; review with git diff")
+        sys.exit(0 if refreshed else 1)
+    problems, skipped = check(manifest)
+    for symbol in skipped:
+        print(f"SKIP (package not installed): {symbol}")
+    for problem in problems:
+        print(f"DRIFT: {problem}")
+    if not skipped and not problems:
+        print(f"manifest matches the installed packages "
+              f"({len(manifest['symbols'])} symbols)")
+    if args.check and skipped:
+        print("--check: unimportable packages above mean nothing was "
+              "validated for them — failing")
+        sys.exit(1)
+    sys.exit(1 if problems else 0)
+
+
+if __name__ == "__main__":
+    main()
