@@ -24,7 +24,19 @@ own sources, keeping pipeline definitions portable as-is:
   component options ``brokers``/``groupId``/``autoOffsetReset``);
 - ``netty-http:http://bind:port/path`` — embedded HTTP *server*
   consumer (Camel's netty-http in ``from()`` position listens): every
-  incoming request becomes a record.
+  incoming request becomes a record;
+- ``aws2-s3://bucket?accessKey=…&deleteAfterRead=false`` — S3 object
+  polling via the native SigV4 client (``agents/storage.S3Source``);
+- ``azure-storage-blob://account/container?accessKey=…`` — Azure blob
+  polling via the native REST client;
+- ``pulsar:persistent://tenant/ns/topic?webServiceUrl=…`` — Pulsar
+  consumer via the framework's WebSocket runtime (``topics/pulsar``) —
+  the messaging analogue; the binary ``serviceUrl`` protocol errors
+  with guidance.
+
+Unsupported schemes fail **at plan time** (planner calls
+:func:`validate_component_uri`) with the supported list and the
+exec-source bridge recipe.
 
 Anything else raises with the honest escape hatch: register a scheme
 mapping from a plugin, or run the real Camel route in its own process
@@ -331,6 +343,158 @@ def _file_endpoint(path: str, pairs: List[Tuple[str, str]]) -> AgentSource:
     return source
 
 
+def _polling_options(pairs: List[Tuple[str, str]]) -> Dict[str, Any]:
+    """The option triple every object-store polling consumer shares
+    (Camel spellings → native source config) — one copy, two users."""
+    return {
+        "delete-objects": _last(pairs, "deleteAfterRead", "true").lower()
+        != "false",
+        "idle-time": _duration_ms(_last(pairs, "delay", "5000"), "delay")
+        / 1000.0,
+        "file-extensions": _last(pairs, "fileExtensions", ""),
+    }
+
+
+def _s3_endpoint(path: str, pairs: List[Tuple[str, str]]) -> AgentSource:
+    """``aws2-s3://bucket?accessKey=&secretKey=&region=&delay=5s&
+    deleteAfterRead=false&uriEndpointOverride=http://minio:9000`` —
+    Camel's aws2-s3 polling consumer mapped onto the framework's own
+    :class:`agents.storage.S3Source` (SigV4 client, delete-on-commit).
+    Camel option names per the aws2-s3 endpoint docs; deleteAfterRead
+    defaults true there, so it does here too."""
+    from langstream_tpu.agents.storage import S3Source
+
+    bucket = path.strip("/")
+    if not bucket:
+        raise ValueError("camel-source: aws2-s3 URI needs a bucket name")
+    endpoint = _last(pairs, "uriEndpointOverride", "")
+    region = _last(pairs, "region", "us-east-1")
+    source = S3Source()
+    source._camel_init_config = {
+        "bucketName": bucket,
+        "endpoint": endpoint or f"https://s3.{region}.amazonaws.com",
+        "access-key": _last(pairs, "accessKey", ""),
+        "secret-key": _last(pairs, "secretKey", ""),
+        "region": region,
+        **_polling_options(pairs),
+    }
+    return source
+
+
+def _azure_blob_endpoint(path: str, pairs: List[Tuple[str, str]]) -> AgentSource:
+    """``azure-storage-blob://account/container?accessKey=…`` — Camel's
+    azure-storage-blob consumer mapped onto
+    :class:`agents.storage.AzureBlobStorageSource` (native Azure REST
+    client). Path is accountName[/containerName], per the Camel
+    component; connectionString / sasToken options supported."""
+    from langstream_tpu.agents.storage import AzureBlobStorageSource
+
+    account, _, container = path.strip("/").partition("/")
+    connection = _last(pairs, "connectionString", "")
+    if not account and not connection:
+        raise ValueError(
+            "camel-source: azure-storage-blob URI needs "
+            "accountName/containerName (or a connectionString option)"
+        )
+    if not container:
+        # a silent default container would poll the wrong place and
+        # yield an empty stream with no clue — the consumer endpoint
+        # must name its container
+        raise ValueError(
+            "camel-source: azure-storage-blob URI needs a container "
+            "segment (azure-storage-blob://account/container)"
+        )
+    source = AzureBlobStorageSource()
+    config: Dict[str, Any] = {
+        "container": container,
+        **_polling_options(pairs),
+    }
+    if connection:
+        config["storage-account-connection-string"] = connection
+    if account:
+        config["storage-account-name"] = account
+    access_key = _last(pairs, "accessKey", "")
+    if access_key:
+        config["storage-account-key"] = access_key
+    sas = _last(pairs, "sasToken", "")
+    if sas:
+        config["sas-token"] = sas
+    source._camel_init_config = config
+    return source
+
+
+class _PulsarEndpoint(AgentSource):
+    """``pulsar:persistent://tenant/ns/topic?webServiceUrl=…&
+    subscriptionName=sub`` — Camel's pulsar consumer mapped onto the
+    framework's own Pulsar runtime (topics/pulsar.py, WebSocket API).
+    The messaging analogue in the scheme registry: Camel's
+    ``serviceUrl`` (binary protocol, pulsar://host:6650) is NOT spoken
+    natively — pass ``webServiceUrl`` (the HTTP/WebSocket endpoint) or
+    run the real Camel route via exec-source."""
+
+    def __init__(self, path: str, pairs: List[Tuple[str, str]]) -> None:
+        from langstream_tpu.topics.pulsar import (
+            PulsarTopicConnectionsRuntime,
+        )
+
+        service = _last(pairs, "serviceUrl", "")
+        web = _last(pairs, "webServiceUrl", "")
+        if service.startswith("pulsar://") and not web:
+            raise ValueError(
+                "camel-source: the pulsar binary protocol "
+                f"({service!r}) is not spoken natively — pass "
+                "webServiceUrl=<http endpoint> (the WebSocket API), or "
+                "bridge the real Camel route with exec-source"
+            )
+        topic = path.strip("/")
+        tenant, namespace = "public", "default"
+        if topic.startswith("non-persistent://"):
+            # the runtime's WebSocket paths are persistent-only
+            # (topics/pulsar.py _full_topic) — consuming the persistent
+            # topic of the same name silently would read the wrong stream
+            raise ValueError(
+                "camel-source: non-persistent pulsar topics are not "
+                "supported by the native runtime — use a persistent "
+                "topic, or bridge the real Camel route with exec-source"
+            )
+        if topic.startswith("persistent://"):
+            parts = topic.split("://", 1)[1].split("/")
+            if len(parts) != 3:
+                raise ValueError(
+                    f"camel-source: bad pulsar topic {topic!r} (want "
+                    "persistent://tenant/namespace/topic)"
+                )
+            tenant, namespace, topic = parts
+        if not topic:
+            raise ValueError("camel-source: pulsar URI needs a topic")
+        self.topic = topic
+        self._runtime = PulsarTopicConnectionsRuntime({
+            "webServiceUrl": web or "http://localhost:8080",
+            "tenant": tenant,
+            "namespace": namespace,
+        })
+        self._consumer = self._runtime.create_consumer(
+            "camel-source",
+            {
+                "topic": topic,
+                "group": _last(pairs, "subscriptionName", "") or None,
+            },
+        )
+
+    async def start(self) -> None:
+        await self._consumer.start()
+
+    async def read(self, max_records: int = 100) -> List[Record]:
+        return await self._consumer.read(max_records, timeout=0.5)
+
+    async def commit(self, records: List[Record]) -> None:
+        await self._consumer.commit(records)
+
+    async def close(self) -> None:
+        await self._consumer.close()
+        await self._runtime.close()
+
+
 # scheme → factory(path, pairs) -> AgentSource. Extensible: plugin
 # packages call register_camel_scheme to map more component families.
 CAMEL_SCHEMES: Dict[str, Callable[[str, List[Tuple[str, str]]], AgentSource]] = {
@@ -338,7 +502,65 @@ CAMEL_SCHEMES: Dict[str, Callable[[str, List[Tuple[str, str]]], AgentSource]] = 
     "file": _file_endpoint,
     "kafka": _KafkaEndpoint,
     "netty-http": _NettyHttpEndpoint,
+    "aws2-s3": _s3_endpoint,
+    "azure-storage-blob": _azure_blob_endpoint,
+    "pulsar": _PulsarEndpoint,
 }
+
+
+def supported_schemes() -> List[str]:
+    """All natively-mapped scheme spellings (registry + http/https) —
+    the single list both the runtime error and the PLAN-TIME validator
+    print, so guidance can't drift from reality."""
+    return sorted(CAMEL_SCHEMES) + ["http", "https"]
+
+
+def _unsupported_scheme_message(scheme: str) -> str:
+    return (
+        f"camel-source component {scheme!r} has no native mapping "
+        f"(supported: {', '.join(supported_schemes())}); register one "
+        "with langstream_tpu.agents.camel.register_camel_scheme from a "
+        "plugin package (declare `expect-plugin-scheme: true` on the "
+        "agent so the planner defers the check to runtime), or run the "
+        "real Camel route in its own process and bridge it with "
+        "exec-source (agents/connector.py)"
+    )
+
+
+def validate_component_uri(
+    uri: str,
+    options: Optional[Dict[str, Any]] = None,
+    expect_plugin_scheme: bool = False,
+) -> Optional[str]:
+    """Plan-time check for the planner's config validation: returns an
+    actionable error string for an unsupported/unparseable URI, None
+    when the URI maps to a native scheme.
+
+    The SCHEME is judged statically even when the query string carries
+    unresolved placeholders (``jms:q?password=${secrets.pw}`` must still
+    fail at plan time); only a placeholder in the scheme segment itself
+    defers the check. ``expect_plugin_scheme`` (the agent's
+    ``expect-plugin-scheme: true``) defers unknown schemes to runtime —
+    plugin packages register schemes when the pod loads them, which the
+    planner cannot see."""
+    if not uri:
+        return None
+    scheme_segment = uri.partition(":")[0]
+    if "${" in scheme_segment:
+        return None  # resolves per-deploy
+    if not isinstance(options, dict):
+        options = None
+    try:
+        scheme, _path, _pairs = parse_component_uri(
+            uri.partition("?")[0], options
+        )
+    except ValueError as error:
+        return str(error)
+    if scheme in CAMEL_SCHEMES or scheme in ("http", "https"):
+        return None
+    if expect_plugin_scheme:
+        return None
+    return _unsupported_scheme_message(scheme)
 
 
 def register_camel_scheme(
@@ -365,15 +587,13 @@ class CamelSourceAgent(AgentSource):
         elif self.scheme in CAMEL_SCHEMES:
             self._delegate = CAMEL_SCHEMES[self.scheme](path, pairs)
         else:
+            # normally unreachable: the planner rejects unsupported URIs
+            # at plan time with the same message (validate_component_uri)
+            # — this guards direct/SDK construction and plugin schemes
+            # that never got registered
             raise ValueError(
-                f"camel-source component {self.scheme!r} has no native "
-                f"mapping (supported: "
-                f"{', '.join(sorted(CAMEL_SCHEMES) + ['http', 'https'])}); "
-                "register one with "
-                "langstream_tpu.agents.camel.register_camel_scheme from a "
-                "plugin package, or run the real Camel route in its own "
-                "process and bridge it with exec-source "
-                "(agents/connector.py)"
+                validate_component_uri(uri)
+                or _unsupported_scheme_message(self.scheme)
             )
         init_config = getattr(self._delegate, "_camel_init_config", None)
         if init_config is not None:

@@ -352,6 +352,27 @@ def _validate_agent_configs(application: Application) -> None:
                 errors.extend(
                     validate_agent_config(agent.type, agent.configuration)
                 )
+                if agent.type == "camel-source":
+                    # unsupported Camel URIs must fail AT PLAN TIME with
+                    # the scheme list + exec-bridge recipe, not when the
+                    # pod boots (reference escape hatch: CamelSource
+                    # accepts any URI because it has the whole JVM zoo)
+                    from langstream_tpu.agents.camel import (
+                        validate_component_uri,
+                    )
+
+                    options = agent.configuration.get("component-options")
+                    problem = validate_component_uri(
+                        str(agent.configuration.get("component-uri") or ""),
+                        options if isinstance(options, dict) else None,
+                        expect_plugin_scheme=str(
+                            agent.configuration.get(
+                                "expect-plugin-scheme", ""
+                            )
+                        ).lower() in ("1", "true", "yes"),
+                    )
+                    if problem:
+                        errors.append(f"camel-source: {problem}")
     if errors:
         raise ValueError(
             "invalid agent configuration:\n  " + "\n  ".join(errors)

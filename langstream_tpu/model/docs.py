@@ -433,12 +433,18 @@ for doc in [
         _P("headers", "object", "extra HTTP headers"),
     )),
     AgentDoc("camel-source", "Consume a Camel endpoint URI (native "
-             "timer/file/http mappings; exec-source for the rest)", (
+             "timer/file/http(s)/kafka/netty-http/aws2-s3/"
+             "azure-storage-blob/pulsar mappings; plugin schemes or "
+             "exec-source for the rest)", (
         _P("component-uri", "string",
            "Camel endpoint, e.g. timer:tick?period=1000", required=True),
         _P("component-options", "object", "extra endpoint parameters"),
         _P("key-header", "string", "header whose value becomes the key"),
         _P("max-buffered-records", "integer", "read batch cap", default=100),
+        _P("expect-plugin-scheme", "boolean",
+           "defer unknown-scheme validation to runtime (a plugin "
+           "package registers the scheme when the pod loads)",
+           default=False),
     ), category="source"),
     AgentDoc("exec-source", "Run a command; stdout lines become records", (
         _P("command", "string", "command line to run", required=True),

@@ -569,3 +569,226 @@ def test_camel_scheme_registry_extensible():
         asyncio.run(main())
     finally:
         camel.CAMEL_SCHEMES.pop("jms", None)
+
+
+def test_camel_source_aws2_s3_uri():
+    """aws2-s3://bucket?... maps onto the native S3Source against the
+    mock S3 server: objects become records, deleteAfterRead honored on
+    commit (Camel's default true)."""
+    import threading
+
+    from test_s3_codestorage import MockS3Server
+
+    from langstream_tpu.runtime.registry import create_agent
+
+    server = MockS3Server()
+    loop = asyncio.new_event_loop()
+    thread = threading.Thread(target=loop.run_forever, daemon=True)
+    thread.start()
+    asyncio.run_coroutine_threadsafe(server.start(), loop).result(10)
+    try:
+        server.objects["camel-bucket"] = {"doc.txt": b"hello from s3"}
+
+        async def main():
+            agent = create_agent("camel-source")
+            await agent.init({
+                "component-uri": (
+                    "aws2-s3://camel-bucket"
+                    f"?uriEndpointOverride=http://127.0.0.1:{server.port}"
+                    "&accessKey=ak&secretKey=sk&delay=1ms"
+                ),
+            })
+            await agent.start()
+            got = []
+            for _ in range(50):
+                got.extend(await agent.read())
+                if got:
+                    break
+            assert got and got[0].value == b"hello from s3"
+            await agent.commit(got)
+            await agent.close()
+
+        asyncio.run(main())
+        # deleteAfterRead (default true) removed the object on commit
+        assert server.objects["camel-bucket"] == {}
+    finally:
+        asyncio.run_coroutine_threadsafe(server.stop(), loop).result(10)
+        loop.call_soon_threadsafe(loop.stop)
+        thread.join(5)
+
+
+def test_camel_source_pulsar_uri():
+    """pulsar:persistent://t/ns/topic?webServiceUrl=… consumes through
+    the framework's Pulsar runtime against the WebSocket mock."""
+    from pulsar_mock import MockPulsar
+
+    from langstream_tpu.api.records import Record
+    from langstream_tpu.runtime.registry import create_agent
+    from langstream_tpu.topics.pulsar import PulsarTopicConnectionsRuntime
+
+    async def main():
+        mock = await MockPulsar().start()
+        try:
+            runtime = PulsarTopicConnectionsRuntime({
+                "webServiceUrl": f"http://127.0.0.1:{mock.port}",
+                "tenant": "t1", "namespace": "ns1",
+            })
+            producer = runtime.create_producer("seed", {"topic": "cam"})
+            await producer.start()
+            await producer.write(Record(value="via-camel"))
+
+            agent = create_agent("camel-source")
+            await agent.init({
+                "component-uri": (
+                    "pulsar:persistent://t1/ns1/cam"
+                    f"?webServiceUrl=http://127.0.0.1:{mock.port}"
+                    "&subscriptionName=sub-1"
+                ),
+            })
+            await agent.start()
+            got = []
+            for _ in range(50):
+                got.extend(await agent.read())
+                if got:
+                    break
+            assert got and got[0].value == "via-camel"
+            await agent.commit(got)
+            await agent.close()
+            await runtime.close()
+        finally:
+            await mock.close()
+
+    asyncio.run(main())
+
+
+def test_camel_source_pulsar_binary_protocol_guidance():
+    from langstream_tpu.runtime.registry import create_agent
+
+    async def main():
+        agent = create_agent("camel-source")
+        with pytest.raises(ValueError, match="webServiceUrl"):
+            await agent.init({
+                "component-uri":
+                    "pulsar:topic?serviceUrl=pulsar://broker:6650",
+            })
+
+    asyncio.run(main())
+
+
+def test_camel_unsupported_uri_fails_at_plan_time(tmp_path):
+    """An unsupported Camel scheme is rejected when the app is PLANNED
+    (scheme list + exec-bridge recipe in the message), not when the pod
+    boots; supported schemes plan clean. Placeholder URIs are deferred."""
+    from langstream_tpu.compiler.parser import build_application
+    from langstream_tpu.compiler.planner import build_execution_plan
+
+    def app_with(uri: str):
+        app_dir = tmp_path / uri.partition(":")[0].replace("/", "_")
+        app_dir.mkdir(exist_ok=True)
+        (app_dir / "pipeline.yaml").write_text(f"""
+topics:
+  - name: out-t
+    creation-mode: create-if-not-exists
+pipeline:
+  - name: src
+    type: camel-source
+    output: out-t
+    configuration:
+      component-uri: "{uri}"
+""")
+        (app_dir / "configuration.yaml").write_text("configuration: {}\n")
+        (app_dir / "instance.yaml").write_text(
+            "instance:\n"
+            "  streamingCluster: {type: memory}\n"
+            "  computeCluster: {type: local}\n"
+        )
+        return build_application(
+            str(app_dir), instance_file=str(app_dir / "instance.yaml")
+        )
+
+    with pytest.raises(ValueError) as excinfo:
+        build_execution_plan(app_with("jms:queue:orders"))
+    message = str(excinfo.value)
+    assert "no native mapping" in message
+    assert "aws2-s3" in message and "exec-source" in message
+
+    # supported + placeholder-bearing URIs plan clean
+    for uri in (
+        "timer:t?period=100",
+        "aws2-s3://bkt?accessKey=a&secretKey=s",
+        "pulsar:topic?webServiceUrl=http://p:8080",
+        "azure-storage-blob://acct/cont?accessKey=k",
+        "kafka:t?brokers=h:9092",
+        "${globals.camel-uri:-}",
+    ):
+        build_execution_plan(app_with(uri))
+
+
+def test_camel_plan_time_edge_cases(tmp_path):
+    """Plugin schemes defer with expect-plugin-scheme; a placeholder in
+    the QUERY does not smuggle an unsupported scheme past the planner;
+    non-dict component-options reports, not crashes."""
+    from langstream_tpu.agents.camel import validate_component_uri
+
+    # unsupported scheme with placeholder OPTIONS still fails statically
+    problem = validate_component_uri("jms:orders?password=${secrets.pw}")
+    assert problem and "no native mapping" in problem
+    # placeholder in the scheme segment defers
+    assert validate_component_uri("${globals.scheme}:x?y=1") is None
+    # plugin opt-out defers unknown schemes to runtime
+    assert validate_component_uri(
+        "jms:orders", expect_plugin_scheme=True
+    ) is None
+    # non-dict options must not crash
+    assert validate_component_uri("timer:t?period=5", options="bogus") is None
+
+    # through the planner: expect-plugin-scheme plans clean
+    from langstream_tpu.compiler.parser import build_application
+    from langstream_tpu.compiler.planner import build_execution_plan
+
+    app_dir = tmp_path / "plug"
+    app_dir.mkdir()
+    (app_dir / "pipeline.yaml").write_text("""
+topics:
+  - name: out-t
+    creation-mode: create-if-not-exists
+pipeline:
+  - name: src
+    type: camel-source
+    output: out-t
+    configuration:
+      component-uri: "jms:queue:orders"
+      expect-plugin-scheme: true
+""")
+    (app_dir / "configuration.yaml").write_text("configuration: {}\n")
+    (app_dir / "instance.yaml").write_text(
+        "instance:\n  streamingCluster: {type: memory}\n"
+        "  computeCluster: {type: local}\n"
+    )
+    build_execution_plan(build_application(
+        str(app_dir), instance_file=str(app_dir / "instance.yaml")
+    ))
+
+
+def test_camel_azure_and_pulsar_uri_validation():
+    from langstream_tpu.runtime.registry import create_agent
+
+    async def main():
+        # azure without a container segment: explicit error, no silent
+        # default container
+        agent = create_agent("camel-source")
+        with pytest.raises(ValueError, match="container"):
+            await agent.init({
+                "component-uri": "azure-storage-blob://acct?accessKey=k",
+            })
+        # non-persistent pulsar topics refuse rather than silently read
+        # the persistent topic of the same name
+        agent = create_agent("camel-source")
+        with pytest.raises(ValueError, match="non-persistent"):
+            await agent.init({
+                "component-uri":
+                    "pulsar:non-persistent://t/ns/x"
+                    "?webServiceUrl=http://p:8080",
+            })
+
+    asyncio.run(main())
