@@ -439,7 +439,10 @@ class _PulsarEndpoint(AgentSource):
 
         service = _last(pairs, "serviceUrl", "")
         web = _last(pairs, "webServiceUrl", "")
-        if service.startswith("pulsar://") and not web:
+        if service and not web:
+            # serviceUrl is the binary protocol by definition (pulsar://
+            # or pulsar+ssl://) — consuming the default localhost web
+            # endpoint instead would silently read nothing
             raise ValueError(
                 "camel-source: the pulsar binary protocol "
                 f"({service!r}) is not spoken natively — pass "
@@ -468,8 +471,9 @@ class _PulsarEndpoint(AgentSource):
         if not topic:
             raise ValueError("camel-source: pulsar URI needs a topic")
         self.topic = topic
+        # the runtime owns the localhost default for a missing endpoint
         self._runtime = PulsarTopicConnectionsRuntime({
-            "webServiceUrl": web or "http://localhost:8080",
+            "webServiceUrl": web,
             "tenant": tenant,
             "namespace": namespace,
         })
@@ -551,9 +555,7 @@ def validate_component_uri(
     if not isinstance(options, dict):
         options = None
     try:
-        scheme, _path, _pairs = parse_component_uri(
-            uri.partition("?")[0], options
-        )
+        scheme, _path, _pairs = parse_component_uri(uri, options)
     except ValueError as error:
         return str(error)
     if scheme in CAMEL_SCHEMES or scheme in ("http", "https"):

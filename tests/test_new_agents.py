@@ -792,3 +792,25 @@ def test_camel_azure_and_pulsar_uri_validation():
             })
 
     asyncio.run(main())
+
+
+def test_camel_pulsar_tls_binary_and_empty_path_uris():
+    """pulsar+ssl:// serviceUrl gets the same guidance as pulsar:// (any
+    serviceUrl without webServiceUrl is binary-protocol), and a URI the
+    runtime accepts (timer:?period=…) is not rejected at plan time."""
+    from langstream_tpu.agents.camel import validate_component_uri
+    from langstream_tpu.runtime.registry import create_agent
+
+    async def main():
+        agent = create_agent("camel-source")
+        with pytest.raises(ValueError, match="webServiceUrl"):
+            await agent.init({
+                "component-uri":
+                    "pulsar:topic?serviceUrl=pulsar+ssl://broker:6651",
+            })
+
+    asyncio.run(main())
+    # plan-time and runtime agree on the full URI, query included
+    assert validate_component_uri("timer:t?period=100") is None
+    problem = validate_component_uri("timer:")
+    assert problem and "not a Camel endpoint URI" in problem

@@ -48,6 +48,7 @@ SHARDS: Dict[str, List[str]] = {
         "test_kube_app_store",
         "test_helm_chart",
         "test_k8s_schema_validation",
+        "test_e2e_tier",
         "test_s3_codestorage",
         "test_cli_admin",
         "test_gateway",
