@@ -555,10 +555,27 @@ def validate_component_uri(
     if not isinstance(options, dict):
         options = None
     try:
-        scheme, _path, _pairs = parse_component_uri(uri, options)
+        scheme, path, _pairs = parse_component_uri(uri, options)
     except ValueError as error:
         return str(error)
     if scheme in CAMEL_SCHEMES or scheme in ("http", "https"):
+        # schemes whose endpoint is meaningless without a path must
+        # still fail at plan time when only a query is given
+        # ('kafka:?brokers=…' — topic forgotten); timer's name may be
+        # empty at runtime
+        needs_path = {
+            "kafka": "a topic name",
+            "pulsar": "a topic",
+            "aws2-s3": "a bucket name",
+            "azure-storage-blob": "accountName/containerName",
+            "file": "a directory path",
+            "netty-http": "a bind URL",
+        }
+        if scheme in needs_path and not path.strip("/"):
+            return (
+                f"camel-source: {scheme} URI needs {needs_path[scheme]} "
+                f"(got {uri!r})"
+            )
         return None
     if expect_plugin_scheme:
         return None

@@ -814,3 +814,19 @@ def test_camel_pulsar_tls_binary_and_empty_path_uris():
     assert validate_component_uri("timer:t?period=100") is None
     problem = validate_component_uri("timer:")
     assert problem and "not a Camel endpoint URI" in problem
+
+
+def test_camel_empty_path_schemes_fail_at_plan_time():
+    from langstream_tpu.agents.camel import validate_component_uri
+
+    for uri, needle in (
+        ("kafka:?brokers=b:9092", "topic name"),
+        ("pulsar:?webServiceUrl=http://p:8080", "a topic"),
+        ("aws2-s3:?accessKey=a", "bucket"),
+        ("azure-storage-blob:?accessKey=k", "accountName"),
+        ("file:?delete=true", "directory"),
+    ):
+        problem = validate_component_uri(uri)
+        assert problem and needle in problem, (uri, problem)
+    # timer's name may legitimately be empty
+    assert validate_component_uri("timer:?period=100") is None
