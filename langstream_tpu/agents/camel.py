@@ -511,6 +511,17 @@ CAMEL_SCHEMES: Dict[str, Callable[[str, List[Tuple[str, str]]], AgentSource]] = 
     "pulsar": _PulsarEndpoint,
 }
 
+# what the endpoint needs in the URI path, declared ON the factory —
+# the ONE source the plan-time validator reads, so runtime checks and
+# plan-time guidance can't drift, and plugin schemes opt in the same
+# way (timer's name may legitimately be empty)
+_KafkaEndpoint.requires_path = "a topic name"
+_PulsarEndpoint.requires_path = "a topic"
+_s3_endpoint.requires_path = "a bucket name"
+_azure_blob_endpoint.requires_path = "accountName/containerName"
+_file_endpoint.requires_path = "a directory path"
+_NettyHttpEndpoint.requires_path = "a bind URL"
+
 
 def supported_schemes() -> List[str]:
     """All natively-mapped scheme spellings (registry + http/https) —
@@ -559,23 +570,16 @@ def validate_component_uri(
     except ValueError as error:
         return str(error)
     if scheme in CAMEL_SCHEMES or scheme in ("http", "https"):
-        # schemes whose endpoint is meaningless without a path must
-        # still fail at plan time when only a query is given
-        # ('kafka:?brokers=…' — topic forgotten); timer's name may be
-        # empty at runtime
-        needs_path = {
-            "kafka": "a topic name",
-            "pulsar": "a topic",
-            "aws2-s3": "a bucket name",
-            "azure-storage-blob": "accountName/containerName",
-            "file": "a directory path",
-            "netty-http": "a bind URL",
-        }
-        if scheme in needs_path and not path.strip("/"):
-            return (
-                f"camel-source: {scheme} URI needs {needs_path[scheme]} "
-                f"(got {uri!r})"
-            )
+        # a query-only URI for a scheme that needs a path must still
+        # fail at plan time ('kafka:?brokers=…' — topic forgotten).
+        # The requirement lives on the factory (requires_path), one
+        # source shared with the runtime checks; http/https need a URL.
+        needs = (
+            "a URL" if scheme in ("http", "https")
+            else getattr(CAMEL_SCHEMES[scheme], "requires_path", None)
+        )
+        if needs and not path.strip("/"):
+            return f"camel-source: {scheme} URI needs {needs} (got {uri!r})"
         return None
     if expect_plugin_scheme:
         return None
@@ -587,7 +591,9 @@ def register_camel_scheme(
     factory: Callable[[str, List[Tuple[str, str]]], AgentSource],
 ) -> None:
     """Map an additional Camel component scheme onto a native source.
-    Plugin packages (runtime/plugins.py) use this to extend the zoo."""
+    Plugin packages (runtime/plugins.py) use this to extend the zoo.
+    Set ``factory.requires_path = "<what>"`` to get the plan-time
+    empty-path rejection the built-in schemes have."""
     CAMEL_SCHEMES[scheme.lower()] = factory
 
 

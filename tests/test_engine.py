@@ -400,15 +400,19 @@ def test_provider_end_to_end():
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("topk", [0, 2])
-def test_engine_fuzz_interleavings(topk):
+@pytest.mark.parametrize(
+    "topk,admission_chunk", [(0, None), (2, None), (0, 2)]
+)
+def test_engine_fuzz_interleavings(topk, admission_chunk):
     """Soak the whole loop at once: pipelined dispatch, staggered
     arrivals, session reuse under slot pressure, long prompts through
     chunked prefill, random sampling params, and cancellations racing
-    admission — with and without logprobs_topk, whose extra jit
-    outputs must survive every path. Every future must resolve; every
-    uncancelled result must be non-empty and within budget; the engine
-    must stay serviceable."""
+    admission — with and without logprobs_topk (whose extra jit
+    outputs must survive every path) and with the admission_chunk
+    short-chunk lever on (adds a second chunk size racing the same
+    interleavings). Every future must resolve; every uncancelled
+    result must be non-empty and within budget; the engine must stay
+    serviceable."""
     import random
 
     config = LlamaConfig.tiny(max_seq_len=192)
@@ -420,6 +424,7 @@ def test_engine_fuzz_interleavings(topk):
             config, params, max_slots=3, max_seq_len=192,
             prefill_buckets=[16, 32], decode_chunk=4,
             pipeline_decode=True, logprobs_topk=topk,
+            admission_chunk=admission_chunk,
         )
         engine.start()
 

@@ -830,3 +830,28 @@ def test_camel_empty_path_schemes_fail_at_plan_time():
         assert problem and needle in problem, (uri, problem)
     # timer's name may legitimately be empty
     assert validate_component_uri("timer:?period=100") is None
+
+
+def test_camel_http_empty_url_and_plugin_requires_path():
+    from langstream_tpu.agents.camel import (
+        CAMEL_SCHEMES,
+        register_camel_scheme,
+        validate_component_uri,
+    )
+
+    problem = validate_component_uri("http:?connectTimeout=5s")
+    assert problem and "a URL" in problem
+    assert validate_component_uri("http://example.com/feed?delay=1s") is None
+
+    # plugin schemes opt into the plan-time path check via the factory
+    def factory(path, pairs):  # pragma: no cover - never constructed
+        raise NotImplementedError
+
+    factory.requires_path = "a queue name"
+    register_camel_scheme("fakemq", factory)
+    try:
+        problem = validate_component_uri("fakemq:?broker=b")
+        assert problem and "a queue name" in problem
+        assert validate_component_uri("fakemq:orders") is None
+    finally:
+        CAMEL_SCHEMES.pop("fakemq", None)
