@@ -105,8 +105,26 @@ PEAK_HBM_GBS = 819.0
 # no line). Watchdog emits a failure record and hard-exits at the deadline.
 DEADLINE_S = float(os.environ.get("BENCH_DEADLINE", "1500"))
 INIT_TIMEOUT_S = float(os.environ.get("BENCH_INIT_TIMEOUT", "420"))
+# wall-clock anchor that SURVIVES re-exec: on a backend-init failure
+# with budget left, the bench execv's itself for a clean JAX state and
+# keeps trying until the deadline (a relay healing 8 minutes into the
+# driver's window must still yield a number). The deadline is measured
+# from the FIRST process's start.
+_EPOCH = float(os.environ.get("BENCH_EPOCH") or time.time())
+os.environ["BENCH_EPOCH"] = str(_EPOCH)
+# re-exec attempt number + accumulated phase timings from prior
+# attempts (critical-path accounting must span execs or the artifact
+# under-reports where the seconds went)
+_ATTEMPT = int(os.environ.get("BENCH_ATTEMPT") or "1")
 _START = time.monotonic()
 _EMITTED = threading.Lock()
+
+
+def deadline_remaining() -> float:
+    """Seconds left of the whole-attempt deadline. Wall-clock based so
+    it spans re-execs; callers needing single-process safety against
+    clock steps clamp with the monotonic budget too (see _watchdog)."""
+    return DEADLINE_S - (time.time() - _EPOCH)
 
 
 def log(*args):
@@ -121,8 +139,13 @@ _PHASE_T0 = _START
 # per-phase wall-clock (seconds), carried in every emitted record: the
 # warm-attempt critical path is an explicit engineering target (≤3 min
 # to first emitted number), so the artifact itself must show where the
-# seconds went
-_TIMINGS: dict = {}
+# seconds went. Seeded with prior attempts' timings across re-execs.
+try:
+    _TIMINGS: dict = dict(
+        json.loads(os.environ.get("BENCH_PRIOR_TIMINGS") or "{}")
+    )
+except (ValueError, TypeError):
+    _TIMINGS = {}
 
 
 def phase(name: str) -> None:
@@ -234,6 +257,8 @@ def emit_provisional(metric: str, tok_s: float, **extra) -> None:
         # last line is a provisional must stay attributable to its leg
         "decode_kernel": os.environ.get("LS_DECODE_FLASH", "") or "auto",
     }
+    if _ATTEMPT > 1:
+        line["attempt"] = _ATTEMPT
     line.update(extra)
     print(json.dumps(line), flush=True)
     _EMITTED_SUCCESS = True
@@ -269,6 +294,8 @@ def emit(metric: str, value: float, vs_baseline: float, **extra) -> bool:
         "vs_baseline": vs_baseline,
         "timings_s": timings(),
     }
+    if _ATTEMPT > 1:
+        line["attempt"] = _ATTEMPT
     line.update(extra)
     print(json.dumps(line), flush=True)
     if value > 0:
@@ -277,7 +304,12 @@ def emit(metric: str, value: float, vs_baseline: float, **extra) -> bool:
 
 
 def _watchdog() -> None:
-    remaining = DEADLINE_S - (time.monotonic() - _START)
+    # clamp the wall-clock (re-exec-spanning) budget with the monotonic
+    # single-process one: an NTP step backward must not let the process
+    # outlive the driver's patience
+    remaining = min(
+        deadline_remaining(), DEADLINE_S - (time.monotonic() - _START)
+    )
     if remaining > 0:
         time.sleep(remaining)
     emit_failure(f"bench deadline ({DEADLINE_S:.0f}s) exceeded")
@@ -1014,9 +1046,40 @@ def main():
         phase("backend-init")
         platform = probe_backend()
     except Exception as error:  # noqa: BLE001
-        # backend down or wedged: a model fallback would re-enter the same
-        # init — emit the failure record and stop here
+        # backend down or wedged. A wedged JAX init cannot be retried
+        # in-process (the backend initializes once), but with enough of
+        # the attempt deadline left a FRESH process can: re-exec and try
+        # again — the relay's healthy windows appear at random, and a
+        # heal 8 minutes into the driver's window must still land a
+        # number. BENCH_EPOCH carries the original start so the overall
+        # deadline (and the driver's patience) is respected.
         log(f"backend init failed: {error!r}")
+        remaining = deadline_remaining()
+        # only INFRA failures are worth retrying: a wedged init
+        # (TimeoutError) or a relay whose down-signature the diagnosis
+        # confirms. A deterministic crash (bad config, missing module)
+        # with a RESPONSIVE relay must fail fast like before.
+        targets_tpu = not os.environ.get("JAX_PLATFORMS") or any(
+            name in os.environ["JAX_PLATFORMS"] for name in ("tpu", "axon")
+        )
+        transient = targets_tpu and (
+            isinstance(error, TimeoutError)
+            or "responsive" not in _relay_diagnosis()
+        )
+        if transient and remaining > INIT_TIMEOUT_S + 120 and os.environ.get(
+            "BENCH_NO_REEXEC", ""
+        ) in ("", "0"):
+            log(
+                f"re-execing for a clean backend attempt "
+                f"({remaining:.0f}s of deadline left, "
+                f"attempt {_ATTEMPT} failed)"
+            )
+            time.sleep(30)  # give a flapping relay a beat to settle
+            os.environ["BENCH_PRIOR_TIMINGS"] = json.dumps(timings())
+            os.environ["BENCH_ATTEMPT"] = str(_ATTEMPT + 1)
+            sys.stdout.flush()
+            sys.stderr.flush()
+            os.execv(sys.executable, [sys.executable] + sys.argv)
         failure(repr(error))
     if platform not in ("", "cpu"):
         # the relay only carries the TPU backend — a CPU run must not

@@ -19,7 +19,32 @@ OUT=${TPU_HEAL_OUT:-$ARTDIR/bench_heal.json}
 echo "$(date -u +%FT%TZ) watcher started" >> "$LOG"
 LOCKFILE=$LANGSTREAM_CHIP_LOCK
 while true; do
-    # probe with a REAL transfer + matmul: the wedged-relay failure mode
+    # STAGE 1 — cheap socket signature (~3 s): the down state is
+    # "accepts then immediately closes". Probing a dead relay with the
+    # bulk probe burns its full 120 s timeout, which with the sleep
+    # made a ~7 min blind spot — longer than the ~60 s healthy windows
+    # (one was MISSED at 17:35Z round 5 this way). Only when the socket
+    # does NOT show the down signature is the expensive probe worth it.
+    if ! python - <<'PYEOF' 2>/dev/null
+import socket, sys
+try:
+    s = socket.create_connection(("127.0.0.1", 2024), timeout=3)
+    s.settimeout(2)
+    try:
+        data = s.recv(1)
+        sys.exit(1 if data == b"" else 0)  # b"" = down signature
+    except socket.timeout:
+        sys.exit(0)  # stays open awaiting bytes: plausibly healthy
+    finally:
+        s.close()
+except OSError:
+    sys.exit(1)
+PYEOF
+    then
+        sleep 45
+        continue
+    fi
+    # STAGE 2 — REAL transfer + matmul: the wedged-relay failure mode
     # keeps tiny-op RTT at microseconds while bulk transfers hang (seen
     # round 3: dispatch p50 0.1 ms, 8 GB weight init stuck >40 min), so
     # a 4-element probe green-lights a dead window. 256 MB up + a
@@ -122,7 +147,9 @@ y.block_until_ready()" 2>/dev/null
             fi
             exit 0
         fi
-        echo "$(date -u +%FT%TZ) bench failed; retrying in 5m" >> "$LOG"
+        echo "$(date -u +%FT%TZ) bench failed; retrying shortly" >> "$LOG"
     fi
-    sleep 300
+    # socket pre-check is ~3 s, so a short cadence is affordable; the
+    # bulk probe only runs when the socket looks healthy
+    sleep 60
 done
