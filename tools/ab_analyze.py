@@ -1,0 +1,180 @@
+#!/usr/bin/env python
+"""Summarize the heal watcher's bench A/B artifacts and recommend
+default flips.
+
+The watcher (tools/tpu_heal_watch.sh) writes, per healthy relay window:
+``bench_artifacts/bench_heal.json`` (main e2e run) plus ``_kvq`` (int8
+KV cache), ``_flashdec0/1`` (flash-decode off/on at 2048 ctx),
+``_admis`` (admission-chunk), and ``_warm``/``_trace``. This tool reads
+whatever subset exists — including provisional (partial-window) records
+— and prints a comparison table plus the default-flip recommendations
+VERDICT r4 #2 asks for ("run the queued on-chip A/Bs and flip defaults
+on wins"), so a result landing after the build session still turns
+into action mechanically next round:
+
+    python tools/ab_analyze.py [artifacts_dir]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Any, Dict, Optional
+
+LEGS = {
+    "bench_heal.json": "main (bf16 KV, auto kernel)",
+    "bench_heal_kvq.json": "int8 KV cache",
+    "bench_heal_flashdec0.json": "flash-decode OFF @2048ctx/16slots",
+    "bench_heal_flashdec1.json": "flash-decode ON @2048ctx/16slots",
+    "bench_heal_admis.json": "admission-chunk 8",
+}
+
+
+def last_json_line(path: str) -> Optional[Dict[str, Any]]:
+    """The bench contract: the LAST stdout line is the result."""
+    record = None
+    try:
+        with open(path) as handle:
+            for line in handle:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    record = json.loads(line)
+                except ValueError:
+                    continue
+    except OSError:
+        return None
+    return record
+
+
+def describe(record: Dict[str, Any]) -> str:
+    if record.get("error"):
+        return f"FAILED @{record.get('phase')}: {record['error'][:60]}"
+    bits = [f"{record.get('value', 0):.0f} tok/s"]
+    if record.get("provisional"):
+        bits.append("(provisional)")
+    if record.get("raw_engine_tok_s"):
+        bits.append(f"raw {record['raw_engine_tok_s']:.0f}")
+    if record.get("decode_ms_per_step"):
+        bits.append(f"{record['decode_ms_per_step']:.1f} ms/step")
+    if record.get("p50_rtt_ms"):
+        bits.append(f"p50 RTT {record['p50_rtt_ms']:.0f} ms")
+    if record.get("p50_ttft_ms"):
+        bits.append(f"TTFT {record['p50_ttft_ms']:.0f} ms")
+    if record.get("attempt"):
+        bits.append(f"attempt {record['attempt']}")
+    return " ".join(bits)
+
+
+def usable(record: Optional[Dict[str, Any]]) -> bool:
+    """A record that can enter an e2e A/B comparison: nonzero AND the
+    e2e gateway metric — a leg whose window died after warmup leaves a
+    raw_engine_decode_* provisional as its last line, and comparing raw
+    decode against e2e would fabricate a huge spurious win."""
+    return (
+        bool(record)
+        and record.get("value", 0) > 0
+        and str(record.get("metric", "")).startswith("e2e_gateway")
+    )
+
+
+def caveat(*records: Optional[Dict[str, Any]]) -> str:
+    """Flag recommendations built on partial-window estimates."""
+    if any(r and r.get("provisional") for r in records):
+        return " [PROVISIONAL inputs - confirm with a full window]"
+    return ""
+
+
+def main() -> None:
+    art_dir = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "bench_artifacts",
+    )
+    records: Dict[str, Optional[Dict[str, Any]]] = {}
+    print(f"# A/B artifacts in {art_dir}\n")
+    for name, label in LEGS.items():
+        record = last_json_line(os.path.join(art_dir, name))
+        records[name] = record
+        status = describe(record) if record else "absent"
+        print(f"  {label:40s} {status}")
+    print()
+
+    main_rec = records["bench_heal.json"]
+    recommendations = []
+    kvq = records["bench_heal_kvq.json"]
+    if usable(main_rec) and usable(kvq):
+        delta = kvq["value"] / main_rec["value"] - 1
+        note = caveat(main_rec, kvq)
+        if delta > 0.03:
+            recommendations.append(
+                f"FLIP kv-quant default to int8: {delta:+.1%} e2e "
+                f"({main_rec['value']:.0f} -> {kvq['value']:.0f} tok/s); "
+                "set engine kv-quant default + jax-completions globals"
+                + note
+            )
+        else:
+            recommendations.append(
+                f"keep bf16 KV cache default ({delta:+.1%} not a win)"
+                + note
+            )
+    fd0, fd1 = records["bench_heal_flashdec0.json"], records[
+        "bench_heal_flashdec1.json"
+    ]
+    if usable(fd0) and usable(fd1):
+        delta = fd1["value"] / fd0["value"] - 1
+        note = caveat(fd0, fd1)
+        if delta > 0.03:
+            recommendations.append(
+                f"KEEP flash-decode auto-gate (ON wins {delta:+.1%} at "
+                "2048 ctx); consider lowering the T>=1024 gate" + note
+            )
+        else:
+            recommendations.append(
+                f"flash-decode not a win at 2048 ctx ({delta:+.1%}); "
+                "keep the XLA path default, re-test at 4096+" + note
+            )
+    admis = records["bench_heal_admis.json"]
+    if usable(main_rec) and usable(admis):
+        tput = admis["value"] / main_rec["value"] - 1
+        ttft_main = main_rec.get("p50_ttft_ms")
+        ttft_admis = admis.get("p50_ttft_ms")
+        note = caveat(main_rec, admis)
+        if not ttft_main or not ttft_admis:
+            # a provisional/partial record carries no TTFT — a missing
+            # field is not a 100% cut
+            recommendations.append(
+                "admission-chunk: TTFT missing on one leg "
+                f"(throughput {tput:+.1%}); need a full-window pair"
+                + note
+            )
+        elif (ttft_main - ttft_admis) / ttft_main > 0.15 and tput > -0.03:
+            cut = (ttft_main - ttft_admis) / ttft_main
+            recommendations.append(
+                f"FLIP admission-chunk default to 8: TTFT cut {cut:.1%} "
+                f"for {tput:+.1%} throughput" + note
+            )
+        else:
+            cut = (ttft_main - ttft_admis) / ttft_main
+            recommendations.append(
+                f"keep admission-chunk off (TTFT cut {cut:.1%}, "
+                f"throughput {tput:+.1%})" + note
+            )
+
+    print("# Recommendations\n")
+    if recommendations:
+        for recommendation in recommendations:
+            print(f"  - {recommendation}")
+    else:
+        print("  - no complete A/B pair yet; leave defaults as-is")
+    if usable(main_rec):
+        target = main_rec["value"] / 800.0
+        print(
+            f"\n  headline: {main_rec['value']:.0f} tok/s = {target:.2f}x "
+            f"the 800 tok/s target"
+        )
+
+
+if __name__ == "__main__":
+    main()
