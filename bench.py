@@ -26,7 +26,11 @@ throughput, not quality. Weights init directly in int8 on device — the
 bf16 tensors are never materialized.
 
 Override via env: BENCH_MODEL=llama-3-1b BENCH_QUANT= (empty = bf16)
-BENCH_MODE=engine BENCH_CLIENTS=32 BENCH_ROUNDS=3.
+BENCH_MODE=engine BENCH_CLIENTS=32 BENCH_ROUNDS=3 BENCH_KV_QUANT=int8
+BENCH_ADMISSION_CHUNK=8 BENCH_MAX_SEQ=2048 BENCH_RTT_BUDGET_MS=1500
+BENCH_COMPILE_ONLY=1 (cache warm) BENCH_YIELD=1 (chip-lock loser)
+BENCH_NO_REEXEC=1 (disable init-retry re-exec) LS_DECODE_FLASH=0/1
+LS_WEIGHTS_CACHE_DIR=<dir> (opt-in weights cache).
 
 vs_baseline compares against the BASELINE.md north-star of 800 output
 tok/s/chip (defined for 8B end-to-end on v5e).
