@@ -381,6 +381,14 @@ class ChatCompletionsStep(Step):
             session = str(ctx.record.key)
         if session is not None:
             options["session-id"] = session
+        # end-to-end trace context: the gateway's trace header rides the
+        # record into the engine's per-request spans (TTFT/TPOT land in
+        # the same timeline as the gateway/runner spans)
+        from langstream_tpu.runtime.tracing import TRACE_ID_HEADER
+
+        trace_id = ctx.properties.get(TRACE_ID_HEADER)
+        if trace_id:
+            options["trace-id"] = str(trace_id)
         if self.KIND == "text":
             # verbatim continuation, no chat template (reference:
             # TextCompletionsStep calls getTextCompletions)

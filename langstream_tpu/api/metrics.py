@@ -1,15 +1,22 @@
-"""Minimal metrics SPI.
+"""Minimal metrics SPI + the ONE Prometheus text renderer.
 
 Equivalent of the reference's counter-only reporter
 (``langstream-api/src/main/java/ai/langstream/api/runner/code/MetricsReporter.java:18``)
 with a Prometheus-backed implementation provided by the runtime
 (reference impl: ``langstream-runtime-impl/.../metrics/PrometheusMetricsReporter.java``).
+
+:func:`prometheus_text` is the single registry→classic-exposition path
+(counters, gauges, cumulative-``le`` histograms, ``# HELP``/``# TYPE``)
+shared by every scrape surface — runner pods (``runtime/pod.py``), the
+OpenAI server (``serving/openai_api.py``), and the gateway
+(``gateway/server.py``) — so the formats cannot drift between them.
 """
 
 from __future__ import annotations
 
+import re
 import threading
-from typing import Dict
+from typing import Dict, List, Mapping, Optional, Tuple
 
 
 class Counter:
@@ -123,3 +130,124 @@ class MetricsReporter:
 
 
 DISABLED = MetricsReporter()
+
+
+# ---------------------------------------------------------------------- #
+# Prometheus classic text exposition (format 0.0.4)
+# ---------------------------------------------------------------------- #
+_METRIC_NAME = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _sanitize(name: str) -> str:
+    metric = _METRIC_NAME.sub("_", name)
+    if metric and metric[0].isdigit():
+        metric = "_" + metric
+    return metric
+
+
+def prometheus_text(
+    counters: Mapping[str, int],
+    gauges: Optional[Mapping[str, float]] = None,
+    histograms: Optional[Mapping[str, Mapping[str, float]]] = None,
+    help_texts: Optional[Mapping[str, str]] = None,
+) -> str:
+    """Render counters/gauges/histograms in the Prometheus text
+    exposition format (histogram snapshots are the ``le``-keyed dicts
+    :meth:`Histogram.snapshot` produces). ``help_texts`` maps raw metric
+    names to their ``# HELP`` line; metrics without one get a generic
+    self-describing help so the output always parses as a complete
+    family (HELP + TYPE + samples)."""
+
+    def help_line(metric: str, raw: str, kind: str) -> str:
+        text = (help_texts or {}).get(raw) or f"langstream-tpu {kind}"
+        return f"# HELP {metric} {text}"
+
+    lines: List[str] = []
+    for name, value in sorted(counters.items()):
+        metric = _sanitize(name)
+        if not metric.endswith("_total"):
+            metric += "_total"
+        lines.append(help_line(metric, name, "counter"))
+        lines.append(f"# TYPE {metric} counter")
+        lines.append(f"{metric} {value}")
+    for name, value in sorted((gauges or {}).items()):
+        metric = _sanitize(name)
+        lines.append(help_line(metric, name, "gauge"))
+        lines.append(f"# TYPE {metric} gauge")
+        lines.append(f"{metric} {value}")
+    for name, snapshot in sorted((histograms or {}).items()):
+        metric = _sanitize(name)
+        lines.append(help_line(metric, name, "histogram"))
+        lines.append(f"# TYPE {metric} histogram")
+        for le, value in snapshot.items():
+            if le in ("sum", "count"):
+                continue
+            lines.append(f'{metric}_bucket{{le="{le}"}} {int(value)}')
+        lines.append(f"{metric}_sum {snapshot.get('sum', 0.0)}")
+        lines.append(f"{metric}_count {int(snapshot.get('count', 0))}")
+    return "\n".join(lines) + "\n"
+
+
+_SAMPLE_LINE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?"
+    r"\s+(?P<value>[-+]?[0-9.eE+-]+|NaN|[+-]?Inf)$"
+)
+
+
+def parse_prometheus_text(
+    text: str,
+) -> Dict[str, List[Tuple[Dict[str, str], float]]]:
+    """Parse classic exposition text into
+    ``{metric: [(labels, value), ...]}`` — used by ``langstream-tpu top``
+    and the golden-format tests. Raises ValueError on any line that is
+    neither a comment nor a well-formed sample (the format assertion)."""
+    out: Dict[str, List[Tuple[Dict[str, str], float]]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        match = _SAMPLE_LINE.match(line)
+        if match is None:
+            raise ValueError(f"not a Prometheus sample line: {line!r}")
+        labels: Dict[str, str] = {}
+        if match.group("labels"):
+            for pair in match.group("labels").split(","):
+                if not pair:
+                    continue
+                key, _, value = pair.partition("=")
+                labels[key.strip()] = value.strip().strip('"')
+        out.setdefault(match.group("name"), []).append(
+            (labels, float(match.group("value")))
+        )
+    return out
+
+
+def quantile_from_buckets(
+    samples: List[Tuple[Dict[str, str], float]], quantile: float
+) -> Optional[float]:
+    """Approximate a quantile from parsed ``_bucket`` samples (cumulative
+    ``le`` counts): the upper bound of the first bucket whose cumulative
+    count reaches the target rank — the standard Prometheus
+    ``histogram_quantile`` shape, minus interpolation."""
+    buckets: List[Tuple[float, float]] = []
+    total = 0.0
+    for labels, value in samples:
+        le = labels.get("le")
+        if le is None:
+            continue
+        upper = float("inf") if le == "+Inf" else float(le)
+        buckets.append((upper, value))
+        total = max(total, value)
+    if not buckets or total <= 0:
+        return None
+    buckets.sort(key=lambda b: b[0])
+    rank = quantile * total
+    finite = [upper for upper, _ in buckets if upper != float("inf")]
+    cap = finite[-1] if finite else None
+    for upper, cumulative in buckets:
+        if cumulative >= rank:
+            # rank in the +Inf bucket: cap at the highest finite bound
+            # (histogram_quantile semantics) rather than returning inf
+            return cap if upper == float("inf") else upper
+    return cap

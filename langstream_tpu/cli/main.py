@@ -355,6 +355,98 @@ async def _broker_serve(args) -> None:
 
 
 # ---------------------------------------------------------------------- #
+# observability: trace merge + live engine top
+# ---------------------------------------------------------------------- #
+def _trace_cmd(args) -> None:
+    """Merge per-pod Chrome-trace dumps (LANGSTREAM_TRACE_DIR) into one
+    Perfetto-loadable timeline, optionally filtered to one trace id."""
+    from langstream_tpu.runtime.tracing import run_trace_merge
+
+    for line in run_trace_merge(
+        args.paths, output=args.output, trace_id=args.trace_id,
+        list_ids=args.list,
+    ):
+        print(line)
+
+
+async def _top_cmd(args) -> None:
+    """Poll a /metrics endpoint and render a live engine table
+    (occupancy, step time, token throughput from poll deltas)."""
+    import time as _time
+
+    import aiohttp
+
+    from langstream_tpu.api.metrics import (
+        parse_prometheus_text,
+        quantile_from_buckets,
+    )
+
+    previous_tokens: Optional[float] = None
+    previous_at: Optional[float] = None
+    iteration = 0
+    async with aiohttp.ClientSession(
+        timeout=aiohttp.ClientTimeout(total=5)
+    ) as session:
+        while True:
+            iteration += 1
+            try:
+                async with session.get(args.url) as response:
+                    text = await response.text()
+                metrics = parse_prometheus_text(text)
+            except (
+                aiohttp.ClientError, asyncio.TimeoutError, ValueError,
+            ) as error:
+                print(f"[{args.url}] scrape failed: {error}")
+                metrics = None
+            if metrics is not None:
+
+                def gauge(name: str, default: float = 0.0) -> float:
+                    samples = metrics.get(name)
+                    return samples[0][1] if samples else default
+
+                now = _time.monotonic()
+                tokens = gauge("jax_engine_tokens_generated")
+                tok_s = 0.0
+                if previous_at is not None and now > previous_at:
+                    tok_s = max(0.0, tokens - (previous_tokens or 0.0)) / (
+                        now - previous_at
+                    )
+                previous_tokens, previous_at = tokens, now
+                p50 = quantile_from_buckets(
+                    metrics.get(
+                        "jax_engine_decode_step_seconds_bucket", []
+                    ),
+                    0.5,
+                )
+                rows = [
+                    ("slot occupancy",
+                     f"{gauge('jax_engine_slot_occupancy'):7.1%}"),
+                    ("decode ms/step (mean)",
+                     f"{gauge('jax_engine_decode_ms_per_step'):9.2f}"),
+                    ("decode ms/step (p50 bucket)",
+                     "      n/a" if p50 is None else f"{p50 * 1e3:9.2f}"),
+                    ("output tok/s (poll delta)", f"{tok_s:9.1f}"),
+                    ("tokens generated", f"{tokens:9.0f}"),
+                    ("decode steps",
+                     f"{gauge('jax_engine_decode_steps'):9.0f}"),
+                    ("prefix KV rows reused",
+                     f"{gauge('jax_engine_prefix_tokens_reused'):9.0f}"),
+                    ("session hits",
+                     f"{gauge('jax_engine_session_hits'):9.0f}"),
+                ]
+                stamp = _time.strftime("%H:%M:%S")
+                print(f"-- langstream-tpu top  {args.url}  {stamp} --")
+                if tokens or gauge("jax_engine_decode_steps"):
+                    for label, value in rows:
+                        print(f"  {label:28s} {value}")
+                else:
+                    print("  engine idle (no decode activity yet)")
+            if args.count and iteration >= args.count:
+                break
+            await asyncio.sleep(args.interval)
+
+
+# ---------------------------------------------------------------------- #
 # docs
 # ---------------------------------------------------------------------- #
 def _docs(args) -> None:
@@ -510,6 +602,40 @@ def build_parser() -> argparse.ArgumentParser:
     docs = sub.add_parser("docs", help="agent-type documentation")
     docs.add_argument("agent_type", nargs="?", help="show one agent's docs")
     docs.add_argument("--json", action="store_true", help="emit the JSON doc model")
+
+    trace = sub.add_parser(
+        "trace",
+        help="merge per-pod Chrome-trace dumps (LANGSTREAM_TRACE_DIR) "
+             "into one Perfetto timeline",
+    )
+    trace.add_argument(
+        "paths", nargs="+",
+        help="trace dump files and/or directories of *.json dumps",
+    )
+    trace.add_argument("-o", "--output", default="merged_trace.json")
+    trace.add_argument(
+        "--trace-id", default=None,
+        help="keep only spans of this request (langstream-trace-id)",
+    )
+    trace.add_argument(
+        "--list", action="store_true",
+        help="list trace ids and the components each one crossed",
+    )
+
+    top = sub.add_parser(
+        "top",
+        help="poll a /metrics endpoint and render a live engine "
+             "occupancy/step-time table",
+    )
+    top.add_argument(
+        "url", nargs="?", default="http://127.0.0.1:8000/metrics",
+        help="scrape URL (runner pod :8080, serve :8000, gateway :8091)",
+    )
+    top.add_argument("--interval", type=float, default=2.0)
+    top.add_argument(
+        "--count", type=int, default=0,
+        help="stop after N polls (0 = until interrupted)",
+    )
 
     # pod entry points (invoked by the deployer's generated manifests;
     # reference: AgentRunnerStarter.java:39, RuntimeDeployer.java:40,
@@ -677,6 +803,13 @@ def main(argv: Optional[List[str]] = None) -> None:
         asyncio.run(_broker_serve(args))
     elif args.command == "docs":
         _docs(args)
+    elif args.command == "trace":
+        _trace_cmd(args)
+    elif args.command == "top":
+        try:
+            asyncio.run(_top_cmd(args))
+        except KeyboardInterrupt:
+            pass
     elif args.command == "agent-runner":
         from langstream_tpu.runtime.pod import agent_runner_main
 

@@ -204,6 +204,31 @@ async def serve_main(args) -> None:
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
+    # flight recorder: ON for every serve run (override the dir with
+    # LANGSTREAM_FLIGHT_DIR, disable with LANGSTREAM_FLIGHT_DIR="") — a
+    # run that dies at backend init must still leave the init-phase
+    # timeline on disk
+    import langstream_tpu
+    from langstream_tpu.runtime import flight
+
+    # default next to the repo's other bench artifacts when running
+    # from a checkout (where tools/ab_analyze.py looks by default);
+    # CWD-relative otherwise — never inside an installed site-packages
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.abspath(langstream_tpu.__file__))
+    )
+    default_dir = (
+        os.path.join(repo_root, "bench_artifacts", "flight")
+        if os.path.isdir(os.path.join(repo_root, "bench_artifacts"))
+        else os.path.join("bench_artifacts", "flight")
+    )
+    flight_dir = os.environ.get("LANGSTREAM_FLIGHT_DIR", default_dir)
+    if flight_dir:
+        path = flight.configure(flight_dir, run_id=f"serve-{args.model}")
+        print(f"flight recorder -> {path}", flush=True)
+    flight.record("phase", name="backend-init", model=args.model)
+    flight.flush()
+
     # multi-host slice: bring up jax.distributed from StatefulSet/env
     # identity before any device access, so the global mesh spans hosts
     from langstream_tpu.runtime.multihost import initialize_multihost
@@ -313,6 +338,8 @@ async def serve_main(args) -> None:
     )
     await server.start()
     port = server.addresses[0][1] if server.addresses else args.port
+    flight.record("phase", name="serving", port=port)
+    flight.flush()
     print(
         f"OpenAI-compatible API on http://{args.host}:{port}/v1 "
         f"(model {args.model})",

@@ -372,7 +372,15 @@ def _validate_agent_configs(application: Application) -> None:
                         ).lower() in ("1", "true", "yes"),
                     )
                     if problem:
-                        errors.append(f"camel-source: {problem}")
+                        # most validator messages arrive already
+                        # prefixed ("camel-source: kafka URI needs a
+                        # topic name") — re-prefixing those yields
+                        # "camel-source: camel-source: ..." (ADVICE r5)
+                        errors.append(
+                            problem
+                            if problem.startswith("camel-source:")
+                            else f"camel-source: {problem}"
+                        )
     if errors:
         raise ValueError(
             "invalid agent configuration:\n  " + "\n  ".join(errors)

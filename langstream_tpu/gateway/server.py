@@ -30,6 +30,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from aiohttp import WSMsgType, web
 
+from langstream_tpu.api.metrics import MetricsReporter, prometheus_text
 from langstream_tpu.api.records import Record, now_millis
 from langstream_tpu.api.topics import OffsetPosition
 from langstream_tpu.gateway.auth import (
@@ -38,6 +39,11 @@ from langstream_tpu.gateway.auth import (
     create_auth_provider,
 )
 from langstream_tpu.model.application import Application, Gateway
+from langstream_tpu.runtime.tracing import (
+    TRACE_ID_HEADER,
+    get_tracer,
+    new_trace_id,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -72,6 +78,11 @@ class GatewayServer:
         self._apps: Dict[Tuple[str, str], _RegisteredApp] = {}
         self._runner: Optional[web.AppRunner] = None
         self._auth_cache: Dict[int, Any] = {}
+        # observability: request-entry spans (NOOP unless tracing is on)
+        # + counters served at /metrics through the shared exposition
+        # renderer — same format as runner pods and the OpenAI server
+        self.tracer = get_tracer("gateway")
+        self.metrics = MetricsReporter(prefix="gateway")
 
     # ------------------------------------------------------------------ #
     # registration / lifecycle
@@ -96,6 +107,7 @@ class GatewayServer:
             "/api/gateways/service/{tenant}/{application}/{gateway}", self._http_service
         )
         app.router.add_get("/healthz", self._healthz)
+        app.router.add_get("/metrics", self._metrics)
         # local UI (reference: `langstream apps ui`)
         app.router.add_get("/ui/{tenant}/{application}", self._ui_page)
         app.router.add_get("/ui/api/{tenant}/{application}", self._ui_api)
@@ -112,6 +124,16 @@ class GatewayServer:
 
     async def _healthz(self, request) -> web.Response:
         return web.json_response({"status": "OK", "apps": len(self._apps)})
+
+    async def _metrics(self, request) -> web.Response:
+        return web.Response(
+            text=prometheus_text(
+                self.metrics.snapshot(),
+                {"gateway_registered_apps": float(len(self._apps))},
+                self.metrics.histogram_snapshots(),
+            ),
+            content_type="text/plain",
+        )
 
     def _ui_app(self, request):
         key = (request.match_info["tenant"], request.match_info["application"])
@@ -284,6 +306,20 @@ class GatewayServer:
         ]
         return body.get("key"), body.get("value"), headers
 
+    @staticmethod
+    def _stamp_trace(
+        headers: Tuple[Tuple[str, str], ...]
+    ) -> Tuple[Tuple[Tuple[str, str], ...], str]:
+        """Ensure a ``langstream-trace-id`` header: keep a client-supplied
+        one (cross-system traces), mint one otherwise. Every ingress path
+        stamps here so one id follows the request through every topic
+        hop, runner span, and engine span."""
+        for key, value in headers:
+            if key == TRACE_ID_HEADER and value:
+                return headers, str(value)
+        trace_id = new_trace_id()
+        return headers + ((TRACE_ID_HEADER, trace_id),), trace_id
+
     async def _do_produce(
         self, registered, gateway, parameters, principal, payload: str
     ) -> None:
@@ -291,13 +327,17 @@ class GatewayServer:
         gateway_headers = self._resolve_headers(
             gateway.produce_options.get("headers"), parameters, principal
         )
-        await (await registered.producer(gateway.topic)).write(
-            Record(
-                value=value,
-                key=key,
-                headers=tuple(user_headers) + tuple(gateway_headers),
-            )
+        headers, trace_id = self._stamp_trace(
+            tuple(user_headers) + tuple(gateway_headers)
         )
+        with self.tracer.span(
+            "gateway.produce", trace_id=trace_id,
+            gateway=gateway.id, topic=gateway.topic,
+        ):
+            await (await registered.producer(gateway.topic)).write(
+                Record(value=value, key=key, headers=headers)
+            )
+        self.metrics.counter("records_produced").count()
 
     async def _ws_produce(self, request) -> web.WebSocketResponse:
         try:
@@ -454,13 +494,19 @@ class GatewayServer:
                     continue
                 try:
                     key, value, user_headers = self._parse_produce(message.data)
-                    await (await registered.producer(questions_topic)).write(
-                        Record(
-                            value=value,
-                            key=key,
-                            headers=tuple(user_headers) + tuple(headers),
-                        )
+                    chat_headers, trace_id = self._stamp_trace(
+                        tuple(user_headers) + tuple(headers)
                     )
+                    with self.tracer.span(
+                        "gateway.chat.produce", trace_id=trace_id,
+                        gateway=gateway.id, topic=questions_topic,
+                    ):
+                        await (
+                            await registered.producer(questions_topic)
+                        ).write(
+                            Record(value=value, key=key, headers=chat_headers)
+                        )
+                    self.metrics.counter("records_produced").count()
                 except GatewayError as error:
                     await ws.send_json({"status": "BAD_REQUEST", "reason": str(error)})
         finally:
@@ -544,14 +590,18 @@ class GatewayServer:
         )
         await reader.start()
         key, value, user_headers = self._parse_produce(await request.text())
-        await (await registered.producer(input_topic)).write(
-            Record(
-                value=value,
-                key=key,
-                headers=tuple(user_headers)
-                + (("langstream-service-request-id", request_id),),
-            )
+        service_headers, trace_id = self._stamp_trace(
+            tuple(user_headers)
+            + (("langstream-service-request-id", request_id),)
         )
+        with self.tracer.span(
+            "gateway.service.produce", trace_id=trace_id,
+            gateway=gateway.id, topic=input_topic,
+        ):
+            await (await registered.producer(input_topic)).write(
+                Record(value=value, key=key, headers=service_headers)
+            )
+        self.metrics.counter("service_requests").count()
         timeout = float(service.get("timeout-seconds", 30))
         deadline = time.monotonic() + timeout
         try:

@@ -51,6 +51,8 @@ from langstream_tpu.parallel.mesh import (
     validate_mesh,
 )
 from langstream_tpu.providers.jax_local import model as model_lib
+from langstream_tpu.runtime import flight
+from langstream_tpu.runtime.tracing import get_tracer
 
 logger = logging.getLogger(__name__)
 
@@ -157,6 +159,11 @@ class GenerationRequest:
     # with reason "cancelled" at the next token boundary (or drops it
     # from the queue before admission), freeing the slot for others
     cancelled: bool = False
+    # end-to-end trace context (langstream-trace-id record header /
+    # x-langstream-trace-id HTTP header): the engine tags its
+    # admission/prefill/request spans with it so one id links the
+    # gateway, the runner, and the device timeline
+    trace_id: Optional[str] = None
 
     def cancel(self) -> None:
         self.cancelled = True
@@ -356,6 +363,19 @@ class DecodeEngine:
         # follower hosts replay the identical jit sequence on their
         # shards of the same global mesh
         self.mirror: Optional[Any] = None
+        # observability plane: per-request spans (NOOP unless
+        # LANGSTREAM_TRACE_DIR is set) + the crash-surviving flight
+        # recorder (no-op unless configured / LANGSTREAM_FLIGHT_DIR)
+        self.tracer = get_tracer("engine")
+        flight.configure_from_env()
+        flight.record(
+            "engine_start",
+            slots=max_slots,
+            ctx=self.max_seq_len,
+            mesh=dict(self.mesh.shape),
+            decode_chunk=self.decode_chunk,
+            kv_quant=bool(self.kv_quant),
+        )
         _LIVE_ENGINES.add(self)
 
     @staticmethod
@@ -763,6 +783,13 @@ class DecodeEngine:
         if self._thread is not None:
             self._thread.join(timeout=30)
             self._thread = None
+        flight.record(
+            "engine_stop",
+            tokens=self.stats["tokens_generated"],
+            requests=self.stats["requests"],
+            decode_steps=self.stats["decode_steps"],
+        )
+        flight.flush()
         if self.mirror is not None:
             try:
                 self.mirror.publish("stop", {}, [])
@@ -788,6 +815,11 @@ class DecodeEngine:
                 f"prompt of {len(request.prompt_tokens)} tokens exceeds the "
                 f"context limit of {limit} (max_seq_len {self.max_seq_len})"
             )
+        # span/TTFT anchors: perf_counter for durations, wall for the
+        # trace timeline (engine spans must align with gateway/runner
+        # spans recorded on other clocks)
+        request._submit_ts = time.perf_counter()  # type: ignore[attr-defined]
+        request._submit_wall = time.time()        # type: ignore[attr-defined]
         self._queue.put(request)
         if self._crashed is not None:
             # crashed between the check above and the put: the loop will
@@ -803,6 +835,7 @@ class DecodeEngine:
         on_token: Optional[Callable[[int, bool], None]] = None,
         session_id: Optional[str] = None,
         handle: Optional[List[GenerationRequest]] = None,
+        trace_id: Optional[str] = None,
     ) -> GenerationResult:
         """Asyncio entry: submit and await the result. Pass ``handle``
         (an empty list) to receive the live request — its ``cancel()``
@@ -818,6 +851,7 @@ class DecodeEngine:
             session_id=session_id,
             future=future,
             loop=loop,
+            trace_id=trace_id,
         )
         if handle is not None:
             handle.append(request)
@@ -887,6 +921,11 @@ class DecodeEngine:
             # submit() either lands in the drained queue below or raises
             self._crashed = exc
             self._running = False
+            # the flight artifact is the crash's on-disk evidence —
+            # flush BEFORE failing waiters (their callbacks may tear the
+            # process down)
+            flight.record("engine_crash", error=repr(exc)[:512])
+            flight.flush()
             self._fail_all_pending()
             raise
 
@@ -1390,6 +1429,14 @@ class DecodeEngine:
             )
             self.stats["prefill_calls"] += 1
             self.stats["prefill_time"] += time.perf_counter() - started
+            flight.record(
+                "prefill",
+                bucket=bucket,
+                batch=size,
+                warm=False,
+                wall_ms=round((time.perf_counter() - started) * 1e3, 3),
+                queue_depth=len(self._pending),
+            )
             self._prefill_inflight.append({
                 "group": [(index, request) for index, request in group],
                 "sampled": sampled,
@@ -1445,6 +1492,14 @@ class DecodeEngine:
             )
             self.stats["warm_prefill_calls"] += 1
             self.stats["prefill_time"] += time.perf_counter() - started
+            flight.record(
+                "prefill",
+                bucket=bucket,
+                batch=size,
+                warm=True,
+                wall_ms=round((time.perf_counter() - started) * 1e3, 3),
+                queue_depth=len(self._pending),
+            )
             self._prefill_inflight.append({
                 "group": [(index, request) for index, request, _ in group],
                 "sampled": sampled,
@@ -1536,6 +1591,35 @@ class DecodeEngine:
                 tops = (np.asarray(tops[0]), np.asarray(tops[1]))
             self.stats["prefill_time"] += time.perf_counter() - wait_started
             age = time.perf_counter() - record["started"]
+            if self.tracer.enabled:
+                now_pc = time.perf_counter()
+                for index, request in record["group"]:
+                    submit_ts = getattr(
+                        request, "_submit_ts", record["started"]
+                    )
+                    submit_wall = getattr(
+                        request, "_submit_wall", time.time()
+                    )
+                    dispatch_wall = submit_wall + (
+                        record["started"] - submit_ts
+                    )
+                    tid = request.trace_id or ""
+                    self.tracer.event(
+                        "engine.admission",
+                        max(0.0, record["started"] - submit_ts),
+                        trace_id=tid,
+                        start_wall=submit_wall,
+                        slot=index,
+                    )
+                    self.tracer.event(
+                        "engine.prefill",
+                        max(0.0, now_pc - record["started"]),
+                        trace_id=tid,
+                        start_wall=dispatch_wall,
+                        slot=index,
+                        prompt_tokens=len(request.prompt_tokens),
+                        ttft_ms=round((now_pc - submit_ts) * 1e3, 3),
+                    )
             for row, (index, request) in enumerate(record["group"]):
                 self.slots[index].prefilling = False
                 self._emit_token(
@@ -1646,6 +1730,28 @@ class DecodeEngine:
             tokens_arg = jnp.asarray(tokens)
             lengths_arg = jnp.asarray(lengths)
             active_arg = jnp.asarray(active)
+        # telemetry snapshot AT DISPATCH: by processing time a rider may
+        # have finished and its slot been recycled to a new request, so
+        # live-slot reads would mis-attribute the chunk. Chained chunks
+        # inherit the carry's snapshot — _can_chain guarantees the rider
+        # set is unchanged
+        trace_ids, queue_depth, kv_frac = "", 0, 0.0
+        if carry is not None:
+            trace_ids = carry["trace_ids"]
+            queue_depth = carry["queue_depth"]
+            kv_frac = carry["kv_frac"]
+        elif self.tracer.enabled or flight.RECORDER.enabled:
+            trace_ids = ",".join(
+                slot.request.trace_id
+                for i, slot in enumerate(self.slots)
+                if active[i] and slot.active and slot.request.trace_id
+            )
+            queue_depth = len(self._pending)
+            kv_frac = round(
+                sum(slot.length for slot in self.slots if slot.active)
+                / float(self.max_slots * self.max_seq_len),
+                4,
+            )
         run = self._get_decode(steps)
         (
             self.cache, self._counts, out_tokens, out_lps, out_tops,
@@ -1671,6 +1777,9 @@ class DecodeEngine:
             "epochs": list(epochs),
             "steps": steps,
             "started": started,
+            "trace_ids": trace_ids,
+            "queue_depth": queue_depth,
+            "kv_frac": kv_frac,
         }
 
     def _process_decode(self, inflight: Dict[str, Any]) -> None:
@@ -1699,6 +1808,32 @@ class DecodeEngine:
         if len(self.chunk_log) < 65536:
             self.chunk_log.append((steps, n_active, wall))
         DECODE_STEP_SECONDS.observe(wall / max(steps, 1))
+        if self.tracer.enabled or flight.RECORDER.enabled:
+            step_ms = round(wall / max(steps, 1) * 1e3, 3)
+            # one span per chunk, tagged with every rider's trace id so
+            # the merge tool can pull a request's device chunks into its
+            # timeline without per-slot span spam; rider ids / queue
+            # depth / KV pressure were snapshotted at DISPATCH (a slot
+            # may have been recycled to a new request since)
+            self.tracer.event(
+                "engine.decode_chunk",
+                wall,
+                start_wall=time.time() - wall,
+                trace_ids=inflight["trace_ids"],
+                steps=steps,
+                active=n_active,
+                step_ms=step_ms,
+            )
+            flight.record(
+                "decode_chunk",
+                steps=steps,
+                active=n_active,
+                slots=self.max_slots,
+                step_ms=step_ms,
+                queue_depth=inflight["queue_depth"],
+                kv_frac=inflight["kv_frac"],
+                tokens=self.stats["tokens_generated"],
+            )
         emit_started = time.perf_counter()
         for i, slot in enumerate(self.slots):
             if not active[i]:
@@ -1729,6 +1864,11 @@ class DecodeEngine:
         """Record a newly generated token for a slot; finish if stopping."""
         slot = self.slots[index]
         request = slot.request
+        if not slot.generated:
+            # first token: TTFT anchor for the request span / flight log
+            request._first_token_ts = (  # type: ignore[attr-defined]
+                time.perf_counter()
+            )
         slot.generated.append(token)
         slot.logprobs.append(logprob)
         if slot.tops is not None:
@@ -1777,6 +1917,40 @@ class DecodeEngine:
             top_logprobs=tops,
         )
         self.stats["requests"] += 1
+        if self.tracer.enabled or flight.RECORDER.enabled:
+            # per-request latency attribution: TTFT (submit → first
+            # token) + TPOT (mean inter-token gap after the first)
+            now_pc = time.perf_counter()
+            submit_ts = getattr(request, "_submit_ts", now_pc)
+            submit_wall = getattr(request, "_submit_wall", time.time())
+            first_ts = getattr(request, "_first_token_ts", now_pc)
+            ttft_ms = round((first_ts - submit_ts) * 1e3, 3)
+            tpot_ms = (
+                round((now_pc - first_ts) / (len(generated) - 1) * 1e3, 3)
+                if len(generated) > 1 else 0.0
+            )
+            tid = request.trace_id or ""
+            self.tracer.event(
+                "engine.request",
+                max(0.0, now_pc - submit_ts),
+                trace_id=tid,
+                start_wall=submit_wall,
+                slot=index,
+                prompt_tokens=len(request.prompt_tokens),
+                tokens=len(generated),
+                finish_reason=reason,
+                ttft_ms=ttft_ms,
+                tpot_ms=tpot_ms,
+            )
+            flight.record(
+                "request",
+                trace_id=tid,
+                prompt_tokens=len(request.prompt_tokens),
+                tokens=len(generated),
+                finish_reason=reason,
+                ttft_ms=ttft_ms,
+                tpot_ms=tpot_ms,
+            )
         # pin the slot for session reuse; otherwise free it fully
         slot.request = None
         slot.epoch += 1

@@ -320,6 +320,9 @@ class JaxCompletionsService(CompletionsService):
             on_token=on_token,
             session_id=session_id,
             handle=handle,
+            trace_id=(
+                str(options["trace-id"]) if options.get("trace-id") else None
+            ),
         )
         if stop_cut:
             # the stream watcher found the stop: the final content IS the

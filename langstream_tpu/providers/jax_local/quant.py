@@ -218,9 +218,38 @@ def init_quantized_params_cached(
                     os.unlink(stale)
             except OSError:
                 pass
+    # the key must separate every config whose INIT VALUES differ, not
+    # just shape-identical ones: a norm-convention flip (norm_plus_one
+    # fills norms with 0 instead of 1), sandwich norms, qkv biases, or a
+    # tied head all change the pytree contents while num_params() can
+    # stay equal — loading another preset's cache silently serves wrong
+    # weights (ADVICE r5). Readable dims stay up front; the digest folds
+    # in the full weight-relevant field set (runtime-only knobs like
+    # use_flash are excluded so kernel A/Bs share one cache entry).
+    import dataclasses
+    import hashlib
+
+    sig_fields = (
+        "vocab_size", "hidden_size", "intermediate_size", "num_layers",
+        "num_heads", "num_kv_heads", "head_dim", "num_experts",
+        "num_experts_per_tok", "tie_embeddings", "post_norms",
+        "qkv_bias", "norm_plus_one", "scale_embedding", "act", "dtype",
+    )
+    known = {f.name for f in dataclasses.fields(type(config))}
+    signature = "|".join(
+        f"{name}={getattr(config, name)!r}"
+        for name in sig_fields if name in known
+    )
+    convention = "".join(
+        tag for tag, on in (
+            ("z1", config.norm_plus_one), ("pn", config.post_norms),
+            ("qb", config.qkv_bias), ("te", config.tie_embeddings),
+        ) if on
+    ) or "std"
+    digest = hashlib.sha1(signature.encode()).hexdigest()[:10]
     key = (
         f"int8_{config.num_layers}L_{config.hidden_size}h_"
-        f"{config.num_params()}p_s{seed}"
+        f"{config.num_params()}p_{convention}_{digest}_s{seed}"
     )
     path = os.path.join(cache_dir, key + ".npz")
     spec = jax.eval_shape(lambda: init_quantized_params(config, seed=seed))

@@ -50,8 +50,13 @@ class LocalApplicationRunner:
         state_directory: Optional[str] = None,
         tracer=None,
     ) -> None:
+        from langstream_tpu.runtime.tracing import get_tracer
+
         self.plan = plan
-        self.tracer = tracer
+        # default to the process-wide runner tracer: a NOOP unless
+        # LANGSTREAM_TRACE_DIR is set, in which case every pod/apps-run
+        # leaves a Chrome-trace dump for `langstream-tpu trace` to merge
+        self.tracer = tracer if tracer is not None else get_tracer("runner")
         self.application = plan.application
         self.topic_runtime = topic_runtime or create_topic_runtime(
             plan.application.instance.streaming_cluster

@@ -31,11 +31,9 @@ import io
 import json
 import logging
 import os
-import re
 import signal
-import tempfile
 import zipfile
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Optional
 
 from langstream_tpu.api.errors import ErrorsSpec
 from langstream_tpu.compiler.planner import AgentNode, AgentSpec, ExecutionPlan
@@ -114,38 +112,10 @@ def _application_for_pod(config: Dict[str, Any]) -> Application:
 # ---------------------------------------------------------------------- #
 # /metrics + /info HTTP (reference AgentRunner.java:99-113)
 # ---------------------------------------------------------------------- #
-_METRIC_NAME = re.compile(r"[^a-zA-Z0-9_:]")
-
-
-def prometheus_text(
-    counters: Dict[str, int],
-    gauges: Optional[Dict[str, float]] = None,
-    histograms: Optional[Dict[str, Dict[str, float]]] = None,
-) -> str:
-    """Render counters/gauges/histograms in the Prometheus text
-    exposition format (histogram snapshots are the ``le``-keyed dicts
-    :meth:`api.metrics.Histogram.snapshot` produces)."""
-    lines: List[str] = []
-    for name, value in sorted(counters.items()):
-        metric = _METRIC_NAME.sub("_", name)
-        if not metric.endswith("_total"):
-            metric += "_total"
-        lines.append(f"# TYPE {metric} counter")
-        lines.append(f"{metric} {value}")
-    for name, value in sorted((gauges or {}).items()):
-        metric = _METRIC_NAME.sub("_", name)
-        lines.append(f"# TYPE {metric} gauge")
-        lines.append(f"{metric} {value}")
-    for name, snapshot in sorted((histograms or {}).items()):
-        metric = _METRIC_NAME.sub("_", name)
-        lines.append(f"# TYPE {metric} histogram")
-        for le, value in snapshot.items():
-            if le in ("sum", "count"):
-                continue
-            lines.append(f'{metric}_bucket{{le="{le}"}} {int(value)}')
-        lines.append(f"{metric}_sum {snapshot.get('sum', 0.0)}")
-        lines.append(f"{metric}_count {int(snapshot.get('count', 0))}")
-    return "\n".join(lines) + "\n"
+# the one registry→exposition renderer lives in api.metrics; re-exported
+# here because this module is where runner pods (and older call sites)
+# import it from
+from langstream_tpu.api.metrics import prometheus_text  # noqa: F401,E402
 
 
 class AgentHttpServer:
@@ -246,6 +216,13 @@ async def agent_runner_main(
         from langstream_tpu.runtime.plugins import load_plugins
 
         load_plugins(plugins_dir)
+    # observability: pods opt into the flight recorder via
+    # LANGSTREAM_FLIGHT_DIR (trace dumps likewise via
+    # LANGSTREAM_TRACE_DIR, handled by the tracer registry)
+    from langstream_tpu.runtime import flight
+
+    flight.configure_from_env()
+    flight.record("phase", name="pod-start", config=config_path)
     # multi-host slice: all pods of this replica enter one pjit program
     # (SURVEY §7 hard part (e)); a no-op for single-host replicas
     from langstream_tpu.runtime.multihost import initialize_multihost

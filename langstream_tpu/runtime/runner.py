@@ -52,6 +52,7 @@ from langstream_tpu.api.errors import (
 from langstream_tpu.api.metrics import MetricsReporter
 from langstream_tpu.api.records import Record
 from langstream_tpu.api.topics import TopicConnectionsRuntime, TopicConsumer, TopicProducer
+from langstream_tpu.runtime.tracing import TRACE_ID_HEADER
 
 logger = logging.getLogger(__name__)
 
@@ -320,16 +321,28 @@ class AgentRunner:
                     await self._pending_low.wait()
                     continue
                 budget = self.max_pending_records - self._pending
-                with self.tracer.span("source.read", agent=self.agent_id):
+                with self.tracer.span("source.read", agent=self.agent_id) as read_span:
                     batch = await self.source.read(max_records=budget)
+                    if batch:
+                        read_span.attributes["records"] = len(batch)
                 if not batch:
                     continue
                 self.stats.records_in += len(batch)
                 self.metrics.counter("records_in").count(len(batch))
                 self._pending += len(batch)
+                # trace context: tag the dispatch span with the batch's
+                # trace id (single-record batches — the gateway/chat hot
+                # path — get exact attribution; bigger batches carry the
+                # head's id plus the full list as an attribute)
+                batch_ids = [
+                    str(r.header(TRACE_ID_HEADER)) for r in batch
+                    if r.header(TRACE_ID_HEADER)
+                ]
                 with self.tracer.span(
                     "processor.dispatch", agent=self.agent_id,
+                    trace_id=batch_ids[0] if batch_ids else "",
                     records=len(batch),
+                    trace_ids=",".join(batch_ids),
                 ):
                     self.processor.process(batch, self._result_sink)
             await self._drain()
@@ -394,19 +407,32 @@ class AgentRunner:
             if result.error is not None:
                 await self._handle_record_error(result.source_record, result.error)
                 return
+            trace_id = result.source_record.header(TRACE_ID_HEADER) or ""
+            records_out = result.result_records
+            if trace_id:
+                # re-attach the trace id on emitted records so it
+                # survives topic hops into downstream agents (processors
+                # that rebuild records from scratch would drop it)
+                records_out = [
+                    record if record.header(TRACE_ID_HEADER)
+                    else record.with_header(TRACE_ID_HEADER, trace_id)
+                    for record in records_out
+                ]
             try:
                 with self.tracer.span(
-                    "sink.write", agent=self.agent_id,
-                    records=len(result.result_records),
+                    "sink.write", trace_id=trace_id, agent=self.agent_id,
+                    records=len(records_out),
                 ):
-                    for record in result.result_records:
+                    for record in records_out:
                         await self.sink.write(record)
                         self.stats.records_out += 1
                         self.metrics.counter("records_out").count()
             except BaseException as error:  # noqa: BLE001
                 await self._handle_record_error(result.source_record, error)
                 return
-            with self.tracer.span("source.commit", agent=self.agent_id):
+            with self.tracer.span(
+                "source.commit", trace_id=trace_id, agent=self.agent_id
+            ):
                 await self.source.commit([result.source_record])
             self._record_done(result.source_record)
         except BaseException as error:  # noqa: BLE001 — fatal

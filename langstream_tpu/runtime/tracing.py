@@ -23,14 +23,33 @@ no-op tracer).
 
 from __future__ import annotations
 
+import atexit
 import contextlib
 import contextvars
+import glob
 import json
 import os
 import threading
 import time
+import uuid
 from collections import deque
-from typing import Any, Deque, Dict, Iterator, List, Optional
+from typing import Any, Deque, Dict, Iterator, List, Optional, Sequence
+
+# the one wire-level trace-context contract: the gateway (or any other
+# edge) stamps this record header on ingress; the runner re-attaches it
+# on every emitted record so it survives topic hops; the engine tags its
+# per-request spans with it. See docs/observability.md.
+TRACE_ID_HEADER = "langstream-trace-id"
+
+
+def new_trace_id() -> str:
+    return uuid.uuid4().hex
+
+
+def trace_dir() -> str:
+    """Directory for per-process Chrome-trace dumps; empty = tracing off
+    (``get_tracer`` then hands out the shared no-op tracer)."""
+    return os.environ.get("LANGSTREAM_TRACE_DIR", "")
 
 
 class Span:
@@ -119,6 +138,33 @@ class Tracer:
             with self._lock:
                 self._spans.append(span)
 
+    def event(
+        self,
+        name: str,
+        duration_s: float,
+        *,
+        trace_id: str = "",
+        start_wall: Optional[float] = None,
+        **attributes: Any,
+    ) -> None:
+        """Record an already-completed span from measurements taken
+        elsewhere (the engine thread times its phases itself — a
+        contextmanager around multi-iteration device work would lie)."""
+        if not self.enabled:
+            return
+        span = Span(
+            name=name,
+            trace_id=trace_id,
+            span_id=self._next_id(),
+            parent_id=None,
+            attributes=attributes,
+        )
+        if start_wall is not None:
+            span.start_wall = start_wall
+        span.duration_ns = max(0, int(duration_s * 1e9))
+        with self._lock:
+            self._spans.append(span)
+
     def spans(self) -> List[Dict[str, Any]]:
         with self._lock:
             return [s.to_dict() for s in self._spans]
@@ -158,7 +204,13 @@ class Tracer:
 
 class _NoopSpan:
     __slots__ = ()
-    attributes: Dict[str, Any] = {}
+
+    @property
+    def attributes(self) -> Dict[str, Any]:
+        # fresh throwaway dict per access: callers may write into a live
+        # span's attributes, and the shared no-op must absorb that
+        # without accumulating state
+        return {}
 
     def __setattr__(self, *_a) -> None:  # pragma: no cover
         pass
@@ -176,6 +228,169 @@ class NoopTracer(Tracer):
 
 
 NOOP = NoopTracer()
+
+
+# ---------------------------------------------------------------------- #
+# process-wide tracer registry + auto-dump
+# ---------------------------------------------------------------------- #
+_TRACERS: Dict[str, Tracer] = {}
+_REGISTRY_LOCK = threading.Lock()
+_DUMP_REGISTERED = False
+
+
+def get_tracer(component: str) -> Tracer:
+    """The process-wide tracer for a component (``gateway``, ``runner``,
+    ``engine``...). Returns :data:`NOOP` unless ``LANGSTREAM_TRACE_DIR``
+    is set, so call sites pay one attribute check when tracing is off.
+    Real tracers are dumped to the trace dir at interpreter exit (and on
+    demand via :func:`dump_all`)."""
+    global _DUMP_REGISTERED
+    if not trace_dir():
+        return NOOP
+    with _REGISTRY_LOCK:
+        tracer = _TRACERS.get(component)
+        if tracer is None:
+            tracer = Tracer(component)
+            _TRACERS[component] = tracer
+        if not _DUMP_REGISTERED:
+            _DUMP_REGISTERED = True
+            atexit.register(dump_all)
+    return tracer
+
+
+def dump_all(directory: Optional[str] = None) -> List[str]:
+    """Write one Chrome-trace JSON per registered tracer into the trace
+    dir; file names carry the component and pid so a multi-pod run's
+    dumps never collide and ``trace_merge`` can label them."""
+    directory = directory or trace_dir()
+    if not directory:
+        return []
+    os.makedirs(directory, exist_ok=True)
+    paths = []
+    with _REGISTRY_LOCK:
+        tracers = dict(_TRACERS)
+    for component, tracer in tracers.items():
+        events = tracer.chrome_trace()
+        if not events:
+            continue
+        path = os.path.join(
+            directory, f"trace_{component}_{os.getpid()}.json"
+        )
+        tracer.dump(path)
+        paths.append(path)
+    return paths
+
+
+# ---------------------------------------------------------------------- #
+# cross-pod trace merging (tools/trace_merge.py + `langstream-tpu trace`)
+# ---------------------------------------------------------------------- #
+def collect_trace_files(paths: Sequence[str]) -> List[str]:
+    """Expand dirs into their ``*.json`` dumps; keep files as given."""
+    out: List[str] = []
+    for path in paths:
+        if os.path.isdir(path):
+            out.extend(sorted(glob.glob(os.path.join(path, "*.json"))))
+        else:
+            out.append(path)
+    return out
+
+
+def _event_trace_ids(event: Dict[str, Any]) -> List[str]:
+    args = event.get("args") or {}
+    ids = []
+    if args.get("trace_id"):
+        ids.append(str(args["trace_id"]))
+    # batch-level spans (decode chunks) carry every rider's id
+    if args.get("trace_ids"):
+        ids.extend(
+            t for t in str(args["trace_ids"]).split(",") if t
+        )
+    return ids
+
+
+def merge_chrome_trace_files(
+    paths: Sequence[str], trace_id: Optional[str] = None
+) -> Dict[str, Any]:
+    """Merge per-pod Chrome-trace dumps into ONE Perfetto-loadable
+    timeline: each source file becomes a distinct ``pid`` (named after
+    the file via process_name metadata), events keep their wall-clock
+    ``ts`` so cross-pod ordering is real time. With ``trace_id``, only
+    events belonging to that request survive."""
+    events: List[Dict[str, Any]] = []
+    for pid, path in enumerate(collect_trace_files(paths), start=1):
+        with open(path) as handle:
+            data = json.load(handle)
+        # both Chrome trace shapes: {"traceEvents": [...]} or bare array
+        source = data.get("traceEvents", []) if isinstance(data, dict) else data
+        label = os.path.splitext(os.path.basename(path))[0]
+        kept = []
+        for event in source:
+            if trace_id is not None and trace_id not in _event_trace_ids(event):
+                continue
+            event = dict(event)
+            event["pid"] = pid
+            kept.append(event)
+        if kept:
+            events.append({
+                "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+                "ts": 0, "args": {"name": label},
+            })
+            events.extend(kept)
+    events.sort(key=lambda e: (e.get("ph") != "M", e.get("ts", 0)))
+    return {"traceEvents": events}
+
+
+def run_trace_merge(
+    paths: Sequence[str],
+    *,
+    output: str = "merged_trace.json",
+    trace_id: Optional[str] = None,
+    list_ids: bool = False,
+) -> List[str]:
+    """The one CLI body behind ``langstream-tpu trace`` AND
+    ``tools/trace_merge.py``: expand paths, list ids or write the merged
+    timeline, return the status lines to print."""
+    files = collect_trace_files(paths)
+    if not files:
+        raise SystemExit(f"no trace dumps under {list(paths)}")
+    if list_ids:
+        summary = trace_summary(files)
+        if not summary:
+            return ["no trace ids found"]
+        return [
+            f"{tid}  components={','.join(entry['components'])}  "
+            f"spans={entry['spans']}"
+            for tid, entry in sorted(summary.items())
+        ]
+    merged = merge_chrome_trace_files(files, trace_id=trace_id)
+    with open(output, "w") as handle:
+        json.dump(merged, handle)
+    return [
+        f"wrote {len(merged['traceEvents'])} events from {len(files)} "
+        f"dump(s) -> {output} (open in Perfetto / chrome://tracing)"
+    ]
+
+
+def trace_summary(paths: Sequence[str]) -> Dict[str, Dict[str, Any]]:
+    """Per-trace-id view over a set of dumps: which components a request
+    crossed and how many spans each contributed. The acceptance check for
+    end-to-end propagation (gateway + runner + engine under one id)."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for path in collect_trace_files(paths):
+        with open(path) as handle:
+            data = json.load(handle)
+        events = data.get("traceEvents", []) if isinstance(data, dict) else data
+        for event in events:
+            category = event.get("cat", "?")
+            for tid in _event_trace_ids(event):
+                entry = out.setdefault(
+                    tid, {"components": set(), "spans": 0}
+                )
+                entry["components"].add(category)
+                entry["spans"] += 1
+    for entry in out.values():
+        entry["components"] = sorted(entry["components"])
+    return out
 
 
 @contextlib.contextmanager

@@ -164,9 +164,10 @@ class OpenAIApiServer:
 
     async def _metrics(self, request) -> web.Response:
         """Prometheus text from the injected gauge/histogram providers
-        (same exposition shape every runner pod serves); backends wire
-        their own — `serve` injects the jax-local engine snapshots."""
-        from langstream_tpu.runtime.pod import prometheus_text
+        (the ONE exposition renderer every runner pod and the gateway
+        serve through); backends wire their own — `serve` injects the
+        jax-local engine snapshots."""
+        from langstream_tpu.api.metrics import prometheus_text
 
         return web.Response(
             text=prometheus_text(
@@ -237,13 +238,26 @@ class OpenAIApiServer:
                 # every legacy client that sends an integer
                 and self._topk_limit > 0
             ):
-                body = dict(body, top_logprobs=lp)
+                # clamp to the server's static ceiling: a legacy client
+                # asking for more alternatives than the engine keeps
+                # should get the best available, not a 400 on a request
+                # shape that succeeded before the feature existed
+                body = dict(body, top_logprobs=min(lp, self._topk_limit))
         try:
             options = _options_from_request(
                 body, self.model, topk_limit=self._topk_limit
             )
         except (ValueError, TypeError) as error:
             return _error(400, f"invalid request parameter: {error}")
+        # trace context: honor a client-supplied id, mint one otherwise —
+        # the engine tags its per-request spans (TTFT/TPOT) with it and
+        # the id is echoed back so clients can correlate
+        trace_id = (
+            request.headers.get("x-langstream-trace-id")
+            or body.get("trace_id")
+            or uuid.uuid4().hex
+        )
+        options["trace-id"] = str(trace_id)
 
         async def complete(consumer=None, options_override=None):
             request_options = options_override or options
@@ -369,7 +383,7 @@ class OpenAIApiServer:
                         results[0].prompt_tokens + completion_tokens
                     ),
                 },
-            })
+            }, headers={"x-langstream-trace-id": str(trace_id)})
         if n > 1:
             return _error(400, "streaming supports n=1 only")
 
@@ -377,6 +391,7 @@ class OpenAIApiServer:
         response = web.StreamResponse(headers={
             "Content-Type": "text/event-stream",
             "Cache-Control": "no-cache",
+            "x-langstream-trace-id": str(trace_id),
         })
         await response.prepare(request)
         queue: asyncio.Queue = asyncio.Queue()

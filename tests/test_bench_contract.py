@@ -146,6 +146,9 @@ def test_cpu_deterministic_failure_fails_fast_no_reexec(tmp_path):
         "BENCH_REQUESTS": "2",
         "BENCH_NEW_TOKENS": "4",
         "BENCH_PROMPT_LEN": "160",
+        # keep the repo's bench_artifacts clean; also lets this test pin
+        # the flight-recorder contract (a failed run leaves a timeline)
+        "LANGSTREAM_FLIGHT_DIR": str(tmp_path),
     }
     env.pop("BENCH_EPOCH", None)
     # subprocess timeout ABOVE the bench deadline: the watchdog's
@@ -161,3 +164,13 @@ def test_cpu_deterministic_failure_fails_fast_no_reexec(tmp_path):
     # the contract holds: a zero failure record, no re-exec retries
     assert last["value"] == 0.0
     assert "fp4" in last["error"] or "deadline" in last["error"]
+    # the flight recorder left the attempt's phase timeline behind even
+    # though the run failed (ISSUE 1 acceptance: evidence on disk)
+    artifacts = [
+        name for name in os.listdir(tmp_path)
+        if name.startswith("flight_") and name.endswith(".jsonl")
+    ]
+    assert artifacts, "failed bench left no flight artifact"
+    with open(os.path.join(tmp_path, artifacts[0])) as handle:
+        kinds = [json.loads(l)["kind"] for l in handle if l.strip()]
+    assert "phase" in kinds and "bench_failure" in kinds

@@ -1,0 +1,50 @@
+#!/usr/bin/env python
+"""Merge per-pod Chrome-trace dumps into one Perfetto timeline.
+
+Every traced process (gateway, runner pods, the serving engine) dumps
+``trace_<component>_<pid>.json`` into ``LANGSTREAM_TRACE_DIR`` at exit.
+This tool stitches those dumps — each file becomes its own named
+``pid`` lane, events keep wall-clock timestamps — so one request's
+``langstream-trace-id`` can be followed across the gateway produce, the
+runner's read/process/write/commit spans, and the engine's
+admission/prefill/decode spans (TTFT/TPOT attributes included):
+
+    python tools/trace_merge.py <dir-or-files...> -o merged.json
+    python tools/trace_merge.py <dir> --list
+    python tools/trace_merge.py <dir> --trace-id <id> -o one_request.json
+
+Same engine as ``langstream-tpu trace`` (cli/main.py); the logic lives
+in ``langstream_tpu/runtime/tracing.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from langstream_tpu.runtime.tracing import run_trace_merge  # noqa: E402
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(
+        description="merge per-pod Chrome-trace dumps by trace id"
+    )
+    parser.add_argument("paths", nargs="+")
+    parser.add_argument("-o", "--output", default="merged_trace.json")
+    parser.add_argument("--trace-id", default=None)
+    parser.add_argument("--list", action="store_true")
+    args = parser.parse_args()
+    for line in run_trace_merge(
+        args.paths, output=args.output, trace_id=args.trace_id,
+        list_ids=args.list,
+    ):
+        print(line)
+
+
+if __name__ == "__main__":
+    main()
