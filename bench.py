@@ -81,6 +81,31 @@ _CHIP_LOCK_FILE = os.environ.get(
 # int8 KV cache ("int8" | "" = bf16 cache) — the e2e A/B knob for the
 # engine's kv-quant option
 KV_QUANT = os.environ.get("BENCH_KV_QUANT", "") or None
+
+
+def _cli_flag(name: str) -> Optional[str]:
+    """Minimal ``--name value`` / ``--name=value`` argv lookup — the
+    bench is env-driven, but the dense-vs-paged A/B wants to be ONE
+    visible flag (``python bench.py --kv-layout paged``)."""
+    for i, arg in enumerate(sys.argv[1:], start=1):
+        if arg == f"--{name}":
+            return sys.argv[i + 1] if i + 1 < len(sys.argv) else None
+        if arg.startswith(f"--{name}="):
+            return arg.split("=", 1)[1]
+    return None
+
+
+# KV cache layout: dense (per-slot regions) | paged (global block pool +
+# persistent prefix cache). One flag for the dense-vs-paged A/B; also
+# settable as BENCH_KV_LAYOUT for the heal watcher's legs.
+KV_LAYOUT = (
+    _cli_flag("kv-layout")
+    or os.environ.get("BENCH_KV_LAYOUT", "")
+    or "dense"
+).lower()
+if KV_LAYOUT not in ("dense", "paged"):
+    print(f"unknown --kv-layout {KV_LAYOUT!r} (dense|paged)", file=sys.stderr)
+    sys.exit(2)
 # one closed-loop client per slot: oversubscribing evicts pinned
 # sessions (measured slower than the turnaround gaps it fills, now that
 # prefill overlaps decode), and 1:1 matches the BASELINE #5 session
@@ -271,6 +296,7 @@ def emit_failure(reason: str) -> bool:
     return emit(
         metric_name(), 0.0, 0.0,
         error=reason, phase=_PHASE, kv_cache=KV_QUANT or "bf16",
+        kv_layout=KV_LAYOUT,
         decode_kernel=os.environ.get("LS_DECODE_FLASH", "") or "auto",
     )
 
@@ -477,6 +503,7 @@ def run_compile_only() -> int:
         admission_chunk=ADMISSION_CHUNK or None,
         quantize=QUANT,
         kv_quant=KV_QUANT,
+        kv_layout=KV_LAYOUT,
         pipeline_decode=PIPELINE,
     )
     variants = len(engine._variant_jobs())  # noqa: SLF001
@@ -728,6 +755,7 @@ async def run_bench():
         admission_chunk=ADMISSION_CHUNK or None,
         quantize=QUANT,
         kv_quant=KV_QUANT,
+        kv_layout=KV_LAYOUT,
         pipeline_decode=PIPELINE,
     )
     try:
@@ -763,6 +791,7 @@ async def run_bench():
         tok_s = generated / elapsed
         emit_success(tok_s, {
             "kv_cache": KV_QUANT or "bf16",
+            "kv_layout": KV_LAYOUT,
             "decode_kernel": os.environ.get("LS_DECODE_FLASH", "") or "auto",
         })
     finally:
@@ -848,6 +877,7 @@ async def run_bench_e2e():
                 "prefill-buckets": prefill_buckets,
                 "precompile": True,
                 "kv-quant": KV_QUANT or "",
+                "kv-layout": KV_LAYOUT,
             },
         }
     }
@@ -1054,6 +1084,7 @@ async def _drive_e2e(runner, gateway, port, engine):
     extras = {
         "broker": BROKER,
         "kv_cache": KV_QUANT or "bf16",
+        "kv_layout": KV_LAYOUT,
         "admission_chunk": ADMISSION_CHUNK,
         "decode_kernel": os.environ.get("LS_DECODE_FLASH", "") or "auto",
         "raw_engine_tok_s": round(raw_tok_s, 1),
@@ -1166,7 +1197,7 @@ def main():
     if MODE != "e2e":
         failed = None
         # engine-mode A/B artifacts must carry the KV-cache mode too
-        extras = {"kv_cache": KV_QUANT or "bf16"}
+        extras = {"kv_cache": KV_QUANT or "bf16", "kv_layout": KV_LAYOUT}
         try:
             tok_s = asyncio.run(run_bench())
         except Exception as error:  # noqa: BLE001 — e.g. OOM on a small chip

@@ -724,6 +724,21 @@ def build_parser() -> argparse.ArgumentParser:
         help="enable OpenAI top_logprobs up to K alternatives per token "
              "(static — adds a top_k to the serving jits; 0 = off)",
     )
+    serve.add_argument(
+        "--kv-layout", default="dense", choices=["dense", "paged"],
+        help="KV cache layout: dense per-slot regions, or a paged "
+             "block pool with a persistent refcounted prefix cache "
+             "(docs/perf.md 'KV layouts')",
+    )
+    serve.add_argument(
+        "--kv-block-size", type=int, default=16,
+        help="paged layout: tokens per pool block",
+    )
+    serve.add_argument(
+        "--kv-blocks", type=int, default=0,
+        help="paged layout: pool size in blocks (0 = the dense-"
+             "equivalent worst case, slots x ceil(max_seq/block))",
+    )
     serve.add_argument("--embeddings-checkpoint", default=None)
     serve.add_argument("--host", default="0.0.0.0")
     serve.add_argument("--port", type=int, default=8000)

@@ -252,6 +252,9 @@ async def serve_main(args) -> None:
             "pipeline-decode": not getattr(args, "no_pipeline_decode", False),
             "prefix-cache": not getattr(args, "no_prefix_cache", False),
             "logprobs-top-k": getattr(args, "logprobs_top_k", 0),
+            "kv-layout": getattr(args, "kv_layout", "dense"),
+            "kv-block-size": getattr(args, "kv_block_size", 16),
+            "kv-blocks": getattr(args, "kv_blocks", 0) or "",
         },
     }
     from langstream_tpu.providers.jax_local.model import LlamaConfig
@@ -279,6 +282,16 @@ async def serve_main(args) -> None:
         config["quantization"] = args.quantization
     if args.tp and args.tp > 1:
         config["mesh"] = {"tp": args.tp}
+    if getattr(args, "kv_layout", "dense") == "paged" and (
+        getattr(args, "followers", 0) or getattr(args, "follower_of", None)
+    ):
+        # fail at configuration time, not on the first admitted request:
+        # the mirror protocol replays dense dispatch records only (the
+        # engine's _check_mirror_layout is the last-resort guard)
+        raise SystemExit(
+            "--kv-layout paged is not supported with multi-host "
+            "serving (--followers/--follower-of) yet; use dense"
+        )
     completions = JaxCompletionsService(config)
     if getattr(args, "follower_of", None):
         # follower host of a multi-host replica: no HTTP surface — just

@@ -178,6 +178,149 @@ def _softmax(scores: jnp.ndarray) -> jnp.ndarray:
 
 
 # ---------------------------------------------------------------------- #
+# paged KV cache (kv_layout: paged)
+# ---------------------------------------------------------------------- #
+# The cache is a global block pool [num_blocks, block_size, kv_heads,
+# head_dim] addressed through per-slot block tables [B, M] (M =
+# max_seq // block_size): token position p of row b lives in pool block
+# ``table[b, p // block_size]`` at offset ``p % block_size``. Block 0 is
+# the null block — tables route padding and masked writes there, and no
+# live length mask ever lets attention read it. The paths below GATHER a
+# row-contiguous view via the table and reuse the dense attention math,
+# so dense and paged layouts share one set of masking/softcap/window
+# formulas (a dedicated Pallas paged kernel — the "Ragged Paged
+# Attention" shape — can later replace the gather without touching the
+# call sites).
+
+
+def gather_blocks(pool: jnp.ndarray, block_tables: jnp.ndarray) -> jnp.ndarray:
+    """[N, Bs, ...] pool + [B, M] tables → [B, M*Bs, ...] contiguous
+    per-row view (a copy — the read side of the paged layout)."""
+    view = pool[block_tables]  # [B, M, Bs, ...]
+    return view.reshape(
+        view.shape[0], view.shape[1] * view.shape[2], *view.shape[3:]
+    )
+
+
+def paged_write_rows(
+    pool: jnp.ndarray,          # [N, Bs, ...]
+    new: jnp.ndarray,           # [B, T, ...]
+    block_tables: jnp.ndarray,  # [B, M]
+    offsets: jnp.ndarray,       # [B] global position of each row's token 0
+    valid: jnp.ndarray,         # [B, T] bool; False routes to the null block
+) -> jnp.ndarray:
+    """Scatter per-token rows into their table-addressed pool blocks.
+    Works for any trailing shape (bf16/int8 values AND their scale
+    leaves). Invalid rows — padding, masked decode slots — land in the
+    null block, whose content is never read."""
+    seq = new.shape[1]
+    block_size = pool.shape[1]
+    pos = offsets[:, None] + jnp.arange(seq)[None, :]          # [B, T]
+    blocks = jnp.take_along_axis(
+        block_tables, (pos // block_size).astype(jnp.int32), axis=1
+    )
+    blocks = jnp.where(valid, blocks, 0)
+    return pool.at[blocks, pos % block_size].set(new.astype(pool.dtype))
+
+
+def paged_decode_attention(
+    q: jnp.ndarray,
+    k_pool: jnp.ndarray,
+    v_pool: jnp.ndarray,
+    block_tables: jnp.ndarray,
+    lengths: jnp.ndarray,
+    *,
+    softcap: Optional[float] = None,
+    window: Optional[jnp.ndarray] = None,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """:func:`decode_attention` over a block pool: gather each row's
+    blocks into a contiguous [B, M*Bs, KVH, D] view, then the dense
+    formula (lengths mask out the tail, incl. any null-block rows)."""
+    k_cache = gather_blocks(k_pool, block_tables)
+    v_cache = gather_blocks(v_pool, block_tables)
+    return decode_attention(
+        q, k_cache, v_cache, lengths,
+        softcap=softcap, window=window, scale=scale,
+    )
+
+
+def paged_chunk_attention(
+    q: jnp.ndarray,
+    k_pool: jnp.ndarray,
+    v_pool: jnp.ndarray,
+    block_tables: jnp.ndarray,
+    starts: jnp.ndarray,
+    lengths: jnp.ndarray,
+    *,
+    softcap: Optional[float] = None,
+    window: Optional[jnp.ndarray] = None,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """:func:`chunk_attention` over a block pool (prefill-at-offset for
+    paged slots — the path that reads a SHARED cached prefix written by
+    some other request's prefill)."""
+    k_cache = gather_blocks(k_pool, block_tables)
+    v_cache = gather_blocks(v_pool, block_tables)
+    return chunk_attention(
+        q, k_cache, v_cache, starts, lengths,
+        softcap=softcap, window=window, scale=scale,
+    )
+
+
+def paged_decode_attention_quant(
+    q: jnp.ndarray,
+    k_pool: jnp.ndarray,     # [N, Bs, KVH, D] int8
+    k_scale: jnp.ndarray,    # [N, Bs, KVH] f32
+    v_pool: jnp.ndarray,
+    v_scale: jnp.ndarray,
+    block_tables: jnp.ndarray,
+    lengths: jnp.ndarray,
+    *,
+    softcap: Optional[float] = None,
+    window: Optional[jnp.ndarray] = None,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Int8-pool twin of :func:`paged_decode_attention` (scale leaves
+    gather through the same tables)."""
+    return decode_attention_quant(
+        q,
+        gather_blocks(k_pool, block_tables),
+        gather_blocks(k_scale, block_tables),
+        gather_blocks(v_pool, block_tables),
+        gather_blocks(v_scale, block_tables),
+        lengths,
+        softcap=softcap, window=window, scale=scale,
+    )
+
+
+def paged_chunk_attention_quant(
+    q: jnp.ndarray,
+    k_pool: jnp.ndarray,
+    k_scale: jnp.ndarray,
+    v_pool: jnp.ndarray,
+    v_scale: jnp.ndarray,
+    block_tables: jnp.ndarray,
+    starts: jnp.ndarray,
+    lengths: jnp.ndarray,
+    *,
+    softcap: Optional[float] = None,
+    window: Optional[jnp.ndarray] = None,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Int8-pool twin of :func:`paged_chunk_attention`."""
+    return chunk_attention_quant(
+        q,
+        gather_blocks(k_pool, block_tables),
+        gather_blocks(k_scale, block_tables),
+        gather_blocks(v_pool, block_tables),
+        gather_blocks(v_scale, block_tables),
+        starts, lengths,
+        softcap=softcap, window=window, scale=scale,
+    )
+
+
+# ---------------------------------------------------------------------- #
 # int8 KV-cache variants
 # ---------------------------------------------------------------------- #
 # The cache stores int8 values with a per-(position, kv-head) scale.

@@ -88,6 +88,9 @@ def engines_snapshot() -> Dict[str, float]:
     session_hits = prefix_hits = prefix_tokens = 0
     decode_time = prefill_time = 0.0
     active_slot_steps = total_slot_steps = 0
+    paged_engines = 0
+    kv_blocks_in_use = kv_blocks_total = 0
+    prefix_hit_tokens = prefix_evictions = 0
     for engine in list(_LIVE_ENGINES):
         stats = engine.stats
         tokens += stats["tokens_generated"]
@@ -100,6 +103,21 @@ def engines_snapshot() -> Dict[str, float]:
         session_hits += stats["session_hits"]
         prefix_hits += stats["prefix_hits"]
         prefix_tokens += stats["prefix_tokens_reused"]
+        if getattr(engine, "kv_manager", None) is not None:
+            paged_engines += 1
+            kv_blocks_in_use += engine.kv_manager.blocks_in_use
+            kv_blocks_total += engine.num_blocks
+            prefix_hit_tokens += engine.kv_manager.stats["hit_tokens"]
+            prefix_evictions += engine.kv_manager.stats["evictions"]
+    if paged_engines:
+        # paged KV pool + persistent prefix cache (kv_layout: paged):
+        # pool capacity/pressure are known from construction, so these
+        # are exposed BEFORE the first token — an operator verifying a
+        # freshly sized-down pool must not scrape no-data
+        out["kv_blocks_in_use"] = float(kv_blocks_in_use)
+        out["kv_blocks_total"] = float(kv_blocks_total)
+        out["prefix_cache_hit_tokens_total"] = float(prefix_hit_tokens)
+        out["prefix_cache_evictions_total"] = float(prefix_evictions)
     if not (tokens or steps):
         return out
     out["jax_engine_session_hits"] = float(session_hits)
@@ -198,6 +216,8 @@ class _Slot:
     tops: Optional[List[Tuple[List[int], List[float]]]] = None  # top-K
                                             # alternatives per token
     history: Optional[List[int]] = None  # full token history in cache
+    blocks: Optional[List[int]] = None   # paged layout: this slot's pool
+                                         # blocks, in sequence order
     session_id: Optional[str] = None     # pinned session (slot free but warm)
     last_used: float = 0.0               # monotonic; drives LRU eviction
     epoch: int = 0                       # bumps on assign/finish; guards
@@ -239,6 +259,10 @@ class DecodeEngine:
         seed: int = 0,
         quantize: Optional[str] = None,  # "int8" = weight-only int8
         kv_quant: Optional[str] = None,  # "int8" = int8 KV cache
+        kv_layout: str = "dense",        # "dense" | "paged" (block pool)
+        kv_block_size: int = 16,         # paged: tokens per pool block
+        kv_blocks: Optional[int] = None,  # paged: pool size (None = the
+                                          # dense-equivalent worst case)
         pipeline_decode: bool = False,
         prefix_cache: bool = True,
         logprobs_topk: int = 0,
@@ -318,17 +342,61 @@ class DecodeEngine:
         if kv_quant not in (None, "int8"):
             raise ValueError(f"unknown kv cache quantization {kv_quant!r}")
         self.kv_quant = kv_quant == "int8"
-        cache_sharding = param_shardings(
-            model_lib.cache_logical_axes(self.kv_quant), self.mesh
-        )
-        with self.mesh:
-            self.cache = jax.device_put(
-                model_lib.init_cache(
-                    config, max_slots, self.max_seq_len,
-                    kv_quant=self.kv_quant,
-                ),
-                cache_sharding,
+        if kv_layout not in ("dense", "paged"):
+            raise ValueError(f"unknown kv layout {kv_layout!r}")
+        self.kv_layout = kv_layout
+        self.paged = kv_layout == "paged"
+        self.kv_manager = None
+        if self.paged:
+            from langstream_tpu.providers.jax_local.paged import (
+                PagedKVManager,
             )
+
+            self.block_size = max(1, int(kv_block_size))
+            # per-slot table width: enough blocks to address max_seq_len
+            self.max_blocks = -(-self.max_seq_len // self.block_size)
+            # default pool = the dense layout's worst case (+ null
+            # block); real deployments size it DOWN — short requests
+            # release blocks early and shared prefixes are stored once,
+            # which is the whole HBM win
+            self.num_blocks = int(
+                kv_blocks or max_slots * self.max_blocks + 1
+            )
+            if self.num_blocks < self.max_blocks + 1:
+                raise ValueError(
+                    f"kv_blocks={self.num_blocks} cannot hold even one "
+                    f"max-length sequence ({self.max_blocks} blocks of "
+                    f"{self.block_size})"
+                )
+            self.kv_manager = PagedKVManager(self.num_blocks, self.block_size)
+            # host-authoritative block tables [slots, max_blocks]; rows
+            # are uploaded per dispatch (0 = the null block)
+            self._block_tables = np.zeros(
+                (max_slots, self.max_blocks), dtype=np.int32
+            )
+            cache_sharding = param_shardings(
+                model_lib.paged_cache_logical_axes(self.kv_quant), self.mesh
+            )
+            with self.mesh:
+                self.cache = jax.device_put(
+                    model_lib.init_paged_cache(
+                        config, self.num_blocks, self.block_size,
+                        kv_quant=self.kv_quant,
+                    ),
+                    cache_sharding,
+                )
+        else:
+            cache_sharding = param_shardings(
+                model_lib.cache_logical_axes(self.kv_quant), self.mesh
+            )
+            with self.mesh:
+                self.cache = jax.device_put(
+                    model_lib.init_cache(
+                        config, max_slots, self.max_seq_len,
+                        kv_quant=self.kv_quant,
+                    ),
+                    cache_sharding,
+                )
         self.slots = [_Slot() for _ in range(max_slots)]
         self.base_seed = seed
         self._seed_sequence = 0
@@ -349,6 +417,7 @@ class DecodeEngine:
         self._prefill_offset_fns: Dict[int, Any] = {}
         self._decode_fns: Dict[int, Any] = {}
         self._copy_fns: Dict[int, Any] = {}
+        self._block_copy_fn: Optional[Any] = None
         # prefill dispatches whose first tokens are not yet harvested
         # (FIFO — the device executes dispatches in order)
         self._prefill_inflight: List[Dict[str, Any]] = []
@@ -375,6 +444,8 @@ class DecodeEngine:
             mesh=dict(self.mesh.shape),
             decode_chunk=self.decode_chunk,
             kv_quant=bool(self.kv_quant),
+            kv_layout=self.kv_layout,
+            kv_blocks=self.num_blocks if self.paged else 0,
         )
         _LIVE_ENGINES.add(self)
 
@@ -434,14 +505,8 @@ class DecodeEngine:
             mesh = self._tp_mesh()
             topk = self.logprobs_topk
 
-            @functools.partial(jax.jit, donate_argnums=(1, 5))
-            def run(params, cache, tokens, lengths, slot_ids, counts,
-                    temperature, top_k, top_p, seeds,
-                    bias_ids, bias_vals):
-                cache, logits = model_lib.prefill(
-                    config, params, cache, tokens, lengths, slot_ids, freqs,
-                    mesh=mesh,
-                )
+            def sample_first(logits, slot_ids, counts, temperature, top_k,
+                             top_p, seeds, lengths, bias_ids, bias_vals):
                 keys = _sampling_keys(seeds, lengths)
                 rows = jnp.arange(logits.shape[0])[:, None]
                 adjusted = logits.at[rows, bias_ids].add(bias_vals)
@@ -452,7 +517,39 @@ class DecodeEngine:
                 # count the first sampled token
                 counts = counts.at[slot_ids].set(0)
                 counts = counts.at[slot_ids, sampled].add(1)
-                return cache, counts, sampled, lp, tops
+                return counts, sampled, lp, tops
+
+            if self.paged:
+
+                @functools.partial(jax.jit, donate_argnums=(1, 6))
+                def run(params, cache, tokens, lengths, slot_ids, tables,
+                        counts, temperature, top_k, top_p, seeds,
+                        bias_ids, bias_vals):
+                    cache, logits = model_lib.paged_prefill(
+                        config, params, cache, tokens, lengths, tables,
+                        freqs, mesh=mesh,
+                    )
+                    counts, sampled, lp, tops = sample_first(
+                        logits, slot_ids, counts, temperature, top_k,
+                        top_p, seeds, lengths, bias_ids, bias_vals,
+                    )
+                    return cache, counts, sampled, lp, tops
+
+            else:
+
+                @functools.partial(jax.jit, donate_argnums=(1, 5))
+                def run(params, cache, tokens, lengths, slot_ids, counts,
+                        temperature, top_k, top_p, seeds,
+                        bias_ids, bias_vals):
+                    cache, logits = model_lib.prefill(
+                        config, params, cache, tokens, lengths, slot_ids,
+                        freqs, mesh=mesh,
+                    )
+                    counts, sampled, lp, tops = sample_first(
+                        logits, slot_ids, counts, temperature, top_k,
+                        top_p, seeds, lengths, bias_ids, bias_vals,
+                    )
+                    return cache, counts, sampled, lp, tops
 
             fn = run
             self._compiled_prefill[bucket] = fn
@@ -464,14 +561,9 @@ class DecodeEngine:
             config, freqs = self.config, self.freqs
             topk = self.logprobs_topk
 
-            @functools.partial(jax.jit, donate_argnums=(1, 6))
-            def run(params, cache, tokens, lengths, offsets, slot_ids,
-                    counts, temperature, top_k, top_p, seeds,
-                    bias_ids, bias_vals):
-                cache, logits = model_lib.prefill_at_offset(
-                    config, params, cache, tokens, lengths, offsets,
-                    slot_ids, freqs,
-                )
+            def sample_first(logits, slot_ids, counts, temperature, top_k,
+                             top_p, seeds, offsets, lengths,
+                             bias_ids, bias_vals):
                 # key position = the row's TOTAL cache length, so a warm
                 # continuation samples exactly like a cold run of the
                 # same full prompt
@@ -483,7 +575,39 @@ class DecodeEngine:
                 tops = _top_logprobs(logits, topk) if topk else None
                 counts = counts.at[slot_ids].set(0)
                 counts = counts.at[slot_ids, sampled].add(1)
-                return cache, counts, sampled, lp, tops
+                return counts, sampled, lp, tops
+
+            if self.paged:
+
+                @functools.partial(jax.jit, donate_argnums=(1, 7))
+                def run(params, cache, tokens, lengths, offsets, slot_ids,
+                        tables, counts, temperature, top_k, top_p, seeds,
+                        bias_ids, bias_vals):
+                    cache, logits = model_lib.paged_prefill_at_offset(
+                        config, params, cache, tokens, lengths, offsets,
+                        tables, freqs,
+                    )
+                    counts, sampled, lp, tops = sample_first(
+                        logits, slot_ids, counts, temperature, top_k,
+                        top_p, seeds, offsets, lengths, bias_ids, bias_vals,
+                    )
+                    return cache, counts, sampled, lp, tops
+
+            else:
+
+                @functools.partial(jax.jit, donate_argnums=(1, 6))
+                def run(params, cache, tokens, lengths, offsets, slot_ids,
+                        counts, temperature, top_k, top_p, seeds,
+                        bias_ids, bias_vals):
+                    cache, logits = model_lib.prefill_at_offset(
+                        config, params, cache, tokens, lengths, offsets,
+                        slot_ids, freqs,
+                    )
+                    counts, sampled, lp, tops = sample_first(
+                        logits, slot_ids, counts, temperature, top_k,
+                        top_p, seeds, offsets, lengths, bias_ids, bias_vals,
+                    )
+                    return cache, counts, sampled, lp, tops
 
             fn = run
             self._prefill_offset_fns[bucket] = fn
@@ -501,19 +625,25 @@ class DecodeEngine:
             config, freqs = self.config, self.freqs
             mesh = self._tp_mesh()
             topk = self.logprobs_topk
+            paged = self.paged
 
-            @functools.partial(jax.jit, donate_argnums=(1, 6))
-            def run(params, cache, tokens, lengths, active, write_mask,
-                    counts, temperature, top_k, top_p,
-                    presence, frequency, seeds, bias_ids, bias_vals):
+            def run_impl(params, cache, tokens, lengths, active, write_mask,
+                         tables, counts, temperature, top_k, top_p,
+                         presence, frequency, seeds, bias_ids, bias_vals):
                 slots = tokens.shape[0]
 
                 def body(carry, _):
                     cache, tokens, lengths, counts = carry
-                    cache, logits = model_lib.decode_step(
-                        config, params, cache, tokens, lengths, freqs,
-                        write_mask, mesh=mesh,
-                    )
+                    if paged:
+                        cache, logits = model_lib.paged_decode_step(
+                            config, params, cache, tokens, lengths,
+                            tables, freqs, write_mask,
+                        )
+                    else:
+                        cache, logits = model_lib.decode_step(
+                            config, params, cache, tokens, lengths, freqs,
+                            write_mask, mesh=mesh,
+                        )
                     # presence/frequency penalties over generated tokens
                     # (identity when both are 0 — exact float math)
                     adjusted = (
@@ -560,6 +690,30 @@ class DecodeEngine:
                     final_tokens, final_lengths,
                 )
 
+            if paged:
+
+                @functools.partial(jax.jit, donate_argnums=(1, 7))
+                def run(params, cache, tokens, lengths, active, write_mask,
+                        tables, counts, temperature, top_k, top_p,
+                        presence, frequency, seeds, bias_ids, bias_vals):
+                    return run_impl(
+                        params, cache, tokens, lengths, active, write_mask,
+                        tables, counts, temperature, top_k, top_p,
+                        presence, frequency, seeds, bias_ids, bias_vals,
+                    )
+
+            else:
+
+                @functools.partial(jax.jit, donate_argnums=(1, 6))
+                def run(params, cache, tokens, lengths, active, write_mask,
+                        counts, temperature, top_k, top_p,
+                        presence, frequency, seeds, bias_ids, bias_vals):
+                    return run_impl(
+                        params, cache, tokens, lengths, active, write_mask,
+                        None, counts, temperature, top_k, top_p,
+                        presence, frequency, seeds, bias_ids, bias_vals,
+                    )
+
             fn = run
             self._decode_fns[steps] = fn
         return fn
@@ -597,6 +751,46 @@ class DecodeEngine:
             fn = run
             self._copy_fns[bucket] = fn
         return fn
+
+    def _get_block_copy(self):
+        """Jitted pool-block copy (paged layout): duplicate block ``src``
+        into ``dst`` across every layer and cache leaf. This is the
+        copy-on-write primitive — a session follow-up that diverges
+        mid-block gets a private copy of the boundary block before its
+        suffix prefill overwrites rows a published chain still needs.
+        ``params`` is unused; it keeps the uniform (params, cache, ...)
+        dispatch shape (see :meth:`_get_copy_prefix`)."""
+        fn = self._block_copy_fn
+        if fn is None:
+
+            @functools.partial(jax.jit, donate_argnums=(1,))
+            def run(params, cache, src, dst):
+                del params
+
+                def move(c):
+                    # [layers, num_blocks, block_size, ...] — value AND
+                    # scale leaves share the leading three axes
+                    tail = (0,) * (c.ndim - 2)
+                    chunk = jax.lax.dynamic_slice(
+                        c, (0, src) + tail,
+                        (c.shape[0], 1) + c.shape[2:],
+                    )
+                    return jax.lax.dynamic_update_slice(
+                        c, chunk, (0, dst) + tail
+                    )
+
+                return (jax.tree_util.tree_map(move, cache),)
+
+            fn = run
+            self._block_copy_fn = fn
+        return fn
+
+    def _dispatch_block_copy(self, src: int, dst: int) -> None:
+        run = self._get_block_copy()
+        (self.cache,) = run(
+            self.params, self.cache, np.int32(src), np.int32(dst)
+        )
+        self.kv_manager.stats["cow_copies"] += 1
 
     def _dispatch_prefix_copy(self, src: int, dst: int, length: int) -> None:
         """Copy cache rows [0:length) of ``src`` into ``dst`` in
@@ -645,6 +839,13 @@ class DecodeEngine:
         def vec(n, dtype):
             return jax.ShapeDtypeStruct((n,), dtype)
 
+        def tables(n):
+            # paged: per-row block tables ride every dispatch
+            return (
+                (jax.ShapeDtypeStruct((n, self.max_blocks), jnp.int32),)
+                if self.paged else ()
+            )
+
         jobs: List[Tuple[Any, Tuple[Any, ...]]] = []
         size = 1
         while size <= self.max_slots:
@@ -663,16 +864,21 @@ class DecodeEngine:
                 jobs.append((self._get_prefill(bucket), (
                     params_aval, cache_aval, tokens,
                     vec(size, jnp.int32), vec(size, jnp.int32),
-                    counts_aval, *sampling,
+                    *tables(size), counts_aval, *sampling,
                 )))
                 jobs.append((self._get_prefill_offset(bucket), (
                     params_aval, cache_aval, tokens,
                     vec(size, jnp.int32), vec(size, jnp.int32),
-                    vec(size, jnp.int32), counts_aval, *sampling,
+                    vec(size, jnp.int32), *tables(size),
+                    counts_aval, *sampling,
                 )))
             size *= 2
-        if self.prefix_cache:
-            scalar = jax.ShapeDtypeStruct((), jnp.int32)
+        scalar = jax.ShapeDtypeStruct((), jnp.int32)
+        if self.paged:
+            jobs.append((self._get_block_copy(), (
+                params_aval, cache_aval, scalar, scalar,
+            )))
+        elif self.prefix_cache:
             for bucket in self.prefill_buckets:
                 jobs.append((self._get_copy_prefix(bucket), (
                     params_aval, cache_aval, scalar, scalar, scalar,
@@ -686,7 +892,7 @@ class DecodeEngine:
                 params_aval, cache_aval,
                 vec(slots, jnp.int32), vec(slots, jnp.int32),
                 vec(slots, jnp.bool_), vec(slots, jnp.bool_),
-                counts_aval,
+                *tables(slots), counts_aval,
                 vec(slots, jnp.float32), vec(slots, jnp.int32),
                 vec(slots, jnp.float32), vec(slots, jnp.float32),
                 vec(slots, jnp.float32), vec(slots, jnp.uint32),
@@ -815,6 +1021,9 @@ class DecodeEngine:
                 f"prompt of {len(request.prompt_tokens)} tokens exceeds the "
                 f"context limit of {limit} (max_seq_len {self.max_seq_len})"
             )
+        # paged: no per-request block check needed — the constructor
+        # guarantees the pool covers at least one max_seq_len sequence,
+        # which bounds any single reservation
         # span/TTFT anchors: perf_counter for durations, wall for the
         # trace timeline (engine spans must align with gateway/runner
         # spans recorded on other clocks)
@@ -1078,6 +1287,9 @@ class DecodeEngine:
           ``_find_slot``'s exclude set).
         Warm reservations are skipped: their cache is mid-transition."""
         prompt = request.prompt_tokens
+        # the best any source can reach: the full prompt minus the
+        # last token (which is always re-prefilled for fresh logits)
+        full = len(prompt) - 1
         best: Optional[Tuple[int, int, bool]] = None
         for i, slot in enumerate(self.slots):
             if i in cold_reserved:
@@ -1088,6 +1300,11 @@ class DecodeEngine:
             else:
                 history = slot.history
                 in_round = False
+                if slot.length < self.WARM_MIN_PREFIX:
+                    # copyable rows are capped at slot.length, so this
+                    # slot can never clear the reuse threshold — skip
+                    # the O(prompt_len) LCP entirely
+                    continue
             if not history:
                 continue
             lcp = self._lcp(prompt, history)
@@ -1105,17 +1322,17 @@ class DecodeEngine:
                 continue
             if best is None or lcp > best[1]:
                 best = (i, lcp, in_round)
+                if lcp >= full:
+                    # full-prefix match: nothing can beat it — stop
+                    # rescanning the remaining slots (the old scan was
+                    # O(slots × prompt_len) per cold admission)
+                    break
         return best
 
-    def _admit(self) -> None:
-        """Move pending requests into slots. Cold requests sharing a prompt
-        bucket are prefilled in ONE batched device call, and warm-session
-        follow-ups sharing a suffix bucket likewise batch into one
-        prefill-at-offset dispatch (batches split into power-of-two group
-        sizes so compilations stay bounded)."""
+    def _drop_cancelled(self) -> None:
+        """Resolve cancelled-before-admission requests without ever
+        spending a slot or a prefill on them."""
         if any(r.cancelled for r in self._pending):
-            # resolve cancelled-before-admission requests without ever
-            # spending a slot or a prefill on them
             keep: List[GenerationRequest] = []
             for queued in self._pending:
                 if queued.cancelled:
@@ -1123,6 +1340,16 @@ class DecodeEngine:
                 else:
                     keep.append(queued)
             self._pending = keep
+
+    def _admit(self) -> None:
+        """Move pending requests into slots. Cold requests sharing a prompt
+        bucket are prefilled in ONE batched device call, and warm-session
+        follow-ups sharing a suffix bucket likewise batch into one
+        prefill-at-offset dispatch (batches split into power-of-two group
+        sizes so compilations stay bounded)."""
+        if self.paged:
+            return self._admit_paged()
+        self._drop_cancelled()
         while self._pending:
             cold: List[Tuple[int, GenerationRequest]] = []
             cold_bucket: Optional[int] = None
@@ -1303,6 +1530,219 @@ class DecodeEngine:
             if not progressed:
                 return
 
+    def _admit_paged(self) -> None:
+        """Paged-layout admission. Block-granular matching against the
+        persistent prefix cache replaces the dense path's slot-resident
+        LCP scan (and its copy-ordering machinery — shared blocks are
+        REFERENCED through the table, never copied), so a shared RAG or
+        system prefix survives any slot turnover. Every request reserves
+        its worst case (prompt + max_new, capped at max_seq_len) up
+        front, so the decode path never allocates and cannot stall on
+        pool pressure mid-flight; when the pool (after LRU eviction)
+        cannot cover a reservation, the request simply stays pending
+        until running requests release blocks.
+
+        Round dispatch order is cold batch → long prefills → warm
+        suffixes: a suffix admitted onto blocks published this round
+        always reads rows whose writes are already dispatched."""
+        self._drop_cancelled()
+        largest = self.prefill_buckets[-1]
+        while self._pending:
+            cold: List[Tuple[int, GenerationRequest]] = []
+            cold_bucket: Optional[int] = None
+            # suffix bucket -> [(slot, request, resume offset)]
+            warm: Dict[int, List[Tuple[int, GenerationRequest, int]]] = {}
+            long_entries: List[Tuple[int, GenerationRequest, int]] = []
+            progressed = False
+            while self._pending:
+                # warm-first session scan, same bounds as the dense path
+                position, index, session_lcp = 0, None, None
+                head = self._pending[0]
+                if getattr(head, "_skipped", 0) < self.MAX_HEAD_SKIPS:
+                    depth = max(2 * self.max_slots, 8)
+                    for p, queued in enumerate(self._pending[:depth]):
+                        warm_index = self._find_warm_slot(queued)
+                        if warm_index is None:
+                            continue
+                        lcp = self._session_warm(warm_index, queued)
+                        if lcp is not None:
+                            position, index, session_lcp = p, warm_index, lcp
+                            break
+                request = self._pending[position]
+                if index is None:
+                    index = self._find_slot(request)
+                    if index is not None:
+                        session_lcp = self._session_warm(index, request)
+                if index is None:
+                    break
+                # probe the resume offset WITHOUT committing, so the
+                # cold-bucket grouping check can end the round before
+                # any blocks move (match() only touches LRU ticks); the
+                # probe's match is handed to _paged_reserve so the
+                # O(prompt_len) chain walk runs once per admission
+                prompt_len = len(request.prompt_tokens)
+                probe_match = None
+                if session_lcp is not None:
+                    probe = session_lcp
+                elif self.prefix_cache:
+                    probe_match = self.kv_manager.match(
+                        request.prompt_tokens
+                    )
+                    probe = probe_match[1]
+                    while probe >= prompt_len:
+                        probe -= self.block_size
+                else:
+                    probe = 0
+                suffix = prompt_len - probe
+                needs_long = suffix > largest or (
+                    probe > 0
+                    and probe + _bucket(suffix, self.prefill_buckets)
+                    > self.max_seq_len
+                )
+                if probe == 0 and not needs_long:
+                    bucket = _bucket(prompt_len, self.prefill_buckets)
+                    if cold_bucket is None:
+                        cold_bucket = bucket
+                    elif bucket != cold_bucket:
+                        break  # different bucket: next outer round
+                resume = self._paged_reserve(
+                    index, request, session_lcp, probe_match
+                )
+                if resume is None:
+                    # pool exhausted even after eviction: every block is
+                    # referenced by running work — wait for releases
+                    break
+                if position > 0:
+                    head._skipped = getattr(head, "_skipped", 0) + 1
+                self._pending.pop(position)
+                self.slots[index].request = request  # reserve the slot
+                if session_lcp is not None:
+                    self.stats["session_hits"] += 1
+                if needs_long:
+                    long_entries.append((index, request, resume))
+                elif resume == 0:
+                    cold.append((index, request))
+                    if len(cold) >= self.max_slots:
+                        break
+                else:
+                    warm.setdefault(
+                        _bucket(prompt_len - resume, self.prefill_buckets),
+                        [],
+                    ).append((index, request, resume))
+            if cold:
+                self._prefill_batch(cold, cold_bucket)
+                progressed = True
+            for index, request, resume in long_entries:
+                self._prefill_long(index, request, resume)
+                progressed = True
+            for suffix_bucket, batch in warm.items():
+                self._prefill_warm_batch(batch, suffix_bucket)
+                progressed = True
+            if not progressed:
+                return
+
+    def _paged_reserve(
+        self,
+        index: int,
+        request: GenerationRequest,
+        session_lcp: Optional[int],
+        match: Optional[Tuple[List[int], int]] = None,
+    ) -> Optional[int]:
+        """Commit pool blocks for a request before it is admitted.
+        Returns the resume offset — tokens already resident for this
+        slot (session continuation or prefix-cache hit) — or None when
+        the pool cannot cover the reservation.
+
+        Copy-on-write happens here: a session follow-up that diverges
+        mid-block gets a private copy of the boundary block, and shared
+        blocks in the overwrite region are swapped for fresh ones (a
+        full overwrite needs no copy) — published chains are never
+        written after publication."""
+        slot = self.slots[index]
+        manager = self.kv_manager
+        size = self.block_size
+        prompt = request.prompt_tokens
+        need_tokens = min(
+            len(prompt) + request.sampling.max_new_tokens, self.max_seq_len
+        )
+        need_blocks = -(-need_tokens // size)
+        if session_lcp is not None and slot.blocks:
+            blocks = list(slot.blocks)
+            keep_full, partial = divmod(session_lcp, size)
+            replace: List[int] = []
+            cow: Optional[int] = None
+            if (
+                partial
+                and keep_full < len(blocks)
+                and manager.is_shared(blocks[keep_full])
+            ):
+                cow = keep_full
+                replace.append(keep_full)
+            start_full = keep_full + (1 if partial else 0)
+            for j in range(start_full, min(len(blocks), need_blocks)):
+                if manager.is_shared(blocks[j]):
+                    replace.append(j)
+            extend = max(0, need_blocks - len(blocks))
+            fresh = manager.allocate(len(replace) + extend)
+            if fresh is None:
+                return None
+            for j, new in zip(replace, fresh):
+                if j == cow:
+                    self._dispatch_block_copy(blocks[j], new)
+                manager.unref(blocks[j])
+                blocks[j] = new
+            blocks.extend(fresh[len(replace):])
+            for extra in blocks[need_blocks:]:
+                manager.unref(extra)  # shrink vs the previous reservation
+            slot.blocks = blocks[:need_blocks]
+            resume = session_lcp
+        else:
+            if slot.blocks:
+                # evicting a pinned session (or leftover) for a new owner
+                manager.release(slot.blocks)
+                slot.blocks = None
+                slot.session_id = None
+                slot.history = None
+                slot.length = 0
+            matched: List[int] = []
+            matched_tokens = 0
+            if self.prefix_cache:
+                # the admission loop's probe already walked the chain;
+                # nothing can change it between probe and commit (same
+                # engine-thread iteration, no allocation in between)
+                matched, matched_tokens = (
+                    (list(match[0]), match[1]) if match is not None
+                    else manager.match(prompt)
+                )
+            # re-prefill at least the last prompt token so fresh logits
+            # exist for the first sample (same rule as the dense paths)
+            while matched and matched_tokens >= len(prompt):
+                matched.pop()
+                matched_tokens -= size
+            manager.ref(matched)
+            fresh = manager.allocate(need_blocks - len(matched))
+            if fresh is None:
+                manager.release(matched)
+                return None
+            slot.blocks = matched + fresh
+            if matched_tokens:
+                self.stats["prefix_hits"] += 1
+                self.stats["prefix_tokens_reused"] += matched_tokens
+                manager.stats["hit_tokens"] += matched_tokens
+            if self.prefix_cache and not matched_tokens:
+                # publish a fully-cold prompt's blocks NOW so same-round
+                # duplicates share them — safe because the cold batch
+                # (which writes every one of these blocks) dispatches
+                # before any warm suffix this round. Partially-matched
+                # prompts publish their divergent tail at finish instead
+                # (their suffix prefill dispatches in the warm wave).
+                manager.publish(prompt, slot.blocks)
+            resume = matched_tokens
+        table = self._block_tables[index]
+        table[:] = 0
+        table[: len(slot.blocks)] = slot.blocks
+        return resume
+
     @staticmethod
     def _pow2_groups(batch: List[Any]) -> List[List[Any]]:
         """Split into power-of-two group sizes (no padding rows — a
@@ -1422,9 +1862,13 @@ class DecodeEngine:
                 temperature, top_k, top_p, seeds, bias_ids, bias_vals,
             ]
             if self.mirror is not None:
+                self._check_mirror_layout()
                 self.mirror.publish("prefill", {"bucket": bucket}, host_args)
+            paged_args = (
+                (self._block_tables[slot_ids],) if self.paged else ()
+            )
             self.cache, self._counts, sampled, lps, tops = run(
-                self.params, self.cache, *host_args[:3],
+                self.params, self.cache, *host_args[:3], *paged_args,
                 self._counts, *host_args[3:],
             )
             self.stats["prefill_calls"] += 1
@@ -1434,6 +1878,7 @@ class DecodeEngine:
                 bucket=bucket,
                 batch=size,
                 warm=False,
+                reused_tokens=0,
                 wall_ms=round((time.perf_counter() - started) * 1e3, 3),
                 queue_depth=len(self._pending),
             )
@@ -1442,6 +1887,7 @@ class DecodeEngine:
                 "sampled": sampled,
                 "lps": lps,
                 "tops": tops,
+                "reused": {},
                 "started": started,
             })
 
@@ -1483,11 +1929,15 @@ class DecodeEngine:
                 temperature, top_k, top_p, seeds, bias_ids, bias_vals,
             ]
             if self.mirror is not None:
+                self._check_mirror_layout()
                 self.mirror.publish(
                     "prefill_offset", {"bucket": bucket}, host_args
                 )
+            paged_args = (
+                (self._block_tables[slot_ids],) if self.paged else ()
+            )
             self.cache, self._counts, sampled, lps, tops = run(
-                self.params, self.cache, *host_args[:4],
+                self.params, self.cache, *host_args[:4], *paged_args,
                 self._counts, *host_args[4:],
             )
             self.stats["warm_prefill_calls"] += 1
@@ -1497,6 +1947,7 @@ class DecodeEngine:
                 bucket=bucket,
                 batch=size,
                 warm=True,
+                reused_tokens=int(sum(r for _, _, r in group)),
                 wall_ms=round((time.perf_counter() - started) * 1e3, 3),
                 queue_depth=len(self._pending),
             )
@@ -1505,6 +1956,7 @@ class DecodeEngine:
                 "sampled": sampled,
                 "lps": lps,
                 "tops": tops,
+                "reused": {index: reused for index, _, reused in group},
                 "started": started,
             })
 
@@ -1550,11 +2002,15 @@ class DecodeEngine:
                 temperature, top_k, top_p, seeds, bias_ids, bias_vals,
             ]
             if self.mirror is not None:
+                self._check_mirror_layout()
                 self.mirror.publish(
                     "prefill_offset", {"bucket": bucket}, host_args
                 )
+            paged_args = (
+                (self._block_tables[slot_ids],) if self.paged else ()
+            )
             self.cache, self._counts, sampled, lps, tops = run(
-                self.params, self.cache, *host_args[:4],
+                self.params, self.cache, *host_args[:4], *paged_args,
                 self._counts, *host_args[4:],
             )
             if step == len(windows) - 1:
@@ -1565,10 +2021,20 @@ class DecodeEngine:
                     "sampled": sampled,
                     "lps": lps,
                     "tops": tops,
+                    "reused": {index: reused} if reused else {},
                     "started": started,
                 })
         self.stats["warm_prefill_calls" if reused else "prefill_calls"] += 1
         self.stats["prefill_time"] += time.perf_counter() - started
+
+    def _check_mirror_layout(self) -> None:
+        """The multi-host mirror replays dense dispatch records; paged
+        dispatches carry block tables the follower protocol does not
+        speak yet. Fail loudly instead of silently diverging shards."""
+        if self.paged:
+            raise NotImplementedError(
+                "multi-host mirror does not support kv_layout=paged yet"
+            )
 
     def _harvest_prefills(self, block: bool = False) -> None:
         """Emit first tokens of completed prefill dispatches (FIFO — the
@@ -1611,6 +2077,7 @@ class DecodeEngine:
                         start_wall=submit_wall,
                         slot=index,
                     )
+                    reused = record.get("reused", {}).get(index, 0)
                     self.tracer.event(
                         "engine.prefill",
                         max(0.0, now_pc - record["started"]),
@@ -1618,6 +2085,11 @@ class DecodeEngine:
                         start_wall=dispatch_wall,
                         slot=index,
                         prompt_tokens=len(request.prompt_tokens),
+                        # cache-served prefix vs actually-prefilled span:
+                        # the acceptance evidence that a prefix-cache hit
+                        # shrank this request's prefill work
+                        reused_tokens=reused,
+                        prefill_tokens=len(request.prompt_tokens) - reused,
                         ttft_ms=round((now_pc - submit_ts) * 1e3, 3),
                     )
             for row, (index, request) in enumerate(record["group"]):
@@ -1670,6 +2142,7 @@ class DecodeEngine:
             tokens_arg = carry["final_tokens"]
             lengths_arg = carry["final_lengths"]
             active_arg = carry["active_dev"]
+            tables_arg = carry["tables_dev"]
             epochs = carry["epochs"]
             if self.mirror is not None:
                 # followers chain from their OWN previous decode output
@@ -1710,6 +2183,7 @@ class DecodeEngine:
             )
             presence, frequency = self._penalty_arrays(self.slots)
             if self.mirror is not None:
+                self._check_mirror_layout()
                 self.mirror.publish("decode", {"steps": steps}, [
                     tokens, lengths, active,
                     temperature, top_k, top_p, presence, frequency,
@@ -1730,35 +2204,53 @@ class DecodeEngine:
             tokens_arg = jnp.asarray(tokens)
             lengths_arg = jnp.asarray(lengths)
             active_arg = jnp.asarray(active)
+            # block tables are device-resident in the carry like every
+            # other chained operand (tables of active riders cannot
+            # change while _can_chain holds)
+            tables_arg = (
+                jnp.asarray(self._block_tables) if self.paged else None
+            )
         # telemetry snapshot AT DISPATCH: by processing time a rider may
         # have finished and its slot been recycled to a new request, so
         # live-slot reads would mis-attribute the chunk. Chained chunks
         # inherit the carry's snapshot — _can_chain guarantees the rider
         # set is unchanged
         trace_ids, queue_depth, kv_frac = "", 0, 0.0
+        kv_blocks, prefix_hit_tokens = 0, 0
         if carry is not None:
             trace_ids = carry["trace_ids"]
             queue_depth = carry["queue_depth"]
             kv_frac = carry["kv_frac"]
+            kv_blocks = carry["kv_blocks"]
+            prefix_hit_tokens = carry["prefix_hit_tokens"]
         elif self.tracer.enabled or flight.RECORDER.enabled:
+            if self.paged:
+                kv_blocks = self.kv_manager.blocks_in_use
+                prefix_hit_tokens = self.kv_manager.stats["hit_tokens"]
             trace_ids = ",".join(
                 slot.request.trace_id
                 for i, slot in enumerate(self.slots)
                 if active[i] and slot.active and slot.request.trace_id
             )
             queue_depth = len(self._pending)
-            kv_frac = round(
-                sum(slot.length for slot in self.slots if slot.active)
-                / float(self.max_slots * self.max_seq_len),
-                4,
-            )
+            if self.paged:
+                kv_frac = round(
+                    self.kv_manager.blocks_in_use / float(self.num_blocks), 4
+                )
+            else:
+                kv_frac = round(
+                    sum(slot.length for slot in self.slots if slot.active)
+                    / float(self.max_slots * self.max_seq_len),
+                    4,
+                )
         run = self._get_decode(steps)
+        paged_args = (tables_arg,) if self.paged else ()
         (
             self.cache, self._counts, out_tokens, out_lps, out_tops,
             final_tokens, final_lengths,
         ) = run(
             self.params, self.cache, tokens_arg, lengths_arg,
-            active_arg, active_arg, self._counts,
+            active_arg, active_arg, *paged_args, self._counts,
             temperature, top_k, top_p, presence, frequency, seeds,
             bias_ids, bias_vals,
         )  # arg order mirrored by FollowerExecutor._decode — keep in sync
@@ -1770,6 +2262,7 @@ class DecodeEngine:
             "final_lengths": final_lengths,
             "active": active,
             "active_dev": active_arg,
+            "tables_dev": tables_arg,
             "sampling_arrays": (
                 temperature, top_k, top_p, presence, frequency, seeds,
                 bias_ids, bias_vals,
@@ -1780,6 +2273,8 @@ class DecodeEngine:
             "trace_ids": trace_ids,
             "queue_depth": queue_depth,
             "kv_frac": kv_frac,
+            "kv_blocks": kv_blocks,
+            "prefix_hit_tokens": prefix_hit_tokens,
         }
 
     def _process_decode(self, inflight: Dict[str, Any]) -> None:
@@ -1824,6 +2319,15 @@ class DecodeEngine:
                 active=n_active,
                 step_ms=step_ms,
             )
+            kv_fields = {}
+            if self.paged:
+                # A/B-able pool pressure series (tools/ab_analyze.py):
+                # blocks resident vs total, cumulative prefix-hit tokens
+                kv_fields = dict(
+                    kv_blocks_in_use=inflight["kv_blocks"],
+                    kv_blocks_total=self.num_blocks,
+                    prefix_hit_tokens=inflight["prefix_hit_tokens"],
+                )
             flight.record(
                 "decode_chunk",
                 steps=steps,
@@ -1833,6 +2337,7 @@ class DecodeEngine:
                 queue_depth=inflight["queue_depth"],
                 kv_frac=inflight["kv_frac"],
                 tokens=self.stats["tokens_generated"],
+                **kv_fields,
             )
         emit_started = time.perf_counter()
         for i, slot in enumerate(self.slots):
@@ -1957,7 +2462,40 @@ class DecodeEngine:
         slot.generated = None
         slot.logprobs = None
         slot.tops = None
-        if request.session_id is not None:
+        if self.paged and slot.blocks is not None:
+            if self.prefix_cache:
+                # publish the completed prefix (prompt + generated) —
+                # only rows actually IN the cache (the final sampled
+                # token is never written before finish), full blocks
+                # only. This is what makes the prefix persistent: the
+                # chain outlives the slot, refcounted by the map.
+                self.kv_manager.publish(
+                    slot.history[: slot.length], slot.blocks
+                )
+            if request.session_id is not None:
+                slot.session_id = request.session_id
+                slot.last_used = time.monotonic()
+                slot.history = slot.history[: slot.length]
+                # trim the worst-case reservation down to what the
+                # session actually holds: an idle pinned session must
+                # not sit on never-written tail blocks the allocator
+                # can neither use nor evict (refcount pins them)
+                keep = -(-slot.length // self.block_size)
+                for extra in slot.blocks[keep:]:
+                    self.kv_manager.unref(extra)
+                slot.blocks = slot.blocks[:keep]
+                self._block_tables[index, keep:] = 0
+            else:
+                # sessionless: drop the slot's references — uncached
+                # blocks free immediately, published ones stay matchable
+                # until LRU eviction needs them
+                self.kv_manager.release(slot.blocks)
+                slot.blocks = None
+                slot.session_id = None
+                slot.history = None
+                slot.length = 0
+                self._block_tables[index, :] = 0
+        elif request.session_id is not None:
             slot.session_id = request.session_id
             slot.last_used = time.monotonic()
             # keep only the history that is actually IN the cache (the

@@ -29,6 +29,11 @@ from langstream_tpu.ops.attention import (
     chunk_attention_quant,
     decode_attention,
     decode_attention_quant,
+    paged_chunk_attention,
+    paged_chunk_attention_quant,
+    paged_decode_attention,
+    paged_decode_attention_quant,
+    paged_write_rows,
     prefill_attention,
     quantize_kv,
 )
@@ -383,6 +388,53 @@ def cache_logical_axes(kv_quant: bool = False) -> Dict[str, Any]:
     return axes
 
 
+def init_paged_cache(
+    config: LlamaConfig,
+    num_blocks: int,
+    block_size: int,
+    kv_quant: bool = False,
+) -> Dict[str, jnp.ndarray]:
+    """Paged KV cache (``kv_layout: paged``): a GLOBAL block pool
+    [layers, num_blocks, block_size, kv_heads, head_dim] shared by every
+    slot, addressed through per-slot block tables. Unlike
+    :func:`init_cache` there is no per-slot max_len region — HBM scales
+    with the tokens actually resident, short requests release their
+    blocks early, and published prefix chains survive slot turnover
+    (engine/paged.py owns the block accounting). Block 0 is the null
+    block (padding / masked writes; never read live).
+
+    ``kv_quant`` mirrors the dense layout: int8 values plus
+    per-(block, position, kv-head) f32 scales."""
+    shape = (
+        config.num_layers, num_blocks, block_size,
+        config.num_kv_heads, config.dims_per_head,
+    )
+    if kv_quant:
+        return {
+            "k": jnp.zeros(shape, dtype=jnp.int8),
+            "v": jnp.zeros(shape, dtype=jnp.int8),
+            "k_scale": jnp.zeros(shape[:-1], dtype=jnp.float32),
+            "v_scale": jnp.zeros(shape[:-1], dtype=jnp.float32),
+        }
+    return {
+        "k": jnp.zeros(shape, dtype=config.dtype),
+        "v": jnp.zeros(shape, dtype=config.dtype),
+    }
+
+
+def paged_cache_logical_axes(kv_quant: bool = False) -> Dict[str, Any]:
+    """Pool blocks are never sharded (any block may serve any request);
+    kv_heads shard under tp like the dense cache."""
+    axes: Dict[str, Any] = {
+        "k": L("layers", None, None, "kv_heads", None),
+        "v": L("layers", None, None, "kv_heads", None),
+    }
+    if kv_quant:
+        axes["k_scale"] = L("layers", None, None, "kv_heads")
+        axes["v_scale"] = L("layers", None, None, "kv_heads")
+    return axes
+
+
 def normalize_rope_scaling(value: Any) -> Optional[Tuple]:
     """HF configs carry rope scaling as a dict; the config field is a
     hashable tuple ("llama3", factor, low, high, original_max). Accepts
@@ -729,18 +781,19 @@ def _prefill_attn_quant(config, q, k_q, k_s, v_q, v_s, lengths, mesh=None,
     )
 
 
-def prefill(
+def _prefill_scan(
     config: LlamaConfig,
     params: Dict[str, jnp.ndarray],
-    cache: Dict[str, jnp.ndarray],
     tokens: jnp.ndarray,     # [B, T] int32 (right-padded)
     lengths: jnp.ndarray,    # [B] true prompt lengths
-    slot_ids: jnp.ndarray,   # [B] cache slots to write
     freqs: jnp.ndarray,
-    mesh=None,               # tp mesh for the sharded flash path
-) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
-    """Run the prompt through the model, write the KV cache at the given
-    slots, return logits of each prompt's last real token [B, V]."""
+    mesh,
+    quantized: bool,
+) -> Tuple[jnp.ndarray, Tuple]:
+    """The cold-prefill layer scan, shared by the dense and paged cache
+    layouts (cold prefill's self-attention never reads the cache, so
+    only the KV WRITE differs between them). Returns (activations
+    [B, T, H] after the final layer, stacked per-layer KV outputs)."""
     batch, seq = tokens.shape
     hd = config.dims_per_head
     positions = jnp.arange(seq)[None, :].repeat(batch, 0)
@@ -749,7 +802,6 @@ def prefill(
 
     layer_inputs = _stack_layer_params(params, config)
     windows = layer_windows(config)
-    quantized = "k_scale" in cache
 
     def layer_fn(x, inputs):
         layer, win = inputs
@@ -797,7 +849,38 @@ def prefill(
         x = x + delta
         return x, layer_kv_out
 
-    x, layer_kv = jax.lax.scan(layer_fn, x, (layer_inputs, windows))
+    return jax.lax.scan(layer_fn, x, (layer_inputs, windows))
+
+
+def _last_token_logits(
+    config: LlamaConfig,
+    params: Dict[str, jnp.ndarray],
+    x: jnp.ndarray,          # [B, T, H]
+    lengths: jnp.ndarray,    # [B]
+) -> jnp.ndarray:
+    x = _norm(config, x, params["final_norm"])
+    batch = x.shape[0]
+    last = x[jnp.arange(batch), (lengths - 1).astype(jnp.int32)]  # [B, H]
+    return _logits(config, params, last)
+
+
+def prefill(
+    config: LlamaConfig,
+    params: Dict[str, jnp.ndarray],
+    cache: Dict[str, jnp.ndarray],
+    tokens: jnp.ndarray,     # [B, T] int32 (right-padded)
+    lengths: jnp.ndarray,    # [B] true prompt lengths
+    slot_ids: jnp.ndarray,   # [B] cache slots to write
+    freqs: jnp.ndarray,
+    mesh=None,               # tp mesh for the sharded flash path
+) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
+    """Run the prompt through the model, write the KV cache at the given
+    slots, return logits of each prompt's last real token [B, V]."""
+    seq = tokens.shape[1]
+    quantized = "k_scale" in cache
+    x, layer_kv = _prefill_scan(
+        config, params, tokens, lengths, freqs, mesh, quantized
+    )
     max_len = cache["k"].shape[2]
     pad = max_len - seq
 
@@ -822,11 +905,7 @@ def prefill(
     out["v"] = cache["v"].at[:, slot_ids].set(
         pad_rows(new_v).astype(cache["v"].dtype)
     )
-
-    x = _norm(config, x, params["final_norm"])
-    last = x[jnp.arange(batch), (lengths - 1).astype(jnp.int32)]  # [B, H]
-    logits = _logits(config, params, last)
-    return out, logits
+    return out, _last_token_logits(config, params, x, lengths)
 
 
 def prefill_at_offset(
@@ -945,6 +1024,232 @@ def prefill_at_offset(
     x = _norm(config, x, params["final_norm"])
     last = x[jnp.arange(batch), (lengths - 1).astype(jnp.int32)]  # [B, H]
     logits = _logits(config, params, last)
+    return out, logits
+
+
+def paged_prefill(
+    config: LlamaConfig,
+    params: Dict[str, jnp.ndarray],
+    cache: Dict[str, jnp.ndarray],   # paged pool (init_paged_cache)
+    tokens: jnp.ndarray,             # [B, T] int32 (right-padded)
+    lengths: jnp.ndarray,            # [B] true prompt lengths
+    block_tables: jnp.ndarray,       # [B, M] pool block per seq block
+    freqs: jnp.ndarray,
+    mesh=None,                       # tp mesh for the sharded flash path
+) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
+    """Cold prefill into the paged block pool: the SAME layer scan (and
+    flash kernel gating) as the dense :func:`prefill` — cold
+    self-attention never reads the cache — with the KV write scattered
+    through the block tables instead of into a per-slot region."""
+    batch, seq = tokens.shape
+    quantized = "k_scale" in cache
+    x, layer_kv = _prefill_scan(
+        config, params, tokens, lengths, freqs, mesh, quantized
+    )
+    valid = jnp.arange(seq)[None, :] < lengths[:, None]
+    zeros = jnp.zeros((batch,), jnp.int32)
+
+    def write(pool, new):
+        return paged_write_rows(pool, new, block_tables, zeros, valid)
+
+    out = dict(cache)
+    if quantized:
+        new_k, new_v, k_scale, v_scale = layer_kv
+        out["k_scale"] = jax.vmap(write)(cache["k_scale"], k_scale)
+        out["v_scale"] = jax.vmap(write)(cache["v_scale"], v_scale)
+    else:
+        new_k, new_v = layer_kv
+    out["k"] = jax.vmap(write)(cache["k"], new_k)
+    out["v"] = jax.vmap(write)(cache["v"], new_v)
+    return out, _last_token_logits(config, params, x, lengths)
+
+
+def paged_prefill_at_offset(
+    config: LlamaConfig,
+    params: Dict[str, jnp.ndarray],
+    cache: Dict[str, jnp.ndarray],   # paged pool
+    tokens: jnp.ndarray,             # [B, T] suffix tokens (right-padded)
+    lengths: jnp.ndarray,            # [B] true suffix lengths
+    offsets: jnp.ndarray,            # [B] existing valid length per row
+    block_tables: jnp.ndarray,       # [B, M]
+    freqs: jnp.ndarray,
+) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
+    """Paged twin of :func:`prefill_at_offset`: suffix KV scatters into
+    table-addressed blocks, attention gathers prefix + suffix through
+    the SAME tables — which is how a request admitted onto a cached
+    prefix chain (prefix-cache hit) attends over blocks some other
+    request's prefill wrote. Shared blocks are never written here: the
+    engine admits suffixes at block-aligned boundaries into private
+    blocks (COW for mid-block session divergence happens before the
+    dispatch)."""
+    batch, seq = tokens.shape
+    hd = config.dims_per_head
+    positions = offsets[:, None] + jnp.arange(seq)[None, :]  # [B, T] global
+    mask = jnp.arange(seq)[None, :] < lengths[:, None]       # [B, T] valid
+    totals = offsets + lengths                               # [B]
+    x = _embed(config, params, tokens)                       # [B, T, H]
+
+    layer_inputs = _stack_layer_params(params, config)
+    windows = layer_windows(config)
+    quantized = "k_scale" in cache
+
+    def layer_fn(carry, inputs):
+        x = carry
+        if quantized:
+            layer, kp, vp, ks, vs, win = inputs
+        else:
+            layer, kp, vp, win = inputs
+        (attn_norm, wq, wk, wv, biases, wo, post_attn, mlp_norm, post_mlp,
+         mlp_weights) = layer
+        normed = _norm(config, x, attn_norm)
+        q, k, v = _project_qkv(normed, wq, wk, wv, biases)
+        q = q.reshape(batch, seq, config.num_heads, hd)
+        k = k.reshape(batch, seq, config.num_kv_heads, hd)
+        v = v.reshape(batch, seq, config.num_kv_heads, hd)
+        q = apply_rope(q, freqs, positions)
+        k = apply_rope(k, freqs, positions)
+        softcap = config.attn_logit_softcap
+        scale = _attn_scale(config)
+        if quantized:
+            k_q, k_s = quantize_kv(k)
+            v_q, v_s = quantize_kv(v)
+            kp = paged_write_rows(kp, k_q, block_tables, offsets, mask)
+            ks = paged_write_rows(ks, k_s, block_tables, offsets, mask)
+            vp = paged_write_rows(vp, v_q, block_tables, offsets, mask)
+            vs = paged_write_rows(vs, v_s, block_tables, offsets, mask)
+            attn = paged_chunk_attention_quant(
+                q, kp, ks, vp, vs, block_tables, offsets, totals,
+                softcap=softcap, window=win, scale=scale,
+            )
+            kv_out = (kp, vp, ks, vs)
+        else:
+            kp = paged_write_rows(kp, k, block_tables, offsets, mask)
+            vp = paged_write_rows(vp, v, block_tables, offsets, mask)
+            attn = paged_chunk_attention(
+                q, kp, vp, block_tables, offsets, totals,
+                softcap=softcap, window=win, scale=scale,
+            )
+            kv_out = (kp, vp)
+        attn = qeinsum(
+            "btd,dh->bth", attn.reshape(batch, seq, config.num_heads * hd), wo
+        )
+        if post_attn is not None:
+            attn = _norm(config, attn, post_attn)
+        x = x + attn
+        normed = _norm(config, x, mlp_norm)
+        delta, _ = _mlp_block(config, normed, mlp_weights, valid=mask, dropless=True)
+        if post_mlp is not None:
+            delta = _norm(config, delta, post_mlp)
+        x = x + delta
+        return x, kv_out
+
+    if quantized:
+        xs = (layer_inputs, cache["k"], cache["v"],
+              cache["k_scale"], cache["v_scale"], windows)
+    else:
+        xs = (layer_inputs, cache["k"], cache["v"], windows)
+    x, kv_caches = jax.lax.scan(layer_fn, x, xs)
+    out = dict(cache)
+    if quantized:
+        out["k"], out["v"], out["k_scale"], out["v_scale"] = kv_caches
+    else:
+        out["k"], out["v"] = kv_caches
+    return out, _last_token_logits(config, params, x, lengths)
+
+
+def paged_decode_step(
+    config: LlamaConfig,
+    params: Dict[str, jnp.ndarray],
+    cache: Dict[str, jnp.ndarray],   # paged pool
+    tokens: jnp.ndarray,             # [S] int32 — one new token per slot
+    lengths: jnp.ndarray,            # [S] length INCLUDING the new token
+    block_tables: jnp.ndarray,       # [S, M]
+    freqs: jnp.ndarray,
+    write_mask: Optional[jnp.ndarray] = None,  # [S] bool
+) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
+    """Paged twin of :func:`decode_step`: the new token's KV scatters
+    into its slot's current block (masked slots route to the null
+    block), attention gathers the live context through the tables.
+    Decode never allocates — the engine reserves each request's worst
+    case (prompt + max_new_tokens) at admission, so this path cannot
+    fail on pool pressure mid-flight."""
+    slots = tokens.shape[0]
+    hd = config.dims_per_head
+    positions = (lengths - 1).astype(jnp.int32)  # [S]
+    if write_mask is None:
+        write_mask = jnp.ones((slots,), dtype=bool)
+    x = _embed(config, params, tokens)  # [S, H]
+
+    layer_inputs = _stack_layer_params(params, config)
+    windows = layer_windows(config)
+    quantized = "k_scale" in cache
+
+    def write(pool, new):
+        return paged_write_rows(
+            pool, new[:, None], block_tables, positions,
+            write_mask[:, None],
+        )
+
+    def layer_fn(carry, inputs):
+        x = carry
+        if quantized:
+            layer, kp, vp, ks, vs, win = inputs
+        else:
+            layer, kp, vp, win = inputs
+        (attn_norm, wq, wk, wv, biases, wo, post_attn, mlp_norm, post_mlp,
+         mlp_weights) = layer
+        normed = _norm(config, x, attn_norm)
+        q, k, v = _project_qkv(normed, wq, wk, wv, biases)
+        q = q.reshape(slots, config.num_heads, hd)
+        k = k.reshape(slots, config.num_kv_heads, hd)
+        v = v.reshape(slots, config.num_kv_heads, hd)
+        q = apply_rope(q[:, None], freqs, positions[:, None])[:, 0]
+        k = apply_rope(k[:, None], freqs, positions[:, None])[:, 0]
+        family = dict(
+            softcap=config.attn_logit_softcap, window=win,
+            scale=_attn_scale(config),
+        )
+        if quantized:
+            k_q, k_s = quantize_kv(k)
+            v_q, v_s = quantize_kv(v)
+            kp, ks = write(kp, k_q), write(ks, k_s)
+            vp, vs = write(vp, v_q), write(vs, v_s)
+            attn = paged_decode_attention_quant(
+                q, kp, ks, vp, vs, block_tables, lengths, **family
+            )
+            kv_out = (kp, vp, ks, vs)
+        else:
+            kp, vp = write(kp, k), write(vp, v)
+            attn = paged_decode_attention(
+                q, kp, vp, block_tables, lengths, **family
+            )
+            kv_out = (kp, vp)
+        attn = qeinsum(
+            "sd,dh->sh", attn.reshape(slots, config.num_heads * hd), wo
+        )
+        if post_attn is not None:
+            attn = _norm(config, attn, post_attn)
+        x = x + attn
+        normed = _norm(config, x, mlp_norm)
+        delta, _ = _mlp_block(config, normed, mlp_weights, dropless=True)
+        if post_mlp is not None:
+            delta = _norm(config, delta, post_mlp)
+        x = x + delta
+        return x, kv_out
+
+    if quantized:
+        xs = (layer_inputs, cache["k"], cache["v"],
+              cache["k_scale"], cache["v_scale"], windows)
+    else:
+        xs = (layer_inputs, cache["k"], cache["v"], windows)
+    x, kv_caches = jax.lax.scan(layer_fn, x, xs, unroll=_decode_unroll())
+    out = dict(cache)
+    if quantized:
+        out["k"], out["v"], out["k_scale"], out["v_scale"] = kv_caches
+    else:
+        out["k"], out["v"] = kv_caches
+    x = _norm(config, x, params["final_norm"])
+    logits = _logits(config, params, x)
     return out, logits
 
 

@@ -1,0 +1,215 @@
+"""Host-side accounting for the paged KV cache (``kv_layout: paged``).
+
+The device side is a global block pool ``[layers, num_blocks, block_size,
+kv_heads, head_dim]`` (``model.init_paged_cache``) addressed through
+per-slot block tables; THIS module owns everything about which block
+holds what:
+
+- **Free-list allocation** with per-block refcounts (block 0 is the null
+  block — padding rows and masked writes are routed there and its
+  content is never read through a live length mask).
+- **Prefix cache**: a persistent token-chunk → block map. Keys are
+  ``(parent_block, chunk_tokens)`` — chaining through the parent block
+  id makes the key collision-free without hashing the whole prefix
+  (a chunk's KV depends on the entire token prefix, which the parent
+  chain uniquely identifies), which is the AIBrix/vLLM hash-chain idea
+  with Python dict identity instead of digests.
+- **Refcounted sharing**: a published block may be referenced by any
+  number of slot tables at once; it is freed only when its refcount is
+  zero AND it has been evicted from the map.
+- **LRU eviction**: when allocation runs dry, least-recently-touched
+  cached blocks with refcount 0 are unpublished, leaf-first (a block
+  with cached children is never evicted before them — a recycled parent
+  id would otherwise let a *different* chain's key resolve to a stale
+  child whose KV belongs to the old prefix).
+
+Copy-on-write is decided here (:meth:`is_shared`) and executed by the
+engine's jitted block-copy: writes into a block that the map or another
+slot still references first get a private copy (session follow-ups that
+diverge mid-block), so shared prefixes are immutable once published.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# the reserved null block: block tables point padding / masked writes
+# here; attention never reads it through a live length mask
+NULL_BLOCK = 0
+
+
+class PagedKVManager:
+    """Block accounting for one engine's pool. NOT thread-safe by
+    design: every call happens on the engine thread, like the slot
+    bookkeeping it extends."""
+
+    def __init__(self, num_blocks: int, block_size: int) -> None:
+        if num_blocks < 2:
+            raise ValueError("paged pool needs at least 2 blocks")
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self._free: deque = deque(range(1, num_blocks))
+        self._refcount = [0] * num_blocks
+        # prefix map: (parent block id | -1, tuple(chunk tokens)) -> block
+        self._map: Dict[Tuple[int, Tuple[int, ...]], int] = {}
+        self._key_of: Dict[int, Tuple[int, Tuple[int, ...]]] = {}
+        self._parent: Dict[int, int] = {}
+        self._children: Dict[int, int] = {}
+        self._lru: Dict[int, int] = {}  # cached block -> last-touch tick
+        self._tick = 0
+        self.stats: Dict[str, int] = {
+            "hit_tokens": 0,       # prompt tokens served from cached blocks
+            "evictions": 0,        # cached blocks unpublished under pressure
+            "cow_copies": 0,       # private copies made before a shared write
+            "published_blocks": 0,
+        }
+
+    # ------------------------------------------------------------------ #
+    # pool state
+    # ------------------------------------------------------------------ #
+    @property
+    def blocks_in_use(self) -> int:
+        """Blocks either referenced by a slot table or held by the
+        prefix cache (everything not on the free list, minus null)."""
+        return self.num_blocks - 1 - len(self._free)
+
+    @property
+    def blocks_cached(self) -> int:
+        return len(self._key_of)
+
+    def refcount(self, block: int) -> int:
+        return self._refcount[block]
+
+    def is_shared(self, block: int) -> bool:
+        """True when writing this block in place would be visible to
+        someone else: another slot's table, or the prefix map."""
+        return self._refcount[block] > 1 or block in self._key_of
+
+    # ------------------------------------------------------------------ #
+    # allocation / refcounts
+    # ------------------------------------------------------------------ #
+    def allocate(self, n: int) -> Optional[List[int]]:
+        """Take ``n`` fresh blocks (refcount 1 each), evicting LRU
+        cached chains if the free list is short. None when the pool
+        genuinely cannot satisfy the request (every block referenced)."""
+        if n <= 0:
+            return []
+        if len(self._free) < n:
+            self._evict(n - len(self._free))
+        if len(self._free) < n:
+            return None
+        out = [self._free.popleft() for _ in range(n)]
+        for block in out:
+            self._refcount[block] = 1
+        return out
+
+    def ref(self, blocks: Sequence[int]) -> None:
+        for block in blocks:
+            self._refcount[block] += 1
+
+    def unref(self, block: int) -> None:
+        self._refcount[block] -= 1
+        assert self._refcount[block] >= 0, f"refcount underflow on {block}"
+        if self._refcount[block] == 0 and block not in self._key_of:
+            self._free.append(block)
+
+    def release(self, blocks: Sequence[int]) -> None:
+        for block in blocks:
+            self.unref(block)
+
+    # ------------------------------------------------------------------ #
+    # prefix cache
+    # ------------------------------------------------------------------ #
+    def _touch(self, block: int) -> None:
+        self._tick += 1
+        self._lru[block] = self._tick
+
+    def match(self, tokens: Sequence[int]) -> Tuple[List[int], int]:
+        """Longest cached block chain covering a prefix of ``tokens``
+        (block-granular — partial blocks never match). Returns
+        (block ids, matched token count); refcounts are NOT taken —
+        callers :meth:`ref` the chain once they commit to it."""
+        size = self.block_size
+        parent, chain = -1, []
+        for i in range(len(tokens) // size):
+            chunk = tuple(tokens[i * size:(i + 1) * size])
+            block = self._map.get((parent, chunk))
+            if block is None:
+                break
+            chain.append(block)
+            parent = block
+        for block in chain:
+            self._touch(block)
+        return chain, len(chain) * size
+
+    def publish(self, tokens: Sequence[int], blocks: Sequence[int]) -> None:
+        """Make the full blocks of ``tokens`` (held in ``blocks``)
+        matchable by future admissions. Idempotent; an existing entry
+        for a chunk wins (the canonical chain continues through it, so
+        duplicates produced by concurrent identical prompts stay
+        private and free normally)."""
+        size = self.block_size
+        parent = -1
+        for i in range(len(tokens) // size):
+            if i >= len(blocks):
+                break
+            block = blocks[i]
+            chunk = tuple(tokens[i * size:(i + 1) * size])
+            key = (parent, chunk)
+            existing = self._map.get(key)
+            if existing is not None:
+                self._touch(existing)
+                parent = existing
+                continue
+            if block in self._key_of:
+                # already published (e.g. re-publish at finish of a
+                # chain published at admission) — just walk through it
+                parent = block
+                continue
+            self._map[key] = block
+            self._key_of[block] = key
+            self._parent[block] = parent
+            if parent >= 0:
+                self._children[parent] = self._children.get(parent, 0) + 1
+            self._touch(block)
+            self.stats["published_blocks"] += 1
+            parent = block
+
+    def _unpublish(self, block: int) -> None:
+        key = self._key_of.pop(block)
+        del self._map[key]
+        parent = self._parent.pop(block)
+        if parent >= 0:
+            self._children[parent] -= 1
+        self._lru.pop(block, None)
+        self._children.pop(block, None)
+
+    def _evict(self, count: int) -> int:
+        """Unpublish up to ``count`` least-recently-used cached blocks
+        that no slot references and that have no cached children
+        (leaf-first keeps parent ids from being recycled under live
+        chain keys). One LRU-ordered pass per chain depth — evicting a
+        leaf can turn its parent into a leaf, so passes repeat only
+        while they make progress (NOT one full sort per block)."""
+        evicted = 0
+        while evicted < count:
+            progress = False
+            for block, _ in sorted(self._lru.items(), key=lambda kv: kv[1]):
+                if evicted >= count:
+                    break
+                if (
+                    self._refcount[block] == 0
+                    and not self._children.get(block)
+                ):
+                    self._unpublish(block)
+                    self._free.append(block)
+                    self.stats["evictions"] += 1
+                    evicted += 1
+                    progress = True
+            if not progress:
+                break
+        return evicted
+
+    def _evict_one(self) -> bool:
+        return self._evict(1) == 1

@@ -160,6 +160,18 @@ class JaxCompletionsService(CompletionsService):
             seed=sampling_seed,
             quantize=config.get("quantization"),
             kv_quant=engine_config.get("kv-quant") or None,
+            # paged KV cache + persistent prefix-block pool (dense stays
+            # the default); placeholder defaults arrive as STRINGS like
+            # every other engine knob
+            kv_layout=str(
+                engine_config.get("kv-layout") or "dense"
+            ).lower(),
+            kv_block_size=int(engine_config.get("kv-block-size") or 16),
+            kv_blocks=(
+                int(engine_config["kv-blocks"])
+                if engine_config.get("kv-blocks")
+                else None
+            ),
             pipeline_decode=str(
                 engine_config.get("pipeline-decode", "")
             ).lower() in ("1", "true", "yes"),

@@ -225,6 +225,183 @@ def test_flash_quant_sharded_tp_matches_reference():
     )
 
 
+# ------------------- quantized XLA paths vs bf16 refs ------------------ #
+# The int8-cache attention folds per-row scales into the contractions
+# (score-side for K, probs-side for V) instead of dequantizing the
+# cache. These tests pin that algebra against the PLAIN attention run
+# over an explicitly dequantized cache — same values, so the only
+# tolerance needed is f32 reassociation — across GQA group sizes
+# (MHA, 2x, 4x grouping) and softcap on/off.
+
+
+def _dequant(values, scale):
+    return values.astype(jnp.float32) * scale[..., None]
+
+
+@pytest.mark.parametrize("heads,kv_heads", [(4, 4), (4, 2), (8, 2)])
+@pytest.mark.parametrize("softcap", [None, 30.0])
+def test_decode_attention_quant_matches_bf16_reference(
+    heads, kv_heads, softcap
+):
+    from langstream_tpu.ops.attention import (
+        decode_attention,
+        decode_attention_quant,
+        quantize_kv,
+    )
+
+    batch, max_len, dim = 3, 64, 32
+    key = jax.random.PRNGKey(11)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (batch, heads, dim), dtype=jnp.float32)
+    k = jax.random.normal(kk, (batch, max_len, kv_heads, dim), jnp.float32)
+    v = jax.random.normal(kv, (batch, max_len, kv_heads, dim), jnp.float32)
+    lengths = jnp.array([64, 40, 1], dtype=jnp.int32)
+    k_q, k_s = quantize_kv(k)
+    v_q, v_s = quantize_kv(v)
+
+    ref = decode_attention(
+        q, _dequant(k_q, k_s), _dequant(v_q, v_s), lengths, softcap=softcap
+    )
+    out = decode_attention_quant(
+        q, k_q, k_s, v_q, v_s, lengths, softcap=softcap
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+@pytest.mark.parametrize("heads,kv_heads", [(4, 4), (4, 2), (8, 2)])
+@pytest.mark.parametrize("softcap", [None, 30.0])
+def test_chunk_attention_quant_matches_bf16_reference(
+    heads, kv_heads, softcap
+):
+    from langstream_tpu.ops.attention import (
+        chunk_attention,
+        chunk_attention_quant,
+        quantize_kv,
+    )
+
+    batch, seq, max_len, dim = 2, 8, 64, 32
+    key = jax.random.PRNGKey(12)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (batch, seq, heads, dim), dtype=jnp.float32)
+    k = jax.random.normal(kk, (batch, max_len, kv_heads, dim), jnp.float32)
+    v = jax.random.normal(kv, (batch, max_len, kv_heads, dim), jnp.float32)
+    starts = jnp.array([16, 3], dtype=jnp.int32)
+    lengths = starts + jnp.array([8, 5], dtype=jnp.int32)
+    k_q, k_s = quantize_kv(k)
+    v_q, v_s = quantize_kv(v)
+
+    ref = chunk_attention(
+        q, _dequant(k_q, k_s), _dequant(v_q, v_s), starts, lengths,
+        softcap=softcap,
+    )
+    out = chunk_attention_quant(
+        q, k_q, k_s, v_q, v_s, starts, lengths, softcap=softcap
+    )
+    # row 1's padding queries (suffix length 5 < seq 8) attend garbage in
+    # both paths but may reassociate differently: compare valid rows
+    np.testing.assert_allclose(
+        np.asarray(out[0]), np.asarray(ref[0]), rtol=2e-5, atol=2e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(out[1, :5]), np.asarray(ref[1, :5]), rtol=2e-5, atol=2e-5
+    )
+
+
+# --------------------------- paged layout ------------------------------ #
+def _paged_layout(k, v, block_size, seed=0):
+    """Scatter dense [B, T, KVH, D] caches into a shuffled block pool +
+    tables, so the paged paths are tested against NON-contiguous,
+    non-identity block placement."""
+    batch, max_len, kv_heads, dim = k.shape
+    blocks_per_row = max_len // block_size
+    total = batch * blocks_per_row
+    rng = np.random.RandomState(seed)
+    order = rng.permutation(total) + 1  # block 0 stays the null block
+    tables = order.reshape(batch, blocks_per_row).astype(np.int32)
+    k_pool = np.zeros((total + 1, block_size, kv_heads, dim), np.float32)
+    v_pool = np.zeros_like(k_pool)
+    for b in range(batch):
+        for j in range(blocks_per_row):
+            rows = slice(j * block_size, (j + 1) * block_size)
+            k_pool[tables[b, j]] = np.asarray(k[b, rows])
+            v_pool[tables[b, j]] = np.asarray(v[b, rows])
+    return jnp.asarray(k_pool), jnp.asarray(v_pool), jnp.asarray(tables)
+
+
+def test_paged_decode_attention_matches_dense():
+    from langstream_tpu.ops.attention import (
+        decode_attention,
+        paged_decode_attention,
+    )
+
+    batch, max_len, heads, kv_heads, dim = 2, 64, 4, 2, 32
+    key = jax.random.PRNGKey(21)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (batch, heads, dim), dtype=jnp.float32)
+    k = jax.random.normal(kk, (batch, max_len, kv_heads, dim), jnp.float32)
+    v = jax.random.normal(kv, (batch, max_len, kv_heads, dim), jnp.float32)
+    lengths = jnp.array([60, 17], dtype=jnp.int32)
+    k_pool, v_pool, tables = _paged_layout(k, v, block_size=16)
+
+    ref = decode_attention(q, k, v, lengths)
+    out = paged_decode_attention(q, k_pool, v_pool, tables, lengths)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_paged_chunk_attention_matches_dense():
+    from langstream_tpu.ops.attention import (
+        chunk_attention,
+        paged_chunk_attention,
+    )
+
+    batch, seq, max_len, heads, kv_heads, dim = 2, 8, 64, 4, 2, 32
+    key = jax.random.PRNGKey(22)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (batch, seq, heads, dim), dtype=jnp.float32)
+    k = jax.random.normal(kk, (batch, max_len, kv_heads, dim), jnp.float32)
+    v = jax.random.normal(kv, (batch, max_len, kv_heads, dim), jnp.float32)
+    starts = jnp.array([20, 5], dtype=jnp.int32)
+    lengths = starts + jnp.array([8, 8], dtype=jnp.int32)
+    k_pool, v_pool, tables = _paged_layout(k, v, block_size=16, seed=1)
+
+    ref = chunk_attention(q, k, v, starts, lengths, window=jnp.int32(24))
+    out = paged_chunk_attention(
+        q, k_pool, v_pool, tables, starts, lengths, window=jnp.int32(24)
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_paged_write_rows_scatters_and_masks():
+    from langstream_tpu.ops.attention import gather_blocks, paged_write_rows
+
+    block_size, kv_heads, dim = 4, 2, 8
+    pool = jnp.zeros((9, block_size, kv_heads, dim), jnp.float32)
+    tables = jnp.asarray([[3, 1, 7, 0], [5, 2, 0, 0]], jnp.int32)
+    new = jnp.arange(2 * 6 * kv_heads * dim, dtype=jnp.float32).reshape(
+        2, 6, kv_heads, dim
+    )
+    offsets = jnp.asarray([2, 0], jnp.int32)       # row 0 writes mid-block
+    valid = jnp.asarray(
+        [[True] * 6, [True] * 3 + [False] * 3]     # row 1: 3 real tokens
+    )
+    pool = paged_write_rows(pool, new, tables, offsets, valid)
+    view = gather_blocks(pool, tables)             # [2, 16, KVH, D]
+    np.testing.assert_array_equal(
+        np.asarray(view[0, 2:8]), np.asarray(new[0])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(view[1, :3]), np.asarray(new[1, :3])
+    )
+    # masked rows landed in the null block, not in the row's real blocks
+    np.testing.assert_array_equal(np.asarray(view[1, 3:8]), 0.0)
+
+
 def test_flash_prefill_window_softcap_matches_reference():
     """Gemma-2 mechanisms in the prefill kernel: sliding-window masking
     (+ out-of-window block compute skip), logit softcap, and the

@@ -32,12 +32,23 @@ def _sample_exposition() -> str:
     gauges = {
         "jax_engine_slot_occupancy": 0.75,
         "jax_engine_decode_ms_per_step": 12.5,
+        # paged KV pool + prefix cache (engines_snapshot, kv_layout: paged)
+        "kv_blocks_in_use": 42.0,
+        "kv_blocks_total": 64.0,
+        "prefix_cache_hit_tokens_total": 1024.0,
+        "prefix_cache_evictions_total": 3.0,
     }
     return prometheus_text(
         reporter.snapshot(), gauges, reporter.histogram_snapshots(),
         help_texts={
             "jax_engine_slot_occupancy":
                 "mean fraction of decode slots active",
+            "kv_blocks_in_use":
+                "paged KV pool blocks referenced by slots or prefix cache",
+            "prefix_cache_hit_tokens_total":
+                "prompt tokens served from cached prefix blocks",
+            "prefix_cache_evictions_total":
+                "prefix-cache blocks evicted under pool pressure",
         },
     )
 

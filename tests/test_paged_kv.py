@@ -1,0 +1,426 @@
+"""Paged KV cache + shared refcounted prefix-block pool (ISSUE 3).
+
+Covers the host-side block manager (allocation, refcounts, prefix map,
+leaf-first LRU eviction), the engine behind ``kv_layout: paged`` —
+token parity with the dense layout under greedy sampling, block-granular
+prefix-cache admission, copy-on-write for mid-block session divergence,
+eviction under pool pressure, admission backpressure when the pool is
+full — and the acceptance scenario: a second request sharing a
+≥256-token prompt prefix prefills only its suffix, evidenced by
+``prefix_cache_hit_tokens_total`` and the per-request prefill span in
+the trace.
+
+The dense/paged engine pair is module-scoped: cache state accumulated
+across tests (published chains, pinned sessions, slot histories) is
+part of the point — every parity assertion holds REGARDLESS of what the
+caches already contain."""
+
+import asyncio
+
+import pytest
+
+from langstream_tpu.providers.jax_local.engine import (
+    DecodeEngine,
+    SamplingParams,
+    engines_snapshot,
+)
+from langstream_tpu.providers.jax_local.model import LlamaConfig, init_params
+from langstream_tpu.providers.jax_local.paged import PagedKVManager
+
+
+# ---------------------------------------------------------------------- #
+# PagedKVManager (host-side accounting)
+# ---------------------------------------------------------------------- #
+def test_manager_match_is_block_granular():
+    manager = PagedKVManager(num_blocks=16, block_size=4)
+    blocks = manager.allocate(3)
+    tokens = list(range(1, 11))  # 10 tokens = 2 full blocks + 2
+    manager.publish(tokens, blocks)
+    chain, matched = manager.match(tokens)
+    assert chain == blocks[:2] and matched == 8  # partial block never matches
+    # diverging inside block 2 matches only block 1
+    chain, matched = manager.match([1, 2, 3, 4, 99, 99, 99, 99, 9])
+    assert chain == blocks[:1] and matched == 4
+    chain, matched = manager.match([7, 7, 7, 7, 7])
+    assert chain == [] and matched == 0
+
+
+def test_manager_refcounts_protect_from_eviction():
+    manager = PagedKVManager(num_blocks=4, block_size=2)  # 3 usable
+    held = manager.allocate(2)
+    manager.publish([1, 2, 3, 4], held)
+    # still referenced: allocation pressure may not evict them
+    assert manager.allocate(2) is None
+    manager.release(held)
+    # refcount 0 + cached: reusable until pressure, then evicted LRU
+    chain, matched = manager.match([1, 2, 3, 4])
+    assert matched == 4
+    fresh = manager.allocate(3)
+    assert fresh is not None
+    assert manager.stats["evictions"] >= 2
+    assert manager.match([1, 2, 3, 4]) == ([], 0)
+
+
+def test_manager_evicts_leaves_before_parents():
+    manager = PagedKVManager(num_blocks=8, block_size=2)
+    blocks = manager.allocate(3)
+    manager.publish([1, 2, 3, 4, 5, 6], blocks)
+    manager.release(blocks)
+    # parent (block holding [1,2]) was touched FIRST (is LRU-oldest) but
+    # must survive until its cached children are gone
+    assert manager._evict_one()
+    assert blocks[2] in manager._free  # deepest chain entry went first
+    assert manager.match([1, 2, 3, 4]) == (blocks[:2], 4)
+
+
+def test_manager_publish_is_idempotent_and_keeps_canonical_chain():
+    manager = PagedKVManager(num_blocks=16, block_size=2)
+    first = manager.allocate(2)
+    manager.publish([5, 6, 7, 8], first)
+    duplicate = manager.allocate(2)
+    manager.publish([5, 6, 7, 8], duplicate)  # same tokens, other blocks
+    chain, matched = manager.match([5, 6, 7, 8])
+    assert chain == first and matched == 4  # canonical chain wins
+    manager.release(duplicate)
+    # unpublished duplicates free immediately
+    assert all(b in manager._free for b in duplicate)
+
+
+# ---------------------------------------------------------------------- #
+# engine: paged vs dense parity (shared module-scoped pair)
+# ---------------------------------------------------------------------- #
+def _tiny_engine(**kwargs):
+    config = LlamaConfig.tiny(max_seq_len=kwargs.pop("max_seq_len", 128))
+    params = init_params(config)
+    engine = DecodeEngine(
+        config, params,
+        max_slots=kwargs.pop("max_slots", 4),
+        max_seq_len=config.max_seq_len,
+        prefill_buckets=kwargs.pop("prefill_buckets", [16, 32, 64]),
+        **kwargs,
+    )
+    engine.start()
+    return engine
+
+
+@pytest.fixture(scope="module")
+def dense_engine():
+    engine = _tiny_engine()
+    yield engine
+    engine.stop()
+
+
+@pytest.fixture(scope="module")
+def paged_engine():
+    engine = _tiny_engine(kv_layout="paged", kv_block_size=8)
+    yield engine
+    engine.stop()
+
+
+def test_paged_concurrent_matches_dense_greedy(dense_engine, paged_engine):
+    async def run(engine):
+        prompts = [
+            [i + 1, i + 2, i + 3, i + 4, i + 5] for i in range(6)
+        ] + [list(range(1, 30))]
+        results = await asyncio.gather(*[
+            engine.generate(p, SamplingParams(max_new_tokens=6))
+            for p in prompts
+        ])
+        return [r.tokens for r in results]
+
+    assert asyncio.run(run(paged_engine)) == asyncio.run(run(dense_engine))
+
+
+def test_prefix_block_hit_after_slot_turnover(dense_engine, paged_engine):
+    """The persistent prefix cache serves a prefix whose original slot
+    is long gone — the capability the dense slot-resident LCP scan
+    fundamentally lacks (it can only copy from live slots)."""
+
+    async def run(engine):
+        first = await engine.generate(
+            list(range(1, 40)), SamplingParams(max_new_tokens=6)
+        )
+        # shares blocks 0..3 (32 tokens) with the first prompt
+        second = await engine.generate(
+            list(range(1, 33)) + [99, 98], SamplingParams(max_new_tokens=6)
+        )
+        return first.tokens, second.tokens
+
+    hits_before = paged_engine.kv_manager.stats["hit_tokens"]
+    assert asyncio.run(run(paged_engine)) == asyncio.run(run(dense_engine))
+    assert paged_engine.kv_manager.stats["hit_tokens"] >= hits_before + 32
+
+
+def test_session_cow_preserves_published_chain(dense_engine, paged_engine):
+    """A session follow-up that diverges MID-BLOCK must copy the boundary
+    block instead of corrupting the published chain a third request
+    still matches."""
+
+    async def run(engine):
+        prompt = list(range(1, 36))  # 35 tokens: 4 full blocks + 3
+        s1 = await engine.generate(
+            prompt, SamplingParams(max_new_tokens=5), session_id="cow"
+        )
+        # follow-up keeps part of the pinned history THEN diverges — at
+        # a point that falls MID-BLOCK inside a block the finish path
+        # published (cache length 39 → blocks 0..3 published; position
+        # 30 is inside published block 3), forcing a copy-on-write
+        history = prompt + s1.tokens
+        follow = history[:30] + [201, 202, 203]
+        s2 = await engine.generate(
+            follow, SamplingParams(max_new_tokens=5), session_id="cow"
+        )
+        # third, sessionless request re-sends the ORIGINAL chain: in
+        # paged mode it matches the published blocks (incl. the one the
+        # session overwrote a copy of) and must see uncorrupted content
+        probe = await engine.generate(
+            history + [42], SamplingParams(max_new_tokens=5)
+        )
+        return s1.tokens, s2.tokens, probe.tokens
+
+    cow_before = paged_engine.kv_manager.stats["cow_copies"]
+    assert asyncio.run(run(paged_engine)) == asyncio.run(run(dense_engine))
+    assert paged_engine.kv_manager.stats["cow_copies"] >= cow_before + 1
+
+
+def test_session_reservation_trimmed_at_finish(paged_engine):
+    """An idle pinned session must hold only the blocks its history
+    occupies — the worst-case (prompt + max_new) reservation is
+    returned to the pool at finish, or sized-down pools would pin
+    never-written tail blocks the allocator cannot evict."""
+
+    async def run():
+        prompt = [61, 62, 63, 64, 65, 66]
+        free = await paged_engine.generate(
+            prompt, SamplingParams(max_new_tokens=48)
+        )
+        stop = free.tokens[2]
+        await paged_engine.generate(
+            prompt, SamplingParams(max_new_tokens=48),
+            stop_tokens={stop}, session_id="trim-check",
+        )
+
+    asyncio.run(run())
+    slot = next(
+        s for s in paged_engine.slots if s.session_id == "trim-check"
+    )
+    size = paged_engine.block_size
+    assert len(slot.blocks) == -(-slot.length // size)
+    assert len(slot.blocks) < -(-(6 + 48) // size)  # << the reservation
+
+
+def test_eviction_under_pool_pressure_keeps_parity(dense_engine):
+    """A pool with zero slack (exactly the dense worst case) forces the
+    prefix cache to evict published chains as fresh prompts arrive —
+    outputs must stay correct and the engine must never deadlock."""
+    paged = _tiny_engine(
+        kv_layout="paged", kv_block_size=16, max_slots=2,
+        kv_blocks=2 * (128 // 16) + 1,
+    )
+    prompts = [
+        [(i * 31 + j) % 250 + 1 for j in range(40)] for i in range(6)
+    ]
+
+    async def run(engine):
+        results = await asyncio.gather(*[
+            engine.generate(p, SamplingParams(max_new_tokens=24))
+            for p in prompts
+        ])
+        return [r.tokens for r in results]
+
+    try:
+        assert asyncio.run(run(paged)) == asyncio.run(run(dense_engine))
+        assert paged.kv_manager.stats["evictions"] > 0
+        # nothing leaked: with all slots free, resident blocks are
+        # exactly the cached (refcount-0) chains
+        manager = paged.kv_manager
+        assert manager.blocks_in_use == manager.blocks_cached
+    finally:
+        paged.stop()
+
+
+def test_admission_waits_for_blocks_not_deadlocks():
+    """More concurrent requests than the pool can hold at once: late
+    arrivals wait for running requests to release blocks instead of
+    failing or deadlocking."""
+    engine = _tiny_engine(
+        kv_layout="paged", kv_block_size=16, max_slots=4,
+        kv_blocks=(128 // 16) + 2,  # barely more than ONE worst case
+    )
+
+    async def run():
+        results = await asyncio.gather(*[
+            engine.generate(
+                [(i * 17 + j) % 250 + 1 for j in range(24)],
+                SamplingParams(max_new_tokens=16),
+            )
+            for i in range(5)
+        ])
+        return [len(r.tokens) for r in results]
+
+    try:
+        assert asyncio.run(run()) == [16] * 5
+    finally:
+        engine.stop()
+
+
+def test_paged_quant_matches_dense_quant_greedy():
+    dense = _tiny_engine(kv_quant="int8", prefill_buckets=[64])
+    paged = _tiny_engine(
+        kv_layout="paged", kv_block_size=8, kv_quant="int8",
+        prefill_buckets=[64],
+    )
+
+    async def run(engine):
+        first = await engine.generate(
+            list(range(1, 40)), SamplingParams(max_new_tokens=6)
+        )
+        second = await engine.generate(
+            list(range(1, 33)) + [99, 98], SamplingParams(max_new_tokens=6)
+        )
+        return first.tokens, second.tokens
+
+    try:
+        assert asyncio.run(run(paged)) == asyncio.run(run(dense))
+        assert paged.kv_manager.stats["hit_tokens"] >= 32
+    finally:
+        dense.stop()
+        paged.stop()
+
+
+# ---------------------------------------------------------------------- #
+# acceptance: ≥256-token shared prefix served from cached blocks
+# ---------------------------------------------------------------------- #
+def test_shared_256_token_prefix_prefills_from_cached_blocks(
+    tmp_path, monkeypatch
+):
+    from langstream_tpu.runtime import flight, tracing
+
+    monkeypatch.setenv("LANGSTREAM_TRACE_DIR", str(tmp_path / "traces"))
+    saved_tracers = dict(tracing._TRACERS)
+    tracing._TRACERS.clear()
+    saved_flight = (flight.RECORDER.path, flight.RECORDER._last_flush)
+    flight.RECORDER.path = None
+    flight.RECORDER._pending.clear()
+    flight.configure(str(tmp_path / "flight"))
+
+    shared = [(13 * i) % 250 + 1 for i in range(288)]  # 18 blocks of 16
+    prompt_a = shared + [(7 * i) % 250 + 1 for i in range(32)]
+    prompt_b = shared + [(11 * i) % 250 + 1 for i in range(32)]
+    sampling = SamplingParams(max_new_tokens=8)
+
+    async def run(engine):
+        a = await engine.generate(prompt_a, sampling, trace_id="req-a")
+        b = await engine.generate(prompt_b, sampling, trace_id="req-b")
+        return a.tokens, b.tokens
+
+    try:
+        paged = _tiny_engine(
+            max_seq_len=512, max_slots=2, prefill_buckets=[64, 512],
+            kv_layout="paged", kv_block_size=16,
+        )
+        try:
+            out_paged = asyncio.run(run(paged))
+            manager_stats = dict(paged.kv_manager.stats)
+            snapshot = engines_snapshot()
+            tracer = paged.tracer
+        finally:
+            paged.stop()
+        dense = _tiny_engine(
+            max_seq_len=512, max_slots=2, prefill_buckets=[64, 512],
+        )
+        try:
+            out_dense = asyncio.run(run(dense))
+        finally:
+            dense.stop()
+
+        # token-level parity against the dense layout (greedy)
+        assert out_paged == out_dense
+        # the full shared prefix was served from cached blocks
+        assert manager_stats["hit_tokens"] >= 256
+        assert snapshot["prefix_cache_hit_tokens_total"] >= 256
+        assert snapshot["kv_blocks_in_use"] > 0
+
+        # per-request prefill span length: request B's prefill covered
+        # only the divergent suffix, not the 320-token prompt
+        flight.flush()
+        entries = flight.read_artifact(flight.RECORDER.path)
+        prefills = [e for e in entries if e["kind"] == "prefill"]
+        cold = [e for e in prefills if not e["reused_tokens"]]
+        warm = [e for e in prefills if e["reused_tokens"]]
+        assert cold and cold[0]["bucket"] == 512
+        assert warm and warm[0]["reused_tokens"] >= 256
+        assert warm[0]["bucket"] <= 64
+
+        spans = [s for s in tracer._spans if s.name == "engine.prefill"]
+        by_trace = {s.trace_id: s.attributes for s in spans}
+        assert by_trace["req-a"]["prefill_tokens"] == len(prompt_a)
+        assert by_trace["req-b"]["reused_tokens"] >= 256
+        assert by_trace["req-b"]["prefill_tokens"] <= 64
+    finally:
+        flight.RECORDER.flush()
+        flight.RECORDER.path = saved_flight[0]
+        tracing._TRACERS.clear()
+        tracing._TRACERS.update(saved_tracers)
+
+
+# ---------------------------------------------------------------------- #
+# guards + config plumbing
+# ---------------------------------------------------------------------- #
+def test_pool_smaller_than_one_sequence_rejected():
+    """The constructor invariant that makes the decode path infallible:
+    the pool must hold at least one max-length sequence."""
+    with pytest.raises(ValueError, match="kv_blocks"):
+        _tiny_engine(kv_layout="paged", kv_block_size=16, kv_blocks=4)
+    with pytest.raises(ValueError, match="layout"):
+        _tiny_engine(kv_layout="ragged")
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)
+def test_paged_rejects_multihost_mirror():
+    engine = _tiny_engine(kv_layout="paged", kv_block_size=16)
+
+    class FakeMirror:
+        def publish(self, *a):
+            raise AssertionError("must not publish paged dispatches")
+
+        def close(self):
+            pass
+
+    engine.mirror = FakeMirror()
+
+    async def run():
+        with pytest.raises(RuntimeError):
+            await engine.generate([1, 2, 3], SamplingParams(max_new_tokens=2))
+
+    try:
+        asyncio.run(run())
+    finally:
+        engine.mirror = None
+        engine.stop()
+
+
+def test_paged_provider_config_plumbing():
+    """kv-layout / kv-block-size / kv-blocks flow from the resource
+    config into the engine (compiler globals → provider → engine)."""
+    from langstream_tpu.providers.jax_local.provider import (
+        JaxCompletionsService,
+    )
+
+    service = JaxCompletionsService({
+        "model": {"preset": "tiny"},
+        "engine": {
+            "max-slots": "2", "max-seq-len": "64",
+            "kv-layout": "paged", "kv-block-size": "8", "kv-blocks": "20",
+        },
+    })
+    try:
+        engine = service.engine
+        assert engine.kv_layout == "paged"
+        assert engine.block_size == 8
+        assert engine.num_blocks == 20
+        assert engine.kv_manager is not None
+    finally:
+        engine.stop()

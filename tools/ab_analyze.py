@@ -28,6 +28,7 @@ LEGS = {
     "bench_heal_flashdec0.json": "flash-decode OFF @2048ctx/16slots",
     "bench_heal_flashdec1.json": "flash-decode ON @2048ctx/16slots",
     "bench_heal_admis.json": "admission-chunk 8",
+    "bench_heal_paged.json": "paged KV + prefix pool (--kv-layout paged)",
 }
 
 
@@ -142,6 +143,24 @@ def flight_summary(art_dir: str) -> Optional[str]:
                 f"  occupancy: mean {sum(occ) / len(occ):.1%} over "
                 f"{len(occ)} chunks"
             )
+        # paged-KV series (kv_layout: paged): pool pressure + cumulative
+        # prefix-cache hit tokens ride each decode_chunk record
+        pool = [
+            (c["kv_blocks_in_use"], c.get("kv_blocks_total", 0))
+            for c in chunks if c.get("kv_blocks_in_use") is not None
+        ]
+        if pool:
+            in_use = [p[0] for p in pool]
+            total = max(p[1] for p in pool) or 1
+            hit_tokens = max(
+                (c.get("prefix_hit_tokens", 0) for c in chunks), default=0
+            )
+            lines.append(
+                f"  kv pool: blocks in use p50 "
+                f"{_percentile(in_use, 0.5)}/{total} "
+                f"(peak {max(in_use)}, {max(in_use) / total:.0%}); "
+                f"prefix-cache hit tokens {hit_tokens}"
+            )
     elif not crashes:
         lines.append("  no decode samples (run died before serving?)")
     return "\n".join(lines)
@@ -198,6 +217,23 @@ def main() -> None:
             recommendations.append(
                 f"flash-decode not a win at 2048 ctx ({delta:+.1%}); "
                 "keep the XLA path default, re-test at 4096+" + note
+            )
+    paged = records["bench_heal_paged.json"]
+    if usable(main_rec) and usable(paged):
+        delta = paged["value"] / main_rec["value"] - 1
+        note = caveat(main_rec, paged)
+        if delta > 0.03:
+            recommendations.append(
+                f"FLIP kv-layout default to paged: {delta:+.1%} e2e "
+                f"({main_rec['value']:.0f} -> {paged['value']:.0f} tok/s); "
+                "set engine kv-layout default + jax-completions globals"
+                + note
+            )
+        else:
+            recommendations.append(
+                f"keep dense KV layout default ({delta:+.1%} not a win "
+                "at bench shapes; paged still wins HBM headroom for "
+                "long-context / shared-prefix traffic)" + note
             )
     admis = records["bench_heal_admis.json"]
     if usable(main_rec) and usable(admis):
