@@ -1156,6 +1156,16 @@ def main():
             time.sleep(30)  # give a flapping relay a beat to settle
             os.environ["BENCH_PRIOR_TIMINGS"] = json.dumps(timings())
             os.environ["BENCH_ATTEMPT"] = str(_ATTEMPT + 1)
+            # execv REPLACES the process image and skips atexit handlers
+            # — the flight ring's flush-at-exit never runs, so drain it
+            # explicitly or every failed attempt's timeline is lost
+            flight = _flight()
+            if flight is not None:
+                flight.record(
+                    "phase", name="re-exec", attempt=_ATTEMPT,
+                    t=round(time.monotonic() - _START, 3),
+                )
+                flight.flush()
             sys.stdout.flush()
             sys.stderr.flush()
             os.execv(sys.executable, [sys.executable] + sys.argv)

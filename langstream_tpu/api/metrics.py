@@ -145,6 +145,16 @@ def _sanitize(name: str) -> str:
     return metric
 
 
+def _split_labels(name: str) -> Tuple[str, str]:
+    """Split a registry key of the form ``metric{label="v"}`` into the
+    (sanitized) metric name and its label suffix (kept verbatim). Plain
+    names pass through with an empty suffix — this is what lets gauge
+    maps carry labeled samples (e.g. per-reason wasted-token counters)
+    through the one shared renderer."""
+    base, brace, labels = name.partition("{")
+    return _sanitize(base), (brace + labels) if brace else ""
+
+
 def prometheus_text(
     counters: Mapping[str, int],
     gauges: Optional[Mapping[str, float]] = None,
@@ -153,28 +163,40 @@ def prometheus_text(
 ) -> str:
     """Render counters/gauges/histograms in the Prometheus text
     exposition format (histogram snapshots are the ``le``-keyed dicts
-    :meth:`Histogram.snapshot` produces). ``help_texts`` maps raw metric
-    names to their ``# HELP`` line; metrics without one get a generic
-    self-describing help so the output always parses as a complete
-    family (HELP + TYPE + samples)."""
+    :meth:`Histogram.snapshot` produces). Counter/gauge keys may carry
+    inline labels (``name{reason="x"}``); same-family samples share one
+    HELP/TYPE header. ``help_texts`` maps raw metric names to their
+    ``# HELP`` line; metrics without one get a generic self-describing
+    help so the output always parses as a complete family
+    (HELP + TYPE + samples)."""
 
     def help_line(metric: str, raw: str, kind: str) -> str:
         text = (help_texts or {}).get(raw) or f"langstream-tpu {kind}"
         return f"# HELP {metric} {text}"
 
+    def render(samples, kind: str, suffix: str = "") -> None:
+        # sort by (parsed family, labels), NOT by raw key: "_" sorts
+        # before "{", so raw-key order could interleave foo_bar between
+        # foo and foo{...} and split one family into duplicate
+        # HELP/TYPE headers (invalid exposition — Prometheus rejects
+        # the whole scrape)
+        parsed = []
+        for name, value in samples.items():
+            metric, labels = _split_labels(name)
+            if suffix and not metric.endswith(suffix):
+                metric += suffix
+            parsed.append((metric, labels, name.partition("{")[0], value))
+        family = None
+        for metric, labels, raw, value in sorted(parsed):
+            if metric != family:
+                family = metric
+                lines.append(help_line(metric, raw, kind))
+                lines.append(f"# TYPE {metric} {kind}")
+            lines.append(f"{metric}{labels} {value}")
+
     lines: List[str] = []
-    for name, value in sorted(counters.items()):
-        metric = _sanitize(name)
-        if not metric.endswith("_total"):
-            metric += "_total"
-        lines.append(help_line(metric, name, "counter"))
-        lines.append(f"# TYPE {metric} counter")
-        lines.append(f"{metric} {value}")
-    for name, value in sorted((gauges or {}).items()):
-        metric = _sanitize(name)
-        lines.append(help_line(metric, name, "gauge"))
-        lines.append(f"# TYPE {metric} gauge")
-        lines.append(f"{metric} {value}")
+    render(counters, "counter", suffix="_total")
+    render(gauges or {}, "gauge")
     for name, snapshot in sorted((histograms or {}).items()):
         metric = _sanitize(name)
         lines.append(help_line(metric, name, "histogram"))
@@ -227,9 +249,10 @@ def quantile_from_buckets(
     samples: List[Tuple[Dict[str, str], float]], quantile: float
 ) -> Optional[float]:
     """Approximate a quantile from parsed ``_bucket`` samples (cumulative
-    ``le`` counts): the upper bound of the first bucket whose cumulative
-    count reaches the target rank — the standard Prometheus
-    ``histogram_quantile`` shape, minus interpolation."""
+    ``le`` counts): linear interpolation inside the bucket containing the
+    target rank — the standard Prometheus ``histogram_quantile`` shape
+    (the first bucket interpolates from 0). A rank landing in the +Inf
+    bucket caps at the highest finite bound rather than returning inf."""
     buckets: List[Tuple[float, float]] = []
     total = 0.0
     for labels, value in samples:
@@ -245,9 +268,16 @@ def quantile_from_buckets(
     rank = quantile * total
     finite = [upper for upper, _ in buckets if upper != float("inf")]
     cap = finite[-1] if finite else None
+    lower, below = 0.0, 0.0
     for upper, cumulative in buckets:
         if cumulative >= rank:
             # rank in the +Inf bucket: cap at the highest finite bound
             # (histogram_quantile semantics) rather than returning inf
-            return cap if upper == float("inf") else upper
+            if upper == float("inf"):
+                return cap
+            if cumulative == below:
+                return upper
+            fraction = (rank - below) / (cumulative - below)
+            return lower + (upper - lower) * max(0.0, min(1.0, fraction))
+        lower, below = upper, cumulative
     return cap

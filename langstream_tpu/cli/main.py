@@ -369,9 +369,43 @@ def _trace_cmd(args) -> None:
         print(line)
 
 
+async def _profile_cmd(args) -> None:
+    """Trigger an on-demand profiler capture on a serving process via
+    its guarded ``/debug/profile`` endpoint (runner pod :8080, serve
+    :8000) and print the artifact directory."""
+    import aiohttp
+
+    url = args.url.rstrip("/")
+    if not url.endswith("/debug/profile"):
+        url += "/debug/profile"
+    timeout = aiohttp.ClientTimeout(total=args.seconds + 60)
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+        async with session.get(
+            url, params={"seconds": args.seconds}
+        ) as response:
+            if response.status == 409:
+                raise SystemExit(
+                    "capture already in progress on the target "
+                    "(one at a time); retry when it finishes"
+                )
+            if response.status != 200:
+                # body may be anything (a proxy's HTML, an older
+                # server's 404 text) — report it raw, don't parse it
+                raise SystemExit(
+                    f"capture failed ({response.status}): "
+                    f"{(await response.text())[:300]}"
+                )
+            body = await response.json(content_type=None)
+    print(f"profile ({args.seconds:.0f}s) -> {body['path']}")
+    print("  inspect with TensorBoard's profile plugin or xprof; "
+          "device_memory.json holds the HBM snapshot")
+
+
 async def _top_cmd(args) -> None:
     """Poll a /metrics endpoint and render a live engine table
-    (occupancy, step time, token throughput from poll deltas)."""
+    (occupancy, step time, token throughput from poll deltas) plus an
+    SLO panel (TTFT/TPOT percentiles vs targets, burn rates) when the
+    target exports SLO gauges."""
     import time as _time
 
     import aiohttp
@@ -423,7 +457,7 @@ async def _top_cmd(args) -> None:
                      f"{gauge('jax_engine_slot_occupancy'):7.1%}"),
                     ("decode ms/step (mean)",
                      f"{gauge('jax_engine_decode_ms_per_step'):9.2f}"),
-                    ("decode ms/step (p50 bucket)",
+                    ("decode ms/step (p50 interp)",
                      "      n/a" if p50 is None else f"{p50 * 1e3:9.2f}"),
                     ("output tok/s (poll delta)", f"{tok_s:9.1f}"),
                     ("tokens generated", f"{tokens:9.0f}"),
@@ -434,6 +468,13 @@ async def _top_cmd(args) -> None:
                     ("session hits",
                      f"{gauge('jax_engine_session_hits'):9.0f}"),
                 ]
+                if "jax_engine_mfu" in metrics:
+                    rows.append(("MFU / MBU (roofline)",
+                                 f"{gauge('jax_engine_mfu'):7.1%} / "
+                                 f"{gauge('jax_engine_mbu'):5.1%}"))
+                if "jax_engine_goodput_ratio" in metrics:
+                    rows.append(("goodput (useful/total tokens)",
+                                 f"{gauge('jax_engine_goodput_ratio'):7.1%}"))
                 stamp = _time.strftime("%H:%M:%S")
                 print(f"-- langstream-tpu top  {args.url}  {stamp} --")
                 if tokens or gauge("jax_engine_decode_steps"):
@@ -441,6 +482,58 @@ async def _top_cmd(args) -> None:
                         print(f"  {label:28s} {value}")
                 else:
                     print("  engine idle (no decode activity yet)")
+                # SLO panel: measured percentiles (interpolated from the
+                # exported buckets) against the configured targets, plus
+                # the multi-window burn rates the engine derives from
+                # the same histograms
+                slo_rows = []
+                for key, label in (("ttft", "TTFT"), ("tpot", "TPOT")):
+                    target = metrics.get(
+                        f"jax_engine_slo_{key}_p95_target_ms"
+                    )
+                    if not target:
+                        continue
+                    target_ms = target[0][1]
+                    p95 = quantile_from_buckets(
+                        metrics.get(
+                            f"jax_engine_{key}_seconds_bucket", []
+                        ),
+                        0.95,
+                    )
+                    p95_ms = None if p95 is None else p95 * 1e3
+
+                    def burn(window: str) -> str:
+                        # absent gauge = no sample landed in the window
+                        # yet — render n/a, NOT a perfect-looking 0.00x
+                        sample = metrics.get(
+                            f"jax_engine_slo_{key}_burn_rate_{window}"
+                        )
+                        return (
+                            f"{sample[0][1]:5.2f}x" if sample
+                            else "  n/a"
+                        )
+
+                    status = (
+                        "  n/a" if p95_ms is None
+                        else ("BREACH" if p95_ms > target_ms else "ok")
+                    )
+                    measured = (
+                        "     n/a" if p95_ms is None else f"{p95_ms:8.1f}"
+                    )
+                    # honest labeling: the p95 (and its ok/BREACH) is
+                    # computed from lifetime-cumulative buckets — a past
+                    # breach lingers there; the burn rates are the
+                    # windowed "is it breaching NOW" signal
+                    slo_rows.append(
+                        f"  {label} p95(life) {measured} ms  "
+                        f"(target {target_ms:7.1f} ms)  "
+                        f"burn 5m {burn('5m')} / 1h {burn('1h')}  "
+                        f"[{status}]"
+                    )
+                if slo_rows:
+                    print("  -- SLO --")
+                    for row in slo_rows:
+                        print(row)
             if args.count and iteration >= args.count:
                 break
             await asyncio.sleep(args.interval)
@@ -637,6 +730,22 @@ def build_parser() -> argparse.ArgumentParser:
         help="stop after N polls (0 = until interrupted)",
     )
 
+    profile = sub.add_parser(
+        "profile",
+        help="trigger an on-demand device-profiler capture on a serving "
+             "process (guarded /debug/profile endpoint; one at a time)",
+    )
+    profile.add_argument(
+        "url", nargs="?", default="http://127.0.0.1:8000",
+        help="server base URL (runner pod :8080, serve :8000) or the "
+             "full /debug/profile URL",
+    )
+    profile.add_argument(
+        "--seconds", type=float, default=3.0,
+        help="capture window (everything the devices run in it lands "
+             "in the trace)",
+    )
+
     # pod entry points (invoked by the deployer's generated manifests;
     # reference: AgentRunnerStarter.java:39, RuntimeDeployer.java:40,
     # ApplicationSetupRunner.java:40)
@@ -739,6 +848,22 @@ def build_parser() -> argparse.ArgumentParser:
         help="paged layout: pool size in blocks (0 = the dense-"
              "equivalent worst case, slots x ceil(max_seq/block))",
     )
+    serve.add_argument(
+        "--slo-ttft-ms", type=float, default=0,
+        help="TTFT p95 SLO target in ms: enables burn-rate gauges on "
+             "/metrics and the `top` SLO panel (0 = off)",
+    )
+    serve.add_argument(
+        "--slo-tpot-ms", type=float, default=0,
+        help="TPOT p95 SLO target in ms (0 = off)",
+    )
+    serve.add_argument(
+        "--no-watchdog", action="store_true",
+        help="disable the decode-stall watchdog (on by default for "
+             "serve: EWMA step-latency degradation, no-progress and "
+             "KV-pool livelock detection with automatic evidence "
+             "capture)",
+    )
     serve.add_argument("--embeddings-checkpoint", default=None)
     serve.add_argument("--host", default="0.0.0.0")
     serve.add_argument("--port", type=int, default=8000)
@@ -825,6 +950,8 @@ def main(argv: Optional[List[str]] = None) -> None:
             asyncio.run(_top_cmd(args))
         except KeyboardInterrupt:
             pass
+    elif args.command == "profile":
+        asyncio.run(_profile_cmd(args))
     elif args.command == "agent-runner":
         from langstream_tpu.runtime.pod import agent_runner_main
 

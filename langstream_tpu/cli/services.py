@@ -27,9 +27,23 @@ logger = logging.getLogger(__name__)
 
 
 def _install_stop(loop, stop: asyncio.Event) -> None:
+    def _signalled() -> None:
+        # flush the flight recorder the moment the signal lands: a k8s
+        # preStop SIGTERM gives a bounded grace period, and the async
+        # teardown below it can be cut short by SIGKILL — the ring's
+        # evidence must already be on disk by then (no-op when the
+        # recorder is disabled)
+        try:
+            from langstream_tpu.runtime import flight
+
+            flight.flush()
+        except Exception:  # noqa: BLE001 — never block the shutdown path
+            pass
+        stop.set()
+
     for sig in (signal.SIGTERM, signal.SIGINT):
         try:
-            loop.add_signal_handler(sig, stop.set)
+            loop.add_signal_handler(sig, _signalled)
         except (NotImplementedError, RuntimeError):
             pass
 
@@ -191,6 +205,21 @@ async def gateway_server_main(args) -> None:
         await gateway.stop()
 
 
+def _mirror_fingerprint(config: Dict[str, Any]) -> bytes:
+    """Leader/follower config digest over the keys that shape the jit
+    programs. Observability-only knobs (SLO targets, watchdog) must not
+    force flag parity across hosts — a follower has no HTTP surface to
+    serve SLOs from."""
+    from langstream_tpu.serving.mirror import config_fingerprint
+
+    scrubbed = {k: v for k, v in config.items() if k != "slo"}
+    scrubbed["engine"] = {
+        k: v for k, v in config.get("engine", {}).items()
+        if k != "watchdog"
+    }
+    return config_fingerprint(scrubbed)
+
+
 async def serve_main(args) -> None:
     """`langstream-tpu serve`: OpenAI-compatible HTTP server straight
     over the jax-local engine (no pipeline needed) — existing OpenAI
@@ -255,8 +284,17 @@ async def serve_main(args) -> None:
             "kv-layout": getattr(args, "kv_layout", "dense"),
             "kv-block-size": getattr(args, "kv_block_size", 16),
             "kv-blocks": getattr(args, "kv_blocks", 0) or "",
+            # decode-stall watchdog: on by default for serve (the
+            # provider starts it; --no-watchdog disables)
+            "watchdog": not getattr(args, "no_watchdog", False),
         },
     }
+    slo_targets = {
+        "ttft-ms-p95": getattr(args, "slo_ttft_ms", 0) or 0,
+        "tpot-ms-p95": getattr(args, "slo_tpot_ms", 0) or 0,
+    }
+    if any(slo_targets.values()):
+        config["slo"] = {k: v for k, v in slo_targets.items() if v}
     from langstream_tpu.providers.jax_local.model import LlamaConfig
 
     try:
@@ -296,17 +334,14 @@ async def serve_main(args) -> None:
     if getattr(args, "follower_of", None):
         # follower host of a multi-host replica: no HTTP surface — just
         # replay the leader's dispatch stream on this process's shard
-        from langstream_tpu.serving.mirror import (
-            FollowerExecutor,
-            config_fingerprint,
-        )
+        from langstream_tpu.serving.mirror import FollowerExecutor
 
         completions.engine.stop()  # executor owns the dispatches
         leader_host, _, leader_port = args.follower_of.rpartition(":")
         executor = FollowerExecutor(completions.engine)
         executor.connect(
             leader_host or "127.0.0.1", int(leader_port),
-            fingerprint=config_fingerprint(config),
+            fingerprint=_mirror_fingerprint(config),
         )
         print(
             f"follower: replaying dispatch stream from {args.follower_of}",
@@ -317,14 +352,11 @@ async def serve_main(args) -> None:
         return
     mirror = None
     if getattr(args, "followers", 0):
-        from langstream_tpu.serving.mirror import (
-            DispatchMirror,
-            config_fingerprint,
-        )
+        from langstream_tpu.serving.mirror import DispatchMirror
 
         mirror = DispatchMirror(
             host=args.host, port=args.mirror_port,
-            fingerprint=config_fingerprint(config),
+            fingerprint=_mirror_fingerprint(config),
         )
         print(
             f"mirror: waiting for {args.followers} follower(s) "

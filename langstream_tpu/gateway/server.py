@@ -126,11 +126,26 @@ class GatewayServer:
         return web.json_response({"status": "OK", "apps": len(self._apps)})
 
     async def _metrics(self, request) -> web.Response:
+        gauges = {"gateway_registered_apps": float(len(self._apps))}
+        histograms = dict(self.metrics.histogram_snapshots())
+        # `apps run` hosts the gateway in the SAME process as the TPU
+        # engine: surface the engine's efficiency gauges (MFU/MBU,
+        # goodput, SLO burn rates, watchdog trips) here too, so every
+        # scrape surface of the process tells the same story. Lazy via
+        # sys.modules — a gateway-only process never imports the engine.
+        import sys as _sys
+
+        engine_module = _sys.modules.get(
+            "langstream_tpu.providers.jax_local.engine"
+        )
+        if engine_module is not None:
+            gauges.update(engine_module.engines_snapshot())
+            histograms.update(engine_module.engines_histograms())
         return web.Response(
             text=prometheus_text(
                 self.metrics.snapshot(),
-                {"gateway_registered_apps": float(len(self._apps))},
-                self.metrics.histogram_snapshots(),
+                gauges,
+                histograms,
             ),
             content_type="text/plain",
         )

@@ -181,6 +181,17 @@ class JaxCompletionsService(CompletionsService):
             # OpenAI `top_logprobs`: static K per engine (shapes the jit
             # outputs); requests may ask for any n <= K
             logprobs_topk=int(engine_config.get("logprobs-top-k", 0) or 0),
+            # SLO targets (`slo: {ttft-ms-p95: 200, tpot-ms-p95: 30}`):
+            # feed the multi-window burn-rate gauges on every /metrics
+            # surface and the `top` SLO panel
+            slo=(
+                {
+                    str(k).replace("-", "_"): float(v)
+                    for k, v in (config.get("slo") or {}).items()
+                    if v
+                }
+                or None
+            ),
         )
         self.top_logprobs_limit = self.engine.logprobs_topk
         if str(engine_config.get("precompile", "")).lower() in (
@@ -190,6 +201,21 @@ class JaxCompletionsService(CompletionsService):
             # request so no jit compile ever stalls live traffic
             self.engine.precompile()
         self.engine.start()
+        # decode-stall watchdog: opt-in (`serve` turns it on; pods via
+        # engine config or LANGSTREAM_WATCHDOG=1) — a degraded/wedged
+        # engine flushes flight evidence and bumps watchdog_trips_total
+        # instead of waiting for a human to notice
+        self.watchdog = None
+        watchdog_flag = str(
+            engine_config.get(
+                "watchdog", os.environ.get("LANGSTREAM_WATCHDOG", "")
+            )
+        ).lower()
+        if watchdog_flag in ("1", "true", "yes", "on"):
+            from langstream_tpu.runtime.watchdog import EngineWatchdog
+
+            self.watchdog = EngineWatchdog(self.engine)
+            self.watchdog.start()
 
     async def get_chat_completions(
         self,
@@ -415,6 +441,8 @@ class JaxCompletionsService(CompletionsService):
         )
 
     async def close(self) -> None:
+        if self.watchdog is not None:
+            self.watchdog.stop()
         self.engine.stop()
 
 

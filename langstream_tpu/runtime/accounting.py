@@ -1,0 +1,342 @@
+"""Efficiency accounting: roofline cost model + SLO burn-rate math.
+
+The observability plane (PR 1) answers "where did a request's time go";
+this module answers "how close to the hardware ceiling is the engine
+running, and is the fleet meeting its SLOs" — the control signals every
+perf PR is judged against (AIBrix / DeepServe treat MFU-style utilization
+and SLO attainment as first-class scheduler inputs).
+
+Three pieces, all analytical and dependency-free so they run identically
+on a laptop and on-chip:
+
+- :class:`PeakSpecs` — per-chip peak FLOP/s and HBM bandwidth
+  (v5e-1 defaults; ``LANGSTREAM_PEAK_TFLOPS`` / ``LANGSTREAM_PEAK_HBM_GBS``
+  override for other chip generations without a code change).
+- :class:`CostModel` — FLOPs and HBM bytes per prefill token and per
+  decode step, derived purely from the model config (layers, heads /
+  kv_heads, head_dim, hidden, vocab, weight/KV quantization widths,
+  dense vs paged KV layout). The engine multiplies these by measured
+  chunk wall time to produce per-chunk **MFU** (model FLOP utilization)
+  and **MBU** (memory-bandwidth utilization).
+- :class:`SLOTracker` — multi-window (5m/1h) SLO burn rates computed
+  from timestamped snapshots of the TTFT/TPOT latency histograms: the
+  same ``le``-bucketed data every /metrics surface exposes, so the burn
+  math is auditable from a scrape alone (:func:`violation_fraction`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Deque, Dict, Mapping, Optional, Tuple
+
+# v5e-1 per-chip peaks (bf16 MXU; weight-only int8 halves weight BYTES
+# but the matmuls still run in bf16 — qeinsum dequantizes into the
+# contraction — so the FLOPs ceiling stays the bf16 one)
+DEFAULT_PEAK_FLOPS = 197e12
+DEFAULT_PEAK_HBM_GBS = 819.0
+
+ENV_PEAK_TFLOPS = "LANGSTREAM_PEAK_TFLOPS"
+ENV_PEAK_HBM_GBS = "LANGSTREAM_PEAK_HBM_GBS"
+
+
+@dataclasses.dataclass(frozen=True)
+class PeakSpecs:
+    """Per-chip hardware ceilings the roofline divides by."""
+
+    flops: float = DEFAULT_PEAK_FLOPS
+    hbm_bytes_per_s: float = DEFAULT_PEAK_HBM_GBS * 1e9
+
+    @classmethod
+    def from_env(cls) -> "PeakSpecs":
+        tflops = os.environ.get(ENV_PEAK_TFLOPS, "")
+        gbs = os.environ.get(ENV_PEAK_HBM_GBS, "")
+        return cls(
+            flops=float(tflops) * 1e12 if tflops else DEFAULT_PEAK_FLOPS,
+            hbm_bytes_per_s=(
+                float(gbs) * 1e9 if gbs else DEFAULT_PEAK_HBM_GBS * 1e9
+            ),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class CostModel:
+    """Analytical FLOPs/bytes per unit of engine work.
+
+    Derived once from the model config at engine construction; every
+    accessor is a handful of integer multiplies, cheap enough to run on
+    the engine thread per dispatch.
+
+    Conventions (all counts are per chip, before any TP division —
+    utilization against the single-chip peak is what the bench reports
+    and what A/B legs compare):
+
+    - matmul FLOPs: ``2 * params`` per token (multiply+add), the
+      standard serving approximation (embedding lookups excluded).
+    - attention FLOPs: QK^T + AV are each ``2 * ctx * num_heads *
+      head_dim`` per token per layer → ``4 * ctx * heads * head_dim *
+      layers`` total. GQA shrinks the KV *bytes* (kv_heads), not the
+      query-side FLOPs.
+    - decode-step HBM bytes: the full weight working set streams once
+      per step (batched slots share it — that is the whole point of
+      batching) plus each active slot's KV history read + 1 row written.
+    - paged layout: KV reads round each slot's context up to the block
+      size (a gather touches whole blocks).
+    - weight-only int8 halves weight bytes (per-channel scales are
+      <1% and excluded); int8 KV stores int8 values + one f32 scale per
+      (layer, position, kv_head) for each of k and v.
+    """
+
+    params: int
+    num_layers: int
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    weight_bytes: int
+    kv_row_bytes: int      # bytes per token of KV history, all layers
+    kv_block_size: int = 1  # paged read granularity (1 = dense)
+
+    @classmethod
+    def from_model_config(
+        cls,
+        config: Any,
+        *,
+        weight_quant: Optional[str] = None,
+        kv_quant: bool = False,
+        kv_block_size: int = 1,
+    ) -> "CostModel":
+        params = config.num_params()
+        head_dim = config.dims_per_head
+        if kv_quant:
+            # int8 values + one f32 scale per (layer, pos, kv_head) for
+            # each of k and v
+            kv_row_bytes = 2 * config.num_layers * config.num_kv_heads * (
+                head_dim + 4
+            )
+        else:
+            kv_row_bytes = (
+                2 * config.num_layers * config.num_kv_heads * head_dim * 2
+            )  # k+v, bf16
+        return cls(
+            params=params,
+            num_layers=config.num_layers,
+            num_heads=config.num_heads,
+            num_kv_heads=config.num_kv_heads,
+            head_dim=head_dim,
+            weight_bytes=params * (1 if weight_quant == "int8" else 2),
+            kv_row_bytes=kv_row_bytes,
+            kv_block_size=max(1, int(kv_block_size)),
+        )
+
+    # ------------------------------------------------------------------ #
+    # decode
+    # ------------------------------------------------------------------ #
+    def kv_read_tokens(self, ctx: int) -> int:
+        """KV history rows a decode step actually reads for one slot at
+        context ``ctx`` (paged gathers touch whole blocks)."""
+        block = self.kv_block_size
+        return -(-ctx // block) * block if block > 1 else ctx
+
+    def decode_chunk_flops(
+        self, steps: int, active: int, kv_tokens: int
+    ) -> float:
+        """FLOPs for one K-step decode chunk. ``kv_tokens`` is the sum of
+        active slots' context lengths at dispatch (attention cost is
+        linear in the summed context, so only the sum is needed)."""
+        per_step = (
+            2.0 * self.params * active
+            + 4.0 * kv_tokens * self.num_heads * self.head_dim
+            * self.num_layers
+        )
+        return per_step * steps
+
+    def decode_chunk_bytes(
+        self, steps: int, active: int, kv_tokens: int
+    ) -> float:
+        """HBM bytes for one K-step decode chunk: weights once per step
+        + each active slot's KV read + 1 row written per slot per step.
+        ``kv_tokens`` should already be block-padded for the paged
+        layout (:meth:`kv_read_tokens` per slot, summed)."""
+        per_step = (
+            float(self.weight_bytes)
+            + float(self.kv_row_bytes) * (kv_tokens + active)
+        )
+        return per_step * steps
+
+    # ------------------------------------------------------------------ #
+    # prefill
+    # ------------------------------------------------------------------ #
+    def prefill_flops(self, new_tokens: int, offset: int = 0) -> float:
+        """FLOPs to prefill ``new_tokens`` starting at cache position
+        ``offset`` (warm continuation / chunked window): matmul
+        ``2·P`` per token plus causal attention over each token's own
+        prefix (position p costs ``4·p·heads·head_dim`` per layer)."""
+        positions_sum = (
+            new_tokens * offset + new_tokens * (new_tokens - 1) // 2
+        )
+        return (
+            2.0 * self.params * new_tokens
+            + 4.0 * positions_sum * self.num_heads * self.head_dim
+            * self.num_layers
+        )
+
+    def prefill_bytes(self, new_tokens: int, offset: int = 0) -> float:
+        """HBM bytes for a prefill dispatch: weights once + KV prefix
+        read + the new rows written. Prefill is FLOPs-bound at any real
+        length; this exists so prefill MBU is also reportable."""
+        return (
+            float(self.weight_bytes)
+            + float(self.kv_row_bytes)
+            * (self.kv_read_tokens(offset) + new_tokens)
+        )
+
+    # ------------------------------------------------------------------ #
+    # utilization
+    # ------------------------------------------------------------------ #
+    @staticmethod
+    def mfu(flops: float, wall_s: float, peaks: PeakSpecs) -> float:
+        return flops / wall_s / peaks.flops if wall_s > 0 else 0.0
+
+    @staticmethod
+    def mbu(hbm_bytes: float, wall_s: float, peaks: PeakSpecs) -> float:
+        return (
+            hbm_bytes / wall_s / peaks.hbm_bytes_per_s if wall_s > 0 else 0.0
+        )
+
+
+# ---------------------------------------------------------------------- #
+# SLO burn rates from histogram snapshots
+# ---------------------------------------------------------------------- #
+def count_le(snapshot: Mapping[str, float], target: float) -> float:
+    """Observations ≤ ``target`` in a cumulative ``le``-keyed histogram
+    snapshot (:meth:`api.metrics.Histogram.snapshot` shape), linearly
+    interpolated inside the bucket containing ``target``. Observations
+    in the +Inf bucket never count as ≤ any finite target."""
+    entries = sorted(
+        (float("inf") if le == "+Inf" else float(le), value)
+        for le, value in snapshot.items()
+        if le not in ("sum", "count")
+    )
+    prev_upper, prev_cum = 0.0, 0.0
+    for upper, cumulative in entries:
+        if target <= upper:
+            if upper == float("inf"):
+                # target beyond the last finite bound: everything in the
+                # +Inf bucket is (conservatively) a violation
+                return prev_cum
+            if upper == prev_upper:
+                return cumulative
+            fraction = (target - prev_upper) / (upper - prev_upper)
+            return prev_cum + (cumulative - prev_cum) * max(
+                0.0, min(1.0, fraction)
+            )
+        prev_upper, prev_cum = upper, cumulative
+    return prev_cum
+
+
+def violation_fraction(
+    now: Mapping[str, float],
+    then: Optional[Mapping[str, float]],
+    target: float,
+) -> Optional[float]:
+    """Fraction of observations ABOVE ``target`` between two snapshots
+    of the same histogram (``then`` = None means since the beginning).
+    Returns None when no observations landed in the interval."""
+    total = now.get("count", 0) - (then.get("count", 0) if then else 0)
+    if total <= 0:
+        return None
+    ok = count_le(now, target) - (count_le(then, target) if then else 0.0)
+    return max(0.0, min(1.0, (total - ok) / total))
+
+
+class SLOTracker:
+    """Multi-window SLO burn rates for TTFT/TPOT targets.
+
+    Burn rate = (violation fraction in the window) / (error budget),
+    the standard SRE multi-window shape: burn 1.0 means the service is
+    consuming its budget exactly as fast as the SLO allows; >1 predicts
+    a breach. Computed from timestamped snapshots of the engine's
+    latency histograms, so the numbers agree with what a Prometheus
+    scrape of the same buckets would show.
+
+    Targets are p95 objectives (``objective=0.95`` → 5% budget):
+    ``{"ttft_ms_p95": 200, "tpot_ms_p95": 30}`` — either key optional.
+    """
+
+    WINDOWS: Tuple[Tuple[str, float], ...] = (("5m", 300.0), ("1h", 3600.0))
+
+    def __init__(
+        self,
+        targets: Mapping[str, Any],
+        histograms: Mapping[str, Any],  # {"ttft": Histogram, "tpot": ...}
+        *,
+        objective: float = 0.95,
+        snapshot_interval: float = 15.0,
+    ) -> None:
+        self.objective = float(objective)
+        self.snapshot_interval = float(snapshot_interval)
+        self.histograms = dict(histograms)
+        self.targets_s: Dict[str, float] = {}
+        for key in ("ttft", "tpot"):
+            raw = targets.get(f"{key}_ms_p95")
+            if raw and key in self.histograms:
+                self.targets_s[key] = float(raw) / 1e3
+        self._ring: Deque[Tuple[float, Dict[str, Dict[str, float]]]] = (
+            deque()
+        )
+        self._lock = threading.Lock()
+
+    def tick(self, now: Optional[float] = None) -> None:
+        """Record a timestamped snapshot (at most one per
+        ``snapshot_interval``); called per finished request and from
+        :meth:`gauges`, so scraping alone keeps the windows honest."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            if self._ring and now - self._ring[-1][0] < self.snapshot_interval:
+                return
+            self._ring.append((
+                now,
+                {
+                    key: self.histograms[key].snapshot()
+                    for key in self.targets_s
+                },
+            ))
+            horizon = now - self.WINDOWS[-1][1] - self.snapshot_interval
+            while len(self._ring) > 1 and self._ring[1][0] <= horizon:
+                self._ring.popleft()
+
+    def _snapshot_before(
+        self, key: str, cutoff: float
+    ) -> Optional[Dict[str, float]]:
+        """Newest ring snapshot taken at or before ``cutoff`` (None =
+        tracker younger than the window → burn over the whole history)."""
+        best = None
+        for ts, snaps in self._ring:
+            if ts <= cutoff:
+                best = snaps.get(key)
+            else:
+                break
+        return best
+
+    def gauges(self, now: Optional[float] = None) -> Dict[str, float]:
+        now = time.monotonic() if now is None else now
+        self.tick(now)
+        out: Dict[str, float] = {}
+        budget = max(1e-9, 1.0 - self.objective)
+        with self._lock:
+            for key, target_s in self.targets_s.items():
+                out[f"jax_engine_slo_{key}_p95_target_ms"] = round(
+                    target_s * 1e3, 3
+                )
+                snap_now = self.histograms[key].snapshot()
+                for label, window in self.WINDOWS:
+                    then = self._snapshot_before(key, now - window)
+                    fraction = violation_fraction(snap_now, then, target_s)
+                    if fraction is not None:
+                        out[f"jax_engine_slo_{key}_burn_rate_{label}"] = (
+                            round(fraction / budget, 4)
+                        )
+        return out

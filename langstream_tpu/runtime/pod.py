@@ -149,6 +149,7 @@ class AgentHttpServer:
         app.router.add_get("/metrics", self._handle_metrics)
         app.router.add_get("/ready", self._handle_ready)
         app.router.add_get("/ok", self._handle_ready)
+        app.router.add_get("/debug/profile", self._handle_profile)
         runner = web.AppRunner(app, access_log=None)
         await runner.setup()
         site = web.TCPSite(runner, self.host, self.port)
@@ -191,6 +192,31 @@ class AgentHttpServer:
 
         return web.Response(text="OK" if self.ready else "STARTING",
                             status=200 if self.ready else 503)
+
+    async def _handle_profile(self, request):
+        """On-demand profiler capture (``?seconds=N``) on runner pods —
+        same contract as the OpenAI server's ``/debug/profile``: one
+        capture at a time, 409 on a concurrent request."""
+        import asyncio as _asyncio
+
+        from aiohttp import web
+
+        from langstream_tpu.runtime import profiling
+
+        try:
+            seconds = float(request.query.get("seconds", 3))
+        except (TypeError, ValueError):
+            return web.json_response(
+                {"error": "seconds must be a number"}, status=400
+            )
+        try:
+            # capture() validates the range itself (one source of truth)
+            path = await _asyncio.to_thread(profiling.capture, seconds)
+        except ValueError as error:
+            return web.json_response({"error": str(error)}, status=400)
+        except profiling.ProfileBusyError as error:
+            return web.json_response({"error": str(error)}, status=409)
+        return web.json_response({"path": path, "seconds": seconds})
 
 
 # ---------------------------------------------------------------------- #

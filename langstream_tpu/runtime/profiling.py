@@ -1,0 +1,124 @@
+"""On-demand device profiler capture (one at a time per process).
+
+Backs the ``/debug/profile?seconds=N`` endpoint on runner pods and the
+OpenAI server, the ``langstream-tpu profile`` CLI verb, and the
+watchdog's automatic evidence capture. Each capture runs
+``jax.profiler.trace`` for N seconds (everything the devices execute in
+the window lands in the xplane trace — MXU utilization, HBM stalls,
+fusion names) plus a per-device memory snapshot, into
+``bench_artifacts/profiles/<utc>_<pid>/``.
+
+A single in-flight capture is enforced process-wide: the profiler is a
+global singleton in JAX, and overlapping traces corrupt each other. A
+second concurrent request raises :class:`ProfileBusyError` (HTTP 409 on
+the serving surfaces).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+ENV_DIR = "LANGSTREAM_PROFILE_DIR"
+MAX_SECONDS = 60.0
+
+
+class ProfileBusyError(RuntimeError):
+    """A profiler capture is already in progress in this process."""
+
+
+_ACTIVE = threading.Lock()
+
+
+def busy() -> bool:
+    return _ACTIVE.locked()
+
+
+def default_dir() -> str:
+    """``$LANGSTREAM_PROFILE_DIR``, else ``bench_artifacts/profiles``
+    next to the repo's other artifacts when running from a checkout
+    (where ``tools/ab_analyze.py`` and the flight recorder live),
+    CWD-relative otherwise."""
+    env = os.environ.get(ENV_DIR)
+    if env:
+        return env
+    import langstream_tpu
+
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.abspath(langstream_tpu.__file__))
+    )
+    base = (
+        os.path.join(repo_root, "bench_artifacts")
+        if os.path.isdir(os.path.join(repo_root, "bench_artifacts"))
+        else "bench_artifacts"
+    )
+    return os.path.join(base, "profiles")
+
+
+def device_memory_snapshot() -> List[Dict[str, Any]]:
+    """Per-device memory stats (bytes in use / peak / limit where the
+    backend reports them). Tolerates backends without ``memory_stats``
+    (CPU) — the snapshot still records the device inventory."""
+    import jax
+
+    out: List[Dict[str, Any]] = []
+    for device in jax.devices():
+        stats: Dict[str, Any] = {}
+        try:
+            stats = dict(device.memory_stats() or {})
+        except Exception:  # noqa: BLE001 — not all backends implement it
+            pass
+        out.append({
+            "id": device.id,
+            "platform": device.platform,
+            "kind": getattr(device, "device_kind", ""),
+            "memory_stats": stats,
+        })
+    return out
+
+
+def capture(seconds: float, base_dir: Optional[str] = None) -> str:
+    """Run one profiler capture; returns the artifact directory.
+
+    Raises :class:`ProfileBusyError` when a capture is already running,
+    ``ValueError`` on an out-of-range duration. The caller's device work
+    continues normally during the window — the trace records it."""
+    seconds = float(seconds)
+    if not 0 < seconds <= MAX_SECONDS:
+        raise ValueError(
+            f"seconds must be in (0, {MAX_SECONDS:.0f}], got {seconds}"
+        )
+    if not _ACTIVE.acquire(blocking=False):
+        raise ProfileBusyError(
+            "a profiler capture is already in progress (one at a time)"
+        )
+    try:
+        stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime())
+        directory = os.path.join(
+            base_dir or default_dir(), f"{stamp}_{os.getpid()}"
+        )
+        os.makedirs(directory, exist_ok=True)
+        import jax
+
+        started = time.perf_counter()
+        with jax.profiler.trace(directory):
+            time.sleep(seconds)
+        snapshot = {
+            "captured_s": round(time.perf_counter() - started, 3),
+            "devices": device_memory_snapshot(),
+        }
+        with open(
+            os.path.join(directory, "device_memory.json"), "w",
+            encoding="utf-8",
+        ) as handle:
+            json.dump(snapshot, handle, indent=2)
+        logger.info("profiler capture (%.1fs) -> %s", seconds, directory)
+        return directory
+    finally:
+        _ACTIVE.release()

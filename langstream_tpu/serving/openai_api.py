@@ -144,6 +144,7 @@ class OpenAIApiServer:
         app.router.add_get("/v1/models", self._models)
         app.router.add_get("/healthz", self._healthz)
         app.router.add_get("/metrics", self._metrics)
+        app.router.add_get("/debug/profile", self._profile)
         return app
 
     async def start(self) -> None:
@@ -177,6 +178,29 @@ class OpenAIApiServer:
             ),
             content_type="text/plain",
         )
+
+    async def _profile(self, request) -> web.Response:
+        """On-demand profiler capture (``?seconds=N``): runs
+        ``jax.profiler.trace`` + a device-memory snapshot into
+        ``bench_artifacts/profiles/<ts>/`` while serving continues.
+        One capture at a time — a concurrent request gets 409."""
+        from langstream_tpu.runtime import profiling
+
+        try:
+            seconds = float(request.query.get("seconds", 3))
+        except (TypeError, ValueError):
+            return _error(400, "seconds must be a number")
+        try:
+            # capture() validates the range itself (one source of truth)
+            path = await asyncio.to_thread(profiling.capture, seconds)
+        except ValueError as error:
+            return _error(400, str(error))
+        except profiling.ProfileBusyError as error:
+            return web.json_response(
+                {"error": {"message": str(error), "type": "conflict"}},
+                status=409,
+            )
+        return web.json_response({"path": path, "seconds": seconds})
 
     async def _models(self, request) -> web.Response:
         return web.json_response({

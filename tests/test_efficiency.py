@@ -1,0 +1,526 @@
+"""Efficiency accounting (ISSUE 4): roofline cost model (MFU/MBU),
+goodput ledger, SLO burn-rate math, the decode-stall watchdog, and the
+guarded on-demand profiler capture."""
+
+import asyncio
+import os
+import queue
+import types
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------- #
+# roofline cost model: hand-computed goldens
+# ---------------------------------------------------------------------- #
+def _tiny_config():
+    from langstream_tpu.providers.jax_local.model import LlamaConfig
+
+    # vocab 256, hidden 64, intermediate 128, layers 2, heads 4,
+    # kv_heads 2 (GQA 2:1), head_dim 64/4 = 16, untied embeddings
+    return LlamaConfig.tiny(max_seq_len=64)
+
+
+# hand-computed parameter count for the tiny shape:
+#   attn/layer  = 64*16*(2*4 + 2*2)          = 12288
+#   mlp/layer   = 3*64*128                   = 24576
+#   norms/layer = 2*64                       = 128
+#   layers      = 2*(12288+24576+128)        = 73984
+#   embeddings  = 256*64*2 (untied)          = 32768
+#   final norm  = 64
+TINY_PARAMS = 73984 + 32768 + 64  # = 106816
+
+
+def test_cost_model_flops_and_bytes_golden():
+    from langstream_tpu.runtime.accounting import CostModel
+
+    model = CostModel.from_model_config(_tiny_config())
+    assert model.params == TINY_PARAMS
+    # bf16 weights: 2 bytes/param
+    assert model.weight_bytes == 2 * TINY_PARAMS  # = 213632
+    # KV row (all layers): k+v * layers * kv_heads * head_dim * 2 bytes
+    # = 2*2*2*16*2 — GQA (kv_heads=2 < heads=4) halves this vs MHA
+    assert model.kv_row_bytes == 256
+
+    # decode chunk: steps=4, active=3 slots, summed context 300 tokens.
+    #   per-step FLOPs = 2*P*active + 4*kv_tokens*heads*head_dim*layers
+    #                  = 2*106816*3 + 4*300*4*16*2
+    #                  = 640896 + 153600 = 794496
+    assert model.decode_chunk_flops(4, 3, 300) == 4 * 794496
+    #   per-step bytes = weights + kv_row*(read ctx + 1 write per slot)
+    #                  = 213632 + 256*(300+3) = 291200
+    assert model.decode_chunk_bytes(4, 3, 300) == 4 * 291200
+
+    # prefill: 10 new tokens at offset 5 — causal attention sums each
+    # token's own position: 10*5 + (0+1+...+9) = 95
+    #   = 2*106816*10 + 4*95*4*16*2 = 2136320 + 48640
+    assert model.prefill_flops(10, offset=5) == 2184960
+    assert model.prefill_flops(0) == 0
+
+
+def test_cost_model_int8_variants_golden():
+    from langstream_tpu.runtime.accounting import CostModel
+
+    config = _tiny_config()
+    # weight-only int8: 1 byte/param; FLOPs unchanged (matmuls stay bf16)
+    w8 = CostModel.from_model_config(config, weight_quant="int8")
+    assert w8.weight_bytes == TINY_PARAMS
+    assert w8.decode_chunk_flops(1, 1, 100) == CostModel.from_model_config(
+        config
+    ).decode_chunk_flops(1, 1, 100)
+    # int8 KV: int8 values + one f32 scale per (layer, pos, kv_head) for
+    # each of k and v = 2*2*2*(16+4)
+    kv8 = CostModel.from_model_config(config, kv_quant=True)
+    assert kv8.kv_row_bytes == 160
+
+
+def test_cost_model_paged_block_rounding():
+    from langstream_tpu.runtime.accounting import CostModel
+
+    paged = CostModel.from_model_config(_tiny_config(), kv_block_size=16)
+    dense = CostModel.from_model_config(_tiny_config())
+    # a paged gather touches whole blocks: ctx 17 reads 32 rows
+    assert paged.kv_read_tokens(17) == 32
+    assert paged.kv_read_tokens(16) == 16
+    assert dense.kv_read_tokens(17) == 17
+
+
+def test_peak_specs_env_override(monkeypatch):
+    from langstream_tpu.runtime import accounting
+
+    assert accounting.PeakSpecs.from_env().flops == pytest.approx(197e12)
+    monkeypatch.setenv("LANGSTREAM_PEAK_TFLOPS", "919")
+    monkeypatch.setenv("LANGSTREAM_PEAK_HBM_GBS", "2765")
+    peaks = accounting.PeakSpecs.from_env()
+    assert peaks.flops == pytest.approx(919e12)
+    assert peaks.hbm_bytes_per_s == pytest.approx(2765e9)
+    # MFU/MBU divide by these
+    assert accounting.CostModel.mfu(919e12, 1.0, peaks) == pytest.approx(1.0)
+    assert accounting.CostModel.mbu(2765e9 / 2, 1.0, peaks) == pytest.approx(
+        0.5
+    )
+
+
+# ---------------------------------------------------------------------- #
+# SLO burn-rate math from synthetic histogram buckets
+# ---------------------------------------------------------------------- #
+def test_violation_fraction_from_histogram_snapshots():
+    from langstream_tpu.api.metrics import Histogram
+    from langstream_tpu.runtime.accounting import (
+        count_le,
+        violation_fraction,
+    )
+
+    histogram = Histogram("ttft", buckets=(0.1, 0.2, 0.4))
+    for _ in range(10):
+        histogram.observe(0.05)
+    then = histogram.snapshot()
+    for _ in range(10):
+        histogram.observe(0.3)
+    now = histogram.snapshot()
+    # count_le interpolates inside the (0.2, 0.4] bucket: 0.3 is halfway
+    assert count_le(now, 0.3) == pytest.approx(15.0)
+    # everything in the +Inf bucket violates any finite target
+    assert count_le(now, 10.0) == pytest.approx(20.0)
+    # all 10 new observations are above the 0.2s target
+    assert violation_fraction(now, then, 0.2) == pytest.approx(1.0)
+    # since the beginning: half
+    assert violation_fraction(now, None, 0.2) == pytest.approx(0.5)
+    # no observations in the interval
+    assert violation_fraction(then, then, 0.2) is None
+
+
+def test_slo_tracker_multi_window_burn_rates():
+    from langstream_tpu.api.metrics import Histogram
+    from langstream_tpu.runtime.accounting import SLOTracker
+
+    histogram = Histogram("ttft", buckets=(0.1, 0.2, 0.4))
+    tracker = SLOTracker(
+        {"ttft_ms_p95": 200.0},
+        {"ttft": histogram},
+        snapshot_interval=1.0,
+    )
+    tracker.tick(now=0.0)
+    # 20 requests, 1 above the 200ms target: exactly the 5% budget
+    for _ in range(19):
+        histogram.observe(0.05)
+    histogram.observe(0.5)
+    gauges = tracker.gauges(now=400.0)
+    assert gauges["jax_engine_slo_ttft_p95_target_ms"] == 200.0
+    assert gauges["jax_engine_slo_ttft_burn_rate_5m"] == pytest.approx(1.0)
+    # 10 more, all violating: the 5m window sees only those (burn 20x =
+    # 100% violations / 5% budget); 1h still spans the whole history
+    for _ in range(10):
+        histogram.observe(0.5)
+    gauges = tracker.gauges(now=800.0)
+    assert gauges["jax_engine_slo_ttft_burn_rate_5m"] == pytest.approx(20.0)
+    assert gauges["jax_engine_slo_ttft_burn_rate_1h"] == pytest.approx(
+        (11 / 30) / 0.05, rel=1e-3
+    )
+
+
+# ---------------------------------------------------------------------- #
+# watchdog
+# ---------------------------------------------------------------------- #
+@pytest.fixture
+def flight_recorder(tmp_path):
+    """A freshly-targeted global recorder, restored after the test (same
+    shape as tests/test_observability.py)."""
+    from langstream_tpu.runtime import flight
+
+    saved = flight.RECORDER.path
+    flight.RECORDER.path = None
+    flight.RECORDER._pending.clear()
+    path = flight.configure(str(tmp_path / "flight"))
+    yield flight, path
+    flight.RECORDER.flush()
+    flight.RECORDER.path = saved
+
+
+def _fake_engine(**overrides):
+    """Duck-typed engine for clock-driven watchdog tests."""
+    engine = types.SimpleNamespace(
+        stats={
+            "decode_chunks": 0, "decode_steps": 0, "decode_time": 0.0,
+            "prefill_calls": 0, "warm_prefill_calls": 0,
+        },
+        _pending=[],
+        _queue=queue.Queue(),
+        slots=[],
+        kv_manager=None,
+        num_blocks=0,
+        _crashed=None,
+    )
+    for key, value in overrides.items():
+        setattr(engine, key, value)
+    return engine
+
+
+def test_watchdog_decode_degradation_vs_ewma_baseline():
+    from langstream_tpu.runtime.watchdog import EngineWatchdog
+
+    engine = _fake_engine()
+    watchdog = EngineWatchdog(
+        engine, min_baseline_chunks=4, degrade_factor=3.0,
+        capture_profile=False,
+    )
+    # healthy polls: 8 steps per chunk at 10 ms/step
+    now = 0.0
+    for _ in range(6):
+        engine.stats["decode_chunks"] += 1
+        engine.stats["decode_steps"] += 8
+        engine.stats["decode_time"] += 8 * 0.010
+        now += 5.0
+        assert watchdog.check(now=now) is None
+    assert watchdog.baseline_step_s == pytest.approx(0.010)
+    # a 5x regression (e.g. thermal throttle) trips; the baseline must
+    # NOT absorb the degraded sample
+    engine.stats["decode_chunks"] += 1
+    engine.stats["decode_steps"] += 8
+    engine.stats["decode_time"] += 8 * 0.050
+    assert watchdog.check(now=now + 5.0) == "decode_degraded"
+    assert watchdog.baseline_step_s == pytest.approx(0.010)
+
+
+def test_watchdog_kv_pool_livelock():
+    """Decode still progresses, but admissions starve on an exhausted
+    pool — the failure mode the no-progress detector cannot see."""
+    from langstream_tpu.runtime.watchdog import EngineWatchdog
+
+    engine = _fake_engine(
+        kv_manager=types.SimpleNamespace(blocks_in_use=64),
+        num_blocks=64,
+        _pending=[object()],
+    )
+    watchdog = EngineWatchdog(
+        engine, livelock_s=10.0, capture_profile=False
+    )
+
+    def advance_decode():
+        engine.stats["decode_chunks"] += 1
+        engine.stats["decode_steps"] += 8
+        engine.stats["decode_time"] += 0.1
+
+    assert watchdog.check(now=0.0) is None   # livelock anchor set
+    advance_decode()
+    assert watchdog.check(now=5.0) is None   # under threshold
+    advance_decode()
+    assert watchdog.check(now=12.0) == "kv_pool_livelock"
+    # an admission landing resets the detector
+    engine.stats["prefill_calls"] += 1
+    advance_decode()
+    assert watchdog.check(now=18.0) is None
+
+
+# ---------------------------------------------------------------------- #
+# goodput ledger
+# ---------------------------------------------------------------------- #
+def test_watchdog_trip_goodput_ledger_and_flight_fields(flight_recorder):
+    """One tiny engine, the ISSUE 4 acceptance claims end to end:
+
+    1. a watchdog no-progress trip proves flight flush +
+       watchdog_trips_total increment WITHOUT killing the data plane
+       (the same engine serves all the traffic below afterwards);
+    2. goodput ledger: delivered tokens are useful, a cancelled
+       request's tokens are wasted{cancelled}, an evicted session's
+       follow-up re-prefill is wasted{evicted_recompute};
+    3. flight decode_chunk records carry per-chunk MFU/MBU + goodput.
+    """
+    from langstream_tpu.providers.jax_local.engine import (
+        DecodeEngine,
+        GenerationRequest,
+        SamplingParams,
+        engines_snapshot,
+    )
+    from langstream_tpu.providers.jax_local.model import (
+        LlamaConfig,
+        init_params,
+    )
+    from langstream_tpu.runtime.watchdog import TRIPS, EngineWatchdog
+
+    flight, path = flight_recorder
+    config = LlamaConfig.tiny(max_seq_len=64)
+    engine = DecodeEngine(
+        config, init_params(config), max_slots=1, max_seq_len=64,
+        prefill_buckets=[16], prefix_cache=False,
+    )
+    # --- watchdog: queued work, engine thread intentionally not
+    # running = a wedged engine from the watchdog's point of view
+    engine._pending.append(
+        GenerationRequest(prompt_tokens=[1], sampling=SamplingParams())
+    )
+    watchdog = EngineWatchdog(
+        engine, no_progress_s=5.0, capture_profile=False
+    )
+    before = TRIPS.value()
+    assert watchdog.check(now=0.0) is None          # anchor set
+    assert watchdog.check(now=2.0) is None          # under threshold
+    assert watchdog.check(now=10.0) == "no_progress"
+    assert TRIPS.value() == before + 1
+    assert engines_snapshot()["watchdog_trips_total"] >= 1.0
+    # the trip flushed the ring: the structured event is ON DISK now
+    trip = next(
+        e for e in flight.read_artifact(path)
+        if e["kind"] == "watchdog_trip"
+    )
+    assert trip["reason"] == "no_progress"
+    assert trip["queue_depth"] == 1
+    # a repeat within the cooldown must not double-count
+    assert watchdog.check(now=12.0) == "no_progress"
+    assert TRIPS.value() == before + 1
+    engine._pending.clear()
+
+    # --- data plane untouched by the trip: the SAME engine now serves
+    # the goodput-ledger traffic
+    async def main():
+        sampling = SamplingParams(max_new_tokens=4)
+        # useful: a normal request
+        result = await engine.generate([1, 2, 3], sampling)
+        assert len(result.tokens) == 4
+        # wasted{cancelled}: the caller walks away after the 1st token
+        handle = []
+        cancelled = await engine.generate(
+            [4, 5, 6], SamplingParams(max_new_tokens=40),
+            on_token=lambda token, last: handle[0].cancel(),
+            handle=handle,
+        )
+        assert cancelled.finish_reason == "cancelled"
+        # wasted{evicted_recompute}: session-b evicts session-a's
+        # pinned slot (max_slots=1); a's follow-up must re-prefill
+        await engine.generate(
+            [1, 2, 3, 4], sampling, session_id="session-a"
+        )
+        await engine.generate(
+            [50, 51, 52], sampling, session_id="session-b"
+        )
+        await engine.generate(
+            [1, 2, 3, 4, 5, 6], sampling, session_id="session-a"
+        )
+
+    asyncio.run(main())
+    engine.stop()
+    assert engine.stats["tokens_useful"] >= 4
+    assert engine.stats["tokens_wasted"].get("cancelled", 0) >= 1
+    assert engine.stats["tokens_wasted"].get("evicted_recompute", 0) > 0
+    # roofline accumulators moved with the decode AND prefill work
+    assert engine.stats["decode_flops"] > 0
+    assert engine.stats["decode_bytes"] > 0
+    assert engine.stats["prefill_flops"] > 0
+    # gauges are rounded to 6 places — a 100k-param model on CPU reads
+    # ~1e-9, so assert presence, not magnitude
+    snapshot = engines_snapshot()
+    assert snapshot["jax_engine_mfu"] >= 0
+    assert snapshot["jax_engine_prefill_mfu"] >= 0
+    prefills = [
+        e for e in flight.read_artifact(path) if e["kind"] == "prefill"
+    ]
+    assert prefills and all(p["flops"] > 0 for p in prefills)
+    chunks = [
+        e for e in flight.read_artifact(path)
+        if e["kind"] == "decode_chunk"
+    ]
+    assert chunks
+    assert all(
+        {"mfu", "mbu", "tokens_useful", "tokens_wasted"} <= set(c)
+        for c in chunks
+    )
+    assert all(c["mfu"] >= 0 and c["mbu"] >= 0 for c in chunks)
+
+
+# ---------------------------------------------------------------------- #
+# `langstream-tpu top` SLO panel
+# ---------------------------------------------------------------------- #
+def test_top_renders_slo_panel(capsys):
+    import argparse
+
+    from aiohttp import web
+
+    from langstream_tpu.api.metrics import Histogram, prometheus_text
+    from langstream_tpu.cli.main import _top_cmd
+
+    ttft = Histogram("jax_engine_ttft_seconds", buckets=(0.1, 0.2, 0.4))
+    for _ in range(19):
+        ttft.observe(0.05)
+    ttft.observe(0.3)
+
+    async def main():
+        async def metrics(request):
+            return web.Response(text=prometheus_text({}, {
+                "jax_engine_tokens_generated": 50.0,
+                "jax_engine_decode_steps": 10.0,
+                "jax_engine_mfu": 0.31,
+                "jax_engine_mbu": 0.72,
+                "jax_engine_goodput_ratio": 0.97,
+                "jax_engine_slo_ttft_p95_target_ms": 200.0,
+                "jax_engine_slo_ttft_burn_rate_5m": 0.8,
+                "jax_engine_slo_ttft_burn_rate_1h": 0.4,
+            }, {ttft.name: ttft.snapshot()}), content_type="text/plain")
+
+        app = web.Application()
+        app.router.add_get("/metrics", metrics)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]  # noqa: SLF001
+        try:
+            await _top_cmd(argparse.Namespace(
+                url=f"http://127.0.0.1:{port}/metrics",
+                interval=0.01, count=1,
+            ))
+        finally:
+            await runner.cleanup()
+
+    asyncio.run(main())
+    out = capsys.readouterr().out
+    assert "MFU / MBU" in out and "31.0%" in out
+    assert "goodput" in out
+    assert "-- SLO --" in out
+    assert "TTFT p95" in out and "target   200.0 ms" in out
+    assert "burn 5m  0.80x / 1h  0.40x" in out
+    # p95 of 20 obs with 19 at 0.05: rank 19 → 0.1 interpolated bound,
+    # under the 200ms target
+    assert "[ok]" in out
+
+
+# ---------------------------------------------------------------------- #
+# on-demand profiler capture
+# ---------------------------------------------------------------------- #
+def test_profile_endpoint_second_concurrent_capture_409(monkeypatch):
+    """The guard contract on both serving surfaces: one capture at a
+    time, a concurrent request → 409 (no real profiler run needed)."""
+    import aiohttp
+    from aiohttp import web
+
+    from langstream_tpu.runtime import profiling
+    from langstream_tpu.runtime.pod import AgentHttpServer
+    from langstream_tpu.serving.openai_api import OpenAIApiServer
+
+    openai_app = OpenAIApiServer(completions=None).build_app()
+    pod_server = AgentHttpServer(info=lambda: {}, port=0, host="127.0.0.1")
+
+    async def main():
+        runner = web.AppRunner(openai_app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        openai_port = site._server.sockets[0].getsockname()[1]  # noqa: SLF001
+        await pod_server.start()
+        urls = [
+            f"http://127.0.0.1:{openai_port}/debug/profile",
+            f"http://127.0.0.1:{pod_server.port}/debug/profile",
+        ]
+        try:
+            async with aiohttp.ClientSession() as session:
+                # a capture in flight: both surfaces refuse a second one
+                assert profiling._ACTIVE.acquire(blocking=False)
+                try:
+                    for url in urls:
+                        async with session.get(
+                            url, params={"seconds": 1}
+                        ) as response:
+                            assert response.status == 409, url
+                finally:
+                    profiling._ACTIVE.release()
+                # malformed duration → 400 before any capture starts
+                # (range validation lives in profiling.capture itself)
+                async with session.get(
+                    urls[0], params={"seconds": "forever"}
+                ) as response:
+                    assert response.status == 400
+                async with session.get(
+                    urls[0], params={"seconds": 9999}
+                ) as response:
+                    assert response.status == 400
+                # free again: the endpoint runs the (stubbed) capture
+                monkeypatch.setattr(
+                    profiling, "capture",
+                    lambda seconds, base_dir=None: "/tmp/fake-profile",
+                )
+                for url in urls:
+                    async with session.get(
+                        url, params={"seconds": 1}
+                    ) as response:
+                        assert response.status == 200, url
+                        body = await response.json()
+                        assert body["path"] == "/tmp/fake-profile"
+        finally:
+            await pod_server.stop()
+            await runner.cleanup()
+
+    asyncio.run(main())
+
+
+@pytest.mark.slow  # real jax.profiler capture — keep tier-1 CPU runs lean
+def test_profile_capture_writes_artifacts(tmp_path):
+    from langstream_tpu.runtime import profiling
+
+    path = profiling.capture(0.2, base_dir=str(tmp_path))
+    assert os.path.isdir(path)
+    assert os.path.isfile(os.path.join(path, "device_memory.json"))
+    assert not profiling.busy()
+    with pytest.raises(ValueError):
+        profiling.capture(0)
+
+
+def test_profile_capture_guard_direct():
+    """Direct-call contract: a second capture while one holds the guard
+    raises ProfileBusyError; duration validation runs BEFORE the guard
+    (a bad request never blocks a later good one)."""
+    from langstream_tpu.runtime import profiling
+
+    assert profiling._ACTIVE.acquire(blocking=False)
+    try:
+        assert profiling.busy()
+        with pytest.raises(profiling.ProfileBusyError):
+            profiling.capture(0.5)
+    finally:
+        profiling._ACTIVE.release()
+    assert not profiling.busy()
+    with pytest.raises(ValueError):
+        profiling.capture(0)
+    with pytest.raises(ValueError):
+        profiling.capture(profiling.MAX_SECONDS + 1)
+    assert not profiling.busy()

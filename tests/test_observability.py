@@ -37,6 +37,18 @@ def _sample_exposition() -> str:
         "kv_blocks_total": 64.0,
         "prefix_cache_hit_tokens_total": 1024.0,
         "prefix_cache_evictions_total": 3.0,
+        # efficiency accounting (ISSUE 4): roofline utilization, goodput
+        # ledger (labeled wasted-token reasons), SLO burn rates, watchdog
+        "jax_engine_mfu": 0.42,
+        "jax_engine_mbu": 0.63,
+        "jax_engine_goodput_ratio": 0.9375,
+        "jax_engine_tokens_useful_total": 960.0,
+        'jax_engine_tokens_wasted_total{reason="cancelled"}': 48.0,
+        'jax_engine_tokens_wasted_total{reason="evicted_recompute"}': 16.0,
+        "jax_engine_slo_ttft_p95_target_ms": 200.0,
+        "jax_engine_slo_ttft_burn_rate_5m": 0.8,
+        "jax_engine_slo_ttft_burn_rate_1h": 0.4,
+        "watchdog_trips_total": 1.0,
     }
     return prometheus_text(
         reporter.snapshot(), gauges, reporter.histogram_snapshots(),
@@ -49,6 +61,21 @@ def _sample_exposition() -> str:
                 "prompt tokens served from cached prefix blocks",
             "prefix_cache_evictions_total":
                 "prefix-cache blocks evicted under pool pressure",
+            "jax_engine_mfu":
+                "model FLOP utilization vs the per-chip peak (roofline)",
+            "jax_engine_mbu":
+                "HBM bandwidth utilization vs the per-chip peak",
+            "jax_engine_goodput_ratio":
+                "useful tokens / all generated tokens",
+            "jax_engine_tokens_wasted_total":
+                "tokens burned on cancelled requests or evicted-session"
+                " recompute, by reason",
+            "jax_engine_slo_ttft_burn_rate_5m":
+                "TTFT SLO burn rate over 5m (1.0 = consuming budget at"
+                " the allowed rate)",
+            "watchdog_trips_total":
+                "decode-stall watchdog trips (degraded / no-progress /"
+                " kv-pool livelock)",
         },
     )
 
@@ -70,6 +97,12 @@ def test_prometheus_exposition_parses_as_valid_format():
     parsed = parse_prometheus_text(text)  # raises on malformed lines
     assert parsed["agent_demo_records_in_total"] == [({}, 7.0)]
     assert parsed["jax_engine_slot_occupancy"] == [({}, 0.75)]
+    # labeled gauge samples (goodput ledger reasons) parse into one
+    # family with per-label samples, sharing a single HELP/TYPE header
+    wasted = parsed["jax_engine_tokens_wasted_total"]
+    assert ({"reason": "cancelled"}, 48.0) in wasted
+    assert ({"reason": "evicted_recompute"}, 16.0) in wasted
+    assert text.count("# TYPE jax_engine_tokens_wasted_total gauge") == 1
     buckets = parsed["agent_demo_latency_seconds_bucket"]
     assert ({"le": "+Inf"}, 5.0) in buckets
     # every family carries HELP + TYPE
@@ -87,7 +120,13 @@ def test_quantile_from_buckets():
     samples = [
         ({"le": "0.01"}, 1.0), ({"le": "0.1"}, 9.0), ({"le": "+Inf"}, 10.0),
     ]
-    assert quantile_from_buckets(samples, 0.5) == 0.1
+    # linear interpolation inside the winning bucket (no stairstep at
+    # bucket edges): rank 5 sits 50% into (0.01, 0.1] by count
+    assert quantile_from_buckets(samples, 0.5) == pytest.approx(0.055)
+    # the first bucket interpolates from 0
+    assert quantile_from_buckets(samples, 0.05) == pytest.approx(0.005)
+    # a rank exactly at a bucket's cumulative count lands on its bound
+    assert quantile_from_buckets(samples, 0.9) == pytest.approx(0.1)
     # rank in the +Inf bucket caps at the highest finite bound
     # (histogram_quantile semantics), never returns inf
     assert quantile_from_buckets(samples, 0.99) == 0.1

@@ -60,6 +60,12 @@ def describe(record: Dict[str, Any]) -> str:
         bits.append(f"raw {record['raw_engine_tok_s']:.0f}")
     if record.get("decode_ms_per_step"):
         bits.append(f"{record['decode_ms_per_step']:.1f} ms/step")
+    # per-leg roofline columns (bench stamps these from its own
+    # decode roofline; flight artifacts carry the per-chunk series)
+    if record.get("mfu") is not None:
+        bits.append(f"MFU {record['mfu'] * 100:.1f}%")
+    if record.get("hbm_bw_pct") is not None:
+        bits.append(f"MBU {record['hbm_bw_pct'] * 100:.1f}%")
     if record.get("p50_rtt_ms"):
         bits.append(f"p50 RTT {record['p50_rtt_ms']:.0f} ms")
     if record.get("p50_ttft_ms"):
@@ -143,6 +149,29 @@ def flight_summary(art_dir: str) -> Optional[str]:
                 f"  occupancy: mean {sum(occ) / len(occ):.1%} over "
                 f"{len(occ)} chunks"
             )
+        # roofline series: per-chunk MFU/MBU stamped by the engine's
+        # efficiency accounting (fractions of the per-chip peak)
+        mfus = [c["mfu"] for c in chunks if c.get("mfu") is not None]
+        mbus = [c["mbu"] for c in chunks if c.get("mbu") is not None]
+        if mfus:
+            lines.append(
+                f"  roofline: MFU p50 {_percentile(mfus, 0.5):.1%} / "
+                f"peak {max(mfus):.1%}; MBU p50 "
+                f"{_percentile(mbus, 0.5):.1%} / peak {max(mbus):.1%}"
+                if mbus else
+                f"  roofline: MFU p50 {_percentile(mfus, 0.5):.1%}"
+            )
+        # goodput ledger: cumulative useful/wasted counters ride each
+        # decode_chunk record — the last one is the run's total
+        tail = chunks[-1]
+        useful = tail.get("tokens_useful")
+        wasted = tail.get("tokens_wasted")
+        if useful is not None and (useful or wasted):
+            total = useful + (wasted or 0)
+            lines.append(
+                f"  goodput: {useful}/{total} tokens useful "
+                f"({useful / total:.1%}); wasted {wasted or 0}"
+            )
         # paged-KV series (kv_layout: paged): pool pressure + cumulative
         # prefix-cache hit tokens ride each decode_chunk record
         pool = [
@@ -171,6 +200,13 @@ def main() -> None:
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "bench_artifacts",
     )
+    if not os.path.isdir(art_dir):
+        # an empty comparison table would read as "every leg absent" —
+        # a wrong path must fail loudly instead
+        raise SystemExit(
+            f"ab_analyze: artifacts directory {art_dir!r} does not exist "
+            "(pass the bench_artifacts dir the legs wrote into)"
+        )
     records: Dict[str, Optional[Dict[str, Any]]] = {}
     print(f"# A/B artifacts in {art_dir}\n")
     for name, label in LEGS.items():
@@ -183,6 +219,19 @@ def main() -> None:
     if flight_digest:
         print(flight_digest)
         print()
+    else:
+        # distinguish "legs ran without evidence" from a clean run: the
+        # efficiency columns (MFU/MBU, goodput) come FROM the flight
+        # artifact, so its absence must be called out, not left as an
+        # empty section
+        print(
+            "# Flight recorder\n\n"
+            f"  MISSING: no flight artifacts under "
+            f"{os.path.join(art_dir, 'flight')} — per-chunk MFU/MBU and "
+            "goodput columns unavailable. Run the legs with "
+            "LANGSTREAM_FLIGHT_DIR set (bench.py and `serve` enable it "
+            "by default).\n"
+        )
 
     main_rec = records["bench_heal.json"]
     recommendations = []

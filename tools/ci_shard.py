@@ -26,6 +26,9 @@ SHARDS: Dict[str, List[str]] = {
     # shard
     "kernels-engine": [
         "test_engine",
+        # efficiency accounting (roofline/MFU/MBU, goodput, watchdog,
+        # SLO burn rates) constructs DecodeEngines — JAX-heavy shard
+        "test_efficiency",
         "test_attention_kernels",
         "test_decode_kernel",
         "test_kv_quant",
