@@ -106,6 +106,37 @@ KV_LAYOUT = (
 if KV_LAYOUT not in ("dense", "paged"):
     print(f"unknown --kv-layout {KV_LAYOUT!r} (dense|paged)", file=sys.stderr)
     sys.exit(2)
+# Paged attention kernel: fused ragged Pallas launch over the block
+# tables (default) vs the gather/scatter reference oracle. Only
+# meaningful with --kv-layout paged; the fused-vs-reference pair is the
+# ROADMAP-item-1 acceptance instrument (ab_analyze.py kernel legs).
+PAGED_KERNEL = (
+    _cli_flag("paged-kernel")
+    or os.environ.get("BENCH_PAGED_KERNEL", "")
+    or "fused"
+).lower()
+if PAGED_KERNEL not in ("fused", "reference"):
+    print(
+        f"unknown --paged-kernel {PAGED_KERNEL!r} (fused|reference)",
+        file=sys.stderr,
+    )
+    sys.exit(2)
+
+
+def _sync_effective_paged_kernel(engine) -> None:
+    """Re-stamp PAGED_KERNEL from the engine's resolved kernel: a
+    requested ``fused`` can fall back to ``reference`` (off-TPU,
+    non-MXU-aligned head_dim, tp>1 — engine resolves the model gate at
+    init), and every artifact/roofline line after this point must name
+    the kernel that actually ran, not the one that was asked for."""
+    global PAGED_KERNEL
+    effective = getattr(engine, "paged_kernel", None)
+    if effective and effective != PAGED_KERNEL:
+        log(
+            f"paged-kernel: requested {PAGED_KERNEL!r} resolved to "
+            f"{effective!r} (engine gate)"
+        )
+        PAGED_KERNEL = effective
 # one closed-loop client per slot: oversubscribing evicts pinned
 # sessions (measured slower than the turnaround gaps it fills, now that
 # prefill overlaps decode), and 1:1 matches the BASELINE #5 session
@@ -232,6 +263,9 @@ def timings() -> dict:
 def roofline(
     config, quant, active_slots: float, mean_ctx: float,
     kv_quant: bool = False,
+    kv_layout: str = "dense",
+    kv_block_size: int = 16,
+    paged_kernel: str = "fused",
 ) -> dict:
     """Decode-step roofline from the model shape: FLOPs (matmul 2·P per
     token + attention QK+AV per layer) and HBM bytes (weights once per
@@ -239,7 +273,12 @@ def roofline(
     driver artifact carries so MFU/HBM% are auditable. Weight-only int8
     halves weight BYTES but the matmuls still run in bf16 (qeinsum
     dequantizes into the contraction), so the FLOPs peak is always the
-    bf16 one."""
+    bf16 one. The KV term mirrors the engine's kernel-aware byte model
+    (``runtime/accounting.py::CostModel.kv_read_bytes``): paged reads
+    round up to whole blocks, the fused ragged kernel streams them once
+    (+ table words), and the gather/scatter reference pays the gather
+    copy AND its re-read (3×) — so the per-leg artifact MBU stays
+    honest across ``--paged-kernel`` legs."""
     params = config.num_params()
     weight_bytes = params * (1 if quant == "int8" else 2)
     if kv_quant:
@@ -256,9 +295,19 @@ def roofline(
         4 * mean_ctx * config.num_heads * config.dims_per_head
         * config.num_layers
     )
+    if kv_layout == "paged":
+        blocks = -(-mean_ctx // kv_block_size)
+        padded_ctx = blocks * kv_block_size
+        kv_read = kv_row_bytes * padded_ctx
+        table_bytes = 4 * config.num_layers * blocks
+        if paged_kernel != "fused":
+            kv_read *= 3  # gather copy: pool read + view write + re-read
+        kv_bytes = kv_read + table_bytes
+    else:
+        kv_bytes = kv_row_bytes * mean_ctx
     return {
         "flops_per_step": flops_per_token * active_slots,
-        "bytes_per_step": weight_bytes + kv_row_bytes * mean_ctx * active_slots,
+        "bytes_per_step": weight_bytes + kv_bytes * active_slots,
     }
 
 
@@ -297,6 +346,7 @@ def emit_failure(reason: str) -> bool:
         metric_name(), 0.0, 0.0,
         error=reason, phase=_PHASE, kv_cache=KV_QUANT or "bf16",
         kv_layout=KV_LAYOUT,
+        paged_kernel=PAGED_KERNEL,
         decode_kernel=os.environ.get("LS_DECODE_FLASH", "") or "auto",
     )
 
@@ -321,9 +371,11 @@ def emit_provisional(metric: str, tok_s: float, **extra) -> None:
         "provisional": True,
         "phase": _PHASE,
         "timings_s": timings(),
-        # same identifying field as emit_failure: a dead A/B leg whose
+        # same identifying fields as emit_failure: a dead A/B leg whose
         # last line is a provisional must stay attributable to its leg
         "decode_kernel": os.environ.get("LS_DECODE_FLASH", "") or "auto",
+        "kv_layout": KV_LAYOUT,
+        "paged_kernel": PAGED_KERNEL,
     }
     if _ATTEMPT > 1:
         line["attempt"] = _ATTEMPT
@@ -504,6 +556,7 @@ def run_compile_only() -> int:
         quantize=QUANT,
         kv_quant=KV_QUANT,
         kv_layout=KV_LAYOUT,
+        paged_kernel=PAGED_KERNEL,
         pipeline_decode=PIPELINE,
     )
     variants = len(engine._variant_jobs())  # noqa: SLF001
@@ -756,8 +809,10 @@ async def run_bench():
         quantize=QUANT,
         kv_quant=KV_QUANT,
         kv_layout=KV_LAYOUT,
+        paged_kernel=PAGED_KERNEL,
         pipeline_decode=PIPELINE,
     )
+    _sync_effective_paged_kernel(engine)
     try:
         engine.precompile()
         engine.start()
@@ -792,6 +847,7 @@ async def run_bench():
         emit_success(tok_s, {
             "kv_cache": KV_QUANT or "bf16",
             "kv_layout": KV_LAYOUT,
+            "paged_kernel": PAGED_KERNEL,
             "decode_kernel": os.environ.get("LS_DECODE_FLASH", "") or "auto",
         })
     finally:
@@ -878,6 +934,7 @@ async def run_bench_e2e():
                 "precompile": True,
                 "kv-quant": KV_QUANT or "",
                 "kv-layout": KV_LAYOUT,
+                "paged-kernel": PAGED_KERNEL,
             },
         }
     }
@@ -906,6 +963,7 @@ async def run_bench_e2e():
         for addr in (gateway._runner.addresses or []):  # noqa: SLF001
             port = addr[1]
         engine = runner._service_provider_registry.completions().engine  # noqa: SLF001
+        _sync_effective_paged_kernel(engine)
         log(f"app+gateway up: {time.perf_counter() - t0:.1f}s (port {port})")
         return await _drive_e2e(runner, gateway, port, engine)
     finally:
@@ -1055,6 +1113,9 @@ async def _drive_e2e(runner, gateway, port, engine):
     roof = roofline(
         engine.config, QUANT, occupancy * MAX_SLOTS, mean_ctx,
         kv_quant=bool(KV_QUANT),
+        kv_layout=KV_LAYOUT,
+        kv_block_size=engine.block_size if KV_LAYOUT == "paged" else 16,
+        paged_kernel=PAGED_KERNEL,
     )
     # weight-only int8 still contracts in bf16 — bf16 peak always
     mfu = steps_per_s * roof["flops_per_step"] / PEAK_FLOPS["bf16"]
@@ -1085,6 +1146,7 @@ async def _drive_e2e(runner, gateway, port, engine):
         "broker": BROKER,
         "kv_cache": KV_QUANT or "bf16",
         "kv_layout": KV_LAYOUT,
+        "paged_kernel": PAGED_KERNEL,
         "admission_chunk": ADMISSION_CHUNK,
         "decode_kernel": os.environ.get("LS_DECODE_FLASH", "") or "auto",
         "raw_engine_tok_s": round(raw_tok_s, 1),
@@ -1207,7 +1269,11 @@ def main():
     if MODE != "e2e":
         failed = None
         # engine-mode A/B artifacts must carry the KV-cache mode too
-        extras = {"kv_cache": KV_QUANT or "bf16", "kv_layout": KV_LAYOUT}
+        extras = {
+            "kv_cache": KV_QUANT or "bf16",
+            "kv_layout": KV_LAYOUT,
+            "paged_kernel": PAGED_KERNEL,
+        }
         try:
             tok_s = asyncio.run(run_bench())
         except Exception as error:  # noqa: BLE001 — e.g. OOM on a small chip

@@ -849,6 +849,12 @@ def build_parser() -> argparse.ArgumentParser:
              "equivalent worst case, slots x ceil(max_seq/block))",
     )
     serve.add_argument(
+        "--paged-kernel", default="fused", choices=["fused", "reference"],
+        help="paged attention kernel: fused ragged Pallas launch over "
+             "the block tables (default) or the gather/scatter "
+             "reference oracle (docs/perf.md 'Ragged paged attention')",
+    )
+    serve.add_argument(
         "--slo-ttft-ms", type=float, default=0,
         help="TTFT p95 SLO target in ms: enables burn-rate gauges on "
              "/metrics and the `top` SLO panel (0 = off)",

@@ -284,6 +284,7 @@ async def serve_main(args) -> None:
             "kv-layout": getattr(args, "kv_layout", "dense"),
             "kv-block-size": getattr(args, "kv_block_size", 16),
             "kv-blocks": getattr(args, "kv_blocks", 0) or "",
+            "paged-kernel": getattr(args, "paged_kernel", "fused"),
             # decode-stall watchdog: on by default for serve (the
             # provider starts it; --no-watchdog disables)
             "watchdog": not getattr(args, "no_watchdog", False),

@@ -188,9 +188,10 @@ def _softmax(scores: jnp.ndarray) -> jnp.ndarray:
 # live length mask ever lets attention read it. The paths below GATHER a
 # row-contiguous view via the table and reuse the dense attention math,
 # so dense and paged layouts share one set of masking/softcap/window
-# formulas (a dedicated Pallas paged kernel — the "Ragged Paged
-# Attention" shape — can later replace the gather without touching the
-# call sites).
+# formulas. They are the REFERENCE ORACLE (``paged_kernel: reference``)
+# for the fused Pallas kernel in ``ops/paged_attention.py``, which reads
+# the tables inside its index maps and streams pool blocks HBM→VMEM
+# directly — same masking formulas, no materialized gather copy.
 
 
 def gather_blocks(pool: jnp.ndarray, block_tables: jnp.ndarray) -> jnp.ndarray:
@@ -212,14 +213,21 @@ def paged_write_rows(
     """Scatter per-token rows into their table-addressed pool blocks.
     Works for any trailing shape (bf16/int8 values AND their scale
     leaves). Invalid rows — padding, masked decode slots — land in the
-    null block, whose content is never read."""
+    null block, whose content is never read. Positions past the table's
+    capacity (``pos // block_size >= M``) are routed through the null
+    block the same way: relying on the take_along_axis index clamp
+    would silently land them in the row's LAST real block, overwriting
+    live rows another chain may still reference."""
     seq = new.shape[1]
     block_size = pool.shape[1]
+    capacity = block_tables.shape[1]                           # M
     pos = offsets[:, None] + jnp.arange(seq)[None, :]          # [B, T]
+    seq_block = (pos // block_size).astype(jnp.int32)
     blocks = jnp.take_along_axis(
-        block_tables, (pos // block_size).astype(jnp.int32), axis=1
+        block_tables, jnp.clip(seq_block, 0, capacity - 1), axis=1
     )
-    blocks = jnp.where(valid, blocks, 0)
+    in_table = (seq_block >= 0) & (seq_block < capacity)
+    blocks = jnp.where(valid & in_table, blocks, 0)
     return pool.at[blocks, pos % block_size].set(new.astype(pool.dtype))
 
 

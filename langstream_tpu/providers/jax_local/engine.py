@@ -345,6 +345,10 @@ class DecodeEngine:
         kv_block_size: int = 16,         # paged: tokens per pool block
         kv_blocks: Optional[int] = None,  # paged: pool size (None = the
                                           # dense-equivalent worst case)
+        paged_kernel: str = "fused",     # paged attention: "fused" (one
+                                          # Pallas launch over the block
+                                          # tables) | "reference" (the
+                                          # gather/scatter oracle)
         pipeline_decode: bool = False,
         prefix_cache: bool = True,
         logprobs_topk: int = 0,
@@ -427,8 +431,27 @@ class DecodeEngine:
         self.kv_quant = kv_quant == "int8"
         if kv_layout not in ("dense", "paged"):
             raise ValueError(f"unknown kv layout {kv_layout!r}")
+        if paged_kernel not in ("fused", "reference"):
+            raise ValueError(f"unknown paged kernel {paged_kernel!r}")
         self.kv_layout = kv_layout
         self.paged = kv_layout == "paged"
+        # fused-vs-reference is the ROADMAP-item-1 A/B knob: "fused"
+        # REQUESTS the ragged Pallas kernel; model._use_fused_paged falls
+        # back to the reference composition off-TPU / on non-MXU-aligned
+        # head dims / under tp>1, so the knob is safe to leave at its
+        # default everywhere. That gate is static per engine (config
+        # shapes, interpret hook, backend, mesh), so resolve it ONCE
+        # here and let accounting, flight/artifact telemetry, and the
+        # dispatch builders all see the kernel that actually runs — a
+        # silent fused→reference fallback must not leave the byte model
+        # charging fused bytes (MBU would read ~3x low).
+        self.paged_kernel_requested = paged_kernel if self.paged else None
+        self.paged_kernel = self.paged_kernel_requested
+        if self.paged_kernel == "fused" and not model_lib._use_fused_paged(
+            config, config.dims_per_head, config.num_heads,
+            config.num_kv_heads, self.mesh,
+        ):
+            self.paged_kernel = "reference"
         self.kv_manager = None
         if self.paged:
             from langstream_tpu.providers.jax_local.paged import (
@@ -492,6 +515,7 @@ class DecodeEngine:
             ),
             kv_quant=self.kv_quant,
             kv_block_size=self.block_size if self.paged else 1,
+            paged_kernel=self.paged_kernel,
         )
         # SLO burn-rate tracking over the process-wide TTFT/TPOT
         # histograms (targets come from serve/provider config)
@@ -553,6 +577,8 @@ class DecodeEngine:
             kv_quant=bool(self.kv_quant),
             kv_layout=self.kv_layout,
             kv_blocks=self.num_blocks if self.paged else 0,
+            paged_kernel=self.paged_kernel or "",
+            paged_kernel_requested=self.paged_kernel_requested or "",
         )
         _LIVE_ENGINES.add(self)
 
@@ -635,6 +661,7 @@ class DecodeEngine:
                 return counts, sampled, lp, tops
 
             if self.paged:
+                paged_kernel = self.paged_kernel
 
                 @functools.partial(jax.jit, donate_argnums=(1, 6))
                 def run(params, cache, tokens, lengths, slot_ids, tables,
@@ -642,7 +669,7 @@ class DecodeEngine:
                         bias_ids, bias_vals):
                     cache, logits = model_lib.paged_prefill(
                         config, params, cache, tokens, lengths, tables,
-                        freqs, mesh=mesh,
+                        freqs, mesh=mesh, kernel=paged_kernel,
                     )
                     counts, sampled, lp, tops = sample_first(
                         logits, slot_ids, counts, temperature, top_k,
@@ -674,6 +701,7 @@ class DecodeEngine:
         fn = self._prefill_offset_fns.get(bucket)
         if fn is None:
             config, freqs = self.config, self.freqs
+            mesh = self._tp_mesh()
             topk = self.logprobs_topk
 
             def sample_first(logits, slot_ids, counts, temperature, top_k,
@@ -693,6 +721,7 @@ class DecodeEngine:
                 return counts, sampled, lp, tops
 
             if self.paged:
+                paged_kernel = self.paged_kernel
 
                 @functools.partial(jax.jit, donate_argnums=(1, 7))
                 def run(params, cache, tokens, lengths, offsets, slot_ids,
@@ -700,7 +729,7 @@ class DecodeEngine:
                         bias_ids, bias_vals):
                     cache, logits = model_lib.paged_prefill_at_offset(
                         config, params, cache, tokens, lengths, offsets,
-                        tables, freqs,
+                        tables, freqs, mesh=mesh, kernel=paged_kernel,
                     )
                     counts, sampled, lp, tops = sample_first(
                         logits, slot_ids, counts, temperature, top_k,
@@ -741,6 +770,7 @@ class DecodeEngine:
             mesh = self._tp_mesh()
             topk = self.logprobs_topk
             paged = self.paged
+            paged_kernel = self.paged_kernel
 
             def run_impl(params, cache, tokens, lengths, active, write_mask,
                          tables, counts, temperature, top_k, top_p,
@@ -752,7 +782,8 @@ class DecodeEngine:
                     if paged:
                         cache, logits = model_lib.paged_decode_step(
                             config, params, cache, tokens, lengths,
-                            tables, freqs, write_mask,
+                            tables, freqs, write_mask, mesh=mesh,
+                            kernel=paged_kernel,
                         )
                     else:
                         cache, logits = model_lib.decode_step(

@@ -781,6 +781,93 @@ def _prefill_attn_quant(config, q, k_q, k_s, v_q, v_s, lengths, mesh=None,
     )
 
 
+def _use_fused_paged(config, dim, heads, kv_heads, mesh):
+    """Gate for the fused ragged paged-attention kernel
+    (``ops/paged_attention.py``) — the paged twin of
+    :func:`_flash_path` / :func:`_decode_flash_path`. Under tensor
+    parallelism the fused kernel stays off: a bare Mosaic call has no
+    SPMD partitioning rule, and the shard_map wrapper is the multi-chip
+    arc (ROADMAP item 3); the gather/scatter reference partitions fine
+    under XLA."""
+    from langstream_tpu.ops.paged_attention import use_fused_paged
+
+    tp_sharded = mesh is not None and dict(mesh.shape).get("tp", 1) > 1
+    if tp_sharded:
+        return False
+    return config.use_flash and use_fused_paged(
+        dim, heads, kv_heads, interpret=config.flash_interpret
+    )
+
+
+def _paged_attn(config, q, k_pool, v_pool, tables, starts, totals, *,
+                window, kernel, mesh=None):
+    """Paged attention dispatch, ONE seam for all three ragged cases:
+    decode (q [S, H, D], starts = lengths-1), prefill-at-offset and cold
+    paged prefill (q [B, T, H, D]). ``kernel == "fused"`` (and shapes /
+    backend permitting — see :func:`_use_fused_paged`) runs the single
+    fused Pallas launch that streams table-addressed pool blocks; the
+    gather/scatter composition in ``ops/attention.py`` stays as the
+    reference oracle."""
+    family = dict(
+        softcap=config.attn_logit_softcap, window=window,
+        scale=_attn_scale(config),
+    )
+    decode = q.ndim == 3
+    heads, dim = q.shape[-2], q.shape[-1]
+    kv_heads = k_pool.shape[2]
+    if kernel == "fused" and _use_fused_paged(
+        config, dim, heads, kv_heads, mesh
+    ):
+        from langstream_tpu.ops.paged_attention import ragged_paged_attention
+
+        out = ragged_paged_attention(
+            q[:, None] if decode else q, k_pool, v_pool, tables,
+            starts, totals, interpret=config.flash_interpret, **family,
+        )
+        return out[:, 0] if decode else out
+    if decode:
+        return paged_decode_attention(
+            q, k_pool, v_pool, tables, totals, **family
+        )
+    return paged_chunk_attention(
+        q, k_pool, v_pool, tables, starts, totals, **family
+    )
+
+
+def _paged_attn_quant(config, q, k_pool, k_scale, v_pool, v_scale, tables,
+                      starts, totals, *, window, kernel, mesh=None):
+    """Int8-pool twin of :func:`_paged_attn` (scales stream through the
+    same table-addressed index maps)."""
+    family = dict(
+        softcap=config.attn_logit_softcap, window=window,
+        scale=_attn_scale(config),
+    )
+    decode = q.ndim == 3
+    heads, dim = q.shape[-2], q.shape[-1]
+    kv_heads = k_pool.shape[2]
+    if kernel == "fused" and _use_fused_paged(
+        config, dim, heads, kv_heads, mesh
+    ):
+        from langstream_tpu.ops.paged_attention import (
+            ragged_paged_attention_quant,
+        )
+
+        out = ragged_paged_attention_quant(
+            q[:, None] if decode else q, k_pool, k_scale, v_pool, v_scale,
+            tables, starts, totals, interpret=config.flash_interpret,
+            **family,
+        )
+        return out[:, 0] if decode else out
+    if decode:
+        return paged_decode_attention_quant(
+            q, k_pool, k_scale, v_pool, v_scale, tables, totals, **family
+        )
+    return paged_chunk_attention_quant(
+        q, k_pool, k_scale, v_pool, v_scale, tables, starts, totals,
+        **family,
+    )
+
+
 def _prefill_scan(
     config: LlamaConfig,
     params: Dict[str, jnp.ndarray],
@@ -1036,13 +1123,29 @@ def paged_prefill(
     block_tables: jnp.ndarray,       # [B, M] pool block per seq block
     freqs: jnp.ndarray,
     mesh=None,                       # tp mesh for the sharded flash path
+    kernel: str = "fused",           # paged attention: fused | reference
 ) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
-    """Cold prefill into the paged block pool: the SAME layer scan (and
-    flash kernel gating) as the dense :func:`prefill` — cold
-    self-attention never reads the cache — with the KV write scattered
-    through the block tables instead of into a per-slot region."""
+    """Cold prefill into the paged block pool.
+
+    Fused path (``kernel="fused"`` and the gate passes): cold prefill is
+    prefill-at-offset with every offset 0 — the SAME fused ragged launch
+    the warm and decode paths use, reading the just-written blocks
+    through the tables (identical formulas over identical row contents,
+    the same trick the quantized cold path has always used). Reference
+    path: the dense layer scan (and flash kernel gating) of
+    :func:`prefill` — cold self-attention never reads the cache — with
+    the KV write scattered through the block tables."""
     batch, seq = tokens.shape
     quantized = "k_scale" in cache
+    hd = config.dims_per_head
+    if kernel == "fused" and _use_fused_paged(
+        config, hd, config.num_heads, config.num_kv_heads, mesh
+    ):
+        return paged_prefill_at_offset(
+            config, params, cache, tokens, lengths,
+            jnp.zeros_like(lengths), block_tables, freqs,
+            mesh=mesh, kernel=kernel,
+        )
     x, layer_kv = _prefill_scan(
         config, params, tokens, lengths, freqs, mesh, quantized
     )
@@ -1073,15 +1176,20 @@ def paged_prefill_at_offset(
     offsets: jnp.ndarray,            # [B] existing valid length per row
     block_tables: jnp.ndarray,       # [B, M]
     freqs: jnp.ndarray,
+    mesh=None,                       # tp mesh (fused kernel gates off
+                                     # under tp>1 — see _use_fused_paged)
+    kernel: str = "fused",           # paged attention: fused | reference
 ) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
     """Paged twin of :func:`prefill_at_offset`: suffix KV scatters into
-    table-addressed blocks, attention gathers prefix + suffix through
+    table-addressed blocks, attention reads prefix + suffix through
     the SAME tables — which is how a request admitted onto a cached
     prefix chain (prefix-cache hit) attends over blocks some other
     request's prefill wrote. Shared blocks are never written here: the
     engine admits suffixes at block-aligned boundaries into private
     blocks (COW for mid-block session divergence happens before the
-    dispatch)."""
+    dispatch). Attention dispatches through :func:`_paged_attn` — one
+    fused table-addressed launch by default, gather/scatter reference
+    otherwise."""
     batch, seq = tokens.shape
     hd = config.dims_per_head
     positions = offsets[:, None] + jnp.arange(seq)[None, :]  # [B, T] global
@@ -1108,8 +1216,6 @@ def paged_prefill_at_offset(
         v = v.reshape(batch, seq, config.num_kv_heads, hd)
         q = apply_rope(q, freqs, positions)
         k = apply_rope(k, freqs, positions)
-        softcap = config.attn_logit_softcap
-        scale = _attn_scale(config)
         if quantized:
             k_q, k_s = quantize_kv(k)
             v_q, v_s = quantize_kv(v)
@@ -1117,17 +1223,17 @@ def paged_prefill_at_offset(
             ks = paged_write_rows(ks, k_s, block_tables, offsets, mask)
             vp = paged_write_rows(vp, v_q, block_tables, offsets, mask)
             vs = paged_write_rows(vs, v_s, block_tables, offsets, mask)
-            attn = paged_chunk_attention_quant(
-                q, kp, ks, vp, vs, block_tables, offsets, totals,
-                softcap=softcap, window=win, scale=scale,
+            attn = _paged_attn_quant(
+                config, q, kp, ks, vp, vs, block_tables, offsets, totals,
+                window=win, kernel=kernel, mesh=mesh,
             )
             kv_out = (kp, vp, ks, vs)
         else:
             kp = paged_write_rows(kp, k, block_tables, offsets, mask)
             vp = paged_write_rows(vp, v, block_tables, offsets, mask)
-            attn = paged_chunk_attention(
-                q, kp, vp, block_tables, offsets, totals,
-                softcap=softcap, window=win, scale=scale,
+            attn = _paged_attn(
+                config, q, kp, vp, block_tables, offsets, totals,
+                window=win, kernel=kernel, mesh=mesh,
             )
             kv_out = (kp, vp)
         attn = qeinsum(
@@ -1166,13 +1272,18 @@ def paged_decode_step(
     block_tables: jnp.ndarray,       # [S, M]
     freqs: jnp.ndarray,
     write_mask: Optional[jnp.ndarray] = None,  # [S] bool
+    mesh=None,                       # tp mesh (fused kernel gates off
+                                     # under tp>1 — see _use_fused_paged)
+    kernel: str = "fused",           # paged attention: fused | reference
 ) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
     """Paged twin of :func:`decode_step`: the new token's KV scatters
     into its slot's current block (masked slots route to the null
-    block), attention gathers the live context through the tables.
-    Decode never allocates — the engine reserves each request's worst
-    case (prompt + max_new_tokens) at admission, so this path cannot
-    fail on pool pressure mid-flight."""
+    block), attention reads the live context through the tables — the
+    decode (Tq=1, start=length-1) case of the :func:`_paged_attn`
+    dispatch, so a mixed prefill+decode paged batch runs the same fused
+    launch path end to end. Decode never allocates — the engine reserves
+    each request's worst case (prompt + max_new_tokens) at admission, so
+    this path cannot fail on pool pressure mid-flight."""
     slots = tokens.shape[0]
     hd = config.dims_per_head
     positions = (lengths - 1).astype(jnp.int32)  # [S]
@@ -1205,23 +1316,21 @@ def paged_decode_step(
         v = v.reshape(slots, config.num_kv_heads, hd)
         q = apply_rope(q[:, None], freqs, positions[:, None])[:, 0]
         k = apply_rope(k[:, None], freqs, positions[:, None])[:, 0]
-        family = dict(
-            softcap=config.attn_logit_softcap, window=win,
-            scale=_attn_scale(config),
-        )
         if quantized:
             k_q, k_s = quantize_kv(k)
             v_q, v_s = quantize_kv(v)
             kp, ks = write(kp, k_q), write(ks, k_s)
             vp, vs = write(vp, v_q), write(vs, v_s)
-            attn = paged_decode_attention_quant(
-                q, kp, ks, vp, vs, block_tables, lengths, **family
+            attn = _paged_attn_quant(
+                config, q, kp, ks, vp, vs, block_tables, positions,
+                lengths, window=win, kernel=kernel, mesh=mesh,
             )
             kv_out = (kp, vp, ks, vs)
         else:
             kp, vp = write(kp, k), write(vp, v)
-            attn = paged_decode_attention(
-                q, kp, vp, block_tables, lengths, **family
+            attn = _paged_attn(
+                config, q, kp, vp, block_tables, positions, lengths,
+                window=win, kernel=kernel, mesh=mesh,
             )
             kv_out = (kp, vp)
         attn = qeinsum(

@@ -172,6 +172,12 @@ class JaxCompletionsService(CompletionsService):
                 if engine_config.get("kv-blocks")
                 else None
             ),
+            # paged attention kernel: fused ragged Pallas launch over
+            # the block tables (default) vs the gather/scatter reference
+            # oracle — the ROADMAP-item-1 A/B knob
+            paged_kernel=str(
+                engine_config.get("paged-kernel") or "fused"
+            ).lower(),
             pipeline_decode=str(
                 engine_config.get("pipeline-decode", "")
             ).lower() in ("1", "true", "yes"),

@@ -84,7 +84,14 @@ class CostModel:
       per step (batched slots share it — that is the whole point of
       batching) plus each active slot's KV history read + 1 row written.
     - paged layout: KV reads round each slot's context up to the block
-      size (a gather touches whole blocks).
+      size (any block-granular access touches whole blocks), and the
+      byte model is KERNEL-aware (``paged_kernel``): the fused ragged
+      Pallas kernel streams each table-addressed pool block once plus
+      the table/metadata words themselves, while the gather/scatter
+      reference composition reads the pool, WRITES a contiguous copy,
+      and re-reads that copy in the attention einsum — 3× the KV-read
+      traffic. Charging both legs the same bytes would make the slower
+      leg's MBU read dishonestly high (:meth:`kv_read_bytes`).
     - weight-only int8 halves weight bytes (per-channel scales are
       <1% and excluded); int8 KV stores int8 values + one f32 scale per
       (layer, position, kv_head) for each of k and v.
@@ -98,6 +105,9 @@ class CostModel:
     weight_bytes: int
     kv_row_bytes: int      # bytes per token of KV history, all layers
     kv_block_size: int = 1  # paged read granularity (1 = dense)
+    # paged attention kernel the engine dispatches: "fused" | "reference"
+    # (None = dense layout — no table indirection to charge for)
+    paged_kernel: Optional[str] = None
 
     @classmethod
     def from_model_config(
@@ -107,6 +117,7 @@ class CostModel:
         weight_quant: Optional[str] = None,
         kv_quant: bool = False,
         kv_block_size: int = 1,
+        paged_kernel: Optional[str] = None,
     ) -> "CostModel":
         params = config.num_params()
         head_dim = config.dims_per_head
@@ -129,6 +140,7 @@ class CostModel:
             weight_bytes=params * (1 if weight_quant == "int8" else 2),
             kv_row_bytes=kv_row_bytes,
             kv_block_size=max(1, int(kv_block_size)),
+            paged_kernel=paged_kernel,
         )
 
     # ------------------------------------------------------------------ #
@@ -139,6 +151,30 @@ class CostModel:
         context ``ctx`` (paged gathers touch whole blocks)."""
         block = self.kv_block_size
         return -(-ctx // block) * block if block > 1 else ctx
+
+    def kv_read_bytes(self, kv_tokens: float) -> float:
+        """HBM bytes to get ``kv_tokens`` rows of (block-padded) KV
+        history in front of the compute units, per the dispatched
+        kernel:
+
+        - dense: rows stream once.
+        - paged fused: pool blocks stream once through the table-
+          addressed index maps, plus the table/metadata words the
+          kernel prefetches (one int32 per touched block per layer —
+          the pallas_call runs once per layer inside the scan).
+        - paged reference: ``gather_blocks`` reads the pool AND writes
+          a contiguous copy, then attention re-reads the copy — 3× the
+          row bytes — plus the same table reads for the gather indices.
+        """
+        base = float(self.kv_row_bytes) * kv_tokens
+        if self.paged_kernel is None:
+            return base
+        table_bytes = 4.0 * self.num_layers * (
+            -(-kv_tokens // self.kv_block_size)
+        )
+        if self.paged_kernel == "fused":
+            return base + table_bytes
+        return 3.0 * base + table_bytes
 
     def decode_chunk_flops(
         self, steps: int, active: int, kv_tokens: int
@@ -157,12 +193,14 @@ class CostModel:
         self, steps: int, active: int, kv_tokens: int
     ) -> float:
         """HBM bytes for one K-step decode chunk: weights once per step
-        + each active slot's KV read + 1 row written per slot per step.
-        ``kv_tokens`` should already be block-padded for the paged
-        layout (:meth:`kv_read_tokens` per slot, summed)."""
+        + each active slot's kernel-aware KV read (:meth:`kv_read_bytes`)
+        + 1 row written per slot per step. ``kv_tokens`` should already
+        be block-padded for the paged layout (:meth:`kv_read_tokens` per
+        slot, summed)."""
         per_step = (
             float(self.weight_bytes)
-            + float(self.kv_row_bytes) * (kv_tokens + active)
+            + self.kv_read_bytes(kv_tokens)
+            + float(self.kv_row_bytes) * active
         )
         return per_step * steps
 
@@ -184,13 +222,13 @@ class CostModel:
         )
 
     def prefill_bytes(self, new_tokens: int, offset: int = 0) -> float:
-        """HBM bytes for a prefill dispatch: weights once + KV prefix
-        read + the new rows written. Prefill is FLOPs-bound at any real
-        length; this exists so prefill MBU is also reportable."""
+        """HBM bytes for a prefill dispatch: weights once + kernel-aware
+        KV prefix read + the new rows written. Prefill is FLOPs-bound at
+        any real length; this exists so prefill MBU is also reportable."""
         return (
             float(self.weight_bytes)
-            + float(self.kv_row_bytes)
-            * (self.kv_read_tokens(offset) + new_tokens)
+            + self.kv_read_bytes(self.kv_read_tokens(offset))
+            + float(self.kv_row_bytes) * new_tokens
         )
 
     # ------------------------------------------------------------------ #

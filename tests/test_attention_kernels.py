@@ -402,6 +402,38 @@ def test_paged_write_rows_scatters_and_masks():
     np.testing.assert_array_equal(np.asarray(view[1, 3:8]), 0.0)
 
 
+def test_paged_write_rows_routes_past_capacity_to_null_block():
+    """Regression (ISSUE 6 satellite): positions at/past the table's
+    capacity (``pos // block_size >= M``) must route through the null
+    block explicitly. The old code leaned on take_along_axis's
+    out-of-bounds clamp, which resolved them to the row's LAST real
+    block — silently overwriting live rows at the table-capacity
+    boundary."""
+    from langstream_tpu.ops.attention import gather_blocks, paged_write_rows
+
+    block_size, kv_heads, dim = 4, 2, 8
+    pool = jnp.zeros((9, block_size, kv_heads, dim), jnp.float32)
+    tables = jnp.asarray([[3, 1]], jnp.int32)  # M = 2 → capacity 8 rows
+    new = jnp.arange(1, 1 + 4 * kv_heads * dim, dtype=jnp.float32).reshape(
+        1, 4, kv_heads, dim
+    )
+    # offset 6: positions 6..9 — the last two straddle the capacity
+    # boundary and must vanish into the null block
+    pool = paged_write_rows(
+        pool, new, tables,
+        jnp.asarray([6], jnp.int32), jnp.ones((1, 4), bool),
+    )
+    view = gather_blocks(pool, tables)  # [1, 8, KVH, D]
+    np.testing.assert_array_equal(np.asarray(view[0, 6:8]), np.asarray(new[0, :2]))
+    # in-capacity rows BEFORE the boundary are untouched (the clamp bug
+    # wrote positions 8/9 into block ``tables[0, 1]`` rows 0/1)
+    np.testing.assert_array_equal(np.asarray(view[0, 4:6]), 0.0)
+    np.testing.assert_array_equal(np.asarray(view[0, :4]), 0.0)
+    # overflow rows landed in the null block (content never read live)
+    np.testing.assert_array_equal(np.asarray(pool[0, 0]), np.asarray(new[0, 2]))
+    np.testing.assert_array_equal(np.asarray(pool[0, 1]), np.asarray(new[0, 3]))
+
+
 def test_flash_prefill_window_softcap_matches_reference():
     """Gemma-2 mechanisms in the prefill kernel: sliding-window masking
     (+ out-of-window block compute skip), logit softcap, and the

@@ -87,6 +87,44 @@ def test_cost_model_paged_block_rounding():
     assert dense.kv_read_tokens(17) == 17
 
 
+def test_cost_model_kernel_aware_paged_bytes():
+    """The paged byte model charges by the DISPATCHED kernel (ISSUE 6):
+    the fused ragged kernel streams each table-addressed pool block once
+    plus the table words; the gather/scatter reference reads the pool,
+    writes a contiguous copy, and re-reads it — 3× the row bytes. Hand-
+    computed on the tiny shape (kv_row_bytes 256, 2 layers)."""
+    from langstream_tpu.runtime.accounting import CostModel
+
+    fused = CostModel.from_model_config(
+        _tiny_config(), kv_block_size=16, paged_kernel="fused"
+    )
+    reference = CostModel.from_model_config(
+        _tiny_config(), kv_block_size=16, paged_kernel="reference"
+    )
+    dense = CostModel.from_model_config(_tiny_config())
+    # 32 block-padded rows = 2 blocks; table words = 4 B * 2 layers * 2
+    #   fused:     256*32 + 16            = 8208
+    #   reference: 3*256*32 + 16          = 24592
+    #   dense:     256*32 (no indirection) = 8192
+    assert fused.kv_read_bytes(32) == 8208
+    assert reference.kv_read_bytes(32) == 24592
+    assert dense.kv_read_bytes(32) == 8192
+
+    # decode chunk (1 step, 1 slot, 32-token block-padded context):
+    #   weights 213632 + kernel-aware read + 1 row written (256)
+    assert fused.decode_chunk_bytes(1, 1, 32) == 213632 + 8208 + 256
+    assert reference.decode_chunk_bytes(1, 1, 32) == 213632 + 24592 + 256
+    # FLOPs are kernel-INdependent — same math, different traffic
+    assert fused.decode_chunk_flops(1, 1, 32) == reference.decode_chunk_flops(
+        1, 1, 32
+    )
+
+    # warm prefill: 10 new rows at offset 17 → prefix padded to 32
+    #   weights + kernel-aware prefix read + 10 rows written (2560)
+    assert fused.prefill_bytes(10, offset=17) == 213632 + 8208 + 2560
+    assert reference.prefill_bytes(10, offset=17) == 213632 + 24592 + 2560
+
+
 def test_peak_specs_env_override(monkeypatch):
     from langstream_tpu.runtime import accounting
 

@@ -1,0 +1,453 @@
+"""Fused ragged paged-attention Pallas kernel (ISSUE 6).
+
+Parity of ``ops/paged_attention.py::ragged_paged_attention`` (interpret
+mode — the exact kernel schedule, CPU-verifiable) against the
+gather/scatter reference oracle in ``ops/attention.py`` across GQA group
+sizes × softcap × sliding window × f32/bf16/int8 pools × ragged lengths
+(empty row, single token, exact block boundary, max-table row), plus the
+engine-level contract: fused and reference ``paged_kernel`` legs are
+token-identical under greedy sampling, and the fused jitted dispatches
+contain NO pool-shaped gather — decode, warm prefill-at-offset, and cold
+paged prefill all ride the one table-addressed launch path."""
+
+import asyncio
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from langstream_tpu.ops.attention import (
+    paged_chunk_attention,
+    paged_chunk_attention_quant,
+    paged_decode_attention,
+    paged_decode_attention_quant,
+    quantize_kv,
+)
+from langstream_tpu.ops.paged_attention import (
+    ragged_paged_attention,
+    ragged_paged_attention_quant,
+    use_fused_paged,
+)
+
+BLOCK = 16
+
+
+def _paged_layout(k, v, block_size=BLOCK, seed=0, dtype=jnp.float32):
+    """Dense [B, T, KVH, D] caches → shuffled block pool + tables, so the
+    kernel's table-addressed index maps are tested against NON-identity,
+    non-contiguous block placement (same trick as
+    tests/test_attention_kernels.py)."""
+    batch, max_len, kv_heads, dim = k.shape
+    blocks_per_row = max_len // block_size
+    total = batch * blocks_per_row
+    rng = np.random.RandomState(seed)
+    order = rng.permutation(total) + 1  # block 0 stays the null block
+    tables = order.reshape(batch, blocks_per_row).astype(np.int32)
+    k_pool = np.zeros((total + 1, block_size, kv_heads, dim), np.float32)
+    v_pool = np.zeros_like(k_pool)
+    for b in range(batch):
+        for j in range(blocks_per_row):
+            rows = slice(j * block_size, (j + 1) * block_size)
+            k_pool[tables[b, j]] = np.asarray(k[b, rows])
+            v_pool[tables[b, j]] = np.asarray(v[b, rows])
+    return (
+        jnp.asarray(k_pool, dtype=dtype),
+        jnp.asarray(v_pool, dtype=dtype),
+        jnp.asarray(tables),
+    )
+
+
+def _make_cache(batch, max_len, kv_heads, dim, seed=0):
+    key = jax.random.PRNGKey(seed)
+    kk, kv = jax.random.split(key)
+    k = jax.random.normal(kk, (batch, max_len, kv_heads, dim), jnp.float32)
+    v = jax.random.normal(kv, (batch, max_len, kv_heads, dim), jnp.float32)
+    return k, v
+
+
+# the ragged-length grid every decode parity case runs: a max-table row
+# (every table entry live), a mid-block tail, a single token, and an
+# exact block-boundary length
+RAGGED_LENGTHS = [64, 17, 1, 32]
+
+
+# ---------------------------------------------------------------------- #
+# decode (Tq=1, start = length-1)
+# ---------------------------------------------------------------------- #
+@pytest.mark.parametrize("heads,kv_heads", [(4, 4), (4, 2), (8, 2)])
+@pytest.mark.parametrize("softcap", [None, 30.0])
+def test_fused_decode_matches_reference(heads, kv_heads, softcap):
+    batch, max_len, dim = 4, 64, 32
+    k, v = _make_cache(batch, max_len, kv_heads, dim, seed=1)
+    q = jax.random.normal(
+        jax.random.PRNGKey(2), (batch, heads, dim), jnp.float32
+    )
+    lengths = jnp.asarray(RAGGED_LENGTHS, jnp.int32)
+    k_pool, v_pool, tables = _paged_layout(k, v)
+
+    ref = paged_decode_attention(
+        q, k_pool, v_pool, tables, lengths, softcap=softcap
+    )
+    out = ragged_paged_attention(
+        q[:, None], k_pool, v_pool, tables, lengths - 1, lengths,
+        softcap=softcap, interpret=True,
+    )[:, 0]
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_fused_decode_window_matches_reference():
+    batch, max_len, heads, kv_heads, dim = 4, 64, 4, 2, 32
+    k, v = _make_cache(batch, max_len, kv_heads, dim, seed=3)
+    q = jax.random.normal(
+        jax.random.PRNGKey(4), (batch, heads, dim), jnp.float32
+    )
+    lengths = jnp.asarray(RAGGED_LENGTHS, jnp.int32)
+    k_pool, v_pool, tables = _paged_layout(k, v, seed=1)
+    window = jnp.int32(24)  # narrower than the longest row's context
+
+    ref = paged_decode_attention(
+        q, k_pool, v_pool, tables, lengths, window=window
+    )
+    out = ragged_paged_attention(
+        q[:, None], k_pool, v_pool, tables, lengths - 1, lengths,
+        window=window, interpret=True,
+    )[:, 0]
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_fused_empty_row_emits_zeros():
+    """A row with zero live context (inactive decode slot) is fully
+    masked: the fused finalize emits exact zeros — well-defined, unlike
+    the reference's fully-masked uniform softmax (both are discarded by
+    the engine, but the kernel must not NaN)."""
+    batch, max_len, heads, kv_heads, dim = 2, 64, 4, 2, 32
+    k, v = _make_cache(batch, max_len, kv_heads, dim, seed=5)
+    q = jax.random.normal(
+        jax.random.PRNGKey(6), (batch, heads, dim), jnp.float32
+    )
+    lengths = jnp.asarray([0, 40], jnp.int32)
+    k_pool, v_pool, tables = _paged_layout(k, v)
+    out = ragged_paged_attention(
+        q[:, None], k_pool, v_pool, tables,
+        jnp.maximum(lengths - 1, 0), lengths, interpret=True,
+    )[:, 0]
+    assert np.all(np.isfinite(np.asarray(out)))
+    np.testing.assert_array_equal(np.asarray(out[0]), 0.0)
+    ref = paged_decode_attention(q, k_pool, v_pool, tables, lengths)
+    np.testing.assert_allclose(
+        np.asarray(out[1]), np.asarray(ref[1]), rtol=2e-5, atol=2e-5
+    )
+
+
+# ---------------------------------------------------------------------- #
+# prefill-at-offset / cold prefill (Tq > 1, ragged starts)
+# ---------------------------------------------------------------------- #
+@pytest.mark.parametrize("heads,kv_heads", [(4, 4), (4, 2)])
+@pytest.mark.parametrize(
+    "softcap,window", [(None, None), (30.0, None), (None, 24), (30.0, 24)]
+)
+def test_fused_chunk_matches_reference(heads, kv_heads, softcap, window):
+    """Warm continuation rows at ragged offsets — incl. a cold row
+    (start 0) and a row whose suffix is padded (fewer new tokens than
+    Tq) — against the gather/scatter chunk reference."""
+    batch, seq, max_len, dim = 3, 8, 64, 32
+    k, v = _make_cache(batch, max_len, kv_heads, dim, seed=7)
+    q = jax.random.normal(
+        jax.random.PRNGKey(8), (batch, seq, heads, dim), jnp.float32
+    )
+    starts = jnp.asarray([20, 5, 0], jnp.int32)
+    news = [8, 8, 3]  # row 2: padded suffix
+    lengths = starts + jnp.asarray(news, jnp.int32)
+    k_pool, v_pool, tables = _paged_layout(k, v, seed=2)
+    window_arr = None if window is None else jnp.int32(window)
+
+    ref = paged_chunk_attention(
+        q, k_pool, v_pool, tables, starts, lengths,
+        softcap=softcap, window=window_arr,
+    )
+    out = ragged_paged_attention(
+        q, k_pool, v_pool, tables, starts, lengths,
+        softcap=softcap, window=window_arr, interpret=True,
+    )
+    for b, n in enumerate(news):
+        # rows past a row's new-token count are padding garbage in BOTH
+        # paths (callers index by length) — compare the live rows
+        np.testing.assert_allclose(
+            np.asarray(out[b, :n]), np.asarray(ref[b, :n]),
+            rtol=2e-5, atol=2e-5,
+        )
+
+
+def test_fused_q_block_tiling_matches_reference():
+    """block_q smaller than Tq (multiple q tiles per row, Tq padded to
+    the tile) must agree with the single-tile launch and the XLA
+    reference."""
+    batch, seq, max_len, heads, kv_heads, dim = 2, 10, 64, 4, 2, 32
+    k, v = _make_cache(batch, max_len, kv_heads, dim, seed=9)
+    q = jax.random.normal(
+        jax.random.PRNGKey(10), (batch, seq, heads, dim), jnp.float32
+    )
+    starts = jnp.asarray([16, 0], jnp.int32)
+    lengths = starts + jnp.asarray([10, 10], jnp.int32)
+    k_pool, v_pool, tables = _paged_layout(k, v, seed=3)
+    ref = paged_chunk_attention(q, k_pool, v_pool, tables, starts, lengths)
+    out = ragged_paged_attention(
+        q, k_pool, v_pool, tables, starts, lengths, block_q=4,
+        interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_fused_bf16_pool_matches_reference():
+    batch, max_len, heads, kv_heads, dim = 2, 64, 4, 2, 32
+    k, v = _make_cache(batch, max_len, kv_heads, dim, seed=11)
+    q = jax.random.normal(
+        jax.random.PRNGKey(12), (batch, heads, dim), jnp.float32
+    ).astype(jnp.bfloat16)
+    lengths = jnp.asarray([60, 33], jnp.int32)
+    k_pool, v_pool, tables = _paged_layout(k, v, dtype=jnp.bfloat16)
+    ref = paged_decode_attention(q, k_pool, v_pool, tables, lengths)
+    out = ragged_paged_attention(
+        q[:, None], k_pool, v_pool, tables, lengths - 1, lengths,
+        interpret=True,
+    )[:, 0]
+    np.testing.assert_allclose(
+        np.asarray(out, dtype=np.float32), np.asarray(ref, np.float32),
+        rtol=2e-2, atol=2e-2,  # probs round through bf16 in-kernel
+    )
+
+
+# ---------------------------------------------------------------------- #
+# int8 pools (scales stream through the same tables)
+# ---------------------------------------------------------------------- #
+@pytest.mark.parametrize("heads,kv_heads", [(4, 4), (4, 2), (8, 2)])
+@pytest.mark.parametrize("softcap", [None, 30.0])
+def test_fused_quant_decode_matches_reference(heads, kv_heads, softcap):
+    batch, max_len, dim = 4, 64, 32
+    k, v = _make_cache(batch, max_len, kv_heads, dim, seed=13)
+    q = jax.random.normal(
+        jax.random.PRNGKey(14), (batch, heads, dim), jnp.float32
+    )
+    lengths = jnp.asarray(RAGGED_LENGTHS, jnp.int32)
+    k_pool, v_pool, tables = _paged_layout(k, v, seed=4)
+    k_q, k_s = quantize_kv(k_pool)
+    v_q, v_s = quantize_kv(v_pool)
+
+    ref = paged_decode_attention_quant(
+        q, k_q, k_s, v_q, v_s, tables, lengths, softcap=softcap
+    )
+    out = ragged_paged_attention_quant(
+        q[:, None], k_q, k_s, v_q, v_s, tables, lengths - 1, lengths,
+        softcap=softcap, interpret=True,
+    )[:, 0]
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_fused_quant_chunk_window_matches_reference():
+    batch, seq, max_len, heads, kv_heads, dim = 2, 8, 64, 4, 2, 32
+    k, v = _make_cache(batch, max_len, kv_heads, dim, seed=15)
+    q = jax.random.normal(
+        jax.random.PRNGKey(16), (batch, seq, heads, dim), jnp.float32
+    )
+    starts = jnp.asarray([24, 0], jnp.int32)
+    lengths = starts + jnp.asarray([8, 8], jnp.int32)
+    k_pool, v_pool, tables = _paged_layout(k, v, seed=5)
+    k_q, k_s = quantize_kv(k_pool)
+    v_q, v_s = quantize_kv(v_pool)
+    window = jnp.int32(20)
+
+    ref = paged_chunk_attention_quant(
+        q, k_q, k_s, v_q, v_s, tables, starts, lengths, window=window
+    )
+    out = ragged_paged_attention_quant(
+        q, k_q, k_s, v_q, v_s, tables, starts, lengths, window=window,
+        interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_use_fused_paged_gate():
+    # structurally invalid GQA never runs the kernel, interpret or not
+    assert not use_fused_paged(128, 5, 2, interpret=True)
+    # interpret mode (CPU test hook) accepts any aligned-free shape
+    assert use_fused_paged(16, 4, 2, interpret=True)
+    # CPU backend, no interpret → gate closed regardless of shape
+    assert not use_fused_paged(128, 32, 8)
+
+
+# ---------------------------------------------------------------------- #
+# engine-level: fused vs reference legs, one launch path, no gather
+# ---------------------------------------------------------------------- #
+def _paged_engine(kernel, kv_quant=None, interpret=True):
+    from langstream_tpu.providers.jax_local.engine import DecodeEngine
+    from langstream_tpu.providers.jax_local.model import (
+        LlamaConfig,
+        init_params,
+    )
+
+    config = LlamaConfig.tiny(max_seq_len=128)
+    if interpret:
+        # the CPU hook: _use_fused_paged runs the kernel in Pallas
+        # interpret mode instead of falling back to the reference
+        config = dataclasses.replace(config, flash_interpret=True)
+    params = init_params(config)
+    return DecodeEngine(
+        config, params, max_slots=4, max_seq_len=128,
+        prefill_buckets=[16, 32, 64], kv_quant=kv_quant,
+        kv_layout="paged", kv_block_size=8, paged_kernel=kernel,
+    )
+
+
+@pytest.mark.parametrize("kv_quant", [None, "int8"])
+def test_engine_fused_matches_reference_greedy(kv_quant):
+    """Token-identical greedy output across the kernel A/B legs — cold
+    prefill, warm prefix-hit continuation, and decode all dispatch
+    through the fused launch on one leg and the gather oracle on the
+    other."""
+    from langstream_tpu.providers.jax_local.engine import SamplingParams
+
+    async def run(engine):
+        first = await engine.generate(
+            list(range(1, 40)), SamplingParams(max_new_tokens=6)
+        )
+        # shares blocks with the first prompt → prefix-hit admission
+        # exercises the warm prefill-at-offset path
+        second = await engine.generate(
+            list(range(1, 33)) + [99, 98], SamplingParams(max_new_tokens=6)
+        )
+        return first.tokens, second.tokens
+
+    fused = _paged_engine("fused", kv_quant=kv_quant)
+    reference = _paged_engine("reference", kv_quant=kv_quant,
+                              interpret=False)
+    fused.start()
+    reference.start()
+    try:
+        assert fused.cost_model.paged_kernel == "fused"
+        assert reference.cost_model.paged_kernel == "reference"
+        assert asyncio.run(run(fused)) == asyncio.run(run(reference))
+        # the fused leg actually served traffic through the prefix pool
+        assert fused.kv_manager.stats["hit_tokens"] >= 32
+    finally:
+        fused.stop()
+        reference.stop()
+
+
+def _lowered_text(engine, fn):
+    """StableHLO text of a jitted engine variant, via the avals
+    _variant_jobs builds (the same avals precompile lowers with)."""
+    jobs = [(f, a) for f, a in engine._variant_jobs() if f is fn]
+    assert jobs, "variant not in the engine's job list"
+    fn, avals = jobs[0]
+    return fn.lower(*avals).as_text()
+
+
+def _pool_gather_lines(engine, text):
+    """Lines gathering the per-layer pool [N, Bs, KVH, D] — the
+    signature of the reference's materialized ``gather_blocks`` copy.
+    Other gathers (embedding lookup, table row lookup) have different
+    operand shapes and don't count."""
+    config = engine.config
+    pool_type = (
+        f"{engine.num_blocks}x{engine.block_size}"
+        f"x{config.num_kv_heads}x{config.dims_per_head}xf32"
+    )
+    return [
+        line for line in text.splitlines()
+        if "gather" in line and pool_type in line
+    ]
+
+
+def test_fused_dispatches_contain_no_pool_gather():
+    """The acceptance check for 'one fused launch, no per-path gather':
+    decode, warm prefill-at-offset, AND cold paged prefill lower without
+    a single pool-shaped gather on the fused leg, while every reference
+    dispatch carries them (k and v per layer scan)."""
+    fused = _paged_engine("fused")
+    reference = _paged_engine("reference", interpret=False)
+    try:
+        for engine in (fused, reference):
+            variants = {
+                "decode": engine._get_decode(1),
+                "cold_prefill": engine._get_prefill(16),
+                "prefill_offset": engine._get_prefill_offset(16),
+            }
+            for name, fn in variants.items():
+                lines = _pool_gather_lines(engine, _lowered_text(engine, fn))
+                if engine is fused:
+                    assert not lines, (
+                        f"fused {name} still gathers the pool:\n"
+                        + "\n".join(lines[:4])
+                    )
+                elif name == "cold_prefill":
+                    # reference cold prefill runs the dense layer scan —
+                    # cold self-attention never READS the cache, so no
+                    # pool gather to lose
+                    continue
+                else:
+                    assert lines, f"reference {name} lost its gather"
+    finally:
+        fused.stop()
+        reference.stop()
+
+
+def test_engine_rejects_unknown_paged_kernel():
+    with pytest.raises(ValueError, match="paged kernel"):
+        _paged_engine("turbo")
+
+
+def test_engine_resolves_fused_fallback_to_reference():
+    """A requested fused kernel the model gate rejects (CPU backend, no
+    interpret hook) resolves to reference AT ENGINE INIT: the
+    kernel-aware byte model and flight/artifact telemetry must charge
+    the gather path that actually runs — a silent fused→reference
+    fallback that kept the fused label would read MBU ~3x low."""
+    engine = _paged_engine("fused", interpret=False)
+    try:
+        assert engine.paged_kernel_requested == "fused"
+        assert engine.paged_kernel == "reference"
+        assert engine.cost_model.paged_kernel == "reference"
+    finally:
+        engine.stop()
+
+    # interpret hook open → the request sticks
+    fused = _paged_engine("fused", interpret=True)
+    try:
+        assert fused.paged_kernel == "fused"
+        assert fused.paged_kernel_requested == "fused"
+    finally:
+        fused.stop()
+
+
+def test_provider_plumbs_paged_kernel():
+    """engine: {paged-kernel: ...} flows compiler globals → provider →
+    engine (string-coerced like every other engine knob)."""
+    from langstream_tpu.providers.jax_local.provider import (
+        JaxCompletionsService,
+    )
+
+    service = JaxCompletionsService({
+        "model": {"preset": "tiny"},
+        "engine": {
+            "max-slots": "2", "max-seq-len": "64",
+            "kv-layout": "paged", "kv-block-size": "8",
+            "paged-kernel": "reference",
+        },
+    })
+    try:
+        assert service.engine.paged_kernel == "reference"
+        assert service.engine.cost_model.paged_kernel == "reference"
+    finally:
+        service.engine.stop()

@@ -28,7 +28,8 @@ LEGS = {
     "bench_heal_flashdec0.json": "flash-decode OFF @2048ctx/16slots",
     "bench_heal_flashdec1.json": "flash-decode ON @2048ctx/16slots",
     "bench_heal_admis.json": "admission-chunk 8",
-    "bench_heal_paged.json": "paged KV + prefix pool (--kv-layout paged)",
+    "bench_heal_paged.json": "paged KV, fused ragged kernel (--kv-layout paged)",
+    "bench_heal_paged_ref.json": "paged KV, gather reference (--paged-kernel reference)",
 }
 
 
@@ -56,6 +57,11 @@ def describe(record: Dict[str, Any]) -> str:
     bits = [f"{record.get('value', 0):.0f} tok/s"]
     if record.get("provisional"):
         bits.append("(provisional)")
+    # kernel-leg column: which paged attention kernel produced the leg
+    # (fused ragged Pallas launch vs the gather/scatter reference) —
+    # the ROADMAP-item-1 paged-vs-dense gap is read off this pair
+    if record.get("kv_layout") == "paged" and record.get("paged_kernel"):
+        bits.append(f"kernel={record['paged_kernel']}")
     if record.get("raw_engine_tok_s"):
         bits.append(f"raw {record['raw_engine_tok_s']:.0f}")
     if record.get("decode_ms_per_step"):
@@ -271,18 +277,41 @@ def main() -> None:
     if usable(main_rec) and usable(paged):
         delta = paged["value"] / main_rec["value"] - 1
         note = caveat(main_rec, paged)
+        kernel = paged.get("paged_kernel") or "fused"
         if delta > 0.03:
             recommendations.append(
-                f"FLIP kv-layout default to paged: {delta:+.1%} e2e "
+                f"FLIP kv-layout default to paged ({kernel} kernel): "
+                f"{delta:+.1%} e2e "
                 f"({main_rec['value']:.0f} -> {paged['value']:.0f} tok/s); "
                 "set engine kv-layout default + jax-completions globals"
                 + note
             )
         else:
             recommendations.append(
-                f"keep dense KV layout default ({delta:+.1%} not a win "
-                "at bench shapes; paged still wins HBM headroom for "
+                f"keep dense KV layout default ({delta:+.1%} with the "
+                f"{kernel} kernel; paged still wins HBM headroom for "
                 "long-context / shared-prefix traffic)" + note
+            )
+    paged_ref = records["bench_heal_paged_ref.json"]
+    if usable(paged) and usable(paged_ref):
+        # fused-vs-reference kernel pair at equal layout: read step time
+        # and the kernel-aware MFU/MBU columns (per-chunk series in the
+        # flight digest above) — the ROADMAP item 1 instrument
+        delta = paged["value"] / paged_ref["value"] - 1
+        note = caveat(paged, paged_ref)
+        if delta > 0.03:
+            recommendations.append(
+                f"KEEP paged-kernel fused default: {delta:+.1%} over the "
+                f"gather reference ({paged_ref['value']:.0f} -> "
+                f"{paged['value']:.0f} tok/s)" + note
+            )
+        else:
+            recommendations.append(
+                f"fused paged kernel not yet a win ({delta:+.1%} vs "
+                "gather reference) — check per-chunk MBU in the flight "
+                "digest: the fused leg models ~1/3 the KV bytes, so "
+                "equal step time at lower MBU means the launch is "
+                "compute/grid-bound (raise kv-block-size)" + note
             )
     admis = records["bench_heal_admis.json"]
     if usable(main_rec) and usable(admis):

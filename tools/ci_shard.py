@@ -30,6 +30,8 @@ SHARDS: Dict[str, List[str]] = {
         # SLO burn rates) constructs DecodeEngines — JAX-heavy shard
         "test_efficiency",
         "test_attention_kernels",
+        "test_paged_kernel",
+        "test_paged_kv",
         "test_decode_kernel",
         "test_kv_quant",
         "test_quant",

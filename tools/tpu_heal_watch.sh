@@ -126,6 +126,25 @@ y.block_until_ready()" 2>/dev/null
                     echo "$(date -u +%FT%TZ) flash-decode A/B leg $leg failed (non-fatal)" >> "$LOG"
                 fi
             done
+            # 2b) paged-KV kernel A/B: fused ragged Pallas kernel vs
+            #    the gather/scatter reference at equal layout (the
+            #    ROADMAP item 1 pair), each leg cache-warmed first
+            for kernel in fused reference; do
+                LEG_OUT="${OUT%.json}_paged.json"
+                [ "$kernel" = reference ] && LEG_OUT="${OUT%.json}_paged_ref.json"
+                BENCH_KV_LAYOUT=paged BENCH_PAGED_KERNEL=$kernel \
+                    BENCH_COMPILE_ONLY=1 BENCH_DEADLINE=3000 \
+                    BENCH_INIT_TIMEOUT=600 \
+                    python bench.py > /dev/null 2>> "$LOG" \
+                    || echo "$(date -u +%FT%TZ) paged $kernel warm interrupted (entries kept)" >> "$LOG"
+                if BENCH_KV_LAYOUT=paged BENCH_PAGED_KERNEL=$kernel \
+                    BENCH_DEADLINE=3600 BENCH_INIT_TIMEOUT=600 \
+                    python bench.py > "$LEG_OUT" 2>> "$LOG"; then
+                    echo "$(date -u +%FT%TZ) paged-kernel A/B $kernel: $(cat "$LEG_OUT")" >> "$LOG"
+                else
+                    echo "$(date -u +%FT%TZ) paged-kernel A/B $kernel failed (non-fatal)" >> "$LOG"
+                fi
+            done
             # 3) admission-chunk A/B: short chunks while admissions
             #    wait (TTFT/p50-RTT lever; compare p50_rtt_ms +
             #    p50_ttft_ms against the main run's at equal tok/s)
