@@ -121,6 +121,24 @@ if PAGED_KERNEL not in ("fused", "reference"):
         file=sys.stderr,
     )
     sys.exit(2)
+# Speculative decoding: off (oracle scan) | ngram (self-drafting
+# prompt-lookup, SPEC_K drafts verified per step). One flag for the
+# spec-on-vs-off A/B; also settable as BENCH_SPEC_DECODE for the heal
+# watcher's leg pair (ROADMAP item 2 acceptance instrument).
+SPEC_DECODE = (
+    _cli_flag("spec-decode")
+    or os.environ.get("BENCH_SPEC_DECODE", "")
+    or "off"
+).lower()
+if SPEC_DECODE not in ("off", "ngram"):
+    print(
+        f"unknown --spec-decode {SPEC_DECODE!r} (off|ngram)",
+        file=sys.stderr,
+    )
+    sys.exit(2)
+SPEC_K = int(
+    _cli_flag("spec-k") or os.environ.get("BENCH_SPEC_K", "") or "4"
+)
 
 
 def _sync_effective_paged_kernel(engine) -> None:
@@ -347,6 +365,7 @@ def emit_failure(reason: str) -> bool:
         error=reason, phase=_PHASE, kv_cache=KV_QUANT or "bf16",
         kv_layout=KV_LAYOUT,
         paged_kernel=PAGED_KERNEL,
+        spec_decode=SPEC_DECODE,
         decode_kernel=os.environ.get("LS_DECODE_FLASH", "") or "auto",
     )
 
@@ -376,6 +395,7 @@ def emit_provisional(metric: str, tok_s: float, **extra) -> None:
         "decode_kernel": os.environ.get("LS_DECODE_FLASH", "") or "auto",
         "kv_layout": KV_LAYOUT,
         "paged_kernel": PAGED_KERNEL,
+        "spec_decode": SPEC_DECODE,
     }
     if _ATTEMPT > 1:
         line["attempt"] = _ATTEMPT
@@ -810,6 +830,8 @@ async def run_bench():
         kv_quant=KV_QUANT,
         kv_layout=KV_LAYOUT,
         paged_kernel=PAGED_KERNEL,
+        spec_decode=SPEC_DECODE,
+        spec_k=SPEC_K,
         pipeline_decode=PIPELINE,
     )
     _sync_effective_paged_kernel(engine)
@@ -848,6 +870,7 @@ async def run_bench():
             "kv_cache": KV_QUANT or "bf16",
             "kv_layout": KV_LAYOUT,
             "paged_kernel": PAGED_KERNEL,
+            "spec_decode": SPEC_DECODE,
             "decode_kernel": os.environ.get("LS_DECODE_FLASH", "") or "auto",
         })
     finally:
@@ -935,6 +958,8 @@ async def run_bench_e2e():
                 "kv-quant": KV_QUANT or "",
                 "kv-layout": KV_LAYOUT,
                 "paged-kernel": PAGED_KERNEL,
+                "spec-decode": SPEC_DECODE,
+                "spec-k": SPEC_K,
             },
         }
     }
@@ -1147,6 +1172,7 @@ async def _drive_e2e(runner, gateway, port, engine):
         "kv_cache": KV_QUANT or "bf16",
         "kv_layout": KV_LAYOUT,
         "paged_kernel": PAGED_KERNEL,
+        "spec_decode": SPEC_DECODE,
         "admission_chunk": ADMISSION_CHUNK,
         "decode_kernel": os.environ.get("LS_DECODE_FLASH", "") or "auto",
         "raw_engine_tok_s": round(raw_tok_s, 1),
@@ -1163,6 +1189,15 @@ async def _drive_e2e(runner, gateway, port, engine):
         "flops_per_step": round(roof["flops_per_step"] / 1e12, 3),
         "gb_per_step": round(roof["bytes_per_step"] / 1e9, 3),
     }
+    if SPEC_DECODE != "off":
+        # the leg's own acceptance evidence: drafted vs verify-accepted
+        # (flight decode_chunk records carry the per-chunk series)
+        drafted = stats.get("tokens_drafted", 0)
+        extras["spec_drafted"] = drafted
+        extras["spec_accepted"] = stats.get("tokens_draft_accepted", 0)
+        extras["spec_acceptance"] = round(
+            extras["spec_accepted"] / drafted, 4
+        ) if drafted else 0.0
     emit_success(tok_s, extras)
     return tok_s, extras
 
@@ -1273,6 +1308,7 @@ def main():
             "kv_cache": KV_QUANT or "bf16",
             "kv_layout": KV_LAYOUT,
             "paged_kernel": PAGED_KERNEL,
+            "spec_decode": SPEC_DECODE,
         }
         try:
             tok_s = asyncio.run(run_bench())

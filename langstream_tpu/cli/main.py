@@ -475,6 +475,15 @@ async def _top_cmd(args) -> None:
                 if "jax_engine_goodput_ratio" in metrics:
                     rows.append(("goodput (useful/total tokens)",
                                  f"{gauge('jax_engine_goodput_ratio'):7.1%}"))
+                if "spec_tokens_drafted_total" in metrics:
+                    # speculative decoding: drafted vs verify-accepted
+                    # candidates — a collapsed rate means the workload
+                    # has no self-repetition for the drafter to mine
+                    rows.append((
+                        "spec accept (drafted tokens)",
+                        f"{gauge('spec_acceptance_rate'):7.1%} "
+                        f"({gauge('spec_tokens_drafted_total'):.0f})",
+                    ))
                 stamp = _time.strftime("%H:%M:%S")
                 print(f"-- langstream-tpu top  {args.url}  {stamp} --")
                 if tokens or gauge("jax_engine_decode_steps"):
@@ -853,6 +862,20 @@ def build_parser() -> argparse.ArgumentParser:
         help="paged attention kernel: fused ragged Pallas launch over "
              "the block tables (default) or the gather/scatter "
              "reference oracle (docs/perf.md 'Ragged paged attention')",
+    )
+    serve.add_argument(
+        "--spec-decode", default="off", choices=["off", "ngram"],
+        help="speculative decoding: self-drafting prompt-lookup drafts "
+             "spec-k tokens per decode step, one batched forward "
+             "verifies them (docs/perf.md 'Speculative decoding')",
+    )
+    serve.add_argument(
+        "--spec-k", type=int, default=4,
+        help="drafted tokens verified per decode step (spec-decode)",
+    )
+    serve.add_argument(
+        "--spec-ngram", type=int, default=2,
+        help="suffix n-gram length the prompt-lookup drafter matches",
     )
     serve.add_argument(
         "--slo-ttft-ms", type=float, default=0,

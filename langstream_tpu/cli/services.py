@@ -285,6 +285,9 @@ async def serve_main(args) -> None:
             "kv-block-size": getattr(args, "kv_block_size", 16),
             "kv-blocks": getattr(args, "kv_blocks", 0) or "",
             "paged-kernel": getattr(args, "paged_kernel", "fused"),
+            "spec-decode": getattr(args, "spec_decode", "off"),
+            "spec-k": getattr(args, "spec_k", 4),
+            "spec-ngram": getattr(args, "spec_ngram", 2),
             # decode-stall watchdog: on by default for serve (the
             # provider starts it; --no-watchdog disables)
             "watchdog": not getattr(args, "no_watchdog", False),
@@ -330,6 +333,16 @@ async def serve_main(args) -> None:
         raise SystemExit(
             "--kv-layout paged is not supported with multi-host "
             "serving (--followers/--follower-of) yet; use dense"
+        )
+    if getattr(args, "spec_decode", "off") != "off" and (
+        getattr(args, "followers", 0) or getattr(args, "follower_of", None)
+    ):
+        # same configuration-time guard as paged: the mirror replays
+        # plain dispatch records; spec dispatches carry the device
+        # token-history operand (engine._check_mirror_layout backstops)
+        raise SystemExit(
+            "--spec-decode is not supported with multi-host serving "
+            "(--followers/--follower-of) yet"
         )
     completions = JaxCompletionsService(config)
     if getattr(args, "follower_of", None):

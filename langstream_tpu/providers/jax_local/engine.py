@@ -120,8 +120,11 @@ def engines_snapshot() -> Dict[str, float]:
     prefix_hit_tokens = prefix_evictions = 0
     useful_tokens = 0
     wasted: Dict[str, int] = {
-        reason: 0 for reason in ("cancelled", "evicted_recompute")
+        reason: 0
+        for reason in ("cancelled", "evicted_recompute", "draft_rejected")
     }
+    spec_engines = 0
+    spec_drafted = spec_accepted = 0
     decode_flops = decode_bytes = prefill_flops = 0.0
     peaks: Optional[accounting.PeakSpecs] = None
     live_engines = list(_LIVE_ENGINES)
@@ -148,6 +151,10 @@ def engines_snapshot() -> Dict[str, float]:
             # SLO targets + multi-window burn rates: visible from the
             # first scrape (targets are config, not traffic)
             out.update(engine.slo.gauges())
+        if getattr(engine, "spec", False):
+            spec_engines += 1
+            spec_drafted += stats["tokens_drafted"]
+            spec_accepted += stats["tokens_draft_accepted"]
         if getattr(engine, "kv_manager", None) is not None:
             paged_engines += 1
             kv_blocks_in_use += engine.kv_manager.blocks_in_use
@@ -171,6 +178,17 @@ def engines_snapshot() -> Dict[str, float]:
         out["kv_blocks_total"] = float(kv_blocks_total)
         out["prefix_cache_hit_tokens_total"] = float(prefix_hit_tokens)
         out["prefix_cache_evictions_total"] = float(prefix_evictions)
+    if spec_engines:
+        # speculative decoding (spec-decode: ngram): drafted/accepted
+        # counters + the acceptance rate — exposed from construction so
+        # an operator A/B-ing the knob never scrapes no-data, and a
+        # collapsed acceptance rate (workload without repetition) is
+        # visible before anyone reads a flight artifact
+        out["spec_tokens_drafted_total"] = float(spec_drafted)
+        out["spec_tokens_accepted_total"] = float(spec_accepted)
+        out["spec_acceptance_rate"] = round(
+            spec_accepted / spec_drafted, 4
+        ) if spec_drafted else 0.0
     if not (tokens or steps):
         return out
     out["jax_engine_session_hits"] = float(session_hits)
@@ -349,6 +367,12 @@ class DecodeEngine:
                                           # Pallas launch over the block
                                           # tables) | "reference" (the
                                           # gather/scatter oracle)
+        spec_decode: str = "off",        # speculative decoding: "off" |
+                                          # "ngram" (self-drafting
+                                          # prompt-lookup, k drafted
+                                          # tokens verified per step)
+        spec_k: int = 4,                 # drafted tokens per decode step
+        spec_ngram: int = 2,             # suffix n-gram the drafter matches
         pipeline_decode: bool = False,
         prefix_cache: bool = True,
         logprobs_topk: int = 0,
@@ -447,6 +471,19 @@ class DecodeEngine:
         # charging fused bytes (MBU would read ~3x low).
         self.paged_kernel_requested = paged_kernel if self.paged else None
         self.paged_kernel = self.paged_kernel_requested
+        # speculative decoding (ROADMAP item 2): a prompt-lookup drafter
+        # proposes spec_k tokens per decode step and ONE verify forward
+        # scores all of them — 1..spec_k+1 tokens per weight pass. The
+        # non-speculative scan stays compiled as the oracle ("off").
+        if spec_decode not in ("off", "ngram"):
+            raise ValueError(f"unknown spec decode mode {spec_decode!r}")
+        self.spec_decode = spec_decode
+        self.spec = spec_decode == "ngram"
+        self.spec_k = max(1, int(spec_k))
+        self.spec_ngram = max(1, int(spec_ngram))
+        # tokens a single scan step can emit (verify block width): the
+        # context/budget arithmetic everywhere else keys off this
+        self.spec_block = (self.spec_k + 1) if self.spec else 1
         if self.paged_kernel == "fused" and not model_lib._use_fused_paged(
             config, config.dims_per_head, config.num_heads,
             config.num_kv_heads, self.mesh,
@@ -547,6 +584,7 @@ class DecodeEngine:
         self._compiled_prefill: Dict[int, Any] = {}
         self._prefill_offset_fns: Dict[int, Any] = {}
         self._decode_fns: Dict[int, Any] = {}
+        self._spec_decode_fns: Dict[int, Any] = {}
         self._copy_fns: Dict[int, Any] = {}
         self._block_copy_fn: Optional[Any] = None
         # prefill dispatches whose first tokens are not yet harvested
@@ -579,6 +617,8 @@ class DecodeEngine:
             kv_blocks=self.num_blocks if self.paged else 0,
             paged_kernel=self.paged_kernel or "",
             paged_kernel_requested=self.paged_kernel_requested or "",
+            spec_decode=self.spec_decode,
+            spec_k=self.spec_k if self.spec else 0,
         )
         _LIVE_ENGINES.add(self)
 
@@ -609,6 +649,15 @@ class DecodeEngine:
             "decode_flops": 0.0,
             "decode_bytes": 0.0,
             "prefill_flops": 0.0,
+            # speculative decoding: drafted candidates vs candidates the
+            # verify pass accepted (rejected = the new wasted reason)
+            "tokens_drafted": 0,
+            "tokens_draft_accepted": 0,
+            # decode wall-time normalizer for the watchdog: tokens an
+            # AVERAGE active slot gained, summed over chunks — equals
+            # decode_steps for plain decode, grows ~(1+accept·k) faster
+            # under speculation, so per-token latency stays comparable
+            "decode_token_steps": 0.0,
         }
 
     def reset_stats(self) -> None:
@@ -763,7 +812,14 @@ class DecodeEngine:
         dispatch latency (which dominates when the chip sits behind a
         network tunnel or when the model is small); stop conditions are
         applied host-side afterwards, surplus steps for a finished slot
-        are discarded and its length pointer rewound."""
+        are discarded and its length pointer rewound.
+
+        With ``spec_decode: ngram`` every scan step is draft→verify→
+        accept instead (:meth:`_get_spec_decode`) and yields 1..spec_k+1
+        tokens per slot per step; this plain scan stays compiled as the
+        non-speculative oracle."""
+        if self.spec:
+            return self._get_spec_decode(steps)
         fn = self._decode_fns.get(steps)
         if fn is None:
             config, freqs = self.config, self.freqs
@@ -862,6 +918,141 @@ class DecodeEngine:
 
             fn = run
             self._decode_fns[steps] = fn
+        return fn
+
+    def _get_spec_decode(self, steps: int):
+        """Jitted K-step SPECULATIVE decode scan (``spec_decode: ngram``).
+        Each scan step: (1) the prompt-lookup drafter proposes up to
+        spec_k tokens from the slot's own device-resident token history,
+        (2) ONE verify forward scores the [S, 1+spec_k] candidate block
+        at every position (dense :func:`model.verify_step`; paged rides
+        the fused kernel's existing Tq>1 formulation), (3) the
+        acceptance pass emits 1..spec_k+1 tokens per slot with the exact
+        sampling semantics of the oracle scan (greedy exact-match /
+        rejection sampling, penalties, bias, seeded keys). Rejected
+        suffixes roll back by NOT advancing lengths — rows past the
+        accepted length are causally invisible and overwritten in order
+        by later steps (paged blocks were reserved at admission, so no
+        allocator churn). Emitted counts ride the scan outputs so the
+        host sees a variable number of tokens per dispatch."""
+        fn = self._spec_decode_fns.get(steps)
+        if fn is None:
+            from langstream_tpu.providers.jax_local import (
+                spec_decode as spec_lib,
+            )
+
+            config, freqs = self.config, self.freqs
+            mesh = self._tp_mesh()
+            topk = self.logprobs_topk
+            paged = self.paged
+            paged_kernel = self.paged_kernel
+            k = self.spec_k
+            ngram = self.spec_ngram
+            block_width = self.spec_block
+            width = self.max_seq_len  # history array width
+
+            def run_impl(params, cache, tokens, lengths, active, write_mask,
+                         history, tables, counts, temperature, top_k, top_p,
+                         presence, frequency, seeds, bias_ids, bias_vals):
+                slots = tokens.shape[0]
+
+                def body(carry, _):
+                    cache, tokens, lengths, counts, history = carry
+                    drafts, num = spec_lib.draft_ngram(
+                        history, lengths, active, ngram=ngram, k=k,
+                    )
+                    block = jnp.concatenate(
+                        [tokens[:, None], drafts], axis=1
+                    )  # [S, 1+k]
+                    valid_lens = jnp.where(active, 1 + num, 0)
+                    if paged:
+                        cache, logits = model_lib.paged_verify_step(
+                            config, params, cache, block, lengths,
+                            valid_lens, tables, freqs,
+                            write_mask=write_mask, mesh=mesh,
+                            kernel=paged_kernel,
+                        )
+                    else:
+                        cache, logits = model_lib.verify_step(
+                            config, params, cache, block, lengths,
+                            valid_lens, freqs, write_mask=write_mask,
+                            mesh=mesh,
+                        )
+                    emitted, lps, valid, counts, tops = (
+                        spec_lib.accept_block(
+                            logits, block, num, counts, active,
+                            temperature, top_k, top_p, seeds, lengths,
+                            presence, frequency, bias_ids, bias_vals, topk,
+                        )
+                    )
+                    m = valid.sum(axis=1).astype(jnp.int32)  # [S] emitted
+                    # append the emitted tokens to the device history
+                    # (positions lengths..lengths+m-1; invalid → dropped)
+                    pos = lengths[:, None] + jnp.arange(block_width)[None, :]
+                    pos = jnp.where(valid, pos, width)
+                    history = history.at[
+                        jnp.arange(slots)[:, None], pos
+                    ].set(emitted, mode="drop")
+                    last = jnp.take_along_axis(
+                        emitted,
+                        jnp.clip(m - 1, 0, block_width - 1)[:, None],
+                        axis=1,
+                    )[:, 0]
+                    tokens = jnp.where(active & (m > 0), last, tokens)
+                    lengths = lengths + jnp.where(active, m, 0)
+                    ys = (emitted, lps, valid, num)
+                    if topk:
+                        ys = ys + tops
+                    return (cache, tokens, lengths, counts, history), ys
+
+                (
+                    (cache, final_tokens, final_lengths, counts,
+                     final_history),
+                    ys,
+                ) = jax.lax.scan(
+                    body, (cache, tokens, lengths, counts, history),
+                    None, length=steps,
+                )
+                # [steps, S, B] -> [S, steps, B]
+                out = ys[0].transpose(1, 0, 2)
+                lps = ys[1].transpose(1, 0, 2)
+                valid = ys[2].transpose(1, 0, 2)
+                drafted = ys[3].transpose(1, 0)  # [S, steps]
+                tops = (
+                    (ys[4].transpose(1, 0, 2, 3), ys[5].transpose(1, 0, 2, 3))
+                    if topk else None
+                )
+                return (
+                    cache, counts, out, lps, valid, drafted, tops,
+                    final_tokens, final_lengths, final_history,
+                )
+
+            if paged:
+
+                @functools.partial(jax.jit, donate_argnums=(1, 6, 8))
+                def run(params, cache, tokens, lengths, active, write_mask,
+                        history, tables, counts, temperature, top_k, top_p,
+                        presence, frequency, seeds, bias_ids, bias_vals):
+                    return run_impl(
+                        params, cache, tokens, lengths, active, write_mask,
+                        history, tables, counts, temperature, top_k, top_p,
+                        presence, frequency, seeds, bias_ids, bias_vals,
+                    )
+
+            else:
+
+                @functools.partial(jax.jit, donate_argnums=(1, 6, 7))
+                def run(params, cache, tokens, lengths, active, write_mask,
+                        history, counts, temperature, top_k, top_p,
+                        presence, frequency, seeds, bias_ids, bias_vals):
+                    return run_impl(
+                        params, cache, tokens, lengths, active, write_mask,
+                        history, None, counts, temperature, top_k, top_p,
+                        presence, frequency, seeds, bias_ids, bias_vals,
+                    )
+
+            fn = run
+            self._spec_decode_fns[steps] = fn
         return fn
 
     def _get_copy_prefix(self, bucket: int):
@@ -1033,12 +1224,20 @@ class DecodeEngine:
         step_variants = {self.decode_chunk, 1}
         if self.admission_chunk:
             step_variants.add(self.admission_chunk)
+        # spec decode threads the per-slot token history (drafting
+        # source) through the scan carry as one extra [S, max_seq] array
+        history = (
+            (jax.ShapeDtypeStruct(
+                (slots, self.max_seq_len), jnp.int32
+            ),)
+            if self.spec else ()
+        )
         for steps in step_variants:
             jobs.append((self._get_decode(steps), (
                 params_aval, cache_aval,
                 vec(slots, jnp.int32), vec(slots, jnp.int32),
                 vec(slots, jnp.bool_), vec(slots, jnp.bool_),
-                *tables(slots), counts_aval,
+                *history, *tables(slots), counts_aval,
                 vec(slots, jnp.float32), vec(slots, jnp.int32),
                 vec(slots, jnp.float32), vec(slots, jnp.float32),
                 vec(slots, jnp.float32), vec(slots, jnp.uint32),
@@ -2247,6 +2446,13 @@ class DecodeEngine:
             raise NotImplementedError(
                 "multi-host mirror does not support kv_layout=paged yet"
             )
+        if self.spec:
+            # spec dispatches carry the device token-history operand and
+            # return variable-width outputs the follower replay protocol
+            # does not speak yet
+            raise NotImplementedError(
+                "multi-host mirror does not support spec_decode yet"
+            )
 
     def _harvest_prefills(self, block: bool = False) -> None:
         """Emit first tokens of completed prefill dispatches (FIFO — the
@@ -2325,16 +2531,18 @@ class DecodeEngine:
             # harvested prefill slots should join the NEXT chunk, not wait
             # out a blind pre-dispatched one
             return False
-        steps = inflight["steps"]
+        # worst-case tokens a chunk can emit per slot: each spec step
+        # may accept every draft plus the bonus token
+        budget = inflight["steps"] * self.spec_block
         for i, slot in enumerate(self.slots):
             if not inflight["active"][i]:
                 continue
             if not slot.active or slot.epoch != inflight["epochs"][i]:
                 return False
             request = slot.request
-            if len(slot.generated) + 2 * steps > request.sampling.max_new_tokens:
+            if len(slot.generated) + 2 * budget > request.sampling.max_new_tokens:
                 return False
-            if slot.length + 1 + 2 * steps >= self.max_seq_len:
+            if slot.length + 1 + 2 * budget >= self.max_seq_len:
                 return False
         return True
 
@@ -2352,8 +2560,11 @@ class DecodeEngine:
             active = carry["active"]
             # approximation: the carry chunk advanced every rider by its
             # step count. Unpadded for paged (block crossings unknown
-            # without slot state, slight undercount) and a rider that
-            # hit a stop token mid-carry still counts (slight overcount)
+            # without slot state, slight undercount), a rider that
+            # hit a stop token mid-carry still counts (slight overcount),
+            # and under spec decode a step advances 1..spec_block tokens
+            # (reading the accepted counts here would sync on the carry
+            # and defeat pipelining — steps is the guaranteed floor)
             # — _can_chain rules out budget/context finishes, so chains
             # stay rare-error-bounded; fresh dispatches are exact.
             kv_tokens = carry["kv_tokens"] + int(active.sum()) * steps
@@ -2365,6 +2576,7 @@ class DecodeEngine:
             lengths_arg = carry["final_lengths"]
             active_arg = carry["active_dev"]
             tables_arg = carry["tables_dev"]
+            history_arg = carry["final_history"]
             epochs = carry["epochs"]
             if self.mirror is not None:
                 # followers chain from their OWN previous decode output
@@ -2385,6 +2597,10 @@ class DecodeEngine:
                 # someone is waiting to join: run a short chunk so the
                 # next dispatch picks them up (see admission_chunk)
                 steps = self.admission_chunk
+            history = (
+                np.zeros((self.max_slots, self.max_seq_len), dtype=np.int32)
+                if self.spec else None
+            )
             for i, slot in enumerate(self.slots):
                 lengths[i] = slot.length
                 epochs[i] = slot.epoch
@@ -2399,9 +2615,20 @@ class DecodeEngine:
                     top_k[i] = slot.request.sampling.top_k
                     top_p[i] = slot.request.sampling.top_p
                     seeds_host[i] = self._request_seed(slot.request)
-                    # a chunk writes cache positions up to length+steps-1;
-                    # drop to single-step near the context boundary
-                    if self.max_seq_len - slot.length - 1 < steps:
+                    if history is not None:
+                        # drafting source: the slot's full token history
+                        # (prompt + generated incl. the pending token —
+                        # h[t] = token at cache position t)
+                        history[i, : len(slot.history)] = slot.history
+                    # a chunk writes cache positions up to
+                    # length + steps·block − 1 (block = 1 + spec_k when
+                    # speculating); drop to single-step near the context
+                    # boundary — the in-jit draft clamp keeps even a
+                    # single spec step inside the cache
+                    if (
+                        self.max_seq_len - slot.length - 1
+                        < steps * self.spec_block
+                    ):
                         steps = 1
             bias_ids, bias_vals = self._bias_rows(
                 [slot.request if slot.ready else None for slot in self.slots]
@@ -2429,6 +2656,7 @@ class DecodeEngine:
             tokens_arg = jnp.asarray(tokens)
             lengths_arg = jnp.asarray(lengths)
             active_arg = jnp.asarray(active)
+            history_arg = jnp.asarray(history) if self.spec else None
             # block tables are device-resident in the carry like every
             # other chained operand (tables of active riders cannot
             # change while _can_chain holds)
@@ -2470,21 +2698,37 @@ class DecodeEngine:
                 )
         run = self._get_decode(steps)
         paged_args = (tables_arg,) if self.paged else ()
-        (
-            self.cache, self._counts, out_tokens, out_lps, out_tops,
-            final_tokens, final_lengths,
-        ) = run(
-            self.params, self.cache, tokens_arg, lengths_arg,
-            active_arg, active_arg, *paged_args, self._counts,
-            temperature, top_k, top_p, presence, frequency, seeds,
-            bias_ids, bias_vals,
-        )  # arg order mirrored by FollowerExecutor._decode — keep in sync
+        out_valid = out_drafted = final_history = None
+        if self.spec:
+            (
+                self.cache, self._counts, out_tokens, out_lps, out_valid,
+                out_drafted, out_tops, final_tokens, final_lengths,
+                final_history,
+            ) = run(
+                self.params, self.cache, tokens_arg, lengths_arg,
+                active_arg, active_arg, history_arg, *paged_args,
+                self._counts, temperature, top_k, top_p, presence,
+                frequency, seeds, bias_ids, bias_vals,
+            )
+        else:
+            (
+                self.cache, self._counts, out_tokens, out_lps, out_tops,
+                final_tokens, final_lengths,
+            ) = run(
+                self.params, self.cache, tokens_arg, lengths_arg,
+                active_arg, active_arg, *paged_args, self._counts,
+                temperature, top_k, top_p, presence, frequency, seeds,
+                bias_ids, bias_vals,
+            )  # arg order mirrored by FollowerExecutor._decode — keep in sync
         return {
             "out_tokens": out_tokens,
             "out_lps": out_lps,
             "out_tops": out_tops,
+            "out_valid": out_valid,
+            "out_drafted": out_drafted,
             "final_tokens": final_tokens,
             "final_lengths": final_lengths,
+            "final_history": final_history,
             "active": active,
             "active_dev": active_arg,
             "tables_dev": tables_arg,
@@ -2506,7 +2750,10 @@ class DecodeEngine:
     def _process_decode(self, inflight: Dict[str, Any]) -> None:
         steps = inflight["steps"]
         active = inflight["active"]
-        out_host = np.asarray(inflight["out_tokens"])  # [S, steps]
+        spec = self.spec
+        # plain: [S, steps]; spec: [S, steps, B] with a True-prefix
+        # valid mask per (slot, step) — 1..B tokens per step
+        out_host = np.asarray(inflight["out_tokens"])
         lps_host = np.asarray(inflight["out_lps"])
         tops = inflight.get("out_tops")
         if tops is not None:  # ([S, steps, K] ids, [S, steps, K] lps)
@@ -2514,6 +2761,29 @@ class DecodeEngine:
         ended = time.perf_counter()
         wall = ended - inflight["started"]
         n_active = int(active.sum())
+        drafted_total = accepted_total = 0
+        if spec:
+            valid_host = np.asarray(inflight["out_valid"])      # [S, steps, B]
+            drafted_host = np.asarray(inflight["out_drafted"])  # [S, steps]
+            emitted_total = int(valid_host[active].sum())
+            drafted_total = int(drafted_host[active].sum())
+            # per (slot, step) the block emits 1 + (leading accepted
+            # drafts) tokens — the +1 is the bonus/fallback token the
+            # verify logits fund either way
+            accepted_total = emitted_total - n_active * steps
+            self.stats["tokens_drafted"] += drafted_total
+            self.stats["tokens_draft_accepted"] += accepted_total
+            # rejected drafts burned verify FLOPs/bandwidth for tokens
+            # nobody receives: a first-class wasted reason in the
+            # goodput ledger, NOT silently folded into useful work
+            self._waste("draft_rejected", drafted_total - accepted_total)
+            token_steps = emitted_total / n_active if n_active else float(steps)
+        else:
+            token_steps = float(steps)
+        # per-accepted-token wall-time normalizer (watchdog baseline):
+        # equals `steps` for plain decode; under speculation a step
+        # legitimately takes longer but yields 1..spec_k+1 tokens
+        self.stats["decode_token_steps"] += token_steps
         self.stats["decode_steps"] += steps
         self.stats["decode_chunks"] += 1
         # pipelined chunks overlap in wall time (chunk N+1 is dispatched
@@ -2535,10 +2805,10 @@ class DecodeEngine:
         # values can read slightly high; the cumulative gauges divide by
         # the busy-time union and stay honest.
         chunk_flops = self.cost_model.decode_chunk_flops(
-            steps, n_active, inflight["kv_tokens"]
+            steps, n_active, inflight["kv_tokens"], block=self.spec_block
         )
         chunk_bytes = self.cost_model.decode_chunk_bytes(
-            steps, n_active, inflight["kv_tokens"]
+            steps, n_active, inflight["kv_tokens"], block=self.spec_block
         )
         self.stats["decode_flops"] += chunk_flops
         self.stats["decode_bytes"] += chunk_bytes
@@ -2574,6 +2844,13 @@ class DecodeEngine:
                     kv_blocks_total=self.num_blocks,
                     prefix_hit_tokens=inflight["prefix_hit_tokens"],
                 )
+            if spec:
+                # speculation gain series: drafted vs verify-accepted
+                # candidates this chunk — ab_analyze digests the
+                # acceptance rate and dispatches-per-token from these
+                kv_fields.update(
+                    drafted=drafted_total, accepted=accepted_total,
+                )
             flight.record(
                 "decode_chunk",
                 steps=steps,
@@ -2608,6 +2885,28 @@ class DecodeEngine:
                     # the length pointer stopped where the stop hit, so the
                     # garbage cache rows beyond it are dead
                     break
+                if spec:
+                    # variable tokens per step: the valid mask is a
+                    # True-prefix over the block; a stop landing
+                    # mid-block discards the accepted suffix the same
+                    # way a mid-chunk stop discards surplus steps
+                    # (length rewind — rows past the stop are dead)
+                    for b in range(self.spec_block):
+                        if not valid_host[i, j, b] or not slot.active:
+                            break
+                        slot.length += 1
+                        self._emit_token(
+                            i, int(out_host[i, j, b]),
+                            float(lps_host[i, j, b]),
+                            top=(
+                                (
+                                    tops[0][i, j, b].tolist(),
+                                    tops[1][i, j, b].tolist(),
+                                )
+                                if tops is not None else None
+                            ),
+                        )
+                    continue
                 slot.length += 1
                 self._emit_token(
                     i, int(out_host[i, j]), float(lps_host[i, j]),
@@ -2881,6 +3180,8 @@ def _sample(
     top_k: jnp.ndarray,       # [S] (0 = disabled)
     keys: jnp.ndarray,        # [S] per-slot PRNG keys (_sampling_keys)
     top_p: Optional[jnp.ndarray] = None,  # [S] (0 = disabled)
+    *,
+    masked: Optional[jnp.ndarray] = None,  # precomputed _truncation_mask
 ) -> jnp.ndarray:
     """Per-slot sampling on device: greedy when temperature==0, else
     temperature softmax with optional top-k and/or top-p truncation.
@@ -2888,7 +3189,10 @@ def _sample(
     Tiered via ``lax.cond`` so the expensive paths only execute when a
     slot actually asks for them — the full [S, V] descending sort costs
     a large share of a decode step's wall time at a 128k vocab, and
-    greedy/plain-categorical traffic (the common case) doesn't need it."""
+    greedy/plain-categorical traffic (the common case) doesn't need it.
+    A caller that already holds the truncation mask for these logits
+    (the speculative acceptance pass needs it for its probabilities)
+    passes it as ``masked`` so the truncated tier skips the re-sort."""
     slots, vocab = logits.shape
     greedy = jnp.argmax(logits, axis=-1)
 
@@ -2898,28 +3202,8 @@ def _sample(
         return _rowwise_categorical(keys, scaled)
 
     def truncated(_):
-        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]  # descending
-        # top-k mask: keep logits >= k-th largest (k clamped to [1, V])
-        k = jnp.clip(top_k, 0, vocab)
-        kth_index = jnp.clip(k - 1, 0, vocab - 1)
-        kth_value = jnp.take_along_axis(
-            sorted_logits, kth_index[:, None], axis=1
-        )
-        masked = jnp.where(
-            (k[:, None] > 0) & (logits < kth_value), -jnp.inf, logits
-        )
-        if top_p is not None:
-            # nucleus: keep the smallest set of tokens whose mass >= p
-            probs = jax.nn.softmax(sorted_logits, axis=-1)
-            cumulative = jnp.cumsum(probs, axis=-1)
-            # threshold = smallest sorted logit still inside the nucleus
-            inside = cumulative - probs < top_p[:, None]
-            cut = jnp.where(inside, sorted_logits, jnp.inf).min(axis=-1)
-            masked = jnp.where(
-                (top_p[:, None] > 0) & (masked < cut[:, None]),
-                -jnp.inf, masked,
-            )
-        scaled = masked / jnp.maximum(temperature, 1e-6)[:, None]
+        m = _truncation_mask(logits, top_k, top_p) if masked is None else masked
+        scaled = m / jnp.maximum(temperature, 1e-6)[:, None]
         return _rowwise_categorical(keys, scaled)
 
     any_truncation = jnp.any(top_k > 0)
@@ -2936,6 +3220,42 @@ def _sample(
         None,
     )
     return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)
+
+
+def _truncation_mask(
+    logits: jnp.ndarray,      # [S, V]
+    top_k: jnp.ndarray,       # [S] (0 = disabled)
+    top_p: Optional[jnp.ndarray],  # [S] (0 = disabled)
+) -> jnp.ndarray:
+    """Top-k/top-p truncation as a -inf mask over the logits — the sort-
+    based masking ``_sample``'s truncated tier applies before scaling.
+    Shared with the speculative acceptance pass
+    (``spec_decode._accept_or_fallback``), which needs the truncated
+    distribution's probabilities rather than a sample, so the two paths
+    cannot drift."""
+    vocab = logits.shape[-1]
+    sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]  # descending
+    # top-k mask: keep logits >= k-th largest (k clamped to [1, V])
+    k = jnp.clip(top_k, 0, vocab)
+    kth_index = jnp.clip(k - 1, 0, vocab - 1)
+    kth_value = jnp.take_along_axis(
+        sorted_logits, kth_index[:, None], axis=1
+    )
+    masked = jnp.where(
+        (k[:, None] > 0) & (logits < kth_value), -jnp.inf, logits
+    )
+    if top_p is not None:
+        # nucleus: keep the smallest set of tokens whose mass >= p
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cumulative = jnp.cumsum(probs, axis=-1)
+        # threshold = smallest sorted logit still inside the nucleus
+        inside = cumulative - probs < top_p[:, None]
+        cut = jnp.where(inside, sorted_logits, jnp.inf).min(axis=-1)
+        masked = jnp.where(
+            (top_p[:, None] > 0) & (masked < cut[:, None]),
+            -jnp.inf, masked,
+        )
+    return masked
 
 
 def _sample_with_logprob(

@@ -1466,6 +1466,220 @@ def _decode_unroll() -> int:
     return max(1, int(os.environ.get("LS_DECODE_UNROLL", "1")))
 
 
+def verify_step(
+    config: LlamaConfig,
+    params: Dict[str, jnp.ndarray],
+    cache: Dict[str, jnp.ndarray],
+    tokens: jnp.ndarray,      # [S, B] int32 — last token + drafted block
+    lengths: jnp.ndarray,     # [S] cache length INCLUDING tokens[:, 0]
+    valid_lens: jnp.ndarray,  # [S] real tokens in the block (1 + drafted;
+                              # 0 = inactive row)
+    freqs: jnp.ndarray,
+    write_mask: Optional[jnp.ndarray] = None,  # [S] bool
+    mesh=None,
+) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
+    """Speculative verify: :func:`decode_step` generalized to a [S, B]
+    token block per slot. Teacher-forces the block at each slot's
+    current position (tokens[:, 0] is the pending token whose KV row a
+    plain decode step would write, tokens[:, 1:] are drafted
+    candidates), writes KV for every real block position, attends
+    causally over prefix + block, and returns logits for EVERY position
+    [S, B, V] — the acceptance pass needs the distribution at each
+    candidate, not just the last one (which is why this is not
+    :func:`prefill_at_offset`). Writes are per-position masked scatters
+    (OOB dropped), so rejected-suffix rollback is a pure length rewind:
+    positions past the accepted length hold garbage that is causally
+    invisible until a later step overwrites them in order."""
+    slots, seq = tokens.shape
+    hd = config.dims_per_head
+    offsets = (lengths - 1).astype(jnp.int32)                # [S]
+    positions = offsets[:, None] + jnp.arange(seq)[None, :]  # [S, B] global
+    mask = jnp.arange(seq)[None, :] < valid_lens[:, None]    # [S, B] valid
+    totals = offsets + valid_lens                            # [S]
+    if write_mask is None:
+        write_mask = jnp.ones((slots,), dtype=bool)
+    wmask = mask & write_mask[:, None]
+    x = _embed(config, params, tokens)                       # [S, B, H]
+
+    layer_inputs = _stack_layer_params(params, config)
+    windows = layer_windows(config)
+    quantized = "k_scale" in cache
+    max_len = cache["k"].shape[2]
+    rows = jnp.arange(slots)[:, None]
+    softcap = config.attn_logit_softcap
+    scale = _attn_scale(config)
+    # masked rows (inactive slot, padding beyond the drafted count, or a
+    # carry that ran past max_seq_len) route out of bounds and drop —
+    # a clamped dynamic_update_slice would silently overwrite live rows
+    write_pos = jnp.where(wmask, positions, max_len)
+
+    def write_rows(kc, new):
+        return kc.at[rows, write_pos].set(
+            new.astype(kc.dtype), mode="drop"
+        )
+
+    def layer_fn(carry, inputs):
+        x = carry
+        if quantized:
+            layer, kc, vc, ks, vs, win = inputs
+        else:
+            layer, kc, vc, win = inputs
+        (attn_norm, wq, wk, wv, biases, wo, post_attn, mlp_norm, post_mlp,
+         mlp_weights) = layer
+        normed = _norm(config, x, attn_norm)
+        q, k, v = _project_qkv(normed, wq, wk, wv, biases)
+        q = q.reshape(slots, seq, config.num_heads, hd)
+        k = k.reshape(slots, seq, config.num_kv_heads, hd)
+        v = v.reshape(slots, seq, config.num_kv_heads, hd)
+        q = apply_rope(q, freqs, positions)
+        k = apply_rope(k, freqs, positions)
+        if quantized:
+            k_q, k_s = quantize_kv(k)
+            v_q, v_s = quantize_kv(v)
+            kc = write_rows(kc, k_q)
+            ks = write_rows(ks, k_s)
+            vc = write_rows(vc, v_q)
+            vs = write_rows(vs, v_s)
+            attn = chunk_attention_quant(
+                q, kc, ks, vc, vs, offsets, totals,
+                softcap=softcap, window=win, scale=scale,
+            )
+            kv_out = (kc, vc, ks, vs)
+        else:
+            kc = write_rows(kc, k)
+            vc = write_rows(vc, v)
+            attn = chunk_attention(
+                q, kc, vc, offsets, totals,
+                softcap=softcap, window=win, scale=scale,
+            )
+            kv_out = (kc, vc)
+        attn = qeinsum(
+            "sbd,dh->sbh", attn.reshape(slots, seq, config.num_heads * hd), wo
+        )
+        if post_attn is not None:
+            attn = _norm(config, attn, post_attn)
+        x = x + attn
+        normed = _norm(config, x, mlp_norm)
+        delta, _ = _mlp_block(config, normed, mlp_weights, valid=mask,
+                              dropless=True)
+        if post_mlp is not None:
+            delta = _norm(config, delta, post_mlp)
+        x = x + delta
+        return x, kv_out
+
+    if quantized:
+        xs = (layer_inputs, cache["k"], cache["v"],
+              cache["k_scale"], cache["v_scale"], windows)
+    else:
+        xs = (layer_inputs, cache["k"], cache["v"], windows)
+    x, kv_caches = jax.lax.scan(layer_fn, x, xs, unroll=_decode_unroll())
+    out = dict(cache)
+    if quantized:
+        out["k"], out["v"], out["k_scale"], out["v_scale"] = kv_caches
+    else:
+        out["k"], out["v"] = kv_caches
+    x = _norm(config, x, params["final_norm"])
+    return out, _logits(config, params, x)  # [S, B, V]
+
+
+def paged_verify_step(
+    config: LlamaConfig,
+    params: Dict[str, jnp.ndarray],
+    cache: Dict[str, jnp.ndarray],   # paged pool
+    tokens: jnp.ndarray,             # [S, B] int32 block per slot
+    lengths: jnp.ndarray,            # [S] length INCLUDING tokens[:, 0]
+    valid_lens: jnp.ndarray,         # [S] real tokens (0 = inactive)
+    block_tables: jnp.ndarray,       # [S, M]
+    freqs: jnp.ndarray,
+    write_mask: Optional[jnp.ndarray] = None,  # [S] bool
+    mesh=None,
+    kernel: str = "fused",
+) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
+    """Paged twin of :func:`verify_step`: the candidate block's KV
+    scatters into table-addressed blocks (masked/overflow rows route to
+    the null block) and attention is the fused kernel's existing Tq>1
+    prefill-at-offset formulation — no new kernel. Blocks were reserved
+    worst-case at admission, so verify never allocates and rollback is
+    a length-pointer rewind only."""
+    slots, seq = tokens.shape
+    hd = config.dims_per_head
+    offsets = (lengths - 1).astype(jnp.int32)
+    positions = offsets[:, None] + jnp.arange(seq)[None, :]  # [S, B] global
+    mask = jnp.arange(seq)[None, :] < valid_lens[:, None]
+    totals = offsets + valid_lens
+    if write_mask is None:
+        write_mask = jnp.ones((slots,), dtype=bool)
+    wmask = mask & write_mask[:, None]
+    x = _embed(config, params, tokens)
+
+    layer_inputs = _stack_layer_params(params, config)
+    windows = layer_windows(config)
+    quantized = "k_scale" in cache
+
+    def layer_fn(carry, inputs):
+        x = carry
+        if quantized:
+            layer, kp, vp, ks, vs, win = inputs
+        else:
+            layer, kp, vp, win = inputs
+        (attn_norm, wq, wk, wv, biases, wo, post_attn, mlp_norm, post_mlp,
+         mlp_weights) = layer
+        normed = _norm(config, x, attn_norm)
+        q, k, v = _project_qkv(normed, wq, wk, wv, biases)
+        q = q.reshape(slots, seq, config.num_heads, hd)
+        k = k.reshape(slots, seq, config.num_kv_heads, hd)
+        v = v.reshape(slots, seq, config.num_kv_heads, hd)
+        q = apply_rope(q, freqs, positions)
+        k = apply_rope(k, freqs, positions)
+        if quantized:
+            k_q, k_s = quantize_kv(k)
+            v_q, v_s = quantize_kv(v)
+            kp = paged_write_rows(kp, k_q, block_tables, offsets, wmask)
+            ks = paged_write_rows(ks, k_s, block_tables, offsets, wmask)
+            vp = paged_write_rows(vp, v_q, block_tables, offsets, wmask)
+            vs = paged_write_rows(vs, v_s, block_tables, offsets, wmask)
+            attn = _paged_attn_quant(
+                config, q, kp, ks, vp, vs, block_tables, offsets, totals,
+                window=win, kernel=kernel, mesh=mesh,
+            )
+            kv_out = (kp, vp, ks, vs)
+        else:
+            kp = paged_write_rows(kp, k, block_tables, offsets, wmask)
+            vp = paged_write_rows(vp, v, block_tables, offsets, wmask)
+            attn = _paged_attn(
+                config, q, kp, vp, block_tables, offsets, totals,
+                window=win, kernel=kernel, mesh=mesh,
+            )
+            kv_out = (kp, vp)
+        attn = qeinsum(
+            "sbd,dh->sbh", attn.reshape(slots, seq, config.num_heads * hd), wo
+        )
+        if post_attn is not None:
+            attn = _norm(config, attn, post_attn)
+        x = x + attn
+        normed = _norm(config, x, mlp_norm)
+        delta, _ = _mlp_block(config, normed, mlp_weights, valid=mask,
+                              dropless=True)
+        if post_mlp is not None:
+            delta = _norm(config, delta, post_mlp)
+        x = x + delta
+        return x, kv_out
+
+    if quantized:
+        xs = (layer_inputs, cache["k"], cache["v"],
+              cache["k_scale"], cache["v_scale"], windows)
+    else:
+        xs = (layer_inputs, cache["k"], cache["v"], windows)
+    x, kv_caches = jax.lax.scan(layer_fn, x, xs, unroll=_decode_unroll())
+    out = dict(cache)
+    if quantized:
+        out["k"], out["v"], out["k_scale"], out["v_scale"] = kv_caches
+    else:
+        out["k"], out["v"] = kv_caches
+    x = _norm(config, x, params["final_norm"])
+    return out, _logits(config, params, x)  # [S, B, V]
+
+
 def apply_layers(
     config: LlamaConfig,
     layer_inputs,          # stacked layer params (from _stack_layer_params),

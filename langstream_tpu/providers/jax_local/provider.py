@@ -178,6 +178,15 @@ class JaxCompletionsService(CompletionsService):
             paged_kernel=str(
                 engine_config.get("paged-kernel") or "fused"
             ).lower(),
+            # speculative decoding (ROADMAP item 2): off (oracle scan,
+            # default) | ngram (self-drafting prompt-lookup, spec-k
+            # drafts verified per step) — threaded exactly like
+            # paged-kernel so serve/bench/globals all speak one knob
+            spec_decode=str(
+                engine_config.get("spec-decode") or "off"
+            ).lower(),
+            spec_k=int(engine_config.get("spec-k") or 4),
+            spec_ngram=int(engine_config.get("spec-ngram") or 2),
             pipeline_decode=str(
                 engine_config.get("pipeline-decode", "")
             ).lower() in ("1", "true", "yes"),

@@ -1,0 +1,240 @@
+"""Self-drafting speculative decoding — prompt-lookup drafter + on-device
+acceptance (ROADMAP item 2).
+
+Decode is memory-bound: every step streams the full weight + KV working
+set to emit ONE token per slot. Speculation converts that wasted
+bandwidth into useful FLOPs: a draft proposes k candidate tokens per
+step, ONE batched forward verifies all k+1 positions at once
+(``model.verify_step`` / ``model.paged_verify_step``), and an on-device
+acceptance pass emits every candidate the model itself would have
+produced — between 1 and k+1 tokens per dispatch for one weight pass.
+
+Two pieces, both pure functions traced inside the engine's decode jit
+(no host round trip per step):
+
+- :func:`draft_ngram` — prompt-lookup drafting (PLD): find the most
+  recent earlier occurrence of the slot's trailing n-gram in its own
+  token history (prompt + generated, maintained as a device array in
+  the scan carry) and propose the k tokens that followed it. Free —
+  no draft model, no extra weights — and strong on the workloads that
+  dominate serving: RAG quotes, code edits, chat templates, structured
+  extraction, anywhere the output re-states spans of the input.
+- :func:`accept_block` — sequential accept/reject over the verified
+  block, preserving the EXACT sampling semantics of ``engine._sample``:
+  greedy traffic accepts a candidate iff it equals the argmax
+  (token-for-token parity with the non-speculative oracle), stochastic
+  traffic runs rejection sampling against the same
+  truncated/temperature-scaled distribution ``_sample`` draws from
+  (accept candidate d w.p. p(d); on rejection, resample from the
+  residual p with d masked — emitted tokens are distributed exactly as
+  p at every position). Presence/frequency penalties and logit_bias are
+  applied position-by-position with counts updated as candidates are
+  accepted, and PRNG keys derive from (seed, position) exactly like the
+  oracle — a slot with no draft this step reproduces the plain step
+  bitwise, including seeded stochastic sampling.
+
+Rollback needs no allocator work: rejected candidates' KV rows sit past
+the accepted length where causal masking makes them invisible, and the
+next step overwrites them in order (paged blocks were reserved
+worst-case at admission).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def draft_ngram(
+    history: jnp.ndarray,   # [S, T] int32 — token at cache position t
+    lengths: jnp.ndarray,   # [S] valid history INCLUDING the pending token
+    active: jnp.ndarray,    # [S] bool
+    *,
+    ngram: int,
+    k: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Prompt-lookup drafting: per slot, suffix-match the trailing
+    ``ngram`` tokens against the history and propose the ``k`` tokens
+    following the MOST RECENT earlier match. Returns (drafts [S, k],
+    num_drafted [S]); num is 0 when no match exists (the verify step
+    then degenerates to a plain decode step)."""
+    slots, width = history.shape
+    idx = jnp.arange(width)
+    # pattern = the trailing n-gram h[L-n .. L-1]
+    pat_pos = jnp.clip(
+        lengths[:, None] - ngram + jnp.arange(ngram)[None, :], 0, width - 1
+    )
+    pattern = jnp.take_along_axis(history, pat_pos, axis=1)  # [S, n]
+    match = jnp.ones((slots, width), dtype=bool)
+    for j in range(ngram):
+        # h[i + j] aligned at i; wrap values are masked below (a valid
+        # candidate needs i + n < L <= width, so it never wraps)
+        match = match & (jnp.roll(history, -j, axis=1) == pattern[:, j:j + 1])
+    # a candidate start i needs the n-gram inside the valid prefix AND
+    # at least one continuation token strictly before the pending
+    # position (i + n < L) — which also excludes the trailing n-gram's
+    # trivial self-match at i = L - n
+    match = match & ((idx[None, :] + ngram) < lengths[:, None])
+    match = match & (lengths[:, None] >= ngram + 1) & active[:, None]
+    best = jnp.max(jnp.where(match, idx[None, :], -1), axis=1)  # [S]
+    found = best >= 0
+    source = jnp.clip(
+        best[:, None] + ngram + jnp.arange(k)[None, :], 0, width - 1
+    )
+    drafts = jnp.take_along_axis(history, source, axis=1)  # [S, k]
+    num = jnp.where(found, jnp.clip(lengths - (best + ngram), 0, k), 0)
+    # context-boundary clamp: drafted KV writes reach position
+    # L - 1 + num, which must stay inside the cache width
+    num = jnp.minimum(num, jnp.maximum(width - lengths, 0))
+    return drafts.astype(jnp.int32), num.astype(jnp.int32)
+
+
+def _accept_or_fallback(
+    adjusted: jnp.ndarray,     # [S, V] penalty/bias-adjusted logits
+    temperature: jnp.ndarray,  # [S]
+    top_k: jnp.ndarray,        # [S]
+    top_p: jnp.ndarray,        # [S]
+    keys: jnp.ndarray,         # [S] per-slot PRNG keys for this position
+    candidate: jnp.ndarray,    # [S] drafted token at this position
+    have: jnp.ndarray,         # [S] bool — a draft exists here
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-position accept decision + the token to emit on rejection
+    (or when no draft exists). Greedy rows accept iff candidate ==
+    argmax and fall back to the argmax itself; stochastic rows accept
+    w.p. p(candidate) under the SAME truncated/scaled distribution
+    ``_sample`` uses and fall back to the residual distribution —
+    token-exact parity for greedy, distribution-exact for sampling."""
+    from langstream_tpu.providers.jax_local import engine as engine_lib
+
+    slots, vocab = adjusted.shape
+    greedy = jnp.argmax(adjusted, axis=-1)
+    stochastic = temperature > 0
+    # ONE truncation sort per position (the full-vocab sort dominates a
+    # sampling step's cost), shared by the fallback sampler and the
+    # acceptance probabilities — same mask, so the two cannot drift;
+    # guarded exactly like _sample's truncated tier so greedy-only
+    # traffic never pays it
+    masked = jax.lax.cond(
+        jnp.any(stochastic) & (jnp.any(top_k > 0) | jnp.any(top_p > 0)),
+        lambda _: engine_lib._truncation_mask(adjusted, top_k, top_p),
+        lambda _: adjusted,
+        None,
+    )
+    # the oracle's own sampler covers the no-draft case: same key, same
+    # cond tiering → a slot with no draft reproduces the plain step
+    # bitwise (greedy AND seeded stochastic)
+    plain = engine_lib._sample(
+        adjusted, temperature, top_k, keys, top_p, masked=masked
+    )
+
+    def stochastic_case(_):
+        scaled = masked / jnp.maximum(temperature, 1e-6)[:, None]
+        logz = jax.scipy.special.logsumexp(scaled, axis=-1)
+        cand = jnp.clip(candidate, 0, vocab - 1)
+        logp = (
+            jnp.take_along_axis(scaled, cand[:, None], axis=1)[:, 0] - logz
+        )
+        accept_keys = jax.vmap(
+            lambda key: jax.random.fold_in(key, 1)
+        )(keys)
+        uniforms = jax.vmap(jax.random.uniform)(accept_keys)
+        # accept d w.p. p(d): a draft outside the truncation set has
+        # p = 0 (logp = -inf) and is always rejected
+        accepted = jnp.log(jnp.maximum(uniforms, 1e-38)) < logp
+        residual_keys = jax.vmap(
+            lambda key: jax.random.fold_in(key, 2)
+        )(keys)
+        residual = scaled.at[jnp.arange(slots), cand].set(-jnp.inf)
+        resampled = engine_lib._rowwise_categorical(residual_keys, residual)
+        return accepted, resampled.astype(jnp.int32)
+
+    def greedy_case(_):
+        return jnp.zeros((slots,), dtype=bool), greedy.astype(jnp.int32)
+
+    accept_st, residual_tok = jax.lax.cond(
+        jnp.any(stochastic) & jnp.any(have), stochastic_case, greedy_case,
+        None,
+    )
+    accept = jnp.where(stochastic, accept_st, greedy == candidate) & have
+    fallback = jnp.where(stochastic & have, residual_tok, plain)
+    return accept, fallback
+
+
+def accept_block(
+    logits: jnp.ndarray,       # [S, B, V] raw verify logits
+    block: jnp.ndarray,        # [S, B] verified tokens (t0 + drafts)
+    num_drafted: jnp.ndarray,  # [S]
+    counts: jnp.ndarray,       # [S, V] generated-token counts (penalties)
+    active: jnp.ndarray,       # [S] bool
+    temperature: jnp.ndarray,
+    top_k: jnp.ndarray,
+    top_p: jnp.ndarray,
+    seeds: jnp.ndarray,        # [S] uint32
+    base_lengths: jnp.ndarray,  # [S] carry lengths at block entry — the
+                               # oracle's key-position for emission 0
+    presence: jnp.ndarray,
+    frequency: jnp.ndarray,
+    bias_ids: jnp.ndarray,     # [S, MAX_LOGIT_BIAS]
+    bias_vals: jnp.ndarray,
+    topk: int,                 # top_logprobs K (0 = off)
+):
+    """Sequential accept/reject over a verified block. Emission index i
+    draws from logits[:, i] (penalties/bias applied with counts as of
+    that position — identical ordering to the oracle scan) and checks
+    candidate block[:, i+1]; the first rejection emits the fallback and
+    stops the slot's block. Returns (emitted [S, B], logprobs [S, B],
+    valid [S, B] — a True-prefix mask of emitted positions, updated
+    counts, tops or None)."""
+    from langstream_tpu.providers.jax_local import engine as engine_lib
+
+    slots, width, _ = logits.shape
+    rows = jnp.arange(slots)
+    # candidate at emission index i is block[:, i + 1]; none at the last
+    candidates = jnp.concatenate(
+        [block[:, 1:], jnp.zeros((slots, 1), block.dtype)], axis=1
+    )
+
+    def position(carry, xs):
+        counts, alive = carry
+        logit_i, cand_i, i = xs
+        raw = logit_i.astype(jnp.float32)
+        adjusted = (
+            raw
+            - presence[:, None] * (counts > 0)
+            - frequency[:, None] * counts
+        )
+        adjusted = adjusted.at[rows[:, None], bias_ids].add(bias_vals)
+        keys = engine_lib._sampling_keys(seeds, base_lengths + i)
+        have = (i < num_drafted) & active
+        accepted, fallback = _accept_or_fallback(
+            adjusted, temperature, top_k, top_p, keys, cand_i, have
+        )
+        emit = jnp.where(have & accepted, cand_i, fallback)
+        emit = jnp.where(active, emit, 0).astype(jnp.int32)
+        valid = alive & active
+        lp = engine_lib._token_logprob(raw, emit)
+        counts = counts.at[rows, emit].add(valid.astype(jnp.int32))
+        alive = alive & have & accepted
+        ys = (emit, lp, valid)
+        if topk:
+            ys = ys + engine_lib._top_logprobs(raw, topk)
+        return (counts, alive), ys
+
+    (counts, _), ys = jax.lax.scan(
+        position,
+        (counts, jnp.ones((slots,), dtype=bool)),
+        (
+            logits.transpose(1, 0, 2),
+            candidates.transpose(1, 0),
+            jnp.arange(width),
+        ),
+    )
+    emitted = ys[0].transpose(1, 0)   # [S, B]
+    logprobs = ys[1].transpose(1, 0)
+    valid = ys[2].transpose(1, 0)
+    tops: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None
+    if topk:
+        tops = (ys[3].transpose(1, 0, 2), ys[4].transpose(1, 0, 2))
+    return emitted, logprobs, valid, counts, tops

@@ -177,30 +177,44 @@ class CostModel:
         return 3.0 * base + table_bytes
 
     def decode_chunk_flops(
-        self, steps: int, active: int, kv_tokens: int
+        self, steps: int, active: int, kv_tokens: int, block: int = 1
     ) -> float:
         """FLOPs for one K-step decode chunk. ``kv_tokens`` is the sum of
         active slots' context lengths at dispatch (attention cost is
-        linear in the summed context, so only the sum is needed)."""
+        linear in the summed context, so only the sum is needed).
+
+        ``block`` is the verify width of a speculative step (1 + spec_k;
+        1 = plain decode): every matmul processes ``block`` positions per
+        slot per step, and each position attends over the slot's context
+        plus its own in-block causal prefix — this is exactly the
+        conversion speculation sells (k× the useful FLOPs for ~1× the
+        weight bytes), so MFU must bill it."""
+        in_block = active * block * (block - 1) / 2.0  # causal intra-block
         per_step = (
-            2.0 * self.params * active
-            + 4.0 * kv_tokens * self.num_heads * self.head_dim
-            * self.num_layers
+            2.0 * self.params * active * block
+            + 4.0 * (kv_tokens * block + in_block)
+            * self.num_heads * self.head_dim * self.num_layers
         )
         return per_step * steps
 
     def decode_chunk_bytes(
-        self, steps: int, active: int, kv_tokens: int
+        self, steps: int, active: int, kv_tokens: int, block: int = 1
     ) -> float:
         """HBM bytes for one K-step decode chunk: weights once per step
         + each active slot's kernel-aware KV read (:meth:`kv_read_bytes`)
-        + 1 row written per slot per step. ``kv_tokens`` should already
-        be block-padded for the paged layout (:meth:`kv_read_tokens` per
-        slot, summed)."""
+        + ``block`` rows written per slot per step. ``kv_tokens`` should
+        already be block-padded for the paged layout
+        (:meth:`kv_read_tokens` per slot, summed).
+
+        ``block`` > 1 (speculative verify) does NOT multiply the weight
+        or KV-read streams — the whole point of verifying k drafts in
+        one forward is that they share the step's weight pass — only the
+        KV rows written scale with the verify width. Billing k tokens at
+        1-token bytes would overstate MBU by ~k×."""
         per_step = (
             float(self.weight_bytes)
             + self.kv_read_bytes(kv_tokens)
-            + float(self.kv_row_bytes) * active
+            + float(self.kv_row_bytes) * active * block
         )
         return per_step * steps
 

@@ -5,8 +5,12 @@ artifact after the fact.
 Three detectors over a :class:`~langstream_tpu.providers.jax_local.engine.DecodeEngine`'s
 public counters (read-only — the watchdog NEVER touches the data plane):
 
-- **decode degradation** — per-poll decode-step latency vs a learned
-  EWMA baseline. The baseline only absorbs healthy samples, so a
+- **decode degradation** — per-poll decode latency vs a learned EWMA
+  baseline, normalized per *accepted token* (the engine's
+  ``decode_token_steps`` counter), not per scan step: with speculative
+  decoding a step legitimately takes longer but yields 1..k+1 tokens,
+  so a per-step baseline would read "enabling --spec-decode" as a
+  degradation. The baseline only absorbs healthy samples, so a
   persistent 4× regression (thermal throttling, a neighbour hogging the
   chip, a pathological batch shape) trips instead of normalizing.
 - **no progress** — work is waiting (queued/pending requests or active
@@ -87,8 +91,10 @@ class EngineWatchdog:
         self.trips = 0
         self.baseline_step_s: Optional[float] = None
         self._baseline_chunks = 0
-        # (ts, decode_chunks, decode_steps, decode_time, prefill_calls)
-        self._last: Optional[Tuple[float, int, int, float, int]] = None
+        # (ts, decode_chunks, decode_token_steps, decode_time,
+        # prefill_calls) — token_steps is the per-accepted-token
+        # normalizer (== decode_steps for a non-speculative engine)
+        self._last: Optional[Tuple[float, int, float, float, int]] = None
         self._stall_anchor: Optional[float] = None
         self._livelock_anchor: Optional[float] = None
         self._last_trip: Dict[str, float] = {}
@@ -141,7 +147,14 @@ class EngineWatchdog:
         now = time.monotonic() if now is None else now
         stats = self.engine.stats
         chunks = stats["decode_chunks"]
-        steps = stats["decode_steps"]
+        # per-ACCEPTED-TOKEN latency normalizer: a speculative step
+        # yields 1..k+1 tokens, so dividing by scan steps would let
+        # enabling spec-decode trip a false "degraded" (and, learned
+        # spec-first, mask a real one). Engines predating the counter
+        # fall back to raw steps (identical for non-speculative decode).
+        steps = float(
+            stats.get("decode_token_steps") or stats["decode_steps"]
+        )
         decode_time = stats["decode_time"]
         prefills = stats["prefill_calls"] + stats["warm_prefill_calls"]
         reason: Optional[str] = None

@@ -125,6 +125,38 @@ def test_cost_model_kernel_aware_paged_bytes():
     assert reference.prefill_bytes(10, offset=17) == 213632 + 24592 + 2560
 
 
+def test_cost_model_spec_verify_block_golden():
+    """Speculative verify billing (ISSUE 7): a block of 1 + spec_k
+    positions multiplies the matmul/attention FLOPs (plus the in-block
+    causal triangle) but streams the weights and KV prefix ONCE — the
+    bandwidth→FLOPs conversion speculation sells. Billing k tokens at
+    1-token bytes would overstate MBU ~k×; billing 1-token FLOPs would
+    understate MFU ~k×. Hand-computed on the tiny shape."""
+    from langstream_tpu.runtime.accounting import CostModel
+
+    model = CostModel.from_model_config(_tiny_config())
+    # block=4 (spec_k=3), 1 step, 3 active slots, summed context 300:
+    #   matmul       = 2*106816*3*4                  = 2563584
+    #   attention    = 4*(300*4 + 3*4*3/2)*4*16*2    = 4*1218*128 = 623616
+    #   (in-block causal triangle: active*block*(block-1)/2 = 18 extra
+    #   key positions across the 4-wide verify)
+    assert model.decode_chunk_flops(1, 3, 300, block=4) == 2563584 + 623616
+    #   bytes = weights ONCE + KV read ONCE + block rows written/slot
+    #         = 213632 + 256*300 + 256*3*4 = 293504
+    assert model.decode_chunk_bytes(1, 3, 300, block=4) == 293504
+    # block=1 degenerates to the plain decode shape exactly
+    assert model.decode_chunk_flops(2, 3, 300, block=1) == (
+        model.decode_chunk_flops(2, 3, 300)
+    )
+    assert model.decode_chunk_bytes(2, 3, 300, block=1) == (
+        model.decode_chunk_bytes(2, 3, 300)
+    )
+    # a verify block is FLOPs-denser per byte than k plain steps at
+    # equal tokens: same matmul FLOPs, ~1/k the weight traffic
+    plain_k = model.decode_chunk_bytes(4, 3, 300)
+    assert model.decode_chunk_bytes(1, 3, 300, block=4) < plain_k / 2
+
+
 def test_peak_specs_env_override(monkeypatch):
     from langstream_tpu.runtime import accounting
 

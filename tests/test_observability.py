@@ -45,6 +45,12 @@ def _sample_exposition() -> str:
         "jax_engine_tokens_useful_total": 960.0,
         'jax_engine_tokens_wasted_total{reason="cancelled"}': 48.0,
         'jax_engine_tokens_wasted_total{reason="evicted_recompute"}': 16.0,
+        # speculative decoding (ISSUE 7): drafted/accepted counters +
+        # acceptance rate, and rejected drafts as a wasted reason
+        'jax_engine_tokens_wasted_total{reason="draft_rejected"}': 24.0,
+        "spec_tokens_drafted_total": 96.0,
+        "spec_tokens_accepted_total": 72.0,
+        "spec_acceptance_rate": 0.75,
         "jax_engine_slo_ttft_p95_target_ms": 200.0,
         "jax_engine_slo_ttft_burn_rate_5m": 0.8,
         "jax_engine_slo_ttft_burn_rate_1h": 0.4,
@@ -68,8 +74,13 @@ def _sample_exposition() -> str:
             "jax_engine_goodput_ratio":
                 "useful tokens / all generated tokens",
             "jax_engine_tokens_wasted_total":
-                "tokens burned on cancelled requests or evicted-session"
-                " recompute, by reason",
+                "tokens burned on cancelled requests, evicted-session"
+                " recompute, or rejected speculative drafts, by reason",
+            "spec_tokens_drafted_total":
+                "speculative-decode candidate tokens proposed by the"
+                " prompt-lookup drafter",
+            "spec_acceptance_rate":
+                "fraction of drafted tokens the verify step accepted",
             "jax_engine_slo_ttft_burn_rate_5m":
                 "TTFT SLO burn rate over 5m (1.0 = consuming budget at"
                 " the allowed rate)",

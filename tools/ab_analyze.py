@@ -30,6 +30,7 @@ LEGS = {
     "bench_heal_admis.json": "admission-chunk 8",
     "bench_heal_paged.json": "paged KV, fused ragged kernel (--kv-layout paged)",
     "bench_heal_paged_ref.json": "paged KV, gather reference (--paged-kernel reference)",
+    "bench_heal_spec.json": "speculative decoding (--spec-decode ngram)",
 }
 
 
@@ -62,6 +63,13 @@ def describe(record: Dict[str, Any]) -> str:
     # the ROADMAP-item-1 paged-vs-dense gap is read off this pair
     if record.get("kv_layout") == "paged" and record.get("paged_kernel"):
         bits.append(f"kernel={record['paged_kernel']}")
+    # spec-decode column: which leg ran speculative decoding, plus its
+    # own acceptance evidence (the on-vs-off delta only means anything
+    # read next to the rate — a collapsed rate explains a flat delta)
+    if record.get("spec_decode") and record["spec_decode"] != "off":
+        bits.append(f"spec={record['spec_decode']}")
+        if record.get("spec_acceptance") is not None:
+            bits.append(f"accept {record['spec_acceptance'] * 100:.0f}%")
     if record.get("raw_engine_tok_s"):
         bits.append(f"raw {record['raw_engine_tok_s']:.0f}")
     if record.get("decode_ms_per_step"):
@@ -178,6 +186,35 @@ def flight_summary(art_dir: str) -> Optional[str]:
                 f"  goodput: {useful}/{total} tokens useful "
                 f"({useful / total:.1%}); wasted {wasted or 0}"
             )
+        # speculative decoding series: per-chunk drafted vs accepted
+        # candidates -> run acceptance rate + dispatches per generated
+        # token (the "fewer forwards per token" acceptance evidence)
+        drafted = sum(c.get("drafted", 0) for c in chunks)
+        if drafted:
+            accepted = sum(c.get("accepted", 0) for c in chunks)
+            # `tokens` is the engine-lifetime cumulative gauge, so a
+            # recording that starts mid-run (on-demand profiling) would
+            # understate dispatches-per-token if divided directly —
+            # align the windows instead: steps AFTER the first record
+            # over the token delta across the recorded span
+            if len(chunks) > 1:
+                total_steps = sum(c.get("steps", 0) for c in chunks[1:])
+                tokens = chunks[-1].get("tokens", 0) - chunks[0].get(
+                    "tokens", 0
+                )
+            else:
+                total_steps = sum(c.get("steps", 0) for c in chunks)
+                tokens = max((c.get("tokens", 0) for c in chunks), default=0)
+            line = (
+                f"  spec decode: {accepted}/{drafted} drafts accepted "
+                f"({accepted / drafted:.1%})"
+            )
+            if tokens and total_steps:
+                line += (
+                    f"; {total_steps / tokens:.2f} decode dispatches "
+                    "per generated token"
+                )
+            lines.append(line)
         # paged-KV series (kv_layout: paged): pool pressure + cumulative
         # prefix-cache hit tokens ride each decode_chunk record
         pool = [
@@ -312,6 +349,39 @@ def main() -> None:
                 "digest: the fused leg models ~1/3 the KV bytes, so "
                 "equal step time at lower MBU means the launch is "
                 "compute/grid-bound (raise kv-block-size)" + note
+            )
+    spec = records["bench_heal_spec.json"]
+    if usable(main_rec) and usable(spec):
+        # spec-on-vs-off pair at equal sampling semantics (greedy parity
+        # is test-enforced): the delta is throughput; the acceptance
+        # rate says whether a flat delta is a drafter miss (low rate —
+        # workload has no self-repetition) or verify overhead
+        delta = spec["value"] / main_rec["value"] - 1
+        note = caveat(main_rec, spec)
+        rate = spec.get("spec_acceptance")
+        rate_note = (
+            f" at {rate:.0%} draft acceptance" if rate is not None else ""
+        )
+        if delta > 0.03:
+            recommendations.append(
+                f"FLIP spec-decode default to ngram: {delta:+.1%} e2e "
+                f"({main_rec['value']:.0f} -> {spec['value']:.0f} tok/s)"
+                f"{rate_note}; set engine spec-decode default + "
+                "jax-completions globals" + note
+            )
+        elif rate is not None and rate < 0.2:
+            recommendations.append(
+                f"keep spec-decode off ({delta:+.1%}): acceptance "
+                f"collapsed to {rate:.0%} — this workload has no "
+                "self-repetition for the prompt-lookup drafter; re-test "
+                "on RAG/code traffic before judging the verify path"
+                + note
+            )
+        else:
+            recommendations.append(
+                f"keep spec-decode off ({delta:+.1%} not a win"
+                f"{rate_note}; verify-step overhead is not being "
+                "repaid — try a smaller --spec-k)" + note
             )
     admis = records["bench_heal_admis.json"]
     if usable(main_rec) and usable(admis):

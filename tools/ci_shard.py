@@ -30,6 +30,9 @@ SHARDS: Dict[str, List[str]] = {
         # SLO burn rates) constructs DecodeEngines — JAX-heavy shard
         "test_efficiency",
         "test_attention_kernels",
+        # speculative decoding (drafter/acceptance units + engine
+        # parity A/Bs) constructs DecodeEngines — JAX-heavy shard
+        "test_spec_decode",
         "test_paged_kernel",
         "test_paged_kv",
         "test_decode_kernel",

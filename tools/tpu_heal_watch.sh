@@ -145,6 +145,25 @@ y.block_until_ready()" 2>/dev/null
                     echo "$(date -u +%FT%TZ) paged-kernel A/B $kernel failed (non-fatal)" >> "$LOG"
                 fi
             done
+            # 2c) speculative-decoding A/B: self-drafting prompt-lookup
+            #    (ngram) vs the oracle scan (the main run is the OFF
+            #    leg — same traffic shape). Warm the spec jit graphs
+            #    first; read next to the acceptance rate ab_analyze
+            #    digests from the leg's flight records.
+            if BENCH_SPEC_DECODE=ngram BENCH_COMPILE_ONLY=1 \
+                BENCH_DEADLINE=3000 BENCH_INIT_TIMEOUT=600 \
+                python bench.py > /dev/null 2>> "$LOG"; then
+                :
+            else
+                echo "$(date -u +%FT%TZ) spec warm interrupted (entries kept)" >> "$LOG"
+            fi
+            if BENCH_SPEC_DECODE=ngram BENCH_DEADLINE=3600 \
+                BENCH_INIT_TIMEOUT=600 \
+                python bench.py > "${OUT%.json}_spec.json" 2>> "$LOG"; then
+                echo "$(date -u +%FT%TZ) spec-decode A/B done: $(cat "${OUT%.json}_spec.json")" >> "$LOG"
+            else
+                echo "$(date -u +%FT%TZ) spec-decode A/B failed (non-fatal)" >> "$LOG"
+            fi
             # 3) admission-chunk A/B: short chunks while admissions
             #    wait (TTFT/p50-RTT lever; compare p50_rtt_ms +
             #    p50_ttft_ms against the main run's at equal tok/s)
