@@ -139,14 +139,46 @@ if SPEC_DECODE not in ("off", "ngram"):
 SPEC_K = int(
     _cli_flag("spec-k") or os.environ.get("BENCH_SPEC_K", "") or "4"
 )
+# Tensor parallelism: chips in the engine's tp mesh (1 = single chip).
+# One flag for the multi-chip legs (--tp 2 / BENCH_TP=2): threaded into
+# the engine's mesh config (engine mode) and the e2e app's `tp` global,
+# and stamped on every artifact record so sharded legs stay
+# distinguishable from single-chip ones in ab_analyze's columns.
+TP = int(_cli_flag("tp") or os.environ.get("BENCH_TP", "") or "1")
+if TP < 1:
+    print(f"invalid --tp {TP} (must be >= 1)", file=sys.stderr)
+    sys.exit(2)
+
+
+def _mesh_config():
+    """Engine-mode mesh from --tp (None = single-device default, so a
+    tp=1 bench builds byte-identical jit graphs to a build without the
+    flag)."""
+    if TP <= 1:
+        return None
+    from langstream_tpu.parallel.mesh import MeshConfig
+
+    return MeshConfig(tp=TP)
+
+
+def per_chip(tok_s: float) -> float:
+    """Whole-replica throughput -> per-chip: every emitted metric is
+    named ``*_per_chip`` and vs_baseline compares against a per-chip
+    target, so a tp=N replica's tokens/sec must divide by its chip
+    count before emission (identity at tp=1). The roofline's MFU/MBU
+    already divide (CostModel.tp_shards); emitting replica tok/s under
+    a per-chip name would overstate tp legs by ~tp x."""
+    return tok_s / TP
 
 
 def _sync_effective_paged_kernel(engine) -> None:
     """Re-stamp PAGED_KERNEL from the engine's resolved kernel: a
-    requested ``fused`` can fall back to ``reference`` (off-TPU,
-    non-MXU-aligned head_dim, tp>1 — engine resolves the model gate at
-    init), and every artifact/roofline line after this point must name
-    the kernel that actually ran, not the one that was asked for."""
+    requested ``fused`` can fall back to ``reference`` (off-TPU sans
+    the interpret hook, non-MXU-aligned head_dim — engine resolves the
+    model gate at init; tp>1 is NOT a downgrade anymore, the kernel
+    runs per kv-head shard through shard_map), and every
+    artifact/roofline line after this point must name the kernel that
+    actually ran, not the one that was asked for."""
     global PAGED_KERNEL
     effective = getattr(engine, "paged_kernel", None)
     if effective and effective != PAGED_KERNEL:
@@ -284,6 +316,7 @@ def roofline(
     kv_layout: str = "dense",
     kv_block_size: int = 16,
     paged_kernel: str = "fused",
+    tp: int = 1,
 ) -> dict:
     """Decode-step roofline from the model shape: FLOPs (matmul 2·P per
     token + attention QK+AV per layer) and HBM bytes (weights once per
@@ -296,9 +329,13 @@ def roofline(
     round up to whole blocks, the fused ragged kernel streams them once
     (+ table words), and the gather/scatter reference pays the gather
     copy AND its re-read (3×) — so the per-leg artifact MBU stays
-    honest across ``--paged-kernel`` legs."""
+    honest across ``--paged-kernel`` legs. ``tp`` divides the sharded
+    per-chip work (weights, KV rows, head FLOPs) like
+    ``CostModel.tp_shards``; block tables stay whole — every shard
+    prefetches the full replicated table."""
     params = config.num_params()
-    weight_bytes = params * (1 if quant == "int8" else 2)
+    tp = max(1, int(tp))
+    weight_bytes = params * (1 if quant == "int8" else 2) / tp
     if kv_quant:
         # int8 values + one f32 scale per (layer, pos, kv_head) for k and v
         kv_row_bytes = 2 * config.num_layers * config.num_kv_heads * (
@@ -309,10 +346,12 @@ def roofline(
             2 * config.num_layers * config.num_kv_heads
             * config.dims_per_head * 2
         )  # k+v, bf16
-    flops_per_token = 2 * params + (
-        4 * mean_ctx * config.num_heads * config.dims_per_head
+    kv_row_bytes /= tp  # kv heads shard over tp
+    flops_per_token = (
+        2 * params
+        + 4 * mean_ctx * config.num_heads * config.dims_per_head
         * config.num_layers
-    )
+    ) / tp
     if kv_layout == "paged":
         blocks = -(-mean_ctx // kv_block_size)
         padded_ctx = blocks * kv_block_size
@@ -366,6 +405,7 @@ def emit_failure(reason: str) -> bool:
         kv_layout=KV_LAYOUT,
         paged_kernel=PAGED_KERNEL,
         spec_decode=SPEC_DECODE,
+        tp=TP,
         decode_kernel=os.environ.get("LS_DECODE_FLASH", "") or "auto",
     )
 
@@ -396,6 +436,7 @@ def emit_provisional(metric: str, tok_s: float, **extra) -> None:
         "kv_layout": KV_LAYOUT,
         "paged_kernel": PAGED_KERNEL,
         "spec_decode": SPEC_DECODE,
+        "tp": TP,
     }
     if _ATTEMPT > 1:
         line["attempt"] = _ATTEMPT
@@ -577,6 +618,7 @@ def run_compile_only() -> int:
         kv_quant=KV_QUANT,
         kv_layout=KV_LAYOUT,
         paged_kernel=PAGED_KERNEL,
+        mesh_config=_mesh_config(),
         pipeline_decode=PIPELINE,
     )
     variants = len(engine._variant_jobs())  # noqa: SLF001
@@ -832,6 +874,7 @@ async def run_bench():
         paged_kernel=PAGED_KERNEL,
         spec_decode=SPEC_DECODE,
         spec_k=SPEC_K,
+        mesh_config=_mesh_config(),
         pipeline_decode=PIPELINE,
     )
     _sync_effective_paged_kernel(engine)
@@ -865,12 +908,13 @@ async def run_bench():
         # measurement final: emit before teardown (engine.stop() can
         # hang on a dead tunnel; the number must not die with it)
         generated = sum(len(r.tokens) for r in results)
-        tok_s = generated / elapsed
+        tok_s = per_chip(generated / elapsed)
         emit_success(tok_s, {
             "kv_cache": KV_QUANT or "bf16",
             "kv_layout": KV_LAYOUT,
             "paged_kernel": PAGED_KERNEL,
             "spec_decode": SPEC_DECODE,
+            "tp": TP,
             "decode_kernel": os.environ.get("LS_DECODE_FLASH", "") or "auto",
         })
     finally:
@@ -887,7 +931,7 @@ async def run_bench():
     p50 = per_step_ms[len(per_step_ms) // 2]
     p95 = per_step_ms[min(len(per_step_ms) - 1, int(len(per_step_ms) * 0.95))]
     log(
-        f"{generated} tokens in {elapsed:.2f}s -> {tok_s:.1f} tok/s\n"
+        f"{generated} tokens in {elapsed:.2f}s -> {tok_s:.1f} tok/s/chip\n"
         f"  decode: {stats['decode_steps']} steps in "
         f"{stats['decode_chunks']} chunks, {stats['decode_time']:.2f}s "
         f"({stats['decode_time'] / steps * 1e3:.2f} ms/step avg, "
@@ -939,7 +983,7 @@ async def run_bench_e2e():
             "computeCluster": {"type": "local"},
             "globals": {
                 "model": MODEL_PRESET,
-                "tp": 1,
+                "tp": TP,
                 "max-slots": MAX_SLOTS,
                 "max-seq-len": max_seq,
                 "max-tokens": NEW_TOKENS,
@@ -1064,7 +1108,9 @@ async def _drive_e2e(runner, gateway, port, engine):
     if warm_stats.get("decode_time"):
         emit_provisional(
             f"raw_engine_decode_tok_per_s_per_chip_{metric_suffix()}",
-            warm_stats["tokens_generated"] / warm_stats["decode_time"],
+            per_chip(
+                warm_stats["tokens_generated"] / warm_stats["decode_time"]
+            ),
             kv_cache=KV_QUANT or "bf16",
             note="warmup-derived raw decode rate; e2e measurement follows",
         )
@@ -1085,7 +1131,7 @@ async def _drive_e2e(runner, gateway, port, engine):
             wall = time.perf_counter() - t0
             if seen and wall > 5:
                 emit_provisional(
-                    metric_name(), seen / wall,
+                    metric_name(), per_chip(seen / wall),
                     kv_cache=KV_QUANT or "bf16",
                     note=f"mid-measure estimate at t+{wall:.0f}s",
                 )
@@ -1105,10 +1151,10 @@ async def _drive_e2e(runner, gateway, port, engine):
     phase("e2e-emit")
 
     tokens = stats["tokens_generated"]
-    tok_s = tokens / elapsed
+    tok_s = per_chip(tokens / elapsed)
     steps = max(stats["decode_steps"], 1)
     decode_time = stats["decode_time"] or 1e-9
-    raw_tok_s = tokens / decode_time
+    raw_tok_s = per_chip(tokens / decode_time)
     occupancy = stats["active_slot_steps"] / (steps * MAX_SLOTS)
     p50_rtt = statistics.median(rtts) if rtts else 0.0
     sorted_rtts = sorted(rtts)
@@ -1141,14 +1187,15 @@ async def _drive_e2e(runner, gateway, port, engine):
         kv_layout=KV_LAYOUT,
         kv_block_size=engine.block_size if KV_LAYOUT == "paged" else 16,
         paged_kernel=PAGED_KERNEL,
+        tp=TP,
     )
     # weight-only int8 still contracts in bf16 — bf16 peak always
     mfu = steps_per_s * roof["flops_per_step"] / PEAK_FLOPS["bf16"]
     hbm_pct = steps_per_s * roof["bytes_per_step"] / (PEAK_HBM_GBS * 1e9)
     log(
         f"e2e: {tokens} tokens / {len(rtts)} requests in {elapsed:.2f}s "
-        f"-> {tok_s:.1f} tok/s at the gateway\n"
-        f"  raw engine decode capability: {raw_tok_s:.1f} tok/s "
+        f"-> {tok_s:.1f} tok/s/chip at the gateway\n"
+        f"  raw engine decode capability: {raw_tok_s:.1f} tok/s/chip "
         f"({decode_time / steps * 1e3:.2f} ms/step, "
         f"{occupancy * 100:.1f}% of {MAX_SLOTS} slots)\n"
         f"  prefill: {stats['prefill_calls']} cold + "
@@ -1173,6 +1220,7 @@ async def _drive_e2e(runner, gateway, port, engine):
         "kv_layout": KV_LAYOUT,
         "paged_kernel": PAGED_KERNEL,
         "spec_decode": SPEC_DECODE,
+        "tp": TP,
         "admission_chunk": ADMISSION_CHUNK,
         "decode_kernel": os.environ.get("LS_DECODE_FLASH", "") or "auto",
         "raw_engine_tok_s": round(raw_tok_s, 1),
@@ -1309,6 +1357,7 @@ def main():
             "kv_layout": KV_LAYOUT,
             "paged_kernel": PAGED_KERNEL,
             "spec_decode": SPEC_DECODE,
+            "tp": TP,
         }
         try:
             tok_s = asyncio.run(run_bench())

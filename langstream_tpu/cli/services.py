@@ -324,22 +324,17 @@ async def serve_main(args) -> None:
         config["quantization"] = args.quantization
     if args.tp and args.tp > 1:
         config["mesh"] = {"tp": args.tp}
-    if getattr(args, "kv_layout", "dense") == "paged" and (
-        getattr(args, "followers", 0) or getattr(args, "follower_of", None)
-    ):
-        # fail at configuration time, not on the first admitted request:
-        # the mirror protocol replays dense dispatch records only (the
-        # engine's _check_mirror_layout is the last-resort guard)
-        raise SystemExit(
-            "--kv-layout paged is not supported with multi-host "
-            "serving (--followers/--follower-of) yet; use dense"
-        )
+    # --kv-layout paged composes with multi-host serving: paged
+    # dispatch records carry their block-table rows and COW copies
+    # publish block_copy records, so followers replay the identical
+    # pool mutations on their shard (serving/mirror.py).
     if getattr(args, "spec_decode", "off") != "off" and (
         getattr(args, "followers", 0) or getattr(args, "follower_of", None)
     ):
-        # same configuration-time guard as paged: the mirror replays
-        # plain dispatch records; spec dispatches carry the device
-        # token-history operand (engine._check_mirror_layout backstops)
+        # configuration-time guard: the mirror replays fixed-width
+        # dispatch records; spec dispatches carry the device
+        # token-history operand and return variable-width outputs
+        # (engine._check_mirror_layout backstops)
         raise SystemExit(
             "--spec-decode is not supported with multi-host serving "
             "(--followers/--follower-of) yet"

@@ -376,12 +376,10 @@ def flash_decode_attention_sharded(
     if quantized:
         in_specs += [scale_spec, scale_spec]
         operands += [k_scale, v_scale]
-    return jax.shard_map(
-        local,
-        mesh=mesh,
-        in_specs=tuple(in_specs),
-        out_specs=head_spec,
-        check_vma=False,
+    from langstream_tpu.ops.flash_attention import compat_shard_map
+
+    return compat_shard_map(
+        local, mesh, tuple(in_specs), head_spec
     )(*operands)
 
 

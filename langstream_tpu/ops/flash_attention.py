@@ -41,6 +41,26 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
+def compat_shard_map(f, mesh, in_specs, out_specs):
+    """``shard_map`` across jax versions: ≥0.8 exposes ``jax.shard_map``
+    (replication checking via ``check_vma``); older releases only ship
+    ``jax.experimental.shard_map.shard_map`` (``check_rep``). Every
+    sharded kernel wrapper in ``ops/`` routes through here so a jax
+    downgrade can't silently strand the tp paths behind an
+    AttributeError."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=False,
+    )
+
+
 def _flash_kernel(
     lengths_ref,  # SMEM [1, 1] — valid length for this batch row
     window_ref,   # SMEM [1, 1] — sliding window (0 = full attention)
@@ -462,12 +482,8 @@ def flash_prefill_attention_sharded(
     if quantized:
         in_specs += [scale_spec, scale_spec]
         operands += [k_scale, v_scale]
-    return jax.shard_map(
-        local,
-        mesh=mesh,
-        in_specs=tuple(in_specs),
-        out_specs=head_spec,
-        check_vma=False,
+    return compat_shard_map(
+        local, mesh, tuple(in_specs), head_spec
     )(*operands)
 
 

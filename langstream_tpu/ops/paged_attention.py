@@ -43,7 +43,13 @@ One grid covers every ragged case the engine dispatches:
 
 The gather/scatter composition stays in ``ops/attention.py`` as the
 reference oracle (``paged_kernel: reference``); ``interpret=True`` runs
-this kernel on CPU so tier-1 parity stays CPU-verifiable.
+this kernel on CPU so tier-1 parity stays CPU-verifiable. Under tensor
+parallelism the kernel dispatches through
+:func:`ragged_paged_attention_sharded` — one independent launch per
+kv-head shard via ``shard_map`` (a bare Mosaic call has no SPMD
+partitioning rule), tables/starts/lengths replicated, the pool split on
+its kv-head axis — the same twin pattern ``flash_attention.py`` /
+``decode_kernel.py`` use.
 """
 
 from __future__ import annotations
@@ -364,6 +370,99 @@ def ragged_paged_attention_quant(
     :func:`langstream_tpu.ops.attention.paged_chunk_attention_quant`."""
     return ragged_paged_attention(
         q, k_pool, v_pool, block_tables, starts, lengths,
+        k_scale=k_scale, v_scale=v_scale, **kwargs,
+    )
+
+
+def ragged_paged_attention_sharded(
+    q: jnp.ndarray,             # [B, Tq, H, D] — H sharded over ``axis_name``
+    k_pool: jnp.ndarray,        # [N, Bs, KVH, D] — KVH sharded
+    v_pool: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, M] (replicated host metadata)
+    starts: jnp.ndarray,        # [B]
+    lengths: jnp.ndarray,       # [B]
+    mesh,
+    *,
+    k_scale: Optional[jnp.ndarray] = None,  # [N, Bs, KVH] — int8 pools
+    v_scale: Optional[jnp.ndarray] = None,
+    softcap: Optional[float] = None,
+    window: Optional[jnp.ndarray] = None,
+    scale: Optional[float] = None,
+    block_q: Optional[int] = None,
+    axis_name: str = "tp",
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Fused ragged paged attention under tensor parallelism — the paged
+    twin of ``flash_prefill_attention_sharded`` /
+    ``flash_decode_attention_sharded``.
+
+    A Mosaic ``pallas_call`` has no SPMD partitioning rule, so the kernel
+    cannot sit inside a tp-sharded jit directly; ``shard_map`` over the
+    kv-head axis runs one independent launch per shard. Attention never
+    mixes kv heads, so no collective is needed: each shard's kernel sees
+    a contiguous local head slab of the pool (the layout
+    ``model.paged_cache_logical_axes`` pins — kv_heads shard, pool blocks
+    never do), the q/output head axis splits by the same tp factor
+    (``validate_mesh`` enforces divisibility, so the GQA group size is
+    shard-invariant and the per-kv-head MXU loop runs over the local
+    shard only). Block tables, starts, lengths, and the (traced)
+    ``window`` scalar are replicated operands — the same host metadata
+    every shard prefetches in full. With ``k_scale``/``v_scale`` the
+    int8-pool kernel runs per shard, scales sharded over their kv-head
+    axis."""
+    from jax.sharding import PartitionSpec as P
+
+    head_spec = P(None, None, axis_name, None)   # q / out [B, Tq, H, D]
+    pool_spec = P(None, None, axis_name, None)   # [N, Bs, KVH, D]
+    scale_spec = P(None, None, axis_name)        # [N, Bs, KVH]
+    quantized = k_scale is not None
+    window_arr = jnp.asarray(
+        0 if window is None else window, dtype=jnp.int32
+    )
+
+    def local(q_l, k_l, v_l, tables_l, starts_l, totals_l, window_l,
+              *scales):
+        return ragged_paged_attention(
+            q_l, k_l, v_l, tables_l, starts_l, totals_l,
+            interpret=interpret, softcap=softcap, window=window_l,
+            scale=scale, block_q=block_q,
+            **(
+                {"k_scale": scales[0], "v_scale": scales[1]}
+                if scales else {}
+            ),
+        )
+
+    in_specs = [
+        head_spec, pool_spec, pool_spec,
+        P(None, None), P(None), P(None), P(),
+    ]
+    operands = [q, k_pool, v_pool, block_tables, starts, lengths, window_arr]
+    if quantized:
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scale, v_scale]
+    from langstream_tpu.ops.flash_attention import compat_shard_map
+
+    return compat_shard_map(
+        local, mesh, tuple(in_specs), head_spec
+    )(*operands)
+
+
+def ragged_paged_attention_quant_sharded(
+    q: jnp.ndarray,
+    k_pool: jnp.ndarray,     # [N, Bs, KVH, D] int8
+    k_scale: jnp.ndarray,    # [N, Bs, KVH] f32
+    v_pool: jnp.ndarray,
+    v_scale: jnp.ndarray,
+    block_tables: jnp.ndarray,
+    starts: jnp.ndarray,
+    lengths: jnp.ndarray,
+    mesh,
+    **kwargs,
+) -> jnp.ndarray:
+    """Int8-pool twin of :func:`ragged_paged_attention_sharded` — thin
+    argument-ordering wrapper."""
+    return ragged_paged_attention_sharded(
+        q, k_pool, v_pool, block_tables, starts, lengths, mesh,
         k_scale=k_scale, v_scale=v_scale, **kwargs,
     )
 

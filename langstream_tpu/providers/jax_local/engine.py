@@ -461,13 +461,16 @@ class DecodeEngine:
         self.paged = kv_layout == "paged"
         # fused-vs-reference is the ROADMAP-item-1 A/B knob: "fused"
         # REQUESTS the ragged Pallas kernel; model._use_fused_paged falls
-        # back to the reference composition off-TPU / on non-MXU-aligned
-        # head dims / under tp>1, so the knob is safe to leave at its
-        # default everywhere. That gate is static per engine (config
-        # shapes, interpret hook, backend, mesh), so resolve it ONCE
-        # here and let accounting, flight/artifact telemetry, and the
-        # dispatch builders all see the kernel that actually runs — a
-        # silent fused→reference fallback must not leave the byte model
+        # back to the reference composition off-TPU (sans the interpret
+        # hook) / on non-MXU-aligned head dims, so the knob is safe to
+        # leave at its default everywhere. tp>1 is NOT a downgrade: the
+        # kernel runs per kv-head shard through its shard_map twin
+        # (ragged_paged_attention_sharded), like the dense flash
+        # kernels. That gate is static per engine (config shapes,
+        # interpret hook, backend), so resolve it ONCE here and let
+        # accounting, flight/artifact telemetry, and the dispatch
+        # builders all see the kernel that actually runs — a silent
+        # fused→reference fallback must not leave the byte model
         # charging fused bytes (MBU would read ~3x low).
         self.paged_kernel_requested = paged_kernel if self.paged else None
         self.paged_kernel = self.paged_kernel_requested
@@ -528,6 +531,10 @@ class DecodeEngine:
                     ),
                     cache_sharding,
                 )
+            # the jitted COW block copy pins its outputs to this layout
+            # so the SPMD partitioner can never resolve the dynamic
+            # block index by all-gathering the pool (see _get_block_copy)
+            self._cache_sharding = cache_sharding
         else:
             cache_sharding = param_shardings(
                 model_lib.cache_logical_axes(self.kv_quant), self.mesh
@@ -553,6 +560,11 @@ class DecodeEngine:
             kv_quant=self.kv_quant,
             kv_block_size=self.block_size if self.paged else 1,
             paged_kernel=self.paged_kernel,
+            # per-CHIP accounting under tensor parallelism: weights and
+            # KV shard over tp, so a chip's share of the work divides —
+            # billing whole-model FLOPs/bytes per chip would overstate
+            # MFU/MBU by ~tp× on sharded engines
+            tp=dict(self.mesh.shape).get("tp", 1),
         )
         # SLO burn-rate tracking over the process-wide TTFT/TPOT
         # histograms (targets come from serve/provider config)
@@ -570,10 +582,17 @@ class DecodeEngine:
         self._seed_sequence = 0
         # per-slot generated-token counts for presence/frequency
         # penalties; lives on device, threaded (donated) through every
-        # prefill/decode dispatch like the KV cache
+        # prefill/decode dispatch like the KV cache. Explicitly
+        # replicated over the mesh: on tp>1 an unplaced buffer would sit
+        # on device 0 only, and lowering engine variants from live avals
+        # (precompile, the StableHLO assertion tests) would see
+        # incompatible device sets before the first dispatch resolves it
+        from jax.sharding import NamedSharding, PartitionSpec
+
         with self.mesh:
-            self._counts = jnp.zeros(
-                (max_slots, config.vocab_size), jnp.int32
+            self._counts = jax.device_put(
+                jnp.zeros((max_slots, config.vocab_size), jnp.int32),
+                NamedSharding(self.mesh, PartitionSpec()),
             )
 
         self._queue: "queue.Queue[Optional[GenerationRequest]]" = queue.Queue()
@@ -1096,15 +1115,20 @@ class DecodeEngine:
         mid-block gets a private copy of the boundary block before its
         suffix prefill overwrites rows a published chain still needs.
         ``params`` is unused; it keeps the uniform (params, cache, ...)
-        dispatch shape (see :meth:`_get_copy_prefix`)."""
+        dispatch shape (see :meth:`_get_copy_prefix`). Outputs carry the
+        pool's sharding constraint: the copied block index is dynamic
+        and the block axis replicated, so without the pin the SPMD
+        partitioner may resolve the slice by all-gathering the
+        kv-head-sharded pool under tp>1."""
         fn = self._block_copy_fn
         if fn is None:
+            sharding = self._cache_sharding
 
             @functools.partial(jax.jit, donate_argnums=(1,))
             def run(params, cache, src, dst):
                 del params
 
-                def move(c):
+                def move(c, s):
                     # [layers, num_blocks, block_size, ...] — value AND
                     # scale leaves share the leading three axes
                     tail = (0,) * (c.ndim - 2)
@@ -1112,17 +1136,28 @@ class DecodeEngine:
                         c, (0, src) + tail,
                         (c.shape[0], 1) + c.shape[2:],
                     )
-                    return jax.lax.dynamic_update_slice(
-                        c, chunk, (0, dst) + tail
+                    return jax.lax.with_sharding_constraint(
+                        jax.lax.dynamic_update_slice(
+                            c, chunk, (0, dst) + tail
+                        ),
+                        s,
                     )
 
-                return (jax.tree_util.tree_map(move, cache),)
+                return (jax.tree_util.tree_map(move, cache, sharding),)
 
             fn = run
             self._block_copy_fn = fn
         return fn
 
     def _dispatch_block_copy(self, src: int, dst: int) -> None:
+        if self.mirror is not None:
+            # COW is a device dispatch: followers must duplicate the
+            # same pool block on their shard, in stream order, or every
+            # later read of the private copy diverges
+            self._check_mirror_layout()
+            self.mirror.publish(
+                "block_copy", {}, [np.int32(src), np.int32(dst)]
+            )
         run = self._get_block_copy()
         (self.cache,) = run(
             self.params, self.cache, np.int32(src), np.int32(dst)
@@ -2248,12 +2283,19 @@ class DecodeEngine:
                 tokens, lengths, slot_ids,
                 temperature, top_k, top_p, seeds, bias_ids, bias_vals,
             ]
-            if self.mirror is not None:
-                self._check_mirror_layout()
-                self.mirror.publish("prefill", {"bucket": bucket}, host_args)
             paged_args = (
                 (self._block_tables[slot_ids],) if self.paged else ()
             )
+            if self.mirror is not None:
+                self._check_mirror_layout()
+                # paged dispatches ship their block-table rows in
+                # dispatch-arg position (small int32 host metadata — no
+                # D2H of pool data); the follower's replay rebuilds the
+                # exact argument tuple from engine.paged
+                self.mirror.publish(
+                    "prefill", {"bucket": bucket},
+                    [*host_args[:3], *paged_args, *host_args[3:]],
+                )
             self.cache, self._counts, sampled, lps, tops = run(
                 self.params, self.cache, *host_args[:3], *paged_args,
                 self._counts, *host_args[3:],
@@ -2323,14 +2365,15 @@ class DecodeEngine:
                 tokens, lengths, offsets, slot_ids,
                 temperature, top_k, top_p, seeds, bias_ids, bias_vals,
             ]
-            if self.mirror is not None:
-                self._check_mirror_layout()
-                self.mirror.publish(
-                    "prefill_offset", {"bucket": bucket}, host_args
-                )
             paged_args = (
                 (self._block_tables[slot_ids],) if self.paged else ()
             )
+            if self.mirror is not None:
+                self._check_mirror_layout()
+                self.mirror.publish(
+                    "prefill_offset", {"bucket": bucket},
+                    [*host_args[:4], *paged_args, *host_args[4:]],
+                )
             self.cache, self._counts, sampled, lps, tops = run(
                 self.params, self.cache, *host_args[:4], *paged_args,
                 self._counts, *host_args[4:],
@@ -2404,14 +2447,15 @@ class DecodeEngine:
                 tokens, lengths, offsets, slot_ids,
                 temperature, top_k, top_p, seeds, bias_ids, bias_vals,
             ]
-            if self.mirror is not None:
-                self._check_mirror_layout()
-                self.mirror.publish(
-                    "prefill_offset", {"bucket": bucket}, host_args
-                )
             paged_args = (
                 (self._block_tables[slot_ids],) if self.paged else ()
             )
+            if self.mirror is not None:
+                self._check_mirror_layout()
+                self.mirror.publish(
+                    "prefill_offset", {"bucket": bucket},
+                    [*host_args[:4], *paged_args, *host_args[4:]],
+                )
             self.cache, self._counts, sampled, lps, tops = run(
                 self.params, self.cache, *host_args[:4], *paged_args,
                 self._counts, *host_args[4:],
@@ -2439,13 +2483,13 @@ class DecodeEngine:
         )
 
     def _check_mirror_layout(self) -> None:
-        """The multi-host mirror replays dense dispatch records; paged
-        dispatches carry block tables the follower protocol does not
-        speak yet. Fail loudly instead of silently diverging shards."""
-        if self.paged:
-            raise NotImplementedError(
-                "multi-host mirror does not support kv_layout=paged yet"
-            )
+        """Engine features the follower replay protocol cannot speak
+        yet. Paged IS spoken: dispatch records carry the block-table
+        rows (host-local int32 metadata) and COW block copies publish
+        their own ``block_copy`` records, so a follower replays the
+        identical device-side pool mutations without running the block
+        allocator itself. Fail loudly on the rest instead of silently
+        diverging shards."""
         if self.spec:
             # spec dispatches carry the device token-history operand and
             # return variable-width outputs the follower replay protocol
@@ -2636,8 +2680,15 @@ class DecodeEngine:
             presence, frequency = self._penalty_arrays(self.slots)
             if self.mirror is not None:
                 self._check_mirror_layout()
+                # paged: the full [S, M] tables ride the record (they
+                # are the dispatch's 7th argument); chained chunks carry
+                # nothing — followers reuse the tables from their carry,
+                # exactly like the leader's device-resident carry
+                table_args = (
+                    (self._block_tables,) if self.paged else ()
+                )
                 self.mirror.publish("decode", {"steps": steps}, [
-                    tokens, lengths, active,
+                    tokens, lengths, active, *table_args,
                     temperature, top_k, top_p, presence, frequency,
                     seeds_host, bias_ids, bias_vals,
                 ])

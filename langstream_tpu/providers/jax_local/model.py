@@ -785,17 +785,40 @@ def _use_fused_paged(config, dim, heads, kv_heads, mesh):
     """Gate for the fused ragged paged-attention kernel
     (``ops/paged_attention.py``) — the paged twin of
     :func:`_flash_path` / :func:`_decode_flash_path`. Under tensor
-    parallelism the fused kernel stays off: a bare Mosaic call has no
-    SPMD partitioning rule, and the shard_map wrapper is the multi-chip
-    arc (ROADMAP item 3); the gather/scatter reference partitions fine
-    under XLA."""
+    parallelism the kernel dispatches through its shard_map twin
+    (``ragged_paged_attention_sharded`` — one launch per kv-head shard,
+    exactly like the dense flash kernels), so the gate is mesh-blind:
+    only shapes (GQA divisibility, MXU head_dim alignment) and backend
+    (TPU, or the interpret test hook) decide. ``mesh`` stays a
+    parameter so the gate signature keeps matching the dispatch seams
+    that pass it."""
+    del mesh  # tp no longer downgrades — the sharded twin handles it
     from langstream_tpu.ops.paged_attention import use_fused_paged
 
-    tp_sharded = mesh is not None and dict(mesh.shape).get("tp", 1) > 1
-    if tp_sharded:
-        return False
     return config.use_flash and use_fused_paged(
         dim, heads, kv_heads, interpret=config.flash_interpret
+    )
+
+
+def _constrain_kv_shard(pool, mesh, *, scale: bool = False):
+    """Pin a (possibly layer-stacked) KV pool leaf to its kv-head shard
+    under tensor parallelism. Every jitted paged WRITE
+    (``paged_write_rows`` scatter) routes its result through here: the
+    scatter indexes the replicated block axis, and without an explicit
+    constraint the SPMD partitioner is free to resolve it by
+    all-gathering the pool — which would silently turn the paged layout
+    into tp× HBM. The kv-head axis sits last on scale leaves
+    ([..., N, Bs, KVH]) and second-to-last on value leaves
+    ([..., N, Bs, KVH, D]). No-op off-mesh and at tp=1 (matching
+    ``paged_cache_logical_axes``, whose tp-sized rule this mirrors)."""
+    if mesh is None or dict(mesh.shape).get("tp", 1) <= 1:
+        return pool
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    axes = [None] * pool.ndim
+    axes[pool.ndim - (1 if scale else 2)] = "tp"
+    return jax.lax.with_sharding_constraint(
+        pool, NamedSharding(mesh, PartitionSpec(*axes))
     )
 
 
@@ -805,7 +828,9 @@ def _paged_attn(config, q, k_pool, v_pool, tables, starts, totals, *,
     decode (q [S, H, D], starts = lengths-1), prefill-at-offset and cold
     paged prefill (q [B, T, H, D]). ``kernel == "fused"`` (and shapes /
     backend permitting — see :func:`_use_fused_paged`) runs the single
-    fused Pallas launch that streams table-addressed pool blocks; the
+    fused Pallas launch that streams table-addressed pool blocks; under
+    tp>1 that launch runs per kv-head shard through the shard_map twin
+    (a bare Mosaic call has no SPMD partitioning rule). The
     gather/scatter composition in ``ops/attention.py`` stays as the
     reference oracle."""
     family = dict(
@@ -818,12 +843,23 @@ def _paged_attn(config, q, k_pool, v_pool, tables, starts, totals, *,
     if kernel == "fused" and _use_fused_paged(
         config, dim, heads, kv_heads, mesh
     ):
-        from langstream_tpu.ops.paged_attention import ragged_paged_attention
-
-        out = ragged_paged_attention(
-            q[:, None] if decode else q, k_pool, v_pool, tables,
-            starts, totals, interpret=config.flash_interpret, **family,
+        from langstream_tpu.ops.paged_attention import (
+            ragged_paged_attention,
+            ragged_paged_attention_sharded,
         )
+
+        tp_sharded = mesh is not None and dict(mesh.shape).get("tp", 1) > 1
+        q_in = q[:, None] if decode else q
+        if tp_sharded:
+            out = ragged_paged_attention_sharded(
+                q_in, k_pool, v_pool, tables, starts, totals, mesh,
+                interpret=config.flash_interpret, **family,
+            )
+        else:
+            out = ragged_paged_attention(
+                q_in, k_pool, v_pool, tables, starts, totals,
+                interpret=config.flash_interpret, **family,
+            )
         return out[:, 0] if decode else out
     if decode:
         return paged_decode_attention(
@@ -850,13 +886,23 @@ def _paged_attn_quant(config, q, k_pool, k_scale, v_pool, v_scale, tables,
     ):
         from langstream_tpu.ops.paged_attention import (
             ragged_paged_attention_quant,
+            ragged_paged_attention_quant_sharded,
         )
 
-        out = ragged_paged_attention_quant(
-            q[:, None] if decode else q, k_pool, k_scale, v_pool, v_scale,
-            tables, starts, totals, interpret=config.flash_interpret,
-            **family,
-        )
+        tp_sharded = mesh is not None and dict(mesh.shape).get("tp", 1) > 1
+        q_in = q[:, None] if decode else q
+        if tp_sharded:
+            out = ragged_paged_attention_quant_sharded(
+                q_in, k_pool, k_scale, v_pool, v_scale,
+                tables, starts, totals, mesh,
+                interpret=config.flash_interpret, **family,
+            )
+        else:
+            out = ragged_paged_attention_quant(
+                q_in, k_pool, k_scale, v_pool, v_scale,
+                tables, starts, totals, interpret=config.flash_interpret,
+                **family,
+            )
         return out[:, 0] if decode else out
     if decode:
         return paged_decode_attention_quant(
@@ -1152,18 +1198,23 @@ def paged_prefill(
     valid = jnp.arange(seq)[None, :] < lengths[:, None]
     zeros = jnp.zeros((batch,), jnp.int32)
 
-    def write(pool, new):
-        return paged_write_rows(pool, new, block_tables, zeros, valid)
+    def write(pool, new, scale=False):
+        return _constrain_kv_shard(
+            jax.vmap(
+                lambda p, n: paged_write_rows(p, n, block_tables, zeros, valid)
+            )(pool, new),
+            mesh, scale=scale,
+        )
 
     out = dict(cache)
     if quantized:
         new_k, new_v, k_scale, v_scale = layer_kv
-        out["k_scale"] = jax.vmap(write)(cache["k_scale"], k_scale)
-        out["v_scale"] = jax.vmap(write)(cache["v_scale"], v_scale)
+        out["k_scale"] = write(cache["k_scale"], k_scale, scale=True)
+        out["v_scale"] = write(cache["v_scale"], v_scale, scale=True)
     else:
         new_k, new_v = layer_kv
-    out["k"] = jax.vmap(write)(cache["k"], new_k)
-    out["v"] = jax.vmap(write)(cache["v"], new_v)
+    out["k"] = write(cache["k"], new_k)
+    out["v"] = write(cache["v"], new_v)
     return out, _last_token_logits(config, params, x, lengths)
 
 
@@ -1176,8 +1227,8 @@ def paged_prefill_at_offset(
     offsets: jnp.ndarray,            # [B] existing valid length per row
     block_tables: jnp.ndarray,       # [B, M]
     freqs: jnp.ndarray,
-    mesh=None,                       # tp mesh (fused kernel gates off
-                                     # under tp>1 — see _use_fused_paged)
+    mesh=None,                       # tp mesh (fused kernel runs per
+                                     # kv-head shard via shard_map)
     kernel: str = "fused",           # paged attention: fused | reference
 ) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
     """Paged twin of :func:`prefill_at_offset`: suffix KV scatters into
@@ -1201,6 +1252,12 @@ def paged_prefill_at_offset(
     windows = layer_windows(config)
     quantized = "k_scale" in cache
 
+    def write(pool, new, scale=False):
+        return _constrain_kv_shard(
+            paged_write_rows(pool, new, block_tables, offsets, mask),
+            mesh, scale=scale,
+        )
+
     def layer_fn(carry, inputs):
         x = carry
         if quantized:
@@ -1219,18 +1276,18 @@ def paged_prefill_at_offset(
         if quantized:
             k_q, k_s = quantize_kv(k)
             v_q, v_s = quantize_kv(v)
-            kp = paged_write_rows(kp, k_q, block_tables, offsets, mask)
-            ks = paged_write_rows(ks, k_s, block_tables, offsets, mask)
-            vp = paged_write_rows(vp, v_q, block_tables, offsets, mask)
-            vs = paged_write_rows(vs, v_s, block_tables, offsets, mask)
+            kp = write(kp, k_q)
+            ks = write(ks, k_s, scale=True)
+            vp = write(vp, v_q)
+            vs = write(vs, v_s, scale=True)
             attn = _paged_attn_quant(
                 config, q, kp, ks, vp, vs, block_tables, offsets, totals,
                 window=win, kernel=kernel, mesh=mesh,
             )
             kv_out = (kp, vp, ks, vs)
         else:
-            kp = paged_write_rows(kp, k, block_tables, offsets, mask)
-            vp = paged_write_rows(vp, v, block_tables, offsets, mask)
+            kp = write(kp, k)
+            vp = write(vp, v)
             attn = _paged_attn(
                 config, q, kp, vp, block_tables, offsets, totals,
                 window=win, kernel=kernel, mesh=mesh,
@@ -1272,8 +1329,8 @@ def paged_decode_step(
     block_tables: jnp.ndarray,       # [S, M]
     freqs: jnp.ndarray,
     write_mask: Optional[jnp.ndarray] = None,  # [S] bool
-    mesh=None,                       # tp mesh (fused kernel gates off
-                                     # under tp>1 — see _use_fused_paged)
+    mesh=None,                       # tp mesh (fused kernel runs per
+                                     # kv-head shard via shard_map)
     kernel: str = "fused",           # paged attention: fused | reference
 ) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
     """Paged twin of :func:`decode_step`: the new token's KV scatters
@@ -1295,10 +1352,13 @@ def paged_decode_step(
     windows = layer_windows(config)
     quantized = "k_scale" in cache
 
-    def write(pool, new):
-        return paged_write_rows(
-            pool, new[:, None], block_tables, positions,
-            write_mask[:, None],
+    def write(pool, new, scale=False):
+        return _constrain_kv_shard(
+            paged_write_rows(
+                pool, new[:, None], block_tables, positions,
+                write_mask[:, None],
+            ),
+            mesh, scale=scale,
         )
 
     def layer_fn(carry, inputs):
@@ -1319,8 +1379,8 @@ def paged_decode_step(
         if quantized:
             k_q, k_s = quantize_kv(k)
             v_q, v_s = quantize_kv(v)
-            kp, ks = write(kp, k_q), write(ks, k_s)
-            vp, vs = write(vp, v_q), write(vs, v_s)
+            kp, ks = write(kp, k_q), write(ks, k_s, scale=True)
+            vp, vs = write(vp, v_q), write(vs, v_s, scale=True)
             attn = _paged_attn_quant(
                 config, q, kp, ks, vp, vs, block_tables, positions,
                 lengths, window=win, kernel=kernel, mesh=mesh,
@@ -1616,6 +1676,12 @@ def paged_verify_step(
     windows = layer_windows(config)
     quantized = "k_scale" in cache
 
+    def write(pool, new, scale=False):
+        return _constrain_kv_shard(
+            paged_write_rows(pool, new, block_tables, offsets, wmask),
+            mesh, scale=scale,
+        )
+
     def layer_fn(carry, inputs):
         x = carry
         if quantized:
@@ -1634,18 +1700,18 @@ def paged_verify_step(
         if quantized:
             k_q, k_s = quantize_kv(k)
             v_q, v_s = quantize_kv(v)
-            kp = paged_write_rows(kp, k_q, block_tables, offsets, wmask)
-            ks = paged_write_rows(ks, k_s, block_tables, offsets, wmask)
-            vp = paged_write_rows(vp, v_q, block_tables, offsets, wmask)
-            vs = paged_write_rows(vs, v_s, block_tables, offsets, wmask)
+            kp = write(kp, k_q)
+            ks = write(ks, k_s, scale=True)
+            vp = write(vp, v_q)
+            vs = write(vs, v_s, scale=True)
             attn = _paged_attn_quant(
                 config, q, kp, ks, vp, vs, block_tables, offsets, totals,
                 window=win, kernel=kernel, mesh=mesh,
             )
             kv_out = (kp, vp, ks, vs)
         else:
-            kp = paged_write_rows(kp, k, block_tables, offsets, wmask)
-            vp = paged_write_rows(vp, v, block_tables, offsets, wmask)
+            kp = write(kp, k)
+            vp = write(vp, v)
             attn = _paged_attn(
                 config, q, kp, vp, block_tables, offsets, totals,
                 window=win, kernel=kernel, mesh=mesh,

@@ -70,9 +70,11 @@ class CostModel:
     accessor is a handful of integer multiplies, cheap enough to run on
     the engine thread per dispatch.
 
-    Conventions (all counts are per chip, before any TP division —
-    utilization against the single-chip peak is what the bench reports
-    and what A/B legs compare):
+    Conventions (all counts are per CHIP — utilization against the
+    single-chip peak is what the bench reports and what A/B legs
+    compare; under tensor parallelism ``tp_shards`` divides the sharded
+    work so a tp=2 engine is not billed whole-model FLOPs/bytes per
+    chip, which would overstate MFU/MBU by ~tp×):
 
     - matmul FLOPs: ``2 * params`` per token (multiply+add), the
       standard serving approximation (embedding lookups excluded).
@@ -95,6 +97,15 @@ class CostModel:
     - weight-only int8 halves weight bytes (per-channel scales are
       <1% and excluded); int8 KV stores int8 values + one f32 scale per
       (layer, position, kv_head) for each of k and v.
+    - tensor parallelism (``tp_shards`` > 1): weights shard over tp
+      (heads/mlp/vocab rules — the whole parameter set to the serving
+      approximation), the KV cache shards on its kv-head axis, and the
+      query-head FLOPs split the same way, so weight bytes, KV
+      row bytes, and every FLOPs accessor divide by ``tp_shards``.
+      Block tables do NOT divide: they are replicated scalar-prefetch
+      operands — every shard's kernel reads the full table — so the
+      per-chip table words stay whole. Activations are replicated per
+      chip (and excluded from the byte model like in the dense case).
     """
 
     params: int
@@ -102,12 +113,15 @@ class CostModel:
     num_heads: int
     num_kv_heads: int
     head_dim: int
-    weight_bytes: int
-    kv_row_bytes: int      # bytes per token of KV history, all layers
+    weight_bytes: int      # per chip (already divided by tp_shards)
+    kv_row_bytes: int      # per chip, per token of KV history, all layers
     kv_block_size: int = 1  # paged read granularity (1 = dense)
     # paged attention kernel the engine dispatches: "fused" | "reference"
     # (None = dense layout — no table indirection to charge for)
     paged_kernel: Optional[str] = None
+    # tensor-parallel shard count: FLOPs accessors divide by this
+    # (weight/KV BYTES are divided once at construction)
+    tp_shards: int = 1
 
     @classmethod
     def from_model_config(
@@ -118,9 +132,11 @@ class CostModel:
         kv_quant: bool = False,
         kv_block_size: int = 1,
         paged_kernel: Optional[str] = None,
+        tp: int = 1,
     ) -> "CostModel":
         params = config.num_params()
         head_dim = config.dims_per_head
+        tp = max(1, int(tp))
         if kv_quant:
             # int8 values + one f32 scale per (layer, pos, kv_head) for
             # each of k and v
@@ -137,10 +153,11 @@ class CostModel:
             num_heads=config.num_heads,
             num_kv_heads=config.num_kv_heads,
             head_dim=head_dim,
-            weight_bytes=params * (1 if weight_quant == "int8" else 2),
-            kv_row_bytes=kv_row_bytes,
+            weight_bytes=params * (1 if weight_quant == "int8" else 2) // tp,
+            kv_row_bytes=kv_row_bytes // tp,
             kv_block_size=max(1, int(kv_block_size)),
             paged_kernel=paged_kernel,
+            tp_shards=tp,
         )
 
     # ------------------------------------------------------------------ #
@@ -195,7 +212,9 @@ class CostModel:
             + 4.0 * (kv_tokens * block + in_block)
             * self.num_heads * self.head_dim * self.num_layers
         )
-        return per_step * steps
+        # per-chip under tp: matmul params and query heads both shard,
+        # so the whole per-step FLOPs count divides by the shard count
+        return per_step * steps / self.tp_shards
 
     def decode_chunk_bytes(
         self, steps: int, active: int, kv_tokens: int, block: int = 1
@@ -233,7 +252,7 @@ class CostModel:
             2.0 * self.params * new_tokens
             + 4.0 * positions_sum * self.num_heads * self.head_dim
             * self.num_layers
-        )
+        ) / self.tp_shards  # per chip: params and heads shard over tp
 
     def prefill_bytes(self, new_tokens: int, offset: int = 0) -> float:
         """HBM bytes for a prefill dispatch: weights once + kernel-aware

@@ -24,6 +24,13 @@ at >8-chip scale):
   ``decode_chained`` record carries no arrays — the follower chains
   from its OWN previous decode outputs, which hold identical values by
   SPMD determinism.
+- ``kv_layout: paged`` replays too: paged dispatch records carry each
+  row's block-table slice (small int32 host metadata — pool data never
+  crosses the wire), and copy-on-write block copies publish their own
+  ``block_copy`` records, so the follower applies the identical pool
+  mutations to its kv-head shard without running the block
+  allocator/prefix-cache/LRU bookkeeping itself — those are host-0
+  decisions already baked into the tables it receives.
 
 Transport is a length-prefixed JSON-header + raw-array-bytes frame
 stream over TCP (deliberately NOT pickle — nothing executable crosses
@@ -272,8 +279,9 @@ class FollowerExecutor:
         self.engine = engine
         self._sock: Optional[socket.socket] = None
         # previous decode output, for chained chunks:
-        # (final_tokens, final_lengths, active_arg, sampling_arrays)
-        self._carry: Optional[Tuple[Any, Any, Any, tuple]] = None
+        # (final_tokens, final_lengths, active_arg, tables, sampling)
+        # — tables is None on dense engines
+        self._carry: Optional[Tuple[Any, Any, Any, Any, tuple]] = None
         self.records = 0
 
     def connect(
@@ -307,6 +315,11 @@ class FollowerExecutor:
 
     def _execute(self, kind: str, meta: Dict[str, Any], arrays: list) -> None:
         engine = self.engine
+        # paged dispatches carry one extra operand — the block-table
+        # rows — in dispatch-arg position (after slot_ids / active);
+        # engine.paged tells the replay how to split the record back
+        # into the jit's exact argument tuple
+        extra = 1 if engine.paged else 0
         # leader dispatches run under the engine mesh (sharding
         # constraints/shard_map resolve against the ambient mesh);
         # replay must too or tp>1 followers diverge
@@ -314,39 +327,49 @@ class FollowerExecutor:
             if kind == "prefill":
                 run = engine._get_prefill(meta["bucket"])
                 engine.cache, engine._counts, _, _, _ = run(
-                    engine.params, engine.cache, *arrays[:3],
-                    engine._counts, *arrays[3:],
+                    engine.params, engine.cache, *arrays[:3 + extra],
+                    engine._counts, *arrays[3 + extra:],
                 )
             elif kind == "prefill_offset":
                 run = engine._get_prefill_offset(meta["bucket"])
                 engine.cache, engine._counts, _, _, _ = run(
-                    engine.params, engine.cache, *arrays[:4],
-                    engine._counts, *arrays[4:],
+                    engine.params, engine.cache, *arrays[:4 + extra],
+                    engine._counts, *arrays[4 + extra:],
                 )
             elif kind == "copy":
                 run = engine._get_copy_prefix(meta["bucket"])
                 (engine.cache,) = run(engine.params, engine.cache, *arrays)
+            elif kind == "block_copy":
+                # the paged COW primitive: duplicate pool block src->dst
+                # on this process's kv-head shard
+                run = engine._get_block_copy()
+                (engine.cache,) = run(engine.params, engine.cache, *arrays)
             elif kind == "decode":
                 tokens, lengths, active = arrays[:3]
+                tables = arrays[3] if extra else None
                 self._decode(
-                    meta["steps"], tokens, lengths, active, tuple(arrays[3:])
+                    meta["steps"], tokens, lengths, active, tables,
+                    tuple(arrays[3 + extra:]),
                 )
             elif kind == "decode_chained":
                 assert self._carry is not None, \
                     "chained decode before any decode"
-                tokens, lengths, active, sampling = self._carry
-                self._decode(meta["steps"], tokens, lengths, active, sampling)
+                tokens, lengths, active, tables, sampling = self._carry
+                self._decode(
+                    meta["steps"], tokens, lengths, active, tables, sampling
+                )
             else:
                 raise ValueError(f"unknown mirror record kind {kind!r}")
 
-    def _decode(self, steps, tokens, lengths, active, sampling) -> None:
+    def _decode(self, steps, tokens, lengths, active, tables, sampling) -> None:
         engine = self.engine
         run = engine._get_decode(steps)
+        paged_args = (tables,) if tables is not None else ()
         (
             engine.cache, engine._counts, _, _, _,
             final_tokens, final_lengths,
         ) = run(
             engine.params, engine.cache, tokens, lengths, active, active,
-            engine._counts, *sampling,
+            *paged_args, engine._counts, *sampling,
         )
-        self._carry = (final_tokens, final_lengths, active, sampling)
+        self._carry = (final_tokens, final_lengths, active, tables, sampling)

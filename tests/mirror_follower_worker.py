@@ -1,13 +1,14 @@
 """Follower-side worker for the two-OS-process mirror test.
 
 Run as ``python tests/mirror_follower_worker.py <host> <port> <out>
-[fingerprint-hex]``: builds the SAME tiny engine as the leader process
-(deterministic init — same seed, same platform), replays the leader's
-dispatch stream over real TCP, then writes a JSON line with the digest
-of its final device state (cache + penalty counts + last decode carry
-tokens) to ``<out>``. The parent compares digests — SPMD determinism
-across real process separation, no jax.distributed required (each side
-runs its own 1-device CPU mesh).
+[fingerprint-hex] [kind]``: builds the SAME tiny engine as the leader
+process (deterministic init — same seed, same platform; ``kind`` =
+``dense`` (default) or ``paged``), replays the leader's dispatch stream
+over real TCP, then writes a JSON line with the digest of its final
+device state (cache + penalty counts + last decode carry tokens) to
+``<out>``. The parent compares digests — SPMD determinism across real
+process separation, no jax.distributed required (each side runs its
+own 1-device CPU mesh).
 """
 
 import hashlib
@@ -42,13 +43,22 @@ def state_digest(engine) -> str:
     return digest.hexdigest()
 
 
-def build_engine():
+def build_engine(kind: str = "dense"):
     from langstream_tpu.providers.jax_local.engine import DecodeEngine
     from langstream_tpu.providers.jax_local.model import (
         LlamaConfig,
         init_params,
     )
 
+    if kind == "paged":
+        # must match the leader in tests/test_mirror_twoproc.py —
+        # pool shape is part of the jit graphs being replayed
+        config = LlamaConfig.tiny(max_seq_len=512)
+        return DecodeEngine(
+            config, init_params(config), max_slots=3, max_seq_len=512,
+            prefill_buckets=[16, 32, 64, 256], decode_chunk=4,
+            kv_layout="paged", kv_block_size=16, kv_blocks=40,
+        )
     config = LlamaConfig.tiny(max_seq_len=256)
     params = init_params(config)
     return DecodeEngine(
@@ -62,9 +72,10 @@ def main() -> int:
     fingerprint = (
         bytes.fromhex(sys.argv[4]) if len(sys.argv) > 4 else b"\x00" * 16
     )
+    kind = sys.argv[5] if len(sys.argv) > 5 else "dense"
     from langstream_tpu.serving.mirror import FollowerExecutor
 
-    engine = build_engine()
+    engine = build_engine(kind)
     executor = FollowerExecutor(engine)
     executor.connect(host, port, timeout=120.0, fingerprint=fingerprint)
     records = executor.run()

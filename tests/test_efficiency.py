@@ -157,6 +157,68 @@ def test_cost_model_spec_verify_block_golden():
     assert model.decode_chunk_bytes(1, 3, 300, block=4) < plain_k / 2
 
 
+def test_cost_model_tp_shards_dense_golden():
+    """Mesh-aware per-CHIP accounting (ISSUE 8): under tp=2 the weights
+    and KV cache shard over the mesh, so a chip's decode step streams
+    half the weight bytes, half the KV rows, and runs half the FLOPs
+    (params and query heads both divide). Billing whole-model work per
+    chip would overstate MFU/MBU by ~2×. Hand-computed on the tiny
+    shape against the tp=1 goldens above."""
+    from langstream_tpu.runtime.accounting import CostModel
+
+    tp2 = CostModel.from_model_config(_tiny_config(), tp=2)
+    assert tp2.tp_shards == 2
+    # bf16 weights: 2 bytes/param over 2 shards = 1 byte/param per chip
+    assert tp2.weight_bytes == TINY_PARAMS  # = 106816
+    # KV row: 256 bytes over 2 kv-head shards
+    assert tp2.kv_row_bytes == 128
+    # decode chunk (4 steps, 3 slots, 300 summed ctx): exactly half the
+    # tp=1 golden per step — 794496 / 2 = 397248
+    assert tp2.decode_chunk_flops(4, 3, 300) == 4 * 397248
+    #   per-step bytes = weights/2 + kv_row/2 * (300 read + 3 written)
+    #                  = 106816 + 128*303 = 145600
+    assert tp2.decode_chunk_bytes(4, 3, 300) == 4 * 145600
+    # prefill halves the same way: 2184960 / 2
+    assert tp2.prefill_flops(10, offset=5) == 1092480
+    # int8 KV rows shard too: 160 / 2
+    kv8 = CostModel.from_model_config(_tiny_config(), kv_quant=True, tp=2)
+    assert kv8.kv_row_bytes == 80
+
+
+def test_cost_model_tp_shards_paged_fused_golden():
+    """Paged byte model under tp=2: pool reads shard with their kv
+    heads, but block TABLES are replicated scalar-prefetch operands —
+    every shard's kernel reads the full table — so the per-chip table
+    words do NOT divide. Hand-computed on the tiny shape (kv_row 256→128
+    per chip, 2 layers, block 16)."""
+    from langstream_tpu.runtime.accounting import CostModel
+
+    fused = CostModel.from_model_config(
+        _tiny_config(), kv_block_size=16, paged_kernel="fused", tp=2
+    )
+    reference = CostModel.from_model_config(
+        _tiny_config(), kv_block_size=16, paged_kernel="reference", tp=2
+    )
+    # 32 block-padded rows: sharded pool read 128*32 = 4096, plus the
+    # FULL table words 4 B * 2 layers * 2 blocks = 16 (not divided)
+    assert fused.kv_read_bytes(32) == 4096 + 16
+    # reference still pays the 3× gather copy on its shard
+    assert reference.kv_read_bytes(32) == 3 * 4096 + 16
+    # decode chunk (1 step, 1 slot, 32-token padded ctx):
+    #   weights/2 (106816) + kernel-aware read + 1 sharded row written
+    assert fused.decode_chunk_bytes(1, 1, 32) == 106816 + 4112 + 128
+    assert reference.decode_chunk_bytes(1, 1, 32) == 106816 + 12304 + 128
+    # FLOPs are kernel-independent and half the tp=1 count:
+    #   (2*106816 + 4*32*4*16*2) / 2 = 230016 / 2
+    assert fused.decode_chunk_flops(1, 1, 32) == 115008
+    assert reference.decode_chunk_flops(1, 1, 32) == 115008
+    # tp=1 stays bit-for-bit what the earlier goldens pinned
+    tp1 = CostModel.from_model_config(
+        _tiny_config(), kv_block_size=16, paged_kernel="fused"
+    )
+    assert tp1.kv_read_bytes(32) == 8208
+
+
 def test_peak_specs_env_override(monkeypatch):
     from langstream_tpu.runtime import accounting
 

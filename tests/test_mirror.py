@@ -145,6 +145,90 @@ def test_mismatched_config_fingerprint_rejected():
     mirror.close()
 
 
+def _paged_engines():
+    """Leader/follower pair with a paged pool SMALL enough (40 blocks vs
+    a 32-block worst case + prefix chains) that the traffic below forces
+    LRU eviction — eviction is host-0 bookkeeping that must never enter
+    the stream, only the tables it produces."""
+    config = LlamaConfig.tiny(max_seq_len=512)
+    params = init_params(config)
+    kwargs = dict(
+        max_slots=3, max_seq_len=512, prefill_buckets=[16, 32, 64, 256],
+        decode_chunk=4, kv_layout="paged", kv_block_size=16, kv_blocks=40,
+    )
+    leader = DecodeEngine(config, params, **kwargs)
+    follower = DecodeEngine(config, params, **kwargs)  # never started
+    return leader, follower
+
+
+def test_follower_replays_paged_to_identical_cache():
+    """kv_layout=paged over the mirror (ISSUE 8): paged dispatch records
+    carry their block-table rows and COW copies publish block_copy
+    records, so a follower replays the identical pool mutations WITHOUT
+    running the allocator/prefix-cache/LRU itself. Traffic covers every
+    paged admission shape — a ≥256-token shared-prefix hit, a session
+    follow-up diverging mid-block (COW), chunked long prefill, and
+    pool-pressure eviction — and the follower must end bit-identical
+    (cache bits encode the full token history, so this is bitwise token
+    parity)."""
+    leader, follower = _paged_engines()
+    mirror = DispatchMirror(host="127.0.0.1", port=0)
+    executor = FollowerExecutor(follower)
+    executor.connect("127.0.0.1", mirror.port)
+    replayed = threading.Thread(target=executor.run)
+    replayed.start()
+    mirror.wait_for_followers(1, timeout=30)
+    leader.mirror = mirror
+    leader.start()
+
+    template = [(17 * j) % 250 + 1 for j in range(256)]
+
+    async def drive():
+        # 1. cold 258-token prompt (chunked: > largest bucket) under a
+        #    session id; publishes a 256-token prefix chain at finish
+        r1 = await leader.generate(
+            template + [7, 8], SamplingParams(max_new_tokens=4),
+            session_id="cow",
+        )
+        # 2. same 256-token template, different tail → block-granular
+        #    prefix-cache hit ≥ 256 tokens (warm prefill-at-offset)
+        await leader.generate(
+            template + [9, 10, 11], SamplingParams(max_new_tokens=4)
+        )
+        # 3. session follow-up diverging MID-BLOCK inside the published
+        #    prefix → copy-on-write of the boundary block
+        history = template + [7, 8] + r1.tokens
+        follow = history[:133] + [201, 202, 203]
+        await leader.generate(
+            follow, SamplingParams(max_new_tokens=4), session_id="cow"
+        )
+        # 4. distinct prompts exhaust the 40-block pool → LRU eviction
+        for i in range(4):
+            await leader.generate(
+                [(i * 31 + j) % 250 + 1 for j in range(120)],
+                SamplingParams(max_new_tokens=4),
+            )
+
+    try:
+        asyncio.run(drive())
+        stats = leader.kv_manager.stats
+        assert stats["hit_tokens"] >= 256, stats
+        assert stats["cow_copies"] >= 1, stats
+        assert stats["evictions"] >= 1, stats
+    finally:
+        leader.stop()
+    replayed.join(timeout=120)
+    assert not replayed.is_alive()
+    assert executor.records > 0
+    for key in leader.cache:
+        assert np.array_equal(
+            np.asarray(leader.cache[key]), np.asarray(follower.cache[key])
+        ), f"paged cache[{key}] diverged"
+    assert np.array_equal(
+        np.asarray(leader._counts), np.asarray(follower._counts)
+    )
+
+
 def test_follower_survives_fuzzed_traffic():
     """Adversarial mix on the leader — sessions racing slot pressure,
     shared-template prefix copies, chunked long prompts, random sampling

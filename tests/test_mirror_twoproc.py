@@ -24,14 +24,16 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "mirror_follower_worker.py")
 
 
-def _spawn_follower(port: int, out_path: str, fingerprint: bytes):
+def _spawn_follower(
+    port: int, out_path: str, fingerprint: bytes, kind: str = "dense"
+):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     return subprocess.Popen(
         [
             sys.executable, WORKER, "127.0.0.1", str(port), out_path,
-            fingerprint.hex(),
+            fingerprint.hex(), kind,
         ],
         env=env,
     )
@@ -107,6 +109,91 @@ def test_two_process_replay_token_identical(tmp_path):
     # bit-identical device state across a real process boundary —
     # cache bits encode the full decode history, so this is
     # token-identical replay
+    assert report["digest"] == state_digest(leader)
+
+
+def test_two_process_paged_replay_token_identical(tmp_path):
+    """The ISSUE 8 mirror acceptance: leader + one follower in a REAL
+    child process with ``kv_layout: paged`` replay to bitwise-identical
+    device state — through a ≥256-token shared-prefix hit, a mid-block
+    COW divergence, chunked long prefill, and pool-pressure eviction.
+    Paged dispatch records carry their block-table rows and COW copies
+    their own records; the follower never runs the block allocator."""
+    from langstream_tpu.providers.jax_local.engine import (
+        DecodeEngine,
+        SamplingParams,
+    )
+    from langstream_tpu.providers.jax_local.model import (
+        LlamaConfig,
+        init_params,
+    )
+    from langstream_tpu.serving.mirror import (
+        DispatchMirror,
+        config_fingerprint,
+    )
+
+    from tests.mirror_follower_worker import state_digest
+
+    fingerprint = config_fingerprint({"model": "tiny-twoproc-paged"})
+    config = LlamaConfig.tiny(max_seq_len=512)
+    # same shape as mirror_follower_worker.build_engine("paged")
+    leader = DecodeEngine(
+        config, init_params(config), max_slots=3, max_seq_len=512,
+        prefill_buckets=[16, 32, 64, 256], decode_chunk=4,
+        kv_layout="paged", kv_block_size=16, kv_blocks=40,
+    )
+    mirror = DispatchMirror(
+        host="127.0.0.1", port=0, fingerprint=fingerprint
+    )
+    out_path = str(tmp_path / "follower_paged.json")
+    follower = _spawn_follower(mirror.port, out_path, fingerprint, "paged")
+    try:
+        mirror.wait_for_followers(1, timeout=180)
+        leader.mirror = mirror
+        leader.start()
+
+        template = [(17 * j) % 250 + 1 for j in range(256)]
+
+        async def drive():
+            # chunked cold prefill (258 > largest bucket) publishing a
+            # 256-token prefix chain under a session id
+            r1 = await leader.generate(
+                template + [7, 8], SamplingParams(max_new_tokens=4),
+                session_id="cow",
+            )
+            # ≥256-token shared-prefix hit (block-granular admission)
+            await leader.generate(
+                template + [9, 10, 11], SamplingParams(max_new_tokens=4)
+            )
+            # session follow-up diverging mid-block inside the
+            # published prefix → COW block copy record
+            history = template + [7, 8] + r1.tokens
+            follow = history[:133] + [201, 202, 203]
+            await leader.generate(
+                follow, SamplingParams(max_new_tokens=4),
+                session_id="cow",
+            )
+            # distinct prompts exhaust the 40-block pool → eviction
+            for i in range(4):
+                await leader.generate(
+                    [(i * 31 + j) % 250 + 1 for j in range(120)],
+                    SamplingParams(max_new_tokens=4),
+                )
+
+        asyncio.run(drive())
+        stats = leader.kv_manager.stats
+        assert stats["hit_tokens"] >= 256, stats
+        assert stats["cow_copies"] >= 1, stats
+        assert stats["evictions"] >= 1, stats
+    finally:
+        leader.stop()  # publishes the stop record and closes the mirror
+    assert follower.wait(timeout=300) == 0
+    with open(out_path) as handle:
+        report = json.load(handle)
+    assert report["records"] > 0
+    # bitwise-identical pool + counts across the process boundary:
+    # cache bits encode the full decode history, so this is
+    # token-identical replay of the paged protocol
     assert report["digest"] == state_digest(leader)
 
 

@@ -31,6 +31,8 @@ LEGS = {
     "bench_heal_paged.json": "paged KV, fused ragged kernel (--kv-layout paged)",
     "bench_heal_paged_ref.json": "paged KV, gather reference (--paged-kernel reference)",
     "bench_heal_spec.json": "speculative decoding (--spec-decode ngram)",
+    "bench_heal_paged_tp2.json": "paged KV, fused kernel, tp=2 mesh (--tp 2)",
+    "bench_heal_paged_ref_tp2.json": "paged KV, gather reference, tp=2 mesh",
 }
 
 
@@ -63,6 +65,12 @@ def describe(record: Dict[str, Any]) -> str:
     # the ROADMAP-item-1 paged-vs-dense gap is read off this pair
     if record.get("kv_layout") == "paged" and record.get("paged_kernel"):
         bits.append(f"kernel={record['paged_kernel']}")
+    # tp column: chips in the leg's tensor-parallel mesh — sharded legs
+    # report per-CHIP tok/s and per-chip MFU/MBU (the cost model divides
+    # sharded work by tp), so a tp=2 leg must never be compared against
+    # a tp=1 leg as if they ran the same hardware
+    if record.get("tp") and int(record["tp"]) > 1:
+        bits.append(f"tp={record['tp']}")
     # spec-decode column: which leg ran speculative decoding, plus its
     # own acceptance evidence (the on-vs-off delta only means anything
     # read next to the rate — a collapsed rate explains a flat delta)
@@ -350,6 +358,43 @@ def main() -> None:
                 "equal step time at lower MBU means the launch is "
                 "compute/grid-bound (raise kv-block-size)" + note
             )
+    paged_tp2 = records["bench_heal_paged_tp2.json"]
+    paged_ref_tp2 = records["bench_heal_paged_ref_tp2.json"]
+    if usable(paged_tp2) and usable(paged_ref_tp2):
+        # fused-vs-reference under tensor parallelism (ROADMAP item 3):
+        # the shard_map'd fused kernel vs the gather/scatter reference
+        # on the same tp=2 mesh. This is the pair that decides whether
+        # multi-chip paged serving keeps the fused default — before the
+        # shard_map twin existed, tp>1 silently downgraded to reference
+        # and paid 3x KV traffic the moment a model outgrew one chip.
+        delta = paged_tp2["value"] / paged_ref_tp2["value"] - 1
+        note = caveat(paged_tp2, paged_ref_tp2)
+        if delta > 0.03:
+            recommendations.append(
+                f"KEEP paged-kernel fused default under tp: {delta:+.1%} "
+                f"over the gather reference on the tp=2 mesh "
+                f"({paged_ref_tp2['value']:.0f} -> "
+                f"{paged_tp2['value']:.0f} tok/s/chip)" + note
+            )
+        else:
+            recommendations.append(
+                f"fused paged kernel not a win under tp=2 ({delta:+.1%} "
+                "vs gather reference) — check per-chunk MBU: per-shard "
+                "launches see 1/tp of the heads, so small models may be "
+                "grid-bound; re-test on the real slice before flipping"
+                + note
+            )
+    if usable(paged) and usable(paged_tp2):
+        # scaling sanity: per-chip throughput under tp=2 vs one chip.
+        # Perfect weak scaling holds per-chip tok/s flat; a deep drop
+        # means the all-reduces (not the paged kernel) own the step.
+        delta = paged_tp2["value"] / paged["value"] - 1
+        recommendations.append(
+            f"tp=2 paged per-chip throughput {delta:+.1%} vs single chip "
+            f"({paged['value']:.0f} -> {paged_tp2['value']:.0f} "
+            "tok/s/chip) — collective overhead, not a kernel verdict"
+            + caveat(paged, paged_tp2)
+        )
     spec = records["bench_heal_spec.json"]
     if usable(main_rec) and usable(spec):
         # spec-on-vs-off pair at equal sampling semantics (greedy parity

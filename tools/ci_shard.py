@@ -35,6 +35,9 @@ SHARDS: Dict[str, List[str]] = {
         "test_spec_decode",
         "test_paged_kernel",
         "test_paged_kv",
+        # multi-chip paged serving (shard_map'd fused kernel, tp=2
+        # engine A/Bs, compiled-HLO collective assertions) — JAX-heavy
+        "test_multichip_paged",
         "test_decode_kernel",
         "test_kv_quant",
         "test_quant",

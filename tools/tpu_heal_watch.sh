@@ -145,6 +145,27 @@ y.block_until_ready()" 2>/dev/null
                     echo "$(date -u +%FT%TZ) paged-kernel A/B $kernel failed (non-fatal)" >> "$LOG"
                 fi
             done
+            # 2b-tp) multi-chip paged kernel A/B: the same fused vs
+            #    reference pair on a tp=2 mesh (ROADMAP item 3 — the
+            #    shard_map'd fused kernel vs the gather reference that
+            #    used to be the forced tp fallback). Skipped gracefully
+            #    by the bench when the relay exposes only one chip.
+            for kernel in fused reference; do
+                LEG_OUT="${OUT%.json}_paged_tp2.json"
+                [ "$kernel" = reference ] && LEG_OUT="${OUT%.json}_paged_ref_tp2.json"
+                BENCH_TP=2 BENCH_KV_LAYOUT=paged BENCH_PAGED_KERNEL=$kernel \
+                    BENCH_COMPILE_ONLY=1 BENCH_DEADLINE=3000 \
+                    BENCH_INIT_TIMEOUT=600 \
+                    python bench.py > /dev/null 2>> "$LOG" \
+                    || echo "$(date -u +%FT%TZ) paged tp2 $kernel warm interrupted (entries kept)" >> "$LOG"
+                if BENCH_TP=2 BENCH_KV_LAYOUT=paged BENCH_PAGED_KERNEL=$kernel \
+                    BENCH_DEADLINE=3600 BENCH_INIT_TIMEOUT=600 \
+                    python bench.py > "$LEG_OUT" 2>> "$LOG"; then
+                    echo "$(date -u +%FT%TZ) paged tp2 A/B $kernel: $(cat "$LEG_OUT")" >> "$LOG"
+                else
+                    echo "$(date -u +%FT%TZ) paged tp2 A/B $kernel failed (non-fatal: needs a 2-chip relay window)" >> "$LOG"
+                fi
+            done
             # 2c) speculative-decoding A/B: self-drafting prompt-lookup
             #    (ngram) vs the oracle scan (the main run is the OFF
             #    leg — same traffic shape). Warm the spec jit graphs
