@@ -16,6 +16,33 @@ import enum
 from typing import Any, Dict, Optional
 
 
+class UnavailableError(RuntimeError):
+    """Transient serving unavailability the CALLER should retry: the
+    request was never started (no partial work), and ``retry_after_s``
+    estimates when capacity returns. The OpenAI surface maps this to
+    HTTP 503 + ``Retry-After`` — the contract that turns an engine
+    rebuild or an overloaded queue into a bounded, retryable signal
+    instead of a 500 (DeepServe's fast-failure property)."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0) -> None:
+        super().__init__(message)
+        self.retry_after_s = max(0.0, float(retry_after_s))
+
+
+class QueueTimeoutError(UnavailableError):
+    """A pending request exceeded its admission deadline and was shed
+    before ever holding a slot (load shedding — the alternative is
+    waiting in the engine queue forever while the caller times out
+    anyway). ``retry_after_s`` derives from the current queue depth and
+    the engine's EWMA step time."""
+
+
+class EngineRebuildingError(UnavailableError):
+    """The engine supervisor is tearing down / rebuilding a crashed
+    engine; in-flight sessions are being resurrected and NEW work must
+    retry after the rebuild window."""
+
+
 class FatalAgentError(RuntimeError):
     """Errors the record-level policy must NEVER consume: the agent
     cannot make progress (dead child process, poisoned device state),

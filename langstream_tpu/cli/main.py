@@ -893,6 +893,24 @@ def build_parser() -> argparse.ArgumentParser:
              "KV-pool livelock detection with automatic evidence "
              "capture)",
     )
+    serve.add_argument(
+        "--no-supervisor", action="store_true",
+        help="disable the engine supervisor (on by default: an engine "
+             "crash or watchdog escalation snapshots every live "
+             "session, rebuilds the engine, and resumes each stream "
+             "bitwise — docs/robustness.md)",
+    )
+    serve.add_argument(
+        "--max-restarts", type=int, default=3,
+        help="supervisor: engine rebuilds allowed inside the restart "
+             "window before giving up (crash-loop circuit breaker)",
+    )
+    serve.add_argument(
+        "--queue-timeout-s", type=float, default=0,
+        help="admission deadline: pending requests older than this are "
+             "shed with 503 + Retry-After instead of waiting in the "
+             "queue forever (0 = off)",
+    )
     serve.add_argument("--embeddings-checkpoint", default=None)
     serve.add_argument("--host", default="0.0.0.0")
     serve.add_argument("--port", type=int, default=8000)

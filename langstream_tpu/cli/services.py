@@ -291,8 +291,21 @@ async def serve_main(args) -> None:
             # decode-stall watchdog: on by default for serve (the
             # provider starts it; --no-watchdog disables)
             "watchdog": not getattr(args, "no_watchdog", False),
+            # engine supervisor (self-healing serving): crash →
+            # snapshot → rebuild → bitwise session resurrection; the
+            # multi-host mirror path disables it below (a rebuilt
+            # leader cannot resynchronize followers yet)
+            "supervisor": not getattr(args, "no_supervisor", False),
+            "max-restarts": getattr(args, "max_restarts", 3),
+            # admission deadline / load shedding (0 = off)
+            "queue-timeout-s": getattr(args, "queue_timeout_s", 0) or "",
         },
     }
+    if getattr(args, "followers", 0) or getattr(args, "follower_of", None):
+        # mirror serving: every leader dispatch must replay on the
+        # followers in stream order — a supervisor rebuild would fork
+        # the stream, so the heal arc is disabled rather than divergent
+        config["engine"]["supervisor"] = False
     slo_targets = {
         "ttft-ms-p95": getattr(args, "slo_ttft_ms", 0) or 0,
         "tpot-ms-p95": getattr(args, "slo_tpot_ms", 0) or 0,

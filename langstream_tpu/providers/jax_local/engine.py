@@ -50,8 +50,9 @@ from langstream_tpu.parallel.mesh import (
     shard_params,
     validate_mesh,
 )
+from langstream_tpu.api import errors as api_errors
 from langstream_tpu.providers.jax_local import model as model_lib
-from langstream_tpu.runtime import flight
+from langstream_tpu.runtime import faults, flight
 from langstream_tpu.runtime.tracing import get_tracer
 
 logger = logging.getLogger(__name__)
@@ -95,14 +96,29 @@ MFU_PER_CHUNK = Histogram("jax_engine_mfu_per_chunk", buckets=_UTIL_BUCKETS)
 MBU_PER_CHUNK = Histogram("jax_engine_mbu_per_chunk", buckets=_UTIL_BUCKETS)
 
 
+def _supervisor_module():
+    """The supervisor module iff something in this process already
+    imported it — the ONE gate that keeps unsupervised processes from
+    ever paying for (or exporting) the self-healing metric families."""
+    import sys as _sys
+
+    return _sys.modules.get("langstream_tpu.runtime.supervisor")
+
+
 def engines_histograms():
-    return {
+    out = {
         h.name: h.snapshot()
         for h in (
             DECODE_STEP_SECONDS, TTFT_SECONDS, TPOT_SECONDS,
             REQUEST_SECONDS, MFU_PER_CHUNK, MBU_PER_CHUNK,
         )
     }
+    # recovery_seconds rides every surface the engine histograms reach
+    # (runner pods, the OpenAI server, the gateway)
+    supervisor_mod = _supervisor_module()
+    if supervisor_mod is not None:
+        out.update(supervisor_mod.supervisor_histograms())
+    return out
 
 
 def engines_snapshot() -> Dict[str, float]:
@@ -121,8 +137,15 @@ def engines_snapshot() -> Dict[str, float]:
     useful_tokens = 0
     wasted: Dict[str, int] = {
         reason: 0
-        for reason in ("cancelled", "evicted_recompute", "draft_rejected")
+        for reason in (
+            "cancelled", "evicted_recompute", "draft_rejected",
+            # supervisor resurrection: tokens re-prefilled to fast-
+            # forward a crashed session back to its pre-crash state
+            "crash_replay",
+        )
     }
+    shed_engines = 0
+    shed: Dict[str, int] = {"queue_timeout": 0}
     spec_engines = 0
     spec_drafted = spec_accepted = 0
     decode_flops = decode_bytes = prefill_flops = 0.0
@@ -143,6 +166,10 @@ def engines_snapshot() -> Dict[str, float]:
         useful_tokens += stats["tokens_useful"]
         for reason, count in stats["tokens_wasted"].items():
             wasted[reason] = wasted.get(reason, 0) + count
+        if engine.queue_timeout_s:
+            shed_engines += 1
+        for reason, count in stats.get("requests_shed", {}).items():
+            shed[reason] = shed.get(reason, 0) + count
         decode_flops += stats["decode_flops"]
         decode_bytes += stats["decode_bytes"]
         prefill_flops += stats["prefill_flops"]
@@ -189,6 +216,18 @@ def engines_snapshot() -> Dict[str, float]:
         out["spec_acceptance_rate"] = round(
             spec_accepted / spec_drafted, 4
         ) if spec_drafted else 0.0
+    if shed_engines or any(shed.values()):
+        # admission deadlines armed (or sheds already happened): the
+        # series must exist BEFORE the first shed so rate() alerts work
+        for reason, count in sorted(shed.items()):
+            out[f'requests_shed_total{{reason="{reason}"}}'] = float(count)
+    # self-healing plane (runtime/supervisor.py): restart/resurrection
+    # counters + the degraded-mode gauge — exposed even with ZERO live
+    # engines, because mid-rebuild (old engine retired, new one still
+    # compiling) is exactly when an operator scrapes for it
+    supervisor_mod = _supervisor_module()
+    if supervisor_mod is not None:
+        out.update(supervisor_mod.supervisor_gauges())
     if not (tokens or steps):
         return out
     out["jax_engine_session_hits"] = float(session_hits)
@@ -282,6 +321,20 @@ class GenerationRequest:
     # admission/prefill/request spans with it so one id links the
     # gateway, the runner, and the device timeline
     trace_id: Optional[str] = None
+    # session resurrection (runtime/supervisor.py): tokens the crashed
+    # predecessor engine had already ACCEPTED for this request. The
+    # supervisor rewrites ``prompt_tokens`` to prompt + replay[:-1]
+    # (teacher-forced through a normal prefill — the paged prefix cache
+    # makes it cheap) and the harvest path fast-forwards the slot
+    # through them instead of emitting a fresh sample: sampling keys
+    # derive from (seed, position) and penalty counts are restored
+    # position-exactly, so the continuation is bitwise identical to the
+    # uncrashed oracle. ``prompt_len`` preserves the ORIGINAL prompt
+    # length across (repeated) resurrections for usage accounting.
+    replay_tokens: Optional[List[int]] = None
+    replay_logprobs: Optional[List[float]] = None
+    replay_tops: Optional[List[Tuple[List[int], List[float]]]] = None
+    prompt_len: Optional[int] = None
 
     def cancel(self) -> None:
         self.cancelled = True
@@ -335,6 +388,32 @@ class _Slot:
         return self.request is not None and not self.prefilling
 
 
+def fail_request_future(
+    request: "GenerationRequest", error: BaseException
+) -> None:
+    """Deliver ``error`` to a request's waiter from any thread — the ONE
+    future-failing path shared by crash fail-fast, load shedding, the
+    retired-queue straggler sweep, and the supervisor's give-up handling
+    (a fix to the loop-closed race must land once, not four times)."""
+    future = request.future
+    if future is None:
+        return
+
+    def resolve() -> None:
+        if not future.done():
+            future.set_exception(error)
+
+    if request.loop is not None:
+        try:
+            request.loop.call_soon_threadsafe(resolve)
+        except RuntimeError:
+            # waiter's loop already closed (caller gave up) — must not
+            # abort failing any REMAINING waiters
+            pass
+    else:
+        resolve()
+
+
 def _bucket(length: int, buckets: List[int]) -> int:
     for size in buckets:
         if length <= size:
@@ -377,6 +456,10 @@ class DecodeEngine:
         prefix_cache: bool = True,
         logprobs_topk: int = 0,
         slo: Optional[Dict[str, Any]] = None,  # {ttft_ms_p95, tpot_ms_p95}
+        queue_timeout_s: Optional[float] = None,  # admission deadline:
+                                          # pending requests older than
+                                          # this are shed with a typed
+                                          # QueueTimeoutError (None=off)
     ) -> None:
         self.config = config
         self.max_slots = max_slots
@@ -600,6 +683,22 @@ class DecodeEngine:
         self._thread: Optional[threading.Thread] = None
         self._running = False
         self._crashed: Optional[BaseException] = None
+        # supervised mode (runtime/supervisor.py): when set, a crashed
+        # device thread hands its live sessions to this hook instead of
+        # failing every waiter — crash → rebuild → resume, not crash →
+        # mass 500. Unset (the default) keeps the fail-fast behavior.
+        self.on_crash: Optional[Callable[[BaseException], None]] = None
+        # admission deadline for load shedding (serve --queue-timeout-s)
+        self.queue_timeout_s = (
+            float(queue_timeout_s) if queue_timeout_s else None
+        )
+        # EWMA decode-step seconds: the Retry-After estimator for shed
+        # requests (queue depth × step time ≈ when a slot frees up)
+        self._step_ewma: Optional[float] = None
+        self._counts_restore_fn: Optional[Any] = None
+        # set once drain_for_recovery has swept the queue: a submit that
+        # lands after the sweep must fail itself (nothing reads it)
+        self._recovery_drained = False
         self._compiled_prefill: Dict[int, Any] = {}
         self._prefill_offset_fns: Dict[int, Any] = {}
         self._decode_fns: Dict[int, Any] = {}
@@ -625,6 +724,10 @@ class DecodeEngine:
         # recorder (no-op unless configured / LANGSTREAM_FLIGHT_DIR)
         self.tracer = get_tracer("engine")
         flight.configure_from_env()
+        # deterministic chaos (LANGSTREAM_FAULTS): zero-cost no-ops when
+        # unarmed; arrival counters are process-global, so a one-shot
+        # fault consumed here stays consumed across a supervisor rebuild
+        faults.configure_from_env()
         flight.record(
             "engine_start",
             slots=max_slots,
@@ -664,6 +767,9 @@ class DecodeEngine:
             # burned on cancelled requests / eviction-induced re-prefill
             "tokens_useful": 0,
             "tokens_wasted": {},     # reason -> tokens
+            # load shedding: pending requests failed fast at their
+            # admission deadline instead of starving in _pending
+            "requests_shed": {},     # reason -> requests
             # roofline accumulators (modeled work per dispatch kind)
             "decode_flops": 0.0,
             "decode_bytes": 0.0,
@@ -1354,6 +1460,11 @@ class DecodeEngine:
     # ------------------------------------------------------------------ #
     def start(self) -> None:
         if self._crashed is not None:
+            if self.on_crash is not None:
+                raise api_errors.EngineRebuildingError(
+                    "engine is rebuilding after a crash; retry shortly",
+                    retry_after_s=2.0,
+                )
             raise RuntimeError("decode engine crashed") from self._crashed
         if self._thread is not None:
             return
@@ -1386,7 +1497,22 @@ class DecodeEngine:
 
     def submit(self, request: GenerationRequest) -> None:
         if self._crashed is not None:
+            if self.on_crash is not None:
+                # supervised: the crash window is a bounded rebuild, not
+                # a terminal state — callers get a typed retryable error
+                # (503 + Retry-After on the HTTP surfaces), never a 500
+                raise api_errors.EngineRebuildingError(
+                    "engine is rebuilding after a crash; retry shortly",
+                    retry_after_s=2.0,
+                )
             raise RuntimeError("decode engine crashed") from self._crashed
+        if request.replay_tokens and self.mirror is not None:
+            # replay admission restores penalty counts with a dispatch
+            # the follower replay protocol does not speak
+            raise NotImplementedError(
+                "session resurrection over the multi-host mirror is not "
+                "supported"
+            )
         bias = request.sampling.logit_bias
         if bias and len(bias) > self.MAX_LOGIT_BIAS:
             raise ValueError(
@@ -1412,8 +1538,16 @@ class DecodeEngine:
         self._queue.put(request)
         if self._crashed is not None:
             # crashed between the check above and the put: the loop will
-            # never drain the queue again, so fail the stragglers here
-            self._fail_all_pending()
+            # never drain the queue again
+            if self.on_crash is None:
+                self._fail_all_pending()
+            elif self._recovery_drained:
+                # supervised AND the recovery drain already swept this
+                # queue: nothing will ever read it again — fail any
+                # strays (incl. this request, unless the drain captured
+                # it, in which case its future rides the resurrection)
+                # with the typed retryable error so no caller hangs
+                self._fail_stragglers()
 
     async def generate(
         self,
@@ -1515,6 +1649,16 @@ class DecodeEngine:
             # process down)
             flight.record("engine_crash", error=repr(exc)[:512])
             flight.flush()
+            if self.on_crash is not None:
+                # supervised: live sessions stay parked in the queue /
+                # _pending / slots for the supervisor to resurrect onto
+                # a rebuilt engine — the hook runs the whole detect →
+                # heal arc on this (already dead) thread, then the
+                # thread exits quietly (the crash is already logged,
+                # flight-recorded, and handled; re-raising would only
+                # spam threading's excepthook mid-recovery)
+                self.on_crash(exc)
+                return
             self._fail_all_pending()
             raise
 
@@ -1721,6 +1865,49 @@ class DecodeEngine:
                     keep.append(queued)
             self._pending = keep
 
+    def _shed_expired(self) -> None:
+        """Admission deadlines (serve ``--queue-timeout-s``): a pending
+        request older than the deadline fails FAST with a typed
+        :class:`~langstream_tpu.api.errors.QueueTimeoutError` instead of
+        starving in ``_pending`` while its caller times out anyway —
+        load shedding under sustained overload."""
+        timeout = self.queue_timeout_s
+        if not timeout or not self._pending:
+            return
+        now = time.perf_counter()
+        keep: List[GenerationRequest] = []
+        for request in self._pending:
+            waited = now - getattr(request, "_submit_ts", now)
+            if waited < timeout:
+                keep.append(request)
+            else:
+                self._shed(request, waited)
+        self._pending = keep
+
+    def _shed(self, request: GenerationRequest, waited: float) -> None:
+        shed = self.stats["requests_shed"]
+        shed["queue_timeout"] = shed.get("queue_timeout", 0) + 1
+        self.stats["requests"] += 1
+        # Retry-After ≈ when a slot plausibly frees: the backlog this
+        # request would wait behind × the EWMA decode-step time (a
+        # coarse lower bound — better than a constant, cheap to compute)
+        step_s = self._step_ewma if self._step_ewma else 0.05
+        retry_after = max(1.0, len(self._pending) * step_s)
+        flight.record(
+            "request_shed",
+            reason="queue_timeout",
+            waited_s=round(waited, 3),
+            queue_depth=len(self._pending),
+            retry_after_s=round(retry_after, 3),
+            trace_id=request.trace_id or "",
+        )
+        fail_request_future(request, api_errors.QueueTimeoutError(
+            f"request waited {waited:.2f}s in the admission queue "
+            f"(queue timeout {self.queue_timeout_s}s); shed before "
+            "admission — retry later",
+            retry_after_s=retry_after,
+        ))
+
     def _admit(self) -> None:
         """Move pending requests into slots. Cold requests sharing a prompt
         bucket are prefilled in ONE batched device call, and warm-session
@@ -1729,6 +1916,7 @@ class DecodeEngine:
         sizes so compilations stay bounded)."""
         if self.paged:
             return self._admit_paged()
+        self._shed_expired()
         self._drop_cancelled()
         while self._pending:
             cold: List[Tuple[int, GenerationRequest]] = []
@@ -1925,6 +2113,7 @@ class DecodeEngine:
         Round dispatch order is cold batch → long prefills → warm
         suffixes: a suffix admitted onto blocks published this round
         always reads rows whose writes are already dispatched."""
+        self._shed_expired()
         self._drop_cancelled()
         largest = self.prefill_buckets[-1]
         while self._pending:
@@ -2257,6 +2446,7 @@ class DecodeEngine:
         """Dispatch cold prefills (first token sampled in-jit) WITHOUT
         blocking — the result is picked up by :meth:`_harvest_prefills`
         while decode chunks for already-running slots continue."""
+        faults.check("dispatch_error")
         for group in self._pow2_groups(batch):
             started = time.perf_counter()
             size = len(group)
@@ -2339,6 +2529,7 @@ class DecodeEngine:
         no per-token forcing, no per-request dispatch). Groups split to
         power-of-two sizes to bound compilations, like cold prefill.
         Non-blocking, like :meth:`_prefill_batch`."""
+        faults.check("dispatch_error")
         for group in self._pow2_groups(batch):
             started = time.perf_counter()
             size = len(group)
@@ -2419,6 +2610,7 @@ class DecodeEngine:
         guarantees the window never writes past ``max_seq_len``. This is
         what lets long-context prompts (ring/Ulysses scale) enter the
         slot cache without a giant single-dispatch bucket."""
+        faults.check("dispatch_error")
         prompt = request.prompt_tokens
         total = len(prompt)
         largest = self.prefill_buckets[-1]
@@ -2556,16 +2748,112 @@ class DecodeEngine:
                     )
             for row, (index, request) in enumerate(record["group"]):
                 self.slots[index].prefilling = False
-                self._emit_token(
-                    index, int(firsts[row]), float(lps[row]),
-                    top=(
-                        (tops[0][row].tolist(), tops[1][row].tolist())
-                        if tops is not None else None
-                    ),
-                )
+                if request.replay_tokens:
+                    # resurrected session: fast-forward through the
+                    # accepted history instead of emitting the prefill's
+                    # own sample (see _resume_replay)
+                    self._resume_replay(
+                        index, request,
+                        reused=record.get("reused", {}).get(index, 0),
+                    )
+                else:
+                    self._emit_token(
+                        index, int(firsts[row]), float(lps[row]),
+                        top=(
+                            (tops[0][row].tolist(), tops[1][row].tolist())
+                            if tops is not None else None
+                        ),
+                    )
                 request._prefill_time = age  # type: ignore[attr-defined]
             self._prefill_inflight.pop(0)
             block = False  # only the oldest is worth waiting for
+
+    def _resume_replay(
+        self, index: int, request: GenerationRequest, reused: int = 0
+    ) -> None:
+        """Fast-forward a resurrected session (supervisor rebuild).
+
+        The prefill that just harvested taught the cache
+        ``prompt + replay[:-1]``; this seeds the slot's bookkeeping with
+        the accepted tokens and teacher-forces ``replay[-1]`` as the
+        pending token — its KV row is written by the next decode step,
+        exactly like a freshly sampled first token, so the continuation
+        samples at cache position ``len(prompt) + len(replay)`` with the
+        key the uncrashed oracle would have used. The prefill's OWN
+        sampled token is discarded: its logits were computed without the
+        restored penalty state, and the caller already holds the real
+        token for that position. Penalty counts are restored
+        position-exactly (:meth:`_restore_counts`), so greedy AND seeded
+        stochastic continuations — penalties included — are bitwise
+        identical to an uncrashed run. Replayed tokens are NOT re-emitted
+        (the caller's stream already has them); they re-enter the final
+        result through ``slot.generated``."""
+        slot = self.slots[index]
+        replay = list(request.replay_tokens)
+        slot.generated = replay
+        lps = list(request.replay_logprobs or [])
+        slot.logprobs = lps + [0.0] * (len(replay) - len(lps))
+        if slot.tops is not None:
+            tops = list(request.replay_tops or [])
+            slot.tops = tops + [([], [])] * (len(replay) - len(tops))
+        slot.history.append(replay[-1])
+        self._restore_counts(index, replay)
+        # TTFT anchor for the resumed span: the next emitted token is
+        # the first the NEW engine produces for this request
+        request._first_token_ts = (  # type: ignore[attr-defined]
+            time.perf_counter()
+        )
+        # goodput ledger: every token this admission re-prefilled is
+        # crash-replay recompute the uncrashed oracle never paid for
+        # (the paged prefix cache shrinks it via `reused`)
+        self._waste(
+            "crash_replay", len(request.prompt_tokens) - reused
+        )
+        flight.record(
+            "session_resume",
+            slot=index,
+            replayed=len(replay),
+            reused_tokens=reused,
+            trace_id=request.trace_id or "",
+        )
+        if request.cancelled:
+            self._finish(index, "cancelled")
+        elif (
+            len(replay) >= request.sampling.max_new_tokens
+            or slot.length + 1 >= self.max_seq_len
+        ):
+            # the crash raced the finish: the session was already at its
+            # budget/context boundary — close it out like the oracle did
+            self._finish(index, "length")
+
+    def _get_counts_restore(self):
+        """Jitted single-row overwrite of the penalty-count array: the
+        replay prefill reset the slot's row and counted its (discarded)
+        sample; this puts back the exact multiset of tokens the crashed
+        engine had accumulated, so the first resumed sample sees the
+        same penalty adjustments the oracle's would."""
+        fn = self._counts_restore_fn
+        if fn is None:
+
+            @jax.jit
+            def run(counts, index, row):
+                return (
+                    jax.lax.dynamic_update_slice(
+                        counts, row[None, :], (index, jnp.int32(0))
+                    ),
+                )
+
+            fn = run
+            self._counts_restore_fn = fn
+        return fn
+
+    def _restore_counts(self, index: int, tokens: List[int]) -> None:
+        row = np.zeros((self.config.vocab_size,), dtype=np.int32)
+        for token in tokens:
+            if 0 <= token < self.config.vocab_size:
+                row[token] += 1
+        run = self._get_counts_restore()
+        (self._counts,) = run(self._counts, np.int32(index), row)
 
     def _can_chain(self, inflight: Dict[str, Any]) -> bool:
         """A chunk may be pre-dispatched off the in-flight carry only when
@@ -2595,6 +2883,10 @@ class DecodeEngine:
     ) -> Dict[str, Any]:
         """Dispatch one decode chunk. With ``carry`` (a previous chunk's
         record), tokens/lengths chain on-device — no host round trip."""
+        faults.check("dispatch_error")
+        # chaos: a dispatch that WEDGES instead of erroring (stuck_step
+        # sleeps `dur` seconds here) — the watchdog/escalation test shape
+        faults.maybe_sleep("stuck_step")
         started = time.perf_counter()
         # summed (block-padded, for paged) context length of the chunk's
         # riders at dispatch — the roofline's attention/KV-read term
@@ -2849,7 +3141,14 @@ class DecodeEngine:
         self.stats["active_slot_steps"] += n_active * steps
         if len(self.chunk_log) < 65536:
             self.chunk_log.append((steps, n_active, wall))
-        DECODE_STEP_SECONDS.observe(wall / max(steps, 1))
+        step_s = wall / max(steps, 1)
+        # EWMA step time: the Retry-After estimator for shed requests
+        # and degraded-mode 503s (coarse but self-calibrating)
+        self._step_ewma = (
+            step_s if self._step_ewma is None
+            else 0.8 * self._step_ewma + 0.2 * step_s
+        )
+        DECODE_STEP_SECONDS.observe(step_s)
         # per-chunk roofline: modeled FLOPs/HBM bytes over measured wall
         # → MFU/MBU vs the per-chip peak. A chunk overlapped by
         # pipelining shares wall time with its neighbour, so per-chunk
@@ -2967,6 +3266,11 @@ class DecodeEngine:
                     ),
                 )
         self.stats["emit_time"] += time.perf_counter() - emit_started
+        # chaos: deterministic engine-thread death AFTER this chunk's
+        # tokens reached their callers — the supervisor must resurrect
+        # every live session from exactly this point, and the resumed
+        # continuation must match the uncrashed oracle bitwise
+        faults.check("engine_thread_crash")
 
     def _emit_token(
         self, index: int, token: int, logprob: float = 0.0, top=None
@@ -3018,9 +3322,16 @@ class DecodeEngine:
             logprobs = logprobs[:-1]
             if tops is not None:
                 tops = tops[:-1]
+        # resurrected sessions carry prompt + replay[:-1] in
+        # prompt_tokens; usage accounting must report the ORIGINAL
+        # prompt length, not the teacher-forced replay prefill's
+        prompt_tokens = (
+            request.prompt_len if request.prompt_len is not None
+            else len(request.prompt_tokens)
+        )
         result = GenerationResult(
             tokens=generated,
-            prompt_tokens=len(request.prompt_tokens),
+            prompt_tokens=prompt_tokens,
             finish_reason=reason,
             prefill_time=getattr(request, "_prefill_time", 0.0),
             logprobs=logprobs,
@@ -3141,9 +3452,16 @@ class DecodeEngine:
             self._post_future(
                 request,
                 GenerationResult(
-                    tokens=[],
-                    prompt_tokens=len(request.prompt_tokens),
+                    # a resurrected request cancelled before re-admission
+                    # still owes its caller the already-delivered tokens
+                    tokens=list(request.replay_tokens or []),
+                    prompt_tokens=(
+                        request.prompt_len
+                        if request.prompt_len is not None
+                        else len(request.prompt_tokens)
+                    ),
                     finish_reason="cancelled",
+                    logprobs=list(request.replay_logprobs or []),
                 ),
             )
 
@@ -3171,22 +3489,7 @@ class DecodeEngine:
         error = RuntimeError("decode engine crashed; see logs")
 
         def fail(request: GenerationRequest) -> None:
-            if request.future is None:
-                return
-
-            def resolve() -> None:
-                if not request.future.done():
-                    request.future.set_exception(error)
-
-            if request.loop is not None:
-                try:
-                    request.loop.call_soon_threadsafe(resolve)
-                except RuntimeError:
-                    # waiter's loop already closed (caller gave up) —
-                    # must not abort failing the REMAINING waiters
-                    pass
-            else:
-                resolve()
+            fail_request_future(request, error)
 
         # drain anything submitted but not yet picked up by the loop
         while True:
@@ -3205,6 +3508,101 @@ class DecodeEngine:
                 fail(slot.request)
                 slot.request = None
                 slot.prefilling = False
+
+    def _fail_stragglers(self) -> None:
+        """Fail (with the typed retryable error) any request sitting in
+        this retired engine's queue: the recovery drain already swept it
+        once, so nothing will ever read these again. Futures the drain
+        DID capture are untouched — they ride the resurrection."""
+        error = api_errors.EngineRebuildingError(
+            "engine is rebuilding after a crash; retry shortly",
+            retry_after_s=2.0,
+        )
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            if item is not None:
+                fail_request_future(item, error)
+
+    # ------------------------------------------------------------------ #
+    # supervisor takeover (runtime/supervisor.py)
+    # ------------------------------------------------------------------ #
+    def drain_for_recovery(self) -> List[GenerationRequest]:
+        """Turn every live session of this (dead or condemned) engine
+        into a request the supervisor can resubmit to a rebuilt one.
+
+        Active slots become REPLAY requests: ``prompt_tokens`` is
+        rewritten to ``prompt + generated[:-1]`` (a normal prefill
+        teaches it back into the cache — block-granular prefix hits make
+        it cheap on paged engines) and the accepted tokens ride
+        ``replay_tokens`` so :meth:`_resume_replay` fast-forwards the
+        slot bitwise. Queued / pending / still-prefilling requests (no
+        token ever reached their caller) resubmit untouched. Slots are
+        neutralized FIRST, so a wedged engine thread that wakes up after
+        an escalation takeover can never emit into a resurrected
+        caller's stream."""
+        requests: List[GenerationRequest] = []
+        # flag FIRST, then sweep: any submit whose put lands after this
+        # point either gets collected below or fails itself in submit()
+        # (_fail_stragglers) — no interleaving leaves a caller hanging
+        self._recovery_drained = True
+        # drain anything submitted but never picked up by the dead loop
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if item is not None:
+                self._pending.append(item)
+        for slot in self.slots:
+            if not slot.active:
+                continue
+            request = slot.request
+            generated = list(slot.generated or [])
+            logprobs = list(slot.logprobs or [])
+            tops = list(slot.tops) if slot.tops is not None else None
+            # neutralize before snapshotting anything else: a zombie
+            # thread finds the slot inactive and skips emission
+            slot.request = None
+            slot.prefilling = False
+            slot.epoch += 1
+            original = (
+                request.prompt_len if request.prompt_len is not None
+                else len(request.prompt_tokens)
+            )
+            if generated:
+                prompt = request.prompt_tokens[:original]
+                request.prompt_len = original
+                request.prompt_tokens = prompt + generated[:-1]
+                request.replay_tokens = generated
+                request.replay_logprobs = logprobs
+                request.replay_tops = tops
+            requests.append(request)
+        requests.extend(self._pending)
+        self._pending = []
+        self._prefill_inflight = []
+        return requests
+
+    def retire(self) -> None:
+        """Drop this engine from the /metrics aggregation immediately
+        (a superseded engine must not double-count against its
+        replacement while awaiting GC)."""
+        _LIVE_ENGINES.discard(self)
+
+    def absorb_stats(self, previous: Dict[str, Any]) -> None:
+        """Carry a crashed predecessor's cumulative counters into this
+        engine so every /metrics series stays monotonic across a
+        supervisor rebuild (a token counter dropping to zero reads as a
+        counter reset mid-incident — exactly when dashboards matter)."""
+        for key, value in previous.items():
+            if isinstance(value, dict):
+                mine = self.stats.setdefault(key, {})
+                for reason, count in value.items():
+                    mine[reason] = mine.get(reason, 0) + count
+            elif isinstance(value, (int, float)):
+                self.stats[key] = self.stats.get(key, 0) + value
 
 
 def _sampling_keys(

@@ -95,6 +95,13 @@ class PagedKVManager:
         genuinely cannot satisfy the request (every block referenced)."""
         if n <= 0:
             return []
+        from langstream_tpu.runtime import faults
+
+        if faults.fire("pool_exhausted") is not None:
+            # chaos (LANGSTREAM_FAULTS=pool_exhausted@...): report an
+            # exhausted pool without touching real state — admission
+            # backpressure / livelock handling on demand, CPU-testable
+            return None
         if len(self._free) < n:
             self._evict(n - len(self._free))
         if len(self._free) < n:

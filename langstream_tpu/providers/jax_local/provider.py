@@ -138,9 +138,7 @@ class JaxCompletionsService(CompletionsService):
             import secrets as _secrets
 
             sampling_seed = _secrets.randbits(32)
-        self.engine = DecodeEngine(
-            model_config,
-            params,
+        engine_kwargs = dict(
             mesh_config=mesh_config,
             max_slots=int(engine_config.get("max-slots", 8)),
             # coerce like every other engine knob: placeholder defaults
@@ -207,15 +205,31 @@ class JaxCompletionsService(CompletionsService):
                 }
                 or None
             ),
+            # admission deadline (serve --queue-timeout-s): pending
+            # requests older than this shed with a typed 503 instead of
+            # starving in the engine queue
+            queue_timeout_s=(
+                float(engine_config["queue-timeout-s"])
+                if engine_config.get("queue-timeout-s")
+                else None
+            ),
         )
-        self.top_logprobs_limit = self.engine.logprobs_topk
-        if str(engine_config.get("precompile", "")).lower() in (
+        precompile = str(engine_config.get("precompile", "")).lower() in (
             "1", "true", "yes",
-        ):
-            # compile every prefill/decode variant before the first
-            # request so no jit compile ever stalls live traffic
-            self.engine.precompile()
-        self.engine.start()
+        )
+
+        def build_engine() -> DecodeEngine:
+            # the supervisor's rebuild path runs this exact closure:
+            # config + ALREADY-LOADED weights are captured, so healing
+            # never reloads a checkpoint, and precompiled variants come
+            # back through the persistent XLA compile cache
+            engine = DecodeEngine(model_config, params, **engine_kwargs)
+            if precompile:
+                # compile every prefill/decode variant before the first
+                # request so no jit compile ever stalls live traffic
+                engine.precompile()
+            return engine
+
         # decode-stall watchdog: opt-in (`serve` turns it on; pods via
         # engine config or LANGSTREAM_WATCHDOG=1) — a degraded/wedged
         # engine flushes flight evidence and bumps watchdog_trips_total
@@ -226,11 +240,68 @@ class JaxCompletionsService(CompletionsService):
                 "watchdog", os.environ.get("LANGSTREAM_WATCHDOG", "")
             )
         ).lower()
-        if watchdog_flag in ("1", "true", "yes", "on"):
+        watchdog_on = watchdog_flag in ("1", "true", "yes", "on")
+
+        def build_watchdog(engine: DecodeEngine):
             from langstream_tpu.runtime.watchdog import EngineWatchdog
 
-            self.watchdog = EngineWatchdog(self.engine)
-            self.watchdog.start()
+            return EngineWatchdog(engine)
+
+        # engine supervisor (self-healing serving): on by default — a
+        # crashed device thread snapshots every live session, rebuilds
+        # the engine, and resumes each stream bitwise instead of mass-
+        # 500ing. Opt out via engine config `supervisor: false`,
+        # LANGSTREAM_SUPERVISOR=0, or `serve --no-supervisor` (the
+        # multi-host mirror path disables it — a rebuilt leader cannot
+        # resynchronize followers yet).
+        self._supervisor = None
+        self._engine: Optional[DecodeEngine] = None
+        supervised = str(
+            engine_config.get(
+                "supervisor", os.environ.get("LANGSTREAM_SUPERVISOR", "1")
+            )
+        ).lower() not in ("0", "false", "no", "off")
+        if supervised:
+            from langstream_tpu.runtime.supervisor import EngineSupervisor
+
+            self._supervisor = EngineSupervisor(
+                build_engine,
+                max_restarts=int(engine_config.get("max-restarts") or 3),
+                restart_window_s=float(
+                    engine_config.get("restart-window-s") or 600.0
+                ),
+                watchdog_factory=build_watchdog if watchdog_on else None,
+            )
+            self.watchdog = self._supervisor.watchdog
+        else:
+            self._engine = build_engine()
+            self._engine.start()
+            if watchdog_on:
+                self.watchdog = build_watchdog(self._engine)
+                self.watchdog.start()
+        self.top_logprobs_limit = self.engine.logprobs_topk
+
+    @property
+    def engine(self):
+        """The CURRENT engine: the supervisor swaps it on a rebuild, so
+        everything downstream (metrics callbacks, the serve wiring, the
+        mirror hookup) must read through this property rather than
+        caching the instance."""
+        if self._supervisor is not None:
+            return self._supervisor.engine
+        return self._engine
+
+    def available(self) -> Optional[float]:
+        """None when accepting work; otherwise the seconds a caller
+        should wait (degraded mode: the supervisor is rebuilding a
+        crashed engine). The OpenAI surface turns this into
+        503 + Retry-After before burning any tokenization work."""
+        supervisor = self._supervisor
+        if supervisor is not None and supervisor.state == "rebuilding":
+            # (a supervisor past its restart budget is "failed", which
+            # is terminal — those requests should 500, not retry)
+            return supervisor.retry_after()
+        return None
 
     async def get_chat_completions(
         self,
@@ -261,6 +332,19 @@ class JaxCompletionsService(CompletionsService):
         stream_consumer: Optional[StreamingChunksConsumer] = None,
     ) -> ChatCompletionResult:
         from langstream_tpu.providers.jax_local.engine import SamplingParams
+
+        wait = self.available()
+        if wait is not None:
+            # degraded mode: the supervisor is mid-rebuild — bounce NEW
+            # work with a typed retryable error (503 + Retry-After on
+            # the HTTP surfaces) before spending any engine work; the
+            # engine's own submit() backstops the race
+            from langstream_tpu.api import errors as api_errors
+
+            raise api_errors.EngineRebuildingError(
+                "engine is rebuilding after a crash; retry shortly",
+                retry_after_s=wait,
+            )
         sampling = SamplingParams(
             temperature=float(options.get("temperature") or 0.0),
             top_k=int(options.get("top-k") or 0),
@@ -456,6 +540,9 @@ class JaxCompletionsService(CompletionsService):
         )
 
     async def close(self) -> None:
+        if self._supervisor is not None:
+            self._supervisor.stop()  # owns its watchdog + engine
+            return
         if self.watchdog is not None:
             self.watchdog.stop()
         self.engine.stop()

@@ -19,6 +19,16 @@ by the current emitters:
   step_ms, queue_depth, kv_frac, tokens (cumulative)
 - ``engine_crash``  — the engine loop died: error repr
 - ``engine_stop``   — clean engine shutdown + final stats
+- ``fault_injected``    — a deterministic chaos fault fired
+  (``runtime/faults.py``): point, arrival, spec
+- ``engine_recovery``   — supervisor heal arc (``runtime/supervisor.py``):
+  phase begin/complete/gave_up/rebuild_failed, sessions, recovery_s
+- ``session_resume``    — one resurrected session fast-forwarded:
+  slot, replayed tokens, prefix-cache-reused tokens
+- ``request_shed``      — a pending request failed fast at its
+  admission deadline: waited_s, queue_depth, retry_after_s
+- ``watchdog_escalation`` — N watchdog trips in a window handed the
+  engine to the supervisor
 
 Disabled (the default) the recorder is a single ``if`` per call; enable
 with :func:`configure` or the ``LANGSTREAM_FLIGHT_DIR`` env var (every

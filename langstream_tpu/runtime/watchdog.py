@@ -74,6 +74,8 @@ class EngineWatchdog:
         capture_min_interval_s: float = 600.0,
         capture_seconds: float = 3.0,
         profile_dir: Optional[str] = None,
+        escalate_trips: int = 3,
+        escalate_window_s: float = 600.0,
     ) -> None:
         self.engine = engine
         self.interval = interval
@@ -101,6 +103,19 @@ class EngineWatchdog:
         self._last_capture: Optional[float] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # escalation (the supervisor's second detection signal):
+        # `escalate_trips` trips within `escalate_window_s` means the
+        # engine is not healing on its own — hand it to `on_escalate`
+        # (wired by EngineSupervisor to a snapshot/rebuild/resume
+        # restart). Evidence-only behavior (flush, profile, counter) is
+        # unchanged; with no callback the escalation is a no-op, and the
+        # existing LANGSTREAM_WATCHDOG / --no-watchdog opt-out still
+        # disables everything. Escalation fires ONCE per window.
+        self.escalate_trips = max(1, int(escalate_trips))
+        self.escalate_window_s = float(escalate_window_s)
+        self.on_escalate: Optional[Any] = None
+        self._trip_times: List[float] = []
+        self._escalated_at: Optional[float] = None
 
     # ------------------------------------------------------------------ #
     # lifecycle
@@ -116,6 +131,12 @@ class EngineWatchdog:
     def stop(self) -> None:
         self._stop.set()
         if self._thread is not None:
+            if self._thread is threading.current_thread():
+                # called from our own loop (supervisor escalation tears
+                # the old watchdog down from inside on_escalate): the
+                # stop flag ends the loop right after this check returns
+                self._thread = None
+                return
             self._thread.join(timeout=self.interval + 5)
             self._thread = None
 
@@ -281,6 +302,41 @@ class EngineWatchdog:
                 target=self._capture, name="watchdog-capture", daemon=True
             )
             thread.start()
+        # escalation LAST: the trip's flight evidence is flushed above,
+        # so a synchronous supervisor restart (which tears this watchdog
+        # down from inside the callback) can't lose it
+        self._note_escalation(reason, now)
+
+    def _note_escalation(self, reason: str, now: float) -> None:
+        self._trip_times.append(now)
+        cutoff = now - self.escalate_window_s
+        self._trip_times = [t for t in self._trip_times if t >= cutoff]
+        if len(self._trip_times) < self.escalate_trips:
+            return
+        if (
+            self._escalated_at is not None
+            and now - self._escalated_at < self.escalate_window_s
+        ):
+            return  # one escalation per window — the restart is underway
+        self._escalated_at = now
+        flight.record(
+            "watchdog_escalation",
+            reason=reason,
+            trips=len(self._trip_times),
+            window_s=self.escalate_window_s,
+        )
+        flight.flush()
+        callback = self.on_escalate
+        if callback is None:
+            return
+        logger.error(
+            "watchdog: %d trips within %.0fs — escalating (%s)",
+            len(self._trip_times), self.escalate_window_s, reason,
+        )
+        try:
+            callback(f"watchdog_escalation:{reason}")
+        except Exception:  # noqa: BLE001 — escalation failing must not
+            logger.exception("watchdog escalation failed")  # kill the loop
 
     def _capture(self) -> None:
         from langstream_tpu.runtime import profiling

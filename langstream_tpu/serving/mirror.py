@@ -308,6 +308,12 @@ class FollowerExecutor:
                     return self.records
                 if kind == "stop":
                     return self.records
+                # chaos (LANGSTREAM_FAULTS=mirror_follower@step=N): a
+                # follower dying mid-replay — the leader-side handling
+                # of a dropped follower is part of the fault surface
+                from langstream_tpu.runtime import faults
+
+                faults.check("mirror_follower")
                 self._execute(kind, meta, arrays)
                 self.records += 1
         finally:

@@ -29,6 +29,7 @@ from typing import Any, Dict, Optional
 
 from aiohttp import web
 
+from langstream_tpu.api import errors as api_errors
 from langstream_tpu.api.service import ChatMessage
 
 
@@ -45,6 +46,19 @@ def _error(status: int, message: str) -> web.Response:
             else "server_error",
         }},
         status=status,
+    )
+
+
+def _unavailable(message: str, retry_after_s: float) -> web.Response:
+    """Degraded mode (engine rebuilding / queue shed): a BOUNDED 503
+    with a Retry-After hint — the client-visible contract that a crash
+    heals instead of 500ing (load balancers and SDKs both honor it)."""
+    import math
+
+    return web.json_response(
+        {"error": {"message": message, "type": "overloaded_error"}},
+        status=503,
+        headers={"Retry-After": str(max(1, math.ceil(retry_after_s)))},
     )
 
 
@@ -222,6 +236,17 @@ class OpenAIApiServer:
     async def _complete(self, request, *, chat: bool) -> web.StreamResponse:
         if self.completions is None:
             return _error(503, "no completions service configured")
+        # degraded-mode gate: while the engine supervisor rebuilds a
+        # crashed engine, NEW work (streaming included — checked before
+        # the SSE response is prepared) answers 503 + Retry-After;
+        # in-flight streams are resurrected, not failed
+        probe = getattr(self.completions, "available", None)
+        retry_in = probe() if callable(probe) else None
+        if retry_in is not None:
+            return _unavailable(
+                "engine is rebuilding after a crash; retry shortly",
+                retry_in,
+            )
         try:
             body = await request.json()
         except json.JSONDecodeError:
@@ -327,15 +352,49 @@ class OpenAIApiServer:
                 ]
                 try:
                     results = await asyncio.gather(*tasks)
-                except BaseException:
-                    # first failure: cancel siblings so their engine
-                    # generations free their slots instead of decoding
-                    # answers nobody will read
-                    for task in tasks:
-                        if not task.done():
-                            task.cancel()
-                    await asyncio.gather(*tasks, return_exceptions=True)
-                    raise
+                except BaseException as first:
+                    # a REAL first failure cancels siblings so their
+                    # engine generations free their slots instead of
+                    # decoding answers nobody will read. But when the
+                    # exception gather surfaces FIRST is a
+                    # CancelledError (a choice's cancel racing its own
+                    # completion), the real failure may still be
+                    # PENDING in a sibling — cancelling it here would
+                    # destroy the very error the caller needs, and the
+                    # client would see a bare dropped connection
+                    if not isinstance(first, asyncio.CancelledError):
+                        for task in tasks:
+                            if not task.done():
+                                task.cancel()
+                    try:
+                        outcomes = await asyncio.gather(
+                            *tasks, return_exceptions=True
+                        )
+                    except asyncio.CancelledError:
+                        # the HANDLER itself was cancelled (client
+                        # disconnected): free the slots and propagate
+                        for task in tasks:
+                            if not task.done():
+                                task.cancel()
+                        raise
+                    # propagate the first REAL error over a
+                    # cancellation artifact (explicitly, not via bare
+                    # `raise`: re-raising after an await can swallow
+                    # the original type)
+                    if isinstance(first, asyncio.CancelledError):
+                        for outcome in outcomes:
+                            if isinstance(
+                                outcome, BaseException
+                            ) and not isinstance(
+                                outcome, asyncio.CancelledError
+                            ):
+                                first = outcome
+                                break
+                    raise first
+            except api_errors.UnavailableError as error:
+                # typed retryable failures (queue shed, engine rebuild):
+                # bounded 503s with Retry-After, never 500s
+                return _unavailable(str(error), error.retry_after_s)
             except (ValueError, TypeError) as error:
                 return _error(400, str(error))
             choices = []

@@ -55,6 +55,13 @@ def _sample_exposition() -> str:
         "jax_engine_slo_ttft_burn_rate_5m": 0.8,
         "jax_engine_slo_ttft_burn_rate_1h": 0.4,
         "watchdog_trips_total": 1.0,
+        # self-healing serving (ISSUE 9): supervisor recovery counters,
+        # the degraded-mode gauge, crash-replay waste, load shedding
+        'jax_engine_tokens_wasted_total{reason="crash_replay"}': 12.0,
+        "engine_restarts_total": 1.0,
+        "sessions_resurrected_total": 2.0,
+        "engine_degraded": 0.0,
+        'requests_shed_total{reason="queue_timeout"}': 3.0,
     }
     return prometheus_text(
         reporter.snapshot(), gauges, reporter.histogram_snapshots(),
@@ -87,6 +94,17 @@ def _sample_exposition() -> str:
             "watchdog_trips_total":
                 "decode-stall watchdog trips (degraded / no-progress /"
                 " kv-pool livelock)",
+            "engine_restarts_total":
+                "supervisor engine rebuilds (crash or watchdog"
+                " escalation)",
+            "sessions_resurrected_total":
+                "live sessions re-admitted bitwise onto a rebuilt engine",
+            "engine_degraded":
+                "1 while the supervisor is rebuilding (serving 503 +"
+                " Retry-After) or terminally failed",
+            "requests_shed_total":
+                "pending requests failed fast at the admission deadline,"
+                " by reason",
         },
     )
 

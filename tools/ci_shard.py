@@ -38,6 +38,10 @@ SHARDS: Dict[str, List[str]] = {
         # multi-chip paged serving (shard_map'd fused kernel, tp=2
         # engine A/Bs, compiled-HLO collective assertions) — JAX-heavy
         "test_multichip_paged",
+        # self-healing serving (fault injection, supervisor rebuilds,
+        # bitwise session resurrection) constructs DecodeEngines —
+        # JAX-heavy shard
+        "test_recovery",
         "test_decode_kernel",
         "test_kv_quant",
         "test_quant",

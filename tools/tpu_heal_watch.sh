@@ -185,6 +185,21 @@ y.block_until_ready()" 2>/dev/null
             else
                 echo "$(date -u +%FT%TZ) spec-decode A/B failed (non-fatal)" >> "$LOG"
             fi
+            # 2d) chaos leg: one mid-run engine-thread crash under full
+            #    load — the supervisor must rebuild and resume every
+            #    stream, and the leg's number (read next to the main
+            #    run's via the chaos= column) prices the recovery
+            #    window + crash_replay overhead. ab_analyze digests
+            #    recovery_seconds / sessions_resurrected from the
+            #    flight artifact. Jit graphs are the main run's — no
+            #    extra warm needed. Non-fatal like every A/B leg.
+            if BENCH_CHAOS="engine_thread_crash@step=200" \
+                BENCH_DEADLINE=3600 BENCH_INIT_TIMEOUT=600 \
+                python bench.py > "${OUT%.json}_chaos.json" 2>> "$LOG"; then
+                echo "$(date -u +%FT%TZ) chaos leg done: $(cat "${OUT%.json}_chaos.json")" >> "$LOG"
+            else
+                echo "$(date -u +%FT%TZ) chaos leg failed (non-fatal)" >> "$LOG"
+            fi
             # 3) admission-chunk A/B: short chunks while admissions
             #    wait (TTFT/p50-RTT lever; compare p50_rtt_ms +
             #    p50_ttft_ms against the main run's at equal tok/s)
