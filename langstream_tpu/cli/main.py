@@ -543,6 +543,65 @@ async def _top_cmd(args) -> None:
                     print("  -- SLO --")
                     for row in slo_rows:
                         print(row)
+                # fleet panel: rendered when the target serves fleet
+                # gauges (a gateway with a registered FleetRouter /
+                # FleetController) — per-replica queue depth + state,
+                # the affinity hit rate, and current/target replicas
+                if "fleet_replicas_current" in metrics or (
+                    "fleet_replica_queue_depth" in metrics
+                ):
+                    # a bare FleetRouter (no controller) exports only
+                    # the known-replica count
+                    current = gauge(
+                        "fleet_replicas_current",
+                        gauge("fleet_replicas_known"),
+                    )
+                    target_samples = metrics.get("fleet_replicas_target")
+                    target = (
+                        f"{target_samples[0][1]:.0f}" if target_samples
+                        else "n/a"
+                    )
+                    print(
+                        f"  -- fleet --  replicas {current:.0f} "
+                        f"(target {target}, "
+                        f"routable {gauge('fleet_replicas_routable'):.0f})"
+                    )
+                    if "fleet_affinity_hit_rate" in metrics:
+                        routed = {
+                            labels.get("policy", "?"): value
+                            for labels, value in metrics.get(
+                                "fleet_routed_total", []
+                            )
+                        }
+                        routed_txt = " ".join(
+                            f"{policy}={count:.0f}"
+                            for policy, count in sorted(routed.items())
+                            if count
+                        )
+                        print(
+                            f"  affinity hit rate "
+                            f"{gauge('fleet_affinity_hit_rate'):7.1%}  "
+                            f"(prefix tokens matched "
+                            f"{gauge('fleet_prefix_match_tokens_total'):.0f}"
+                            f"; routed {routed_txt or '0'})"
+                        )
+                    states = {
+                        labels.get("replica", "?"): labels.get("state", "?")
+                        for labels, value in metrics.get(
+                            "fleet_replica_state", []
+                        )
+                        if value
+                    }
+                    for labels, depth in sorted(
+                        metrics.get("fleet_replica_queue_depth", []),
+                        key=lambda s: s[0].get("replica", ""),
+                    ):
+                        replica = labels.get("replica", "?")
+                        state = states.get(replica, "?")
+                        print(
+                            f"    {replica:20s} queue {depth:5.0f}  "
+                            f"[{state}]"
+                        )
             if args.count and iteration >= args.count:
                 break
             await asyncio.sleep(args.interval)

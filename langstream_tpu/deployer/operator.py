@@ -37,6 +37,10 @@ from langstream_tpu.model.application import Application
 logger = logging.getLogger(__name__)
 
 _APP_LABEL = "langstream.tpu/application"
+# set by Operator.scale: the fleet autoscaler owns this StatefulSet's
+# replica count, and level-based reconcile must not snap it back to
+# the plan's parallelism (HPA-ownership semantics)
+_FLEET_REPLICAS_ANNOTATION = "langstream.tpu/fleet-replicas"
 
 
 class Operator:
@@ -109,10 +113,24 @@ class Operator:
         agent = AgentCustomResource.from_manifest(agent_doc)
         self.kube.apply(generate_agent_secret(agent))
         self.kube.apply(generate_headless_service(agent))
-        self.kube.apply(generate_statefulset(
+        manifest = generate_statefulset(
             agent, image=self.image, accelerator=self.accelerator,
             code_storage_config=self.code_storage_config,
-        ))
+        )
+        existing = self.kube.get("StatefulSet", agent.namespace, agent.name)
+        if existing is not None:
+            autoscaled = (
+                existing.get("metadata", {}).get("annotations") or {}
+            ).get(_FLEET_REPLICAS_ANNOTATION)
+            if autoscaled is not None:
+                # the fleet autoscaler owns the count: re-applying the
+                # plan's parallelism would silently undo a live scale
+                # decision on every reconcile pass
+                manifest["spec"]["replicas"] = int(autoscaled)
+                manifest.setdefault("metadata", {}).setdefault(
+                    "annotations", {}
+                )[_FLEET_REPLICAS_ANNOTATION] = autoscaled
+        self.kube.apply(manifest)
         sts = self.kube.get("StatefulSet", agent.namespace, agent.name)
         self.kube.patch_status(
             "Agent", agent.namespace, agent.name,
@@ -122,6 +140,38 @@ class Operator:
                 "observedGeneration": agent.generation,
             },
         )
+
+    def scale(self, namespace: str, name: str, replicas: int) -> int:
+        """Patch an agent StatefulSet's replica count — the fleet
+        autoscaler's actuator (``fleet/autoscaler.py``). Goes through
+        the same apply path as reconcile (generation bump on spec
+        change), and mirrors the count into the Agent CR status so
+        ``apps get`` tells the truth. Returns the applied count."""
+        replicas = max(0, int(replicas))
+        sts = self.kube.get("StatefulSet", namespace, name)
+        if sts is None:
+            raise LookupError(f"no StatefulSet {namespace}/{name} to scale")
+        annotations = sts.setdefault("metadata", {}).setdefault(
+            "annotations", {}
+        )
+        if (
+            sts["spec"].get("replicas") != replicas
+            or annotations.get(_FLEET_REPLICAS_ANNOTATION) != str(replicas)
+        ):
+            sts["spec"]["replicas"] = replicas
+            # mark autoscaler ownership so reconcile_agent preserves
+            # the count instead of re-applying the plan's parallelism
+            annotations[_FLEET_REPLICAS_ANNOTATION] = str(replicas)
+            self.kube.apply(sts)
+            logger.info(
+                "scaled StatefulSet %s/%s to %d replicas",
+                namespace, name, replicas,
+            )
+        if self.kube.get("Agent", namespace, name) is not None:
+            self.kube.patch_status(
+                "Agent", namespace, name, {"replicas": replicas}
+            )
+        return replicas
 
     def _delete_agent(self, namespace: str, name: str) -> None:
         self.kube.delete("StatefulSet", namespace, name)

@@ -1,0 +1,73 @@
+"""Fleet layer: N independent runner pods become one scheduled fleet.
+
+Three pieces, all CPU-verifiable (memory topics + MockKubeApi):
+
+- :mod:`~langstream_tpu.fleet.router` — prefix-affinity routing over
+  gossiped hash-chain digests (the paged prefix cache's
+  ``(parent_block, chunk)`` chaining re-expressed as rolling keyed
+  digests that cross process boundaries), with least-queue-depth
+  fallback and degraded/condemned/draining replicas taken out of
+  rotation.
+- :mod:`~langstream_tpu.fleet.autoscaler` — SLO burn rates + queue
+  depth + shed deltas → hysteretic replica-count decisions actuated
+  through ``Operator.scale`` (drain-then-shrink on the way down).
+- :mod:`~langstream_tpu.fleet.sim` — M fake engines with REAL paged
+  prefix caches behind memory topics; the acceptance instrument for
+  affinity-vs-round-robin hit tokens, kill-mid-stream re-routing, and
+  scale-up/down behavior (``tests/test_fleet.py``), plus the
+  ``bench_fleet_*.json`` A/B artifacts ``tools/ab_analyze.py`` digests.
+
+See ``docs/fleet.md`` for the heartbeat schema, scoring, and policy.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from langstream_tpu.fleet.autoscaler import (  # noqa: F401
+    AutoscaleDecision,
+    AutoscalePolicy,
+    SLOAutoscaler,
+)
+from langstream_tpu.fleet.router import (  # noqa: F401
+    REPLICA_HEADER,
+    FleetRouter,
+    NoRoutableReplica,
+    RouteDecision,
+    digests_from_keys,
+    prompt_digests,
+)
+
+
+class FleetController:
+    """Router + optional autoscaler behind one face: the object a
+    front door (gateway, OpenAI server) registers to get routing
+    decisions and a single merged ``gauges()`` for its /metrics."""
+
+    def __init__(
+        self,
+        router: FleetRouter,
+        autoscaler: Optional[SLOAutoscaler] = None,
+        *,
+        replicas_current=None,
+    ) -> None:
+        self.router = router
+        self.autoscaler = autoscaler
+        # zero-arg callable returning the actuated replica count (e.g.
+        # a StatefulSet spec read); None = report the router's view
+        self._replicas_current = replicas_current
+
+    def route(self, prompt_tokens=None, now=None) -> RouteDecision:
+        return self.router.route(prompt_tokens, now=now)
+
+    def gauges(self, now: Optional[float] = None) -> Dict[str, float]:
+        out = self.router.gauges(now=now)
+        if self._replicas_current is not None:
+            out["fleet_replicas_current"] = float(self._replicas_current())
+        else:
+            out["fleet_replicas_current"] = out.get(
+                "fleet_replicas_known", 0.0
+            )
+        if self.autoscaler is not None:
+            out.update(self.autoscaler.gauges())
+        return out

@@ -1,0 +1,321 @@
+"""SLO-driven autoscaler: burn rates + queue depth → replica count.
+
+The control loop consumes the signals every replica already exports
+through ``engines_snapshot`` and gossips in its heartbeat (see
+``fleet/heartbeat.py``):
+
+- **SLO burn rates** (``jax_engine_slo_{ttft,tpot}_burn_rate_5m`` from
+  PR 4's multi-window :class:`~langstream_tpu.runtime.accounting.SLOTracker`):
+  burn > 1 means the fleet is consuming error budget faster than the
+  SLO allows — the canonical "scale up" signal (DeepServe, arxiv
+  2501.14417, scales on exactly this).
+- **Queue depth** per replica (``jax_engine_queue_depth``): backlog
+  that will become TTFT violations one admission later.
+- **Shed counts** (``requests_shed_total{reason="queue_timeout"}``):
+  a nonzero delta means the admission deadline is already failing
+  callers — pressure regardless of what the burn windows say yet.
+
+Decisions are **hysteretic** so the fleet never flaps: scale-up needs
+the up-cooldown elapsed, scale-down additionally needs
+``idle_evals`` consecutive calm evaluations AND the down-cooldown —
+and a scale-down never kills sessions: the victim (highest ordinal,
+matching StatefulSet semantics) is first marked **draining** in the
+router (no new sessions; resident prefix chains age out with the last
+ones), and the StatefulSet is only shrunk once the victim reports an
+empty queue and zero active sessions.
+
+The actuator is ``Operator.scale(namespace, name, replicas)`` patching
+the StatefulSet through the kube API — :class:`MockKubeApi` in tests,
+so the whole loop is CPU-verifiable end to end.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from langstream_tpu.fleet.router import FleetRouter, ReplicaState
+
+logger = logging.getLogger(__name__)
+
+_BURN_KEYS = (
+    "jax_engine_slo_ttft_burn_rate_5m",
+    "jax_engine_slo_tpot_burn_rate_5m",
+)
+_SHED_KEY = 'requests_shed_total{reason="queue_timeout"}'
+
+
+@dataclasses.dataclass
+class AutoscalePolicy:
+    """Thresholds + hysteresis knobs. Defaults suit a small fleet; the
+    important invariants are threshold GAPS (burn_up > burn_down,
+    queue_up > queue_down) — equal thresholds would flap on noise."""
+
+    min_replicas: int = 1
+    max_replicas: int = 8
+    burn_up: float = 1.0        # any replica burning budget ≥ as fast as allowed
+    burn_down: float = 0.25     # all replicas comfortably inside budget
+    queue_up: float = 4.0       # mean backlog per replica
+    queue_down: float = 0.5
+    up_cooldown_s: float = 30.0
+    down_cooldown_s: float = 120.0
+    idle_evals: int = 3         # consecutive calm evaluations before down
+    step: int = 1               # replicas added per scale-up decision
+
+    def __post_init__(self) -> None:
+        if self.burn_down >= self.burn_up:
+            raise ValueError("burn_down must be < burn_up (hysteresis gap)")
+        if self.queue_down >= self.queue_up:
+            raise ValueError("queue_down must be < queue_up (hysteresis gap)")
+        if not 1 <= self.min_replicas <= self.max_replicas:
+            raise ValueError("need 1 <= min_replicas <= max_replicas")
+
+
+@dataclasses.dataclass
+class AutoscaleDecision:
+    current: int
+    target: int
+    reason: str
+    draining: List[str] = dataclasses.field(default_factory=list)
+
+
+class SLOAutoscaler:
+    """One fleet's scaling brain. ``scale`` is the actuator callback
+    ``(replicas: int) -> None`` — typically
+    ``lambda n: operator.scale(namespace, name, n)``. All clock inputs
+    take an explicit ``now`` so simulated fleets run on simulated
+    time."""
+
+    def __init__(
+        self,
+        policy: Optional[AutoscalePolicy] = None,
+        *,
+        scale: Optional[Callable[[int], None]] = None,
+    ) -> None:
+        self.policy = policy or AutoscalePolicy()
+        self._scale = scale
+        self._last_up_at = float("-inf")
+        self._last_down_at = float("-inf")
+        self._calm_evals = 0
+        # per-replica shed baselines: a replica blinking out of one
+        # eval's fresh set and back must not re-count its lifetime
+        # counter as new pressure (entries persist across absences;
+        # max(0, …) absorbs a restarted replica's counter reset)
+        self._last_shed: Dict[str, float] = {}
+        self._draining: List[str] = []
+        self.last_eval_hot = False
+        self.target = 0  # last decided target (0 = no evaluation yet)
+        self.events: Dict[str, int] = {"up": 0, "down": 0}
+        self.decisions: List[AutoscaleDecision] = []
+
+    # ------------------------------------------------------------------ #
+    # signal extraction
+    # ------------------------------------------------------------------ #
+    def _pressure(self, replicas: Sequence[ReplicaState]) -> Dict[str, float]:
+        max_burn, queue_sum, shed_delta = 0.0, 0.0, 0.0
+        for state in replicas:
+            for key in _BURN_KEYS:
+                max_burn = max(max_burn, state.gauges.get(key, 0.0))
+            queue_sum += state.queue_depth
+            if _SHED_KEY in state.gauges:
+                shed = state.gauges[_SHED_KEY]
+                baseline = self._last_shed.get(state.replica_id)
+                if baseline is not None:
+                    shed_delta += max(0.0, shed - baseline)
+                # first sighting establishes the baseline only — a
+                # joining replica's lifetime counter is not a spike
+                self._last_shed[state.replica_id] = shed
+        mean_queue = queue_sum / len(replicas) if replicas else 0.0
+        return {
+            "max_burn": max_burn,
+            "mean_queue": mean_queue,
+            "shed_delta": shed_delta,
+        }
+
+    # ------------------------------------------------------------------ #
+    # decision
+    # ------------------------------------------------------------------ #
+    def evaluate(
+        self,
+        replicas: Sequence[ReplicaState],
+        current: int,
+        now: Optional[float] = None,
+    ) -> AutoscaleDecision:
+        """Pure-ish decision: computes the target count from the fleet
+        view without actuating. Records the decision for flap audits
+        (tests assert the sequence is monotone per direction)."""
+        now = time.monotonic() if now is None else now
+        policy = self.policy
+        signals = self._pressure(replicas)
+        shed_delta = signals["shed_delta"]
+
+        hot = (
+            signals["max_burn"] >= policy.burn_up
+            or signals["mean_queue"] >= policy.queue_up
+            or shed_delta > 0
+        )
+        calm = (
+            signals["max_burn"] <= policy.burn_down
+            and signals["mean_queue"] <= policy.queue_down
+            and shed_delta == 0
+        )
+
+        target, reason = current, "steady"
+        self.last_eval_hot = hot
+        if hot:
+            self._calm_evals = 0
+            if now - self._last_up_at >= policy.up_cooldown_s:
+                target = min(policy.max_replicas, current + policy.step)
+                if target > current:
+                    reason = (
+                        f"scale-up: burn {signals['max_burn']:.2f} / "
+                        f"queue {signals['mean_queue']:.1f} / "
+                        f"shed +{shed_delta:.0f}"
+                    )
+                else:
+                    reason = "pressure at max_replicas"
+            else:
+                reason = "pressure inside up-cooldown"
+        elif calm:
+            self._calm_evals += 1
+            if (
+                self._calm_evals >= policy.idle_evals
+                and now - self._last_down_at >= policy.down_cooldown_s
+                # never shrink while budget was recently burning: the
+                # up-cooldown doubles as a post-spike refractory period
+                and now - self._last_up_at >= policy.up_cooldown_s
+            ):
+                target = max(policy.min_replicas, current - 1)
+                if target < current:
+                    reason = (
+                        f"scale-down: calm x{self._calm_evals} "
+                        f"(burn {signals['max_burn']:.2f}, "
+                        f"queue {signals['mean_queue']:.1f})"
+                    )
+        else:
+            # the hysteresis band between thresholds: hold position
+            self._calm_evals = 0
+
+        decision = AutoscaleDecision(
+            current=current, target=target, reason=reason,
+            draining=list(self._draining),
+        )
+        self.decisions.append(decision)
+        self.target = target
+        return decision
+
+    # ------------------------------------------------------------------ #
+    # actuation with drain
+    # ------------------------------------------------------------------ #
+    def step(
+        self,
+        router: FleetRouter,
+        current: int,
+        now: Optional[float] = None,
+    ) -> AutoscaleDecision:
+        """Evaluate against the router's live view and actuate:
+        scale-up immediately; scale-down via drain-then-shrink."""
+        now = time.monotonic() if now is None else now
+        view = router.snapshot_states()
+        fresh = [
+            s for s in view if s.fresh(now, router.heartbeat_timeout_s)
+        ]
+        decision = self.evaluate(fresh or view, current, now)
+
+        if self._draining and self.last_eval_hot:
+            # demand is back — even at max_replicas, where no actuated
+            # scale-up will fire: letting the drain complete would
+            # shrink a HOT fleet below max and flap straight back up
+            for replica_id in self._draining:
+                router.mark_draining(replica_id, False)
+            self._draining = []
+            decision.draining = []
+
+        if decision.target > current:
+            self._last_up_at = now
+            self.events["up"] += 1
+            if self._scale is not None:
+                self._scale(decision.target)
+            return decision
+
+        if decision.target < current and not self._draining:
+            # victim = highest ordinal (StatefulSets shrink from the
+            # top); drain first, shrink when it reports idle
+            victims = [s.replica_id for s in view if not s.draining]
+            if victims:
+                # length-then-lex = numeric order for `name-<ordinal>`
+                # ids ("runner-10" drains before "runner-2" would)
+                victim = sorted(victims, key=lambda r: (len(r), r))[-1]
+                self._draining = [victim]
+                router.mark_draining(victim, True)
+                decision.draining = [victim]
+                logger.info("fleet scale-down: draining %s", victim)
+
+        if self._draining:
+            drained = []
+            for replica_id in self._draining:
+                state = router.state_of(replica_id)
+                # drained when idle — or gone: a victim that crashed
+                # mid-drain stops heartbeating, and its frozen
+                # last-gossiped queue depth must not wedge the drain
+                # (and with it every future scale-down) forever
+                if state is None or not state.fresh(
+                    now, router.heartbeat_timeout_s
+                ) or (
+                    state.queue_depth <= 0 and state.active_sessions <= 0
+                ):
+                    drained.append(replica_id)
+            if drained:
+                self._last_down_at = now
+                self._calm_evals = 0
+                self.events["down"] += 1
+                new_target = max(
+                    self.policy.min_replicas, current - len(drained)
+                )
+                for replica_id in drained:
+                    self._draining.remove(replica_id)
+                    # do NOT forget the victim yet: the pod keeps
+                    # heartbeating until kube actually terminates it,
+                    # and a forgotten entry would re-register fresh and
+                    # serving — routing new sessions onto a dying pod.
+                    # The draining mark stays (observe never clears
+                    # it); the reaper below removes the entry once its
+                    # gossip goes stale, and a future re-grown ordinal
+                    # re-enters via its new epoch.
+                decision.target = new_target
+                decision.reason = (
+                    f"scale-down applied: drained {','.join(drained)}"
+                )
+                decision.draining = list(self._draining)
+                self.target = new_target
+                if self._scale is not None:
+                    self._scale(new_target)
+        # reap terminated victims: draining, no longer ours to watch,
+        # and silent past the timeout = the pod is actually gone
+        for state in router.snapshot_states():
+            if (
+                state.draining
+                and state.replica_id not in self._draining
+                and not state.fresh(now, router.heartbeat_timeout_s)
+            ):
+                router.forget(state.replica_id)
+        return decision
+
+    # ------------------------------------------------------------------ #
+    # metrics
+    # ------------------------------------------------------------------ #
+    def gauges(self) -> Dict[str, float]:
+        out = {
+            "fleet_replicas_draining": float(len(self._draining)),
+        }
+        if self.target > 0:
+            # absent until the first evaluation: a scrape must read
+            # "no target yet" (top renders n/a), not a target of 0
+            out["fleet_replicas_target"] = float(self.target)
+        for direction, count in sorted(self.events.items()):
+            out[
+                f'fleet_autoscale_events_total{{direction="{direction}"}}'
+            ] = float(count)
+        return out

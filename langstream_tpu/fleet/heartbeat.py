@@ -1,0 +1,185 @@
+"""Fleet heartbeat protocol: runners gossip, the router listens.
+
+One heartbeat is one JSON-able dict published to a shared topic
+(default ``fleet-heartbeats``) on the existing topic fabric — memory
+broker in tests/local runs, Kafka/Pulsar in clusters; nothing here
+knows the difference (both ends speak the
+``TopicProducer``/``TopicReader`` SPI).
+
+Schema (all fields optional except ``replica``; unknown fields are
+ignored so the schema can grow without a fleet-wide flag day):
+
+    {
+      "replica":         "runner-0",      # stable pod identity
+      "seq":             42,              # per-replica monotonic counter
+      "epoch":           "9f3a…",         # per-PROCESS identity: a new
+                                          #   epoch = a restarted pod
+                                          #   (fresh seq counter)
+      "state":           "serving",       # serving|degraded|rebuilding|down
+      "queue_depth":     3,               # admission queue + pending
+      "active_sessions": 5,               # sessions holding slots
+      "block_size":      16,              # paged block size (0 = dense)
+      "chain_digests":   ["ab12…", …],    # resident prefix chains
+                                          #   (router.digests_from_keys)
+      "gauges":          {…}              # engines_snapshot subset:
+                                          #   SLO burn rates, sheds,
+                                          #   prefix hit tokens
+    }
+
+The router drops out-of-order ``seq`` (a delayed heartbeat must never
+resurrect a condemned replica) and times out replicas that stop
+gossiping — so a crashed runner falls out of rotation within one
+``heartbeat_timeout_s`` even if nothing condemns it explicitly.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import uuid
+from typing import Any, Dict, Mapping, Optional
+
+logger = logging.getLogger(__name__)
+
+HEARTBEAT_TOPIC = "fleet-heartbeats"
+
+# process identity stamped on every heartbeat: the router tells a pod
+# RESTART (new epoch, fresh seq counter — accept immediately) from an
+# at-least-once transport REPLAYING a dead process's records (old
+# epoch — drop), which bare seq numbers cannot distinguish
+PROCESS_EPOCH = uuid.uuid4().hex
+
+# gauges worth gossiping: the autoscaler's pressure signals plus the
+# affinity instrument, NOT the whole snapshot (heartbeats are frequent)
+_GOSSIP_GAUGES = (
+    "jax_engine_slo_ttft_burn_rate_5m",
+    "jax_engine_slo_ttft_burn_rate_1h",
+    "jax_engine_slo_tpot_burn_rate_5m",
+    "jax_engine_slo_tpot_burn_rate_1h",
+    "jax_engine_queue_depth",
+    'requests_shed_total{reason="queue_timeout"}',
+    "prefix_cache_hit_tokens_total",
+)
+
+
+def build_heartbeat(
+    replica_id: str,
+    seq: int,
+    *,
+    engine: Optional[Any] = None,
+    supervisor: Optional[Any] = None,
+    snapshot: Optional[Mapping[str, float]] = None,
+    digest_limit: int = 4096,
+) -> Dict[str, Any]:
+    """Assemble a heartbeat from a live engine (+ optional supervisor).
+
+    ``engine`` is a ``DecodeEngine`` (or anything exposing
+    ``queue_depth``/``kv_manager``/``block_size``/``slots``);
+    ``supervisor`` contributes the degraded/rebuilding state the router
+    treats as a drain signal. ``snapshot`` overrides the gauge source
+    (defaults to ``engines_snapshot()`` of the live process).
+    """
+    heartbeat: Dict[str, Any] = {
+        "replica": replica_id, "seq": int(seq), "epoch": PROCESS_EPOCH,
+    }
+    state = "serving"
+    if supervisor is not None:
+        state = {
+            "serving": "serving",
+            "rebuilding": "rebuilding",
+            "failed": "degraded",
+            "stopped": "down",
+        }.get(getattr(supervisor, "state", "serving"), "serving")
+    heartbeat["state"] = state
+    if engine is not None:
+        heartbeat["queue_depth"] = int(getattr(engine, "queue_depth", 0))
+        slots = getattr(engine, "slots", None)
+        if slots is not None:
+            heartbeat["active_sessions"] = sum(
+                1 for s in slots if getattr(s, "active", False)
+            )
+        manager = getattr(engine, "kv_manager", None)
+        if manager is not None:
+            from langstream_tpu.fleet.router import digests_from_keys
+
+            heartbeat["block_size"] = int(manager.block_size)
+            # PagedKVManager is engine-thread-owned (documented not
+            # thread-safe), and this builder usually runs on the
+            # gossip task: retry the snapshot+digest a few times if a
+            # concurrent publish/evict resizes a dict mid-iteration,
+            # and on a persistently hot pool OMIT the field — observe()
+            # keeps the router's previous digest set when absent, so a
+            # busy beat degrades to slightly stale affinity, never a
+            # crashed gossip loop (stale digests cost a cache miss at
+            # worst). The memo's chain-key validation makes any racy
+            # write-back value-safe.
+            for _ in range(4):
+                try:
+                    heartbeat["chain_digests"] = sorted(
+                        digests_from_keys(
+                            manager.published_keys(limit=digest_limit),
+                            memo=getattr(manager, "digest_memo", None),
+                        )
+                    )
+                    break
+                except RuntimeError:  # dict resized under iteration
+                    continue
+        else:
+            heartbeat["block_size"] = 0
+    if snapshot is None and engine is not None:
+        from langstream_tpu.providers.jax_local.engine import engines_snapshot
+
+        snapshot = engines_snapshot()
+    if snapshot:
+        heartbeat["gauges"] = {
+            key: float(snapshot[key]) for key in _GOSSIP_GAUGES
+            if key in snapshot
+        }
+    return heartbeat
+
+
+async def publish_loop(
+    producer: Any,
+    beat: Any,
+    *,
+    interval_s: float = 2.0,
+    stop: Optional[asyncio.Event] = None,
+) -> None:
+    """Gossip pump: call ``beat()`` (a zero-arg heartbeat builder, e.g.
+    a ``build_heartbeat`` closure with its own seq counter) and publish
+    the dict every ``interval_s``. A failed publish is logged and
+    retried next beat — heartbeating must never kill a runner."""
+    from langstream_tpu.api.records import Record
+
+    stop = stop or asyncio.Event()
+    while not stop.is_set():
+        try:
+            heartbeat = beat()
+            await producer.write(
+                Record(value=heartbeat, key=heartbeat.get("replica"))
+            )
+        except Exception:  # noqa: BLE001 — gossip is best-effort
+            logger.exception("fleet heartbeat publish failed")
+        try:
+            await asyncio.wait_for(stop.wait(), timeout=interval_s)
+        except asyncio.TimeoutError:
+            pass
+
+
+async def consume_loop(
+    reader: Any,
+    router: Any,
+    *,
+    stop: Optional[asyncio.Event] = None,
+    poll_timeout_s: float = 0.2,
+) -> None:
+    """Router-side pump: tail the heartbeat topic and feed
+    ``router.observe``. Records whose value is not a dict are skipped
+    (``observe`` additionally rejects malformed dicts)."""
+    stop = stop or asyncio.Event()
+    while not stop.is_set():
+        batch = await reader.read(timeout=poll_timeout_s)
+        for record in batch:
+            value = record.value
+            if isinstance(value, Mapping):
+                router.observe(value)

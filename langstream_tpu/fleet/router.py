@@ -1,0 +1,474 @@
+"""Prefix-affinity router: send each session to the replica that
+already holds its KV prefix.
+
+The paged prefix cache (``providers/jax_local/paged.py``) keys cached
+blocks by ``(parent_block, chunk_tokens)`` — chaining through the
+parent makes the key collision-free because a chunk's KV depends on the
+whole token prefix, which the parent chain uniquely identifies. A
+router cannot speak block ids (they are private to one pool), so this
+module re-expresses the same chain in a pool-free form: a **rolling
+keyed digest** per full block of tokens,
+
+    d_i = blake2b(chunk_tokens_i, key=d_{i-1})        (d_0 keyed empty)
+
+which any front door can compute from the prompt alone and any runner
+can compute from its resident chains (:func:`digests_from_keys` walks
+the manager's published ``(parent, chunk)`` map). Two chains share a
+digest iff they share the entire token prefix — the AIBrix hash-chain
+idea (arxiv 2504.03648) with an actual hash because the ids must cross
+process boundaries.
+
+Routing (AIBrix/DeepServe shape — prefix-aware first, load-aware
+fallback):
+
+1. drop replicas that are **unroutable**: heartbeat older than the
+   timeout, state ``degraded``/``rebuilding``/``down`` (the PR 9
+   supervisor's 503 becomes a routing signal here, not a client
+   error), condemned by :meth:`FleetRouter.mark_unroutable`, or
+   draining for scale-down;
+2. score each remaining replica by the number of **leading** prompt
+   digests present in its advertised chain-digest set (longest cached
+   prefix wins — a stale digest can only cost a cache miss, never an
+   error);
+3. route to the best score; ties and zero-match prompts fall back to
+   least queue depth (the router bumps its local queue estimate per
+   decision so a burst between heartbeats doesn't dogpile one replica).
+
+Heartbeats are plain dicts (see ``fleet/heartbeat.py`` for the schema
+and the topic-fabric pump); :meth:`FleetRouter.observe` applies one,
+dropping out-of-order sequence numbers so a delayed heartbeat can
+never resurrect a condemned replica or roll back a digest set.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import threading
+import time
+from typing import Dict, List, Mapping, Optional, Sequence, Set, Tuple
+
+# record header stamped by fleet-aware front doors (gateway produce
+# path) carrying the routing decision to the topic fabric
+REPLICA_HEADER = "langstream-replica"
+
+_DIGEST_SIZE = 12  # bytes; 24 hex chars on the wire
+
+
+def _chunk_digest(parent: bytes, chunk: Sequence[int]) -> bytes:
+    data = ",".join(str(int(t)) for t in chunk).encode()
+    return hashlib.blake2b(data, digest_size=_DIGEST_SIZE, key=parent).digest()
+
+
+def prompt_digests(
+    tokens: Sequence[int], block_size: int, limit: Optional[int] = None
+) -> List[str]:
+    """Rolling hash-chain digests for ``tokens``, one per FULL block
+    (partial trailing blocks never match, mirroring the manager's
+    block-granular admission). ``limit`` caps the chain length — a
+    100k-token prompt must not stall the front door."""
+    if block_size <= 0:
+        return []
+    out: List[str] = []
+    parent = b""
+    blocks = len(tokens) // block_size
+    if limit is not None:
+        blocks = min(blocks, limit)
+    for i in range(blocks):
+        parent = _chunk_digest(parent, tokens[i * block_size:(i + 1) * block_size])
+        out.append(parent.hex())
+    return out
+
+
+def digests_from_keys(
+    keys: Mapping[int, Tuple[int, Tuple[int, ...]]],
+    memo: Optional[Dict[int, object]] = None,
+) -> Set[str]:
+    """Digest set for a manager's published chain map
+    (``PagedKVManager.published_keys()``: block -> (parent_block,
+    chunk_tokens); parent ``-1`` = chain root). Iterative walk — chains
+    can be thousands of blocks deep and must not hit the recursion
+    limit.
+
+    ``memo`` (e.g. ``PagedKVManager.digest_memo``) persists digests
+    across calls: a block's digest is immutable while it stays
+    published, so heartbeat N+1 only hashes chunks published since
+    heartbeat N instead of re-hashing the whole pool every beat.
+    Entries are stored as ``block -> ((parent, chunk), digest)`` and
+    only seeded when the stored key matches this snapshot's key for
+    the block — a block id recycled onto a different chain (including
+    by a racy write-back after an eviction) fails the match and is
+    simply recomputed, never advertised stale. Only real digests are
+    persisted — the empty poison marker for a torn snapshot's broken
+    ancestry stays call-local, since the ancestor may well be present
+    next call."""
+    persistent = memo
+    local: Dict[int, bytes] = {}
+    if persistent is not None:
+        for block, entry in persistent.items():
+            if not (isinstance(entry, tuple) and len(entry) == 2):
+                continue
+            key, digest = entry
+            if isinstance(digest, bytes) and keys.get(block) == key:
+                local[block] = digest
+    memo = local
+
+    def resolve(block: int) -> Optional[bytes]:
+        stack = [block]
+        while stack:
+            top = stack[-1]
+            if top in memo:
+                stack.pop()
+                continue
+            entry = keys.get(top)
+            if entry is None:
+                # ancestor missing from the snapshot (capped or torn):
+                # the chain below it cannot be keyed — skip it
+                memo[top] = b""
+                stack.pop()
+                continue
+            parent, chunk = entry
+            if parent >= 0 and parent not in memo:
+                stack.append(parent)
+                continue
+            parent_digest = b"" if parent < 0 else memo[parent]
+            if parent >= 0 and not parent_digest:
+                memo[top] = b""  # broken ancestry poisons descendants
+            else:
+                memo[top] = _chunk_digest(parent_digest, chunk)
+            stack.pop()
+        return memo.get(block) or None
+
+    out: Set[str] = set()
+    for block in keys:
+        digest = resolve(block)
+        if digest:
+            out.add(digest.hex())
+    if persistent is not None:
+        for block, digest in local.items():
+            if digest and block in keys:
+                # never persist the broken-ancestry marker; key the
+                # entry to its chain so recycling invalidates it
+                persistent[block] = (keys[block], digest)
+    return out
+
+
+class NoRoutableReplica(Exception):
+    """Every known replica is stale, degraded, draining, or condemned."""
+
+
+@dataclasses.dataclass
+class ReplicaState:
+    """The router's last-known view of one runner replica."""
+
+    replica_id: str
+    seq: int = -1
+    epoch: str = ""  # process identity; "" = sender predates the field
+    # epochs this replica has ALREADY moved past: a replayed record
+    # from a superseded process must read as stale, not as yet another
+    # restart (bounded by actual restart count)
+    prior_epochs: Set[str] = dataclasses.field(default_factory=set)
+    last_seen: float = float("-inf")
+    state: str = "serving"  # serving|degraded|rebuilding|down
+    queue_depth: float = 0.0
+    active_sessions: float = 0.0
+    block_size: int = 0
+    digests: Set[str] = dataclasses.field(default_factory=set)
+    gauges: Dict[str, float] = dataclasses.field(default_factory=dict)
+    draining: bool = False
+    condemned_at_seq: Optional[int] = None
+    condemn_reason: str = ""
+
+    def fresh(self, now: float, timeout: float) -> bool:
+        return now - self.last_seen <= timeout
+
+    def routable(self, now: float, timeout: float) -> bool:
+        return (
+            self.state == "serving"
+            and self.fresh(now, timeout)
+            and not self.draining
+            and self.condemned_at_seq is None
+        )
+
+
+@dataclasses.dataclass
+class RouteDecision:
+    replica_id: str
+    policy: str            # affinity | least_queue | round_robin
+    matched_blocks: int = 0
+    matched_tokens: int = 0
+
+
+class FleetRouter:
+    """Prefix-affinity router over a heartbeat-fed replica view.
+
+    Thread-safe: the gateway observes heartbeats from a consumer task
+    while request handlers route concurrently. ``policy`` selects the
+    production behavior (``affinity``) or the A/B baseline
+    (``round_robin`` — blind cycling, the pre-fleet gateway shape).
+    """
+
+    def __init__(
+        self,
+        *,
+        policy: str = "affinity",
+        heartbeat_timeout_s: float = 10.0,
+    ) -> None:
+        if policy not in ("affinity", "round_robin"):
+            raise ValueError(f"unknown routing policy {policy!r}")
+        self.policy = policy
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self.replicas: Dict[str, ReplicaState] = {}
+        self._lock = threading.Lock()
+        self._rr = 0
+        self._routed: Dict[str, int] = {
+            "affinity": 0, "least_queue": 0, "round_robin": 0,
+        }
+        self._matched_tokens = 0
+
+    # ------------------------------------------------------------------ #
+    # heartbeat view
+    # ------------------------------------------------------------------ #
+    def observe(self, heartbeat: Mapping[str, object], now: Optional[float] = None) -> bool:
+        """Apply one heartbeat dict; returns False when dropped
+        (unknown shape or out-of-order seq). A heartbeat never throws:
+        a malformed gossip record must not take the router down."""
+        now = time.monotonic() if now is None else now
+        replica_id = heartbeat.get("replica")
+        if not isinstance(replica_id, str) or not replica_id:
+            return False
+        seq = int(heartbeat.get("seq", 0) or 0)
+        epoch = str(heartbeat.get("epoch", "") or "")
+        with self._lock:
+            state = self.replicas.get(replica_id)
+            if state is None:
+                state = ReplicaState(replica_id=replica_id)
+                self.replicas[replica_id] = state
+            if epoch and epoch != state.epoch and epoch in state.prior_epochs:
+                return False  # replayed record from a superseded process
+            if epoch and state.epoch and epoch != state.epoch:
+                # PROVABLY a different process (pod restart): the old
+                # epoch's seq numbering, condemnation, and drain mark
+                # die with it (StatefulSets reuse ordinals — a re-grown
+                # replica must not inherit its predecessor's drain)
+                state.prior_epochs.add(state.epoch)
+                state.condemned_at_seq = None
+                state.draining = False
+            elif seq <= state.seq:
+                # out-of-order gossip never rolls a LIVE view back, and
+                # a SAME-epoch lower seq is provably a replay of this
+                # very process's past records — dead-pod replays must
+                # not mark a stale replica serving again
+                if epoch and epoch == state.epoch:
+                    return False
+                if state.fresh(now, self.heartbeat_timeout_s):
+                    return False
+                # epoch-less sender, stale view: accept as a possible
+                # restart — but the condemnation is REBASED, not
+                # cleared: an at-least-once transport can replay a dead
+                # replica's last heartbeats, and only a live stream (a
+                # subsequent NEWER-seq serving beat) may resurrect a
+                # condemned replica
+                if state.condemned_at_seq is not None:
+                    state.condemned_at_seq = seq
+            state.epoch = epoch or state.epoch
+            state.seq = seq
+            state.last_seen = now
+            state.state = str(heartbeat.get("state", "serving"))
+            state.queue_depth = float(heartbeat.get("queue_depth", 0) or 0)
+            state.active_sessions = float(
+                heartbeat.get("active_sessions", 0) or 0
+            )
+            state.block_size = int(heartbeat.get("block_size", 0) or 0)
+            digests = heartbeat.get("chain_digests")
+            if isinstance(digests, (list, set, tuple)):
+                # full replacement, not a merge: evicted chains age out
+                # of scoring with the next heartbeat
+                state.digests = {str(d) for d in digests}
+            gauges = heartbeat.get("gauges")
+            if isinstance(gauges, Mapping):
+                state.gauges = {
+                    str(k): float(v) for k, v in gauges.items()
+                    if isinstance(v, (int, float))
+                }
+            # a replica that healed (supervisor rebuild finished) clears
+            # its condemnation by gossiping serving at a NEWER seq
+            if (
+                state.condemned_at_seq is not None
+                and seq > state.condemned_at_seq
+                and state.state == "serving"
+            ):
+                state.condemned_at_seq = None
+        return True
+
+    def mark_unroutable(self, replica_id: str, reason: str = "condemned") -> None:
+        """Condemn a replica immediately (gateway saw 503/refused, the
+        supervisor reported degraded): stop routing new sessions there
+        until a NEWER serving heartbeat arrives."""
+        with self._lock:
+            state = self.replicas.setdefault(
+                replica_id, ReplicaState(replica_id=replica_id)
+            )
+            state.condemned_at_seq = state.seq
+            state.condemn_reason = reason
+
+    def mark_draining(self, replica_id: str, draining: bool = True) -> None:
+        """Scale-down drain: stop routing NEW sessions; in-flight ones
+        finish on the replica (prefix chains age out with them)."""
+        with self._lock:
+            state = self.replicas.setdefault(
+                replica_id, ReplicaState(replica_id=replica_id)
+            )
+            state.draining = draining
+
+    def forget(self, replica_id: str) -> None:
+        with self._lock:
+            self.replicas.pop(replica_id, None)
+
+    def routable(self, now: Optional[float] = None) -> List[ReplicaState]:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            return [
+                s for s in self.replicas.values()
+                if s.routable(now, self.heartbeat_timeout_s)
+            ]
+
+    def snapshot_states(self) -> List[ReplicaState]:
+        """Lock-held snapshot of the replica view, id-sorted — what
+        out-of-band readers (the autoscaler loop) must iterate instead
+        of ``.replicas`` so a concurrent heartbeat insert can't blow up
+        their iteration."""
+        with self._lock:
+            return sorted(
+                self.replicas.values(), key=lambda s: s.replica_id
+            )
+
+    def state_of(self, replica_id: str) -> Optional[ReplicaState]:
+        with self._lock:
+            return self.replicas.get(replica_id)
+
+    # ------------------------------------------------------------------ #
+    # routing
+    # ------------------------------------------------------------------ #
+    def route(
+        self,
+        prompt_tokens: Optional[Sequence[int]] = None,
+        now: Optional[float] = None,
+    ) -> RouteDecision:
+        """Pick a replica for a new session. Raises
+        :class:`NoRoutableReplica` when the whole fleet is unroutable —
+        the caller's 503-with-Retry-After moment."""
+        now = time.monotonic() if now is None else now
+        # hash OUTSIDE the lock: the digest chain is O(prompt) blake2b
+        # work, and holding the router-wide lock for it would serialize
+        # every concurrent route/observe/gauges behind one request.
+        # Chains are per-decision only — a cross-call cache keyed on a
+        # token prefix would hand one prompt another's chain.
+        chains: Dict[int, List[str]] = {}
+        if prompt_tokens is not None and self.policy == "affinity":
+            with self._lock:
+                sizes = {
+                    s.block_size for s in self.replicas.values()
+                    if s.block_size > 0 and s.digests
+                    and s.routable(now, self.heartbeat_timeout_s)
+                }
+            for block_size in sizes:
+                chains[block_size] = prompt_digests(
+                    prompt_tokens, block_size, limit=512
+                )
+        with self._lock:
+            candidates = [
+                s for s in self.replicas.values()
+                if s.routable(now, self.heartbeat_timeout_s)
+            ]
+            if not candidates:
+                raise NoRoutableReplica(
+                    f"no routable replica among {sorted(self.replicas)}"
+                )
+            candidates.sort(key=lambda s: s.replica_id)
+            if self.policy == "round_robin":
+                chosen = candidates[self._rr % len(candidates)]
+                self._rr += 1
+                decision = RouteDecision(chosen.replica_id, "round_robin")
+            else:
+                best, best_score = None, -1
+                for state in candidates:
+                    score = 0
+                    # a block size that appeared between the two lock
+                    # sections simply scores 0 this decision
+                    chain = chains.get(state.block_size)
+                    if chain and state.digests:
+                        for digest in chain:
+                            if digest not in state.digests:
+                                break
+                            score += 1
+                    if score > best_score or (
+                        score == best_score
+                        and best is not None
+                        and state.queue_depth < best.queue_depth
+                    ):
+                        best, best_score = state, score
+                assert best is not None
+                chosen = best
+                if best_score > 0:
+                    decision = RouteDecision(
+                        chosen.replica_id, "affinity",
+                        matched_blocks=best_score,
+                        matched_tokens=best_score * chosen.block_size,
+                    )
+                else:
+                    decision = RouteDecision(chosen.replica_id, "least_queue")
+            # local estimate bump: a burst routed between heartbeats
+            # spreads instead of dogpiling the momentarily-least-loaded
+            chosen.queue_depth += 1.0
+            self._routed[decision.policy] = (
+                self._routed.get(decision.policy, 0) + 1
+            )
+            self._matched_tokens += decision.matched_tokens
+            return decision
+
+    # ------------------------------------------------------------------ #
+    # metrics
+    # ------------------------------------------------------------------ #
+    def gauges(self, now: Optional[float] = None) -> Dict[str, float]:
+        """Fleet gauges in the inline-label form the shared renderer
+        (``api/metrics.prometheus_text``) already speaks — served by
+        the gateway's /metrics and read by ``langstream-tpu top``."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            out: Dict[str, float] = {}
+            routed = sum(self._routed.values())
+            for policy, count in sorted(self._routed.items()):
+                out[f'fleet_routed_total{{policy="{policy}"}}'] = float(count)
+            if self.policy == "affinity":
+                out["fleet_affinity_hit_rate"] = round(
+                    self._routed["affinity"] / routed, 4
+                ) if routed else 0.0
+                out["fleet_prefix_match_tokens_total"] = float(
+                    self._matched_tokens
+                )
+            routable = 0
+            for state in sorted(
+                self.replicas.values(), key=lambda s: s.replica_id
+            ):
+                label = f'{{replica="{state.replica_id}"}}'
+                out[f"fleet_replica_queue_depth{label}"] = float(
+                    state.queue_depth
+                )
+                if state.routable(now, self.heartbeat_timeout_s):
+                    display, routable = "serving", routable + 1
+                elif state.draining:
+                    display = "draining"
+                elif not state.fresh(now, self.heartbeat_timeout_s):
+                    display = "stale"
+                elif state.condemned_at_seq is not None:
+                    display = "condemned"
+                else:
+                    display = state.state
+                out[
+                    f'fleet_replica_state{{replica="{state.replica_id}",'
+                    f'state="{display}"}}'
+                ] = 1.0
+            out["fleet_replicas_known"] = float(len(self.replicas))
+            out["fleet_replicas_routable"] = float(routable)
+            return out

@@ -1,0 +1,683 @@
+"""Simulated fleet: M fake engines with REAL paged prefix caches.
+
+The fleet layer's acceptance instrument (``tests/test_fleet.py``): the
+router, heartbeat protocol, and autoscaler are the production classes;
+only the engine is fake — a :class:`SimReplica` replaces the device
+with a step-counting slot model but keeps a real
+:class:`~langstream_tpu.providers.jax_local.paged.PagedKVManager`, so
+prefix matching, block-granular admission, publish-at-admission/finish,
+refcounts, and LRU eviction behave exactly like a runner pod's pool.
+Heartbeats flow through a real in-process memory topic
+(``topics/memory.py``) and scaling actuates a real
+``Operator.scale`` against a ``MockKubeApi`` StatefulSet, so the whole
+loop — gossip → routing → pressure → patch → reconcile — runs on CPU
+with no JAX and no cluster.
+
+Time is simulated (``fleet.now`` advances ``step_time`` per tick), so
+SLO windows, heartbeat timeouts, and autoscaler cooldowns run in
+microseconds of wall clock.
+
+Cost model (deliberately minimal): admission occupies a slot for
+``ceil(missed_prefill_tokens / prefill_rate)`` steps — a prefix hit
+skips prefill work, which is WHY affinity routing lifts throughput and
+cuts TTFT/sheds, not just a counter. Decode is one token per step per
+slot. Generated tokens are a pure function of (prompt, index) so a
+session killed mid-stream and re-routed continues its exact stream on
+any replica — the fleet-level analogue of PR 9's bitwise resurrection.
+
+``python -m langstream_tpu.fleet.sim`` runs the routed-vs-round-robin
+A/B on identical traffic and writes ``bench_fleet_routed.json`` /
+``bench_fleet_rr.json`` artifacts for ``tools/ab_analyze.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import dataclasses
+import json
+import math
+import os
+import random
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
+
+from langstream_tpu.api.records import Record
+from langstream_tpu.deployer.kube import MockKubeApi
+from langstream_tpu.deployer.operator import Operator
+from langstream_tpu.fleet.autoscaler import AutoscalePolicy, SLOAutoscaler
+from langstream_tpu.fleet.heartbeat import HEARTBEAT_TOPIC
+from langstream_tpu.fleet.router import (
+    FleetRouter,
+    NoRoutableReplica,
+    digests_from_keys,
+)
+from langstream_tpu.providers.jax_local.paged import PagedKVManager
+from langstream_tpu.topics.memory import (
+    MemoryBroker,
+    MemoryTopicProducer,
+    MemoryTopicReader,
+)
+from langstream_tpu.api.topics import OffsetPosition
+
+
+class ReplicaDown(Exception):
+    """Submit raced a crash: the fleet re-routes, the client never sees it."""
+
+
+def generated_token(prompt: Sequence[int], index: int) -> int:
+    """Deterministic continuation token ``index`` for ``prompt`` —
+    replica-independent, so a re-routed session's stream is bitwise
+    identical to the unkilled oracle."""
+    seed = 0
+    for t in prompt:
+        seed = (seed * 1000003 + int(t)) & 0xFFFFFFFF
+    return 2 + (seed * 31 + index * 7919) % 29989
+
+
+class SimSession:
+    """One client stream. ``tokens`` is what the client saw — append
+    only, each token exactly once; ``errors`` is what a real client
+    would surface as a 500 (503-with-retry paths stay internal)."""
+
+    _ids = 0
+
+    def __init__(self, prompt: Sequence[int], max_new_tokens: int = 8) -> None:
+        SimSession._ids += 1
+        self.id = f"sess-{SimSession._ids}"
+        self.prompt = list(prompt)
+        self.max_new_tokens = int(max_new_tokens)
+        self.tokens: List[int] = []
+        self.errors: List[str] = []
+        self.done = False
+        self.reroutes = 0
+        self.replica: Optional[str] = None
+        self.submitted_at: Optional[float] = None  # fleet submit (sim s)
+        self.first_token_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+
+    @property
+    def remaining(self) -> int:
+        return self.max_new_tokens - len(self.tokens)
+
+    def admission_tokens(self) -> List[int]:
+        """What a (re)admission prefills: original prompt plus every
+        token already delivered (PR 9 replay shape)."""
+        return self.prompt + self.tokens
+
+    def expected_tokens(self) -> List[int]:
+        return [
+            generated_token(self.prompt, i)
+            for i in range(self.max_new_tokens)
+        ]
+
+
+class _Slot:
+    __slots__ = ("session", "table", "prefill_remaining", "adm_tokens")
+
+    def __init__(self, session, table, prefill_steps, adm_tokens) -> None:
+        self.session = session
+        self.table = table
+        self.prefill_remaining = prefill_steps
+        self.adm_tokens = adm_tokens
+
+
+class SimReplica:
+    """Fake engine, real pool. The step model: admission pops the
+    queue into free slots (worst-case block reservation — allocation
+    failure is backpressure, exactly like ``_admit_paged``), prefill
+    holds the slot ``ceil(miss/prefill_rate)`` steps, decode emits one
+    token per step, finish publishes the full-block chain and releases
+    the table."""
+
+    def __init__(
+        self,
+        name: str,
+        *,
+        num_blocks: int = 256,
+        block_size: int = 8,
+        slots: int = 4,
+        prefill_rate: int = 64,
+        queue_timeout_s: Optional[float] = None,
+        ttft_target_s: float = 2.0,
+        digest_limit: int = 4096,
+    ) -> None:
+        self.name = name
+        self.block_size = block_size
+        self.num_slots = slots
+        self.prefill_rate = prefill_rate
+        self.queue_timeout_s = queue_timeout_s
+        self.ttft_target_s = ttft_target_s
+        self.digest_limit = digest_limit
+        self.kv = PagedKVManager(num_blocks, block_size)
+        self.queue: Deque[Tuple[SimSession, float]] = deque()
+        self.active: List[_Slot] = []
+        self.state = "serving"
+        self.seq = 0
+        self.boot = 0  # bumped per rebuild: the heartbeat epoch
+        self.shed_total = 0
+        self._ttft_samples: Deque[Tuple[float, float]] = deque()
+
+    # -------------------------------------------------------------- #
+    # serving
+    # -------------------------------------------------------------- #
+    def submit(self, session: SimSession, now: float) -> None:
+        if self.state != "serving":
+            raise ReplicaDown(f"{self.name} is {self.state}")
+        session.replica = self.name
+        if session.submitted_at is None:
+            session.submitted_at = now
+        self.queue.append((session, now))
+
+    def _admit(self, now: float) -> None:
+        while self.queue and len(self.active) < self.num_slots:
+            session, queued_at = self.queue[0]
+            adm = session.admission_tokens()
+            chain, matched = self.kv.match(adm)
+            need = max(
+                0,
+                math.ceil(
+                    (len(adm) + session.remaining) / self.block_size
+                ) - len(chain),
+            )
+            fresh = self.kv.allocate(need)
+            if fresh is None:
+                return  # pool backpressure: admission waits
+            self.queue.popleft()
+            self.kv.ref(chain)
+            self.kv.stats["hit_tokens"] += matched
+            table = chain + fresh
+            # publish-cold-at-admission: concurrent same-prefix
+            # sessions hit these blocks before this one finishes
+            self.kv.publish(adm, table)
+            prefill_steps = math.ceil(
+                max(0, len(adm) - matched) / self.prefill_rate
+            )
+            self.active.append(_Slot(session, table, prefill_steps, adm))
+
+    def _shed_expired(self, now: float) -> List[SimSession]:
+        if not self.queue_timeout_s:
+            return []
+        shed: List[SimSession] = []
+        keep: Deque[Tuple[SimSession, float]] = deque()
+        for session, queued_at in self.queue:
+            if now - queued_at >= self.queue_timeout_s:
+                self.shed_total += 1
+                shed.append(session)
+            else:
+                keep.append((session, queued_at))
+        self.queue = keep
+        return shed
+
+    def step(self, now: float) -> Dict[str, List[SimSession]]:
+        """One engine step: shed expired, admit, prefill/decode.
+        Returns sessions that finished and sessions shed at the
+        admission deadline (the fleet re-routes sheds — a 503 with
+        Retry-After, never a client 500)."""
+        if self.state != "serving":
+            return {"finished": [], "shed": []}
+        shed = self._shed_expired(now)
+        self._admit(now)
+        finished: List[SimSession] = []
+        for slot in list(self.active):
+            if slot.prefill_remaining > 0:
+                slot.prefill_remaining -= 1
+                continue
+            session = slot.session
+            session.tokens.append(
+                generated_token(session.prompt, len(session.tokens))
+            )
+            if session.first_token_at is None:
+                session.first_token_at = now
+                assert session.submitted_at is not None
+                self._ttft_samples.append(
+                    (now, now - session.submitted_at)
+                )
+                while (
+                    self._ttft_samples
+                    and now - self._ttft_samples[0][0] > 3600.0
+                ):
+                    self._ttft_samples.popleft()
+            if session.remaining <= 0:
+                session.done = True
+                session.finished_at = now
+                full = session.prompt + session.tokens
+                self.kv.publish(full, slot.table)
+                self.kv.release(slot.table)
+                self.active.remove(slot)
+                finished.append(session)
+        return {"finished": finished, "shed": shed}
+
+    # -------------------------------------------------------------- #
+    # failure / recovery (the PR 9 arc at fleet granularity)
+    # -------------------------------------------------------------- #
+    def kill(self) -> List[SimSession]:
+        """Crash: every queued and active session is handed back for
+        fleet-level resurrection (prompt + delivered tokens); the pool
+        dies with the process."""
+        self.state = "down"
+        orphans = [s for s, _ in self.queue] + [
+            slot.session for slot in self.active
+        ]
+        self.queue.clear()
+        self.active.clear()
+        return orphans
+
+    def rebuild(self) -> None:
+        """Supervisor finished: fresh pool (prefix cache lost), same
+        identity, heartbeat seq continues so the router's condemnation
+        clears on the next serving gossip."""
+        self.kv = PagedKVManager(self.kv.num_blocks, self.block_size)
+        self.state = "serving"
+        self.boot += 1  # new process: new heartbeat epoch
+
+    # -------------------------------------------------------------- #
+    # gossip
+    # -------------------------------------------------------------- #
+    def _burn_rates(self, now: float) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for label, window in (("5m", 300.0), ("1h", 3600.0)):
+            samples = [
+                ttft for ts, ttft in self._ttft_samples
+                if now - ts <= window
+            ]
+            if not samples:
+                continue
+            violations = sum(
+                1 for ttft in samples if ttft > self.ttft_target_s
+            )
+            out[f"jax_engine_slo_ttft_burn_rate_{label}"] = round(
+                (violations / len(samples)) / 0.05, 4
+            )
+        return out
+
+    def heartbeat(self, now: float) -> Dict[str, Any]:
+        self.seq += 1
+        gauges = self._burn_rates(now)
+        gauges['requests_shed_total{reason="queue_timeout"}'] = float(
+            self.shed_total
+        )
+        gauges["prefix_cache_hit_tokens_total"] = float(
+            self.kv.stats["hit_tokens"]
+        )
+        return {
+            "replica": self.name,
+            "seq": self.seq,
+            "epoch": f"{self.name}/boot-{self.boot}",
+            "state": self.state,
+            "queue_depth": len(self.queue),
+            "active_sessions": len(self.active),
+            "block_size": self.block_size,
+            "chain_digests": sorted(
+                digests_from_keys(
+                    self.kv.published_keys(limit=self.digest_limit),
+                    memo=self.kv.digest_memo,
+                )
+            ),
+            "gauges": gauges,
+        }
+
+
+class SimFleet:
+    """M :class:`SimReplica`s behind a memory-topic heartbeat fabric,
+    the production router, and (optionally) the production autoscaler
+    actuating a MockKubeApi StatefulSet."""
+
+    def __init__(
+        self,
+        replicas: int = 3,
+        *,
+        policy: str = "affinity",
+        step_time: float = 0.25,
+        heartbeat_interval_s: float = 1.0,
+        heartbeat_timeout_s: float = 5.0,
+        autoscale: Optional[AutoscalePolicy] = None,
+        autoscale_interval_s: float = 5.0,
+        namespace: str = "fleet",
+        statefulset: str = "runner",
+        unrouted_patience_ticks: int = 200,
+        **replica_kwargs: Any,
+    ) -> None:
+        self.now = 0.0
+        self.step_time = step_time
+        self.policy = policy
+        self.heartbeat_interval_s = heartbeat_interval_s
+        self._next_heartbeat = 0.0
+        self.replica_kwargs = replica_kwargs
+        self.router = FleetRouter(
+            policy=policy, heartbeat_timeout_s=heartbeat_timeout_s
+        )
+        self.broker = MemoryBroker()
+        self._producer = MemoryTopicProducer(self.broker, HEARTBEAT_TOPIC)
+        self._reader = MemoryTopicReader(
+            self.broker, HEARTBEAT_TOPIC, OffsetPosition.EARLIEST
+        )
+        self.replicas: Dict[str, SimReplica] = {}
+        self.namespace, self.statefulset = namespace, statefulset
+        self.kube = MockKubeApi()
+        self.operator = Operator(self.kube)
+        self.kube.apply({
+            "kind": "StatefulSet",
+            "metadata": {"name": statefulset, "namespace": namespace},
+            "spec": {"replicas": replicas},
+        })
+        self.autoscaler: Optional[SLOAutoscaler] = None
+        self.autoscale_interval_s = autoscale_interval_s
+        self._next_autoscale = 0.0
+        if autoscale is not None:
+            self.autoscaler = SLOAutoscaler(
+                autoscale,
+                scale=lambda n: self.operator.scale(
+                    namespace, statefulset, n
+                ),
+            )
+        for ordinal in range(replicas):
+            self._spawn(ordinal)
+        # fleet books
+        self.sessions: List[SimSession] = []
+        self._unrouted: Deque[SimSession] = deque()
+        # retry budget for a session no replica will take: past it the
+        # client REALLY sees the failure (this is what keeps the
+        # zero-client-errors assertions falsifiable — a fleet that
+        # cannot place a session does produce an error)
+        self.unrouted_patience_ticks = int(unrouted_patience_ticks)
+        self.reroutes = 0
+        self.fleet_sheds = 0
+        self.retired_hit_tokens = 0  # killed replicas' counters survive
+
+    # -------------------------------------------------------------- #
+    # replica lifecycle
+    # -------------------------------------------------------------- #
+    def _spawn(self, ordinal: int) -> SimReplica:
+        name = f"{self.statefulset}-{ordinal}"
+        replica = SimReplica(name, **self.replica_kwargs)
+        self.replicas[name] = replica
+        return replica
+
+    def kill(self, name: str) -> None:
+        """Crash one runner mid-stream: condemn it in the router (the
+        gateway's 503 signal) and resurrect its sessions elsewhere."""
+        replica = self.replicas[name]
+        self.retired_hit_tokens += replica.kv.stats["hit_tokens"]
+        orphans = replica.kill()
+        self.router.mark_unroutable(name, reason="crashed")
+        for session in orphans:
+            session.reroutes += 1
+            self.reroutes += 1
+            self._route_submit(session)
+
+    def revive(self, name: str) -> None:
+        self.replicas[name].rebuild()
+
+    # -------------------------------------------------------------- #
+    # traffic
+    # -------------------------------------------------------------- #
+    def submit(
+        self, prompt: Sequence[int], max_new_tokens: int = 8
+    ) -> SimSession:
+        session = SimSession(prompt, max_new_tokens)
+        session.submitted_at = self.now
+        self.sessions.append(session)
+        self._route_submit(session)
+        return session
+
+    def _route_submit(self, session: SimSession) -> None:
+        """Route (or re-route) a session; a submit that races a crash
+        condemns the replica and retries — only a fleet with zero
+        routable replicas parks the session for the next tick (the
+        client's 503-with-Retry-After, not a 500)."""
+        for _ in range(len(self.replicas) + 1):
+            try:
+                decision = self.router.route(
+                    session.admission_tokens(), now=self.now
+                )
+            except NoRoutableReplica:
+                break
+            replica = self.replicas.get(decision.replica_id)
+            if replica is None:
+                self.router.forget(decision.replica_id)
+                continue
+            try:
+                replica.submit(session, self.now)
+                session._unrouted_ticks = 0
+                return
+            except ReplicaDown:
+                self.router.mark_unroutable(
+                    decision.replica_id, reason="connection refused"
+                )
+        self._unrouted.append(session)
+
+    # -------------------------------------------------------------- #
+    # the loop
+    # -------------------------------------------------------------- #
+    async def _pump_heartbeats(self) -> None:
+        for replica in self.replicas.values():
+            if replica.state != "down":
+                heartbeat = replica.heartbeat(self.now)
+                await self._producer.write(
+                    Record(value=heartbeat, key=replica.name)
+                )
+        for record in await self._reader.read(
+            max_records=10_000, timeout=0.0
+        ):
+            if isinstance(record.value, dict):
+                self.router.observe(record.value, now=self.now)
+
+    def _reconcile_replicas(self) -> None:
+        """StatefulSet semantics: ordinals ``0..replicas-1`` exist.
+        Scale-up spawns the missing ordinals; scale-down removes
+        ordinals past ``desired`` once drained (a down-but-in-range
+        replica is the supervisor's problem, not the reconciler's)."""
+        sts = self.kube.get(
+            "StatefulSet", self.namespace, self.statefulset
+        )
+        desired = int(sts["spec"]["replicas"]) if sts else len(self.replicas)
+        for ordinal in range(desired):
+            if f"{self.statefulset}-{ordinal}" not in self.replicas:
+                self._spawn(ordinal)
+        for name in sorted(
+            self.replicas, key=lambda n: int(n.rsplit("-", 1)[1])
+        )[desired:]:
+            replica = self.replicas[name]
+            if not replica.queue and not replica.active:
+                self.retired_hit_tokens += replica.kv.stats["hit_tokens"]
+                self.replicas.pop(name)
+                self.router.forget(name)
+
+    async def tick(self) -> None:
+        self.now += self.step_time
+        retry, self._unrouted = self._unrouted, deque()
+        for session in retry:
+            waited = getattr(session, "_unrouted_ticks", 0) + 1
+            session._unrouted_ticks = waited
+            if waited > self.unrouted_patience_ticks:
+                # retries exhausted: the client's 503s harden into a
+                # real failure (counted by client_errors())
+                session.errors.append(
+                    f"503: no routable replica after {waited} retries"
+                )
+                continue
+            self._route_submit(session)
+        for replica in list(self.replicas.values()):
+            result = replica.step(self.now)
+            for session in result["shed"]:
+                self.fleet_sheds += 1
+                session.reroutes += 1
+                self._route_submit(session)
+        if self.now >= self._next_heartbeat:
+            self._next_heartbeat = self.now + self.heartbeat_interval_s
+            await self._pump_heartbeats()
+        if self.autoscaler is not None and self.now >= self._next_autoscale:
+            self._next_autoscale = self.now + self.autoscale_interval_s
+            sts = self.kube.get(
+                "StatefulSet", self.namespace, self.statefulset
+            )
+            current = int(sts["spec"]["replicas"])
+            self.autoscaler.step(self.router, current, now=self.now)
+            self._reconcile_replicas()
+
+    async def run(self, ticks: int) -> None:
+        for _ in range(ticks):
+            await self.tick()
+
+    async def run_until_idle(self, max_ticks: int = 10_000) -> None:
+        for _ in range(max_ticks):
+            await self.tick()
+            if self._unrouted:
+                continue
+            if all(
+                not r.queue and not r.active
+                for r in self.replicas.values()
+            ) and all(s.done or s.errors for s in self.sessions):
+                return
+        raise TimeoutError(
+            f"fleet not idle after {max_ticks} ticks "
+            f"(unrouted={len(self._unrouted)})"
+        )
+
+    # -------------------------------------------------------------- #
+    # books
+    # -------------------------------------------------------------- #
+    def fleet_hit_tokens(self) -> int:
+        return self.retired_hit_tokens + sum(
+            r.kv.stats["hit_tokens"] for r in self.replicas.values()
+        )
+
+    def fleet_shed_total(self) -> int:
+        return self.fleet_sheds
+
+    def client_errors(self) -> int:
+        return sum(len(s.errors) for s in self.sessions)
+
+    def gauges(self) -> Dict[str, float]:
+        out = self.router.gauges(now=self.now)
+        out["fleet_replicas_current"] = float(
+            sum(1 for r in self.replicas.values() if r.state != "down")
+        )
+        if self.autoscaler is not None:
+            out.update(self.autoscaler.gauges())
+        return out
+
+
+# ------------------------------------------------------------------ #
+# shared-prefix traffic + the routed-vs-round-robin A/B artifact
+# ------------------------------------------------------------------ #
+@dataclasses.dataclass
+class TrafficSpec:
+    groups: int = 4
+    sessions_per_group: int = 16
+    prefix_blocks: int = 4       # shared prefix length, in blocks
+    suffix_tokens: int = 8       # unique per-session tail
+    max_new_tokens: int = 8
+    wave_size: int = 8           # sessions submitted per wave
+    ticks_between_waves: int = 4
+    seed: int = 1234
+
+
+def make_prompts(
+    spec: TrafficSpec, block_size: int
+) -> List[List[int]]:
+    rng = random.Random(spec.seed)
+    prefixes = [
+        [rng.randrange(2, 30000)
+         for _ in range(spec.prefix_blocks * block_size)]
+        for _ in range(spec.groups)
+    ]
+    prompts = []
+    for group, prefix in enumerate(prefixes):
+        for _ in range(spec.sessions_per_group):
+            prompts.append(
+                prefix + [rng.randrange(2, 30000)
+                          for _ in range(spec.suffix_tokens)]
+            )
+    # interleave groups the way real traffic arrives (round-robin over
+    # groups, NOT group-sorted — affinity has to earn its hits)
+    order = list(range(len(prompts)))
+    rng.shuffle(order)
+    return [prompts[i] for i in order]
+
+
+async def run_leg(
+    policy: str,
+    spec: TrafficSpec,
+    *,
+    replicas: int = 4,
+    block_size: int = 8,
+    queue_timeout_s: Optional[float] = 8.0,
+    **fleet_kwargs: Any,
+) -> Dict[str, Any]:
+    fleet = SimFleet(
+        replicas,
+        policy=policy,
+        block_size=block_size,
+        queue_timeout_s=queue_timeout_s,
+        **fleet_kwargs,
+    )
+    # prime the router's view before the first routing decision
+    await fleet._pump_heartbeats()
+    prompts = make_prompts(spec, block_size)
+    waves = [
+        prompts[i:i + spec.wave_size]
+        for i in range(0, len(prompts), spec.wave_size)
+    ]
+    for wave in waves:
+        for prompt in wave:
+            fleet.submit(prompt, max_new_tokens=spec.max_new_tokens)
+        await fleet.run(spec.ticks_between_waves)
+    await fleet.run_until_idle()
+    ttfts = sorted(
+        s.first_token_at - s.submitted_at
+        for s in fleet.sessions
+        if s.first_token_at is not None and s.submitted_at is not None
+    )
+    record = {
+        "metric": "fleet_sim",
+        "policy": policy,
+        "value": float(fleet.fleet_hit_tokens()),
+        "prefix_hit_tokens": fleet.fleet_hit_tokens(),
+        "requests_shed": fleet.fleet_shed_total(),
+        "reroutes": fleet.reroutes,
+        "client_errors": fleet.client_errors(),
+        "sessions": len(fleet.sessions),
+        "replicas": replicas,
+        "sim_seconds": round(fleet.now, 3),
+        "ttft_p50_s": round(ttfts[len(ttfts) // 2], 3) if ttfts else None,
+    }
+    return record
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    parser = argparse.ArgumentParser(
+        description="routed-vs-round-robin fleet A/B on simulated traffic"
+    )
+    parser.add_argument("--replicas", type=int, default=4)
+    parser.add_argument("--groups", type=int, default=4)
+    parser.add_argument("--sessions-per-group", type=int, default=16)
+    parser.add_argument("--seed", type=int, default=1234)
+    parser.add_argument(
+        "--out", default="bench_artifacts",
+        help="directory for bench_fleet_routed.json / bench_fleet_rr.json",
+    )
+    args = parser.parse_args(argv)
+    spec = TrafficSpec(
+        groups=args.groups,
+        sessions_per_group=args.sessions_per_group,
+        seed=args.seed,
+    )
+    os.makedirs(args.out, exist_ok=True)
+    legs = {
+        "bench_fleet_routed.json": "affinity",
+        "bench_fleet_rr.json": "round_robin",
+    }
+    for filename, policy in legs.items():
+        record = asyncio.run(
+            run_leg(policy, spec, replicas=args.replicas)
+        )
+        path = os.path.join(args.out, filename)
+        with open(path, "w") as handle:
+            handle.write(json.dumps(record) + "\n")
+        print(json.dumps(record))
+
+
+if __name__ == "__main__":
+    main()

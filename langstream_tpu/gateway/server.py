@@ -83,6 +83,10 @@ class GatewayServer:
         # renderer — same format as runner pods and the OpenAI server
         self.tracer = get_tracer("gateway")
         self.metrics = MetricsReporter(prefix="gateway")
+        # fleet layer (langstream_tpu/fleet): when a router/controller
+        # is registered, produce paths stamp a replica-affinity header
+        # and /metrics serves the fleet gauges
+        self._fleet = None
 
     # ------------------------------------------------------------------ #
     # registration / lifecycle
@@ -94,6 +98,14 @@ class GatewayServer:
 
     def register_local_runner(self, local_runner, tenant: str = "default") -> None:
         self.register(tenant, local_runner.application, local_runner.topic_runtime)
+
+    def register_fleet(self, controller) -> None:
+        """Attach a fleet router/controller (``fleet.FleetRouter`` or
+        ``fleet.FleetController``): produce paths consult it for a
+        prefix-affinity replica and /metrics merges its gauges. The
+        gateway stays fully functional without one — routing is an
+        overlay, not a dependency."""
+        self._fleet = controller
 
     async def start(self) -> None:
         app = web.Application()
@@ -141,6 +153,11 @@ class GatewayServer:
         if engine_module is not None:
             gauges.update(engine_module.engines_snapshot())
             histograms.update(engine_module.engines_histograms())
+        # fleet routing/autoscaling gauges (per-replica queue depth and
+        # state, affinity hit rate, replica counts) — the `top` fleet
+        # panel reads exactly these families
+        if self._fleet is not None:
+            gauges.update(self._fleet.gauges())
         return web.Response(
             text=prometheus_text(
                 self.metrics.snapshot(),
@@ -335,6 +352,37 @@ class GatewayServer:
         trace_id = new_trace_id()
         return headers + ((TRACE_ID_HEADER, trace_id),), trace_id
 
+    def _fleet_headers(self, value: Any) -> Tuple[Tuple[str, str], ...]:
+        """Prefix-affinity routing at the front door: when a fleet
+        router is registered, pick the replica whose resident chain set
+        best matches the session's token prefix (``tokens`` in a dict
+        payload; token-less payloads fall back least-queue-depth) and
+        stamp it as the ``langstream-replica`` header, so downstream
+        consumers — and keyed partitioners — can honor the decision.
+        Never fails the produce: an unroutable fleet degrades to the
+        pre-fleet blind path."""
+        if self._fleet is None:
+            return ()
+        from langstream_tpu.fleet.router import (
+            REPLICA_HEADER,
+            NoRoutableReplica,
+        )
+
+        tokens = None
+        if isinstance(value, dict):
+            raw = value.get("tokens")
+            if isinstance(raw, list) and all(
+                isinstance(t, int) for t in raw
+            ):
+                tokens = raw
+        try:
+            decision = self._fleet.route(tokens)
+        except NoRoutableReplica:
+            self.metrics.counter("fleet_unroutable").count()
+            return ()
+        self.metrics.counter("fleet_routed").count()
+        return ((REPLICA_HEADER, decision.replica_id),)
+
     async def _do_produce(
         self, registered, gateway, parameters, principal, payload: str
     ) -> None:
@@ -343,7 +391,9 @@ class GatewayServer:
             gateway.produce_options.get("headers"), parameters, principal
         )
         headers, trace_id = self._stamp_trace(
-            tuple(user_headers) + tuple(gateway_headers)
+            tuple(user_headers)
+            + tuple(gateway_headers)
+            + self._fleet_headers(value)
         )
         with self.tracer.span(
             "gateway.produce", trace_id=trace_id,

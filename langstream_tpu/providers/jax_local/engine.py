@@ -196,6 +196,13 @@ def engines_snapshot() -> Dict[str, float]:
         from langstream_tpu.runtime.watchdog import trips_total
 
         out["watchdog_trips_total"] = float(trips_total())
+        # admission backlog: the fleet layer's routing/scaling signal
+        # (fleet/router.py least-queue fallback, fleet/autoscaler.py
+        # queue pressure) — exposed from construction so an idle
+        # replica scrapes 0, not no-data
+        out["jax_engine_queue_depth"] = float(
+            sum(engine.queue_depth for engine in live_engines)
+        )
     if paged_engines:
         # paged KV pool + persistent prefix cache (kv_layout: paged):
         # pool capacity/pressure are known from construction, so these
@@ -1494,6 +1501,14 @@ class DecodeEngine:
                 # writer already dead (follower dropped) — still close
                 logger.warning("mirror: stop record not delivered")
             self.mirror.close()
+
+    @property
+    def queue_depth(self) -> int:
+        """Requests waiting for a slot: the submit queue plus the
+        admission-pending list. Read from any thread (both reads are
+        atomic snapshots); the fleet layer's routing/scaling signal and
+        the ``jax_engine_queue_depth`` gauge."""
+        return self._queue.qsize() + len(self._pending)
 
     def submit(self, request: GenerationRequest) -> None:
         if self._crashed is not None:

@@ -58,6 +58,14 @@ class PagedKVManager:
         self._children: Dict[int, int] = {}
         self._lru: Dict[int, int] = {}  # cached block -> last-touch tick
         self._tick = 0
+        # opaque per-published-block scratch for external digesters
+        # (fleet/router.py:digests_from_keys memoizes its hash chains
+        # here): entries live as long as the block stays published —
+        # popped in _unpublish, and each entry additionally carries the
+        # chain key it was computed for, so even a write-back racing an
+        # eviction on another thread can never serve a recycled id a
+        # stale digest (the key mismatch forces a recompute)
+        self.digest_memo: Dict[int, object] = {}
         self.stats: Dict[str, int] = {
             "hit_tokens": 0,       # prompt tokens served from cached blocks
             "evictions": 0,        # cached blocks unpublished under pressure
@@ -183,9 +191,44 @@ class PagedKVManager:
             self.stats["published_blocks"] += 1
             parent = block
 
+    def published_keys(
+        self, limit: Optional[int] = None
+    ) -> Dict[int, Tuple[int, Tuple[int, ...]]]:
+        """Snapshot of the published chain map ``block ->
+        (parent_block, chunk_tokens)`` — the fleet router's raw
+        material (``fleet/router.py:digests_from_keys`` turns it into
+        pool-free hash-chain digests for heartbeat gossip).
+
+        ``limit`` caps the snapshot for gossip budgets: the
+        most-recently-touched blocks win, with their ancestor chains
+        included (publish order + leaf-first eviction guarantee every
+        published block's ancestors are published, and a digest set
+        missing an ancestor could never match the chain below it)."""
+        if limit is None or len(self._key_of) <= limit:
+            return dict(self._key_of)
+        out: Dict[int, Tuple[int, Tuple[int, ...]]] = {}
+        by_recency = sorted(
+            self._key_of, key=lambda b: self._lru.get(b, 0), reverse=True
+        )
+        for block in by_recency:
+            if len(out) >= limit:
+                break
+            walk = block
+            chain = []
+            while walk >= 0 and walk not in out:
+                key = self._key_of.get(walk)
+                if key is None:
+                    break
+                chain.append((walk, key))
+                walk = key[0]
+            for b, key in chain:
+                out[b] = key
+        return out
+
     def _unpublish(self, block: int) -> None:
         key = self._key_of.pop(block)
         del self._map[key]
+        self.digest_memo.pop(block, None)
         parent = self._parent.pop(block)
         if parent >= 0:
             self._children[parent] -= 1

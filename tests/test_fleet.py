@@ -1,0 +1,1050 @@
+"""Fleet layer (ISSUE 11): prefix-affinity routing over gossiped
+hash-chain digests, SLO-driven autoscaling through the operator, and
+the simulated fleet that proves both on CPU — memory topics, real
+PagedKVManagers, MockKubeApi, no JAX."""
+
+import asyncio
+
+import pytest
+
+from langstream_tpu.deployer.kube import MockKubeApi
+from langstream_tpu.deployer.operator import Operator
+from langstream_tpu.fleet import FleetController
+from langstream_tpu.fleet.autoscaler import (
+    AutoscalePolicy,
+    SLOAutoscaler,
+)
+from langstream_tpu.fleet.router import (
+    FleetRouter,
+    NoRoutableReplica,
+    digests_from_keys,
+    prompt_digests,
+)
+from langstream_tpu.fleet.sim import (
+    SimFleet,
+    SimReplica,
+    TrafficSpec,
+    generated_token,
+    run_leg,
+)
+from langstream_tpu.providers.jax_local.paged import PagedKVManager
+
+BS = 8  # block size used throughout
+
+
+def hb(replica, seq, *, state="serving", queue=0, active=0,
+       digests=(), gauges=None, block_size=BS):
+    return {
+        "replica": replica, "seq": seq, "state": state,
+        "queue_depth": queue, "active_sessions": active,
+        "block_size": block_size, "chain_digests": list(digests),
+        "gauges": gauges or {},
+    }
+
+
+# ---------------------------------------------------------------------- #
+# hash-chain digests
+# ---------------------------------------------------------------------- #
+def test_prompt_digests_are_block_granular_and_chained():
+    tokens = list(range(100, 100 + 3 * BS + 5))  # 3 full blocks + tail
+    digests = prompt_digests(tokens, BS)
+    assert len(digests) == 3  # the partial tail block never matches
+    # shared prefix -> shared leading digests; divergence at block 2
+    other = tokens[: 2 * BS] + [9999] * BS
+    other_digests = prompt_digests(other, BS)
+    assert other_digests[:2] == digests[:2]
+    assert other_digests[2] != digests[2]
+    # the chain is position-dependent: same chunk under a different
+    # parent produces a different digest (collision-free chaining)
+    swapped = tokens[BS:2 * BS] + tokens[:BS] + tokens[2 * BS:]
+    assert prompt_digests(swapped, BS)[1] != digests[1]
+    assert prompt_digests(tokens, BS, limit=2) == digests[:2]
+
+
+def test_digests_from_published_keys_match_prompt_digests():
+    manager = PagedKVManager(num_blocks=32, block_size=BS)
+    tokens = list(range(7, 7 + 4 * BS))
+    blocks = manager.allocate(4)
+    manager.publish(tokens, blocks)
+    resident = digests_from_keys(manager.published_keys())
+    # every full-block prefix of the published chain is advertised
+    assert set(prompt_digests(tokens, BS)) <= resident
+    # an unpublished prompt shares only the digests of its real overlap
+    cold = tokens[:BS] + [5] * (2 * BS)
+    assert prompt_digests(cold, BS)[0] in resident
+    assert prompt_digests(cold, BS)[1] not in resident
+
+
+def test_published_keys_limit_keeps_ancestor_chains():
+    manager = PagedKVManager(num_blocks=64, block_size=BS)
+    long_tokens = list(range(1000, 1000 + 6 * BS))
+    long_blocks = manager.allocate(6)
+    manager.publish(long_tokens, long_blocks)
+    short_tokens = list(range(5000, 5000 + BS))
+    short_blocks = manager.allocate(1)
+    manager.publish(short_tokens, short_blocks)
+    # touch the long chain last so recency prefers it
+    manager.match(long_tokens)
+    capped = manager.published_keys(limit=3)
+    # whatever made the cut is ancestry-complete: every included
+    # block's parent is included (or a root) — digests stay computable
+    for block, (parent, _chunk) in capped.items():
+        assert parent < 0 or parent in capped
+    full = digests_from_keys(manager.published_keys())
+    assert digests_from_keys(capped) <= full
+
+
+# ---------------------------------------------------------------------- #
+# router
+# ---------------------------------------------------------------------- #
+def test_route_prefers_longest_prefix_then_least_queue():
+    router = FleetRouter()
+    tokens = list(range(300, 300 + 4 * BS))
+    digests = prompt_digests(tokens, BS)
+    router.observe(hb("r0", 1, queue=0, digests=digests[:1]), now=0.0)
+    router.observe(hb("r1", 1, queue=9, digests=digests[:3]), now=0.0)
+    router.observe(hb("r2", 1, queue=0, digests=()), now=0.0)
+    decision = router.route(tokens, now=1.0)
+    assert decision.replica_id == "r1"  # longest match beats queue depth
+    assert decision.policy == "affinity"
+    assert decision.matched_blocks == 3
+    assert decision.matched_tokens == 3 * BS
+    # no-match prompt: least queue depth wins (r1 now estimates 10)
+    cold = [7] * (4 * BS)
+    decision = router.route(cold, now=1.0)
+    assert decision.policy == "least_queue"
+    assert decision.replica_id in ("r0", "r2")
+
+
+def test_route_local_queue_estimate_spreads_bursts():
+    router = FleetRouter()
+    router.observe(hb("r0", 1, queue=0), now=0.0)
+    router.observe(hb("r1", 1, queue=1), now=0.0)
+    picks = [router.route(None, now=0.5).replica_id for _ in range(4)]
+    # without the post-decision bump all four would dogpile r0
+    assert set(picks) == {"r0", "r1"}
+
+
+def test_round_robin_policy_cycles():
+    router = FleetRouter(policy="round_robin")
+    for name in ("r0", "r1", "r2"):
+        router.observe(hb(name, 1), now=0.0)
+    picks = [router.route([1] * BS, now=0.1).replica_id for _ in range(6)]
+    assert picks == ["r0", "r1", "r2", "r0", "r1", "r2"]
+
+
+def test_heartbeat_timeout_marks_replica_unroutable():
+    router = FleetRouter(heartbeat_timeout_s=5.0)
+    router.observe(hb("r0", 1), now=0.0)
+    router.observe(hb("r1", 1), now=4.0)
+    assert {s.replica_id for s in router.routable(now=4.5)} == {"r0", "r1"}
+    # r0's gossip goes quiet -> it falls out of rotation on its own
+    assert {s.replica_id for s in router.routable(now=6.0)} == {"r1"}
+    assert router.route([1] * BS, now=6.0).replica_id == "r1"
+    # the whole fleet going quiet is the caller's 503 moment
+    with pytest.raises(NoRoutableReplica):
+        router.route([1] * BS, now=20.0)
+
+
+def test_stale_digests_and_out_of_order_heartbeats_dont_wedge_scoring():
+    router = FleetRouter()
+    tokens = list(range(40, 40 + 2 * BS))
+    digests = prompt_digests(tokens, BS)
+    # r0 advertises chains it has since evicted: scoring still works —
+    # the worst case is a cache miss on arrival, never an error
+    router.observe(hb("r0", 5, digests=digests), now=0.0)
+    assert router.route(tokens, now=0.1).replica_id == "r0"
+    # a delayed (lower-seq) heartbeat with the OLD digest set is
+    # dropped; the fresh empty set stands
+    assert router.observe(hb("r0", 6, digests=()), now=0.2)
+    assert not router.observe(hb("r0", 4, digests=digests), now=0.3)
+    decision = router.route(tokens, now=0.4)
+    assert decision.policy == "least_queue"  # stale digests gone
+    # garbage gossip is ignored, not fatal
+    assert not router.observe({"bogus": True}, now=0.5)
+    assert not router.observe({"replica": ""}, now=0.5)
+
+
+def test_degraded_state_and_condemnation_drain_then_reenter():
+    router = FleetRouter()
+    router.observe(hb("r0", 1), now=0.0)
+    router.observe(hb("r1", 1), now=0.0)
+    # supervisor rebuilding (PR 9's 503) is a routing signal
+    router.observe(hb("r0", 2, state="rebuilding"), now=1.0)
+    assert [s.replica_id for s in router.routable(now=1.1)] == ["r1"]
+    # gateway-side condemnation (connection refused) works even
+    # before any state change gossips
+    router.mark_unroutable("r1", reason="connection refused")
+    with pytest.raises(NoRoutableReplica):
+        router.route(None, now=1.2)
+    # a NEWER serving heartbeat re-enters each replica into rotation:
+    # the return-from-rebuild path
+    router.observe(hb("r0", 3, state="serving"), now=2.0)
+    router.observe(hb("r1", 2, state="serving"), now=2.0)
+    assert {s.replica_id for s in router.routable(now=2.1)} == {"r0", "r1"}
+    # but a STALE serving heartbeat cannot clear a condemnation
+    router.mark_unroutable("r1")
+    assert not router.observe(hb("r1", 2), now=2.5)
+    assert {s.replica_id for s in router.routable(now=2.6)} == {"r0"}
+
+
+def test_pod_restart_seq_reset_reenters_after_silence():
+    """A restarted POD (not just an in-process rebuild) starts a fresh
+    seq counter: after its gossip has been silent past the timeout, a
+    lower-seq heartbeat is a new epoch, not out-of-order noise —
+    otherwise the replica would stay unroutable until the new counter
+    re-exceeded the old one."""
+    router = FleetRouter(heartbeat_timeout_s=5.0)
+    router.observe(hb("r0", 10_000), now=0.0)
+    # a genuinely delayed duplicate while the view is FRESH still drops
+    assert not router.observe(hb("r0", 9_999), now=1.0)
+    # restart: silence past the timeout, then seq=1 from the new process
+    assert router.observe(hb("r0", 1), now=20.0)
+    assert [s.replica_id for s in router.routable(now=20.1)] == ["r0"]
+    # an old-epoch condemnation does not outlive the restart
+    router.mark_unroutable("r0")
+    assert router.observe(hb("r0", 2), now=40.0)
+    assert [s.replica_id for s in router.routable(now=40.1)] == ["r0"]
+
+
+def test_per_decision_digest_chains_not_shared_across_prompts():
+    """Two prompts sharing a long prefix but diverging after it must
+    each be scored on their OWN digest chain (regression: a cross-call
+    cache keyed on a token prefix handed prompt B prompt A's chain)."""
+    router = FleetRouter()
+    shared = list(range(10_000, 10_000 + 6 * BS))
+    tail_a = [1] * (2 * BS)
+    tail_b = [2] * (2 * BS)
+    digests_a = prompt_digests(shared + tail_a, BS)
+    router.observe(hb("rA", 1, digests=digests_a), now=0.0)
+    router.observe(hb("rShared", 1, digests=digests_a[:6]), now=0.0)
+    first = router.route(shared + tail_a, now=0.1)
+    assert first.replica_id == "rA" and first.matched_blocks == 8
+    # same 6-block prefix, different tail: rA only matches 6 blocks now
+    second = router.route(shared + tail_b, now=0.2)
+    assert second.matched_blocks == 6, second
+
+
+def test_digest_memo_is_incremental_and_eviction_safe():
+    manager = PagedKVManager(num_blocks=8, block_size=BS)
+    tokens = list(range(4 * BS))
+    blocks = manager.allocate(4)
+    manager.publish(tokens, blocks)
+    first = digests_from_keys(
+        manager.published_keys(), memo=manager.digest_memo
+    )
+    assert set(manager.digest_memo) == set(blocks)
+    # memo'd second pass agrees exactly
+    assert digests_from_keys(
+        manager.published_keys(), memo=manager.digest_memo
+    ) == first
+    # evict everything (allocate past capacity), republish DIFFERENT
+    # tokens into recycled block ids: digests must follow the tokens,
+    # not the stale memo entries
+    manager.release(blocks)
+    drained = manager.allocate(7)
+    assert drained is not None
+    assert not manager.digest_memo  # unpublish cleared every entry
+    manager.release(drained)
+    other = list(range(5_000, 5_000 + 4 * BS))
+    blocks2 = manager.allocate(4)
+    manager.publish(other, blocks2)
+    second = digests_from_keys(
+        manager.published_keys(), memo=manager.digest_memo
+    )
+    assert second == set(prompt_digests(other, BS))
+    assert second != first
+
+
+def test_draining_stops_new_sessions_only():
+    router = FleetRouter()
+    router.observe(hb("r0", 1), now=0.0)
+    router.observe(hb("r1", 1), now=0.0)
+    router.mark_draining("r1")
+    for _ in range(3):
+        assert router.route(None, now=0.1).replica_id == "r0"
+    router.mark_draining("r1", False)
+    assert {router.route(None, now=0.2).replica_id
+            for _ in range(4)} == {"r0", "r1"}
+
+
+def test_router_gauges_render_through_shared_exposition():
+    from langstream_tpu.api.metrics import (
+        parse_prometheus_text,
+        prometheus_text,
+    )
+
+    router = FleetRouter()
+    tokens = list(range(60, 60 + 2 * BS))
+    router.observe(
+        hb("r0", 1, queue=2, digests=prompt_digests(tokens, BS)), now=0.0
+    )
+    router.observe(hb("r1", 1, state="rebuilding"), now=0.0)
+    router.route(tokens, now=0.1)
+    router.route(None, now=0.1)
+    text = prometheus_text({}, router.gauges(now=0.2))
+    parsed = parse_prometheus_text(text)  # raises on malformed lines
+    routed = dict(
+        (labels["policy"], value)
+        for labels, value in parsed["fleet_routed_total"]
+    )
+    assert routed["affinity"] == 1.0
+    assert parsed["fleet_replicas_routable"] == [({}, 1.0)]
+    states = {
+        labels["replica"]: labels["state"]
+        for labels, value in parsed["fleet_replica_state"]
+    }
+    assert states == {"r0": "serving", "r1": "rebuilding"}
+    assert parsed["fleet_prefix_match_tokens_total"][0][1] == 2 * BS
+
+
+# ---------------------------------------------------------------------- #
+# heartbeat protocol plumbing
+# ---------------------------------------------------------------------- #
+def test_build_heartbeat_from_engine_shape():
+    from langstream_tpu.fleet.heartbeat import build_heartbeat
+
+    class _Slot:
+        def __init__(self, active):
+            self.active = active
+
+    class _Engine:
+        queue_depth = 3
+        slots = [_Slot(True), _Slot(False)]
+        kv_manager = PagedKVManager(num_blocks=16, block_size=BS)
+
+    class _Supervisor:
+        state = "rebuilding"
+
+    tokens = list(range(2 * BS))
+    blocks = _Engine.kv_manager.allocate(2)
+    _Engine.kv_manager.publish(tokens, blocks)
+    beat = build_heartbeat(
+        "runner-0", 7, engine=_Engine(), supervisor=_Supervisor(),
+        snapshot={
+            "jax_engine_queue_depth": 3.0,
+            "jax_engine_slo_ttft_burn_rate_5m": 1.5,
+            "jax_engine_mfu": 0.4,  # not gossiped — not a fleet signal
+        },
+    )
+    assert beat["replica"] == "runner-0" and beat["seq"] == 7
+    assert beat["state"] == "rebuilding"
+    assert beat["queue_depth"] == 3 and beat["active_sessions"] == 1
+    assert beat["block_size"] == BS
+    assert set(beat["chain_digests"]) == digests_from_keys(
+        _Engine.kv_manager.published_keys()
+    )
+    assert beat["gauges"]["jax_engine_slo_ttft_burn_rate_5m"] == 1.5
+    assert "jax_engine_mfu" not in beat["gauges"]
+    # a router consumes it directly
+    router = FleetRouter()
+    assert router.observe(beat, now=0.0)
+    assert router.replicas["runner-0"].state == "rebuilding"
+
+
+def test_heartbeat_loops_over_memory_topic():
+    from langstream_tpu.api.topics import OffsetPosition
+    from langstream_tpu.fleet import heartbeat as hb_mod
+    from langstream_tpu.topics.memory import (
+        MemoryBroker,
+        MemoryTopicProducer,
+        MemoryTopicReader,
+    )
+
+    async def scenario():
+        broker = MemoryBroker()
+        producer = MemoryTopicProducer(broker, hb_mod.HEARTBEAT_TOPIC)
+        reader = MemoryTopicReader(
+            broker, hb_mod.HEARTBEAT_TOPIC, OffsetPosition.EARLIEST
+        )
+        router = FleetRouter()
+        seq = {"n": 0}
+
+        def beat():
+            seq["n"] += 1
+            return hb("runner-0", seq["n"], queue=seq["n"])
+
+        stop = asyncio.Event()
+        pub = asyncio.ensure_future(hb_mod.publish_loop(
+            producer, beat, interval_s=0.01, stop=stop
+        ))
+        sub = asyncio.ensure_future(hb_mod.consume_loop(
+            reader, router, stop=stop, poll_timeout_s=0.01
+        ))
+        for _ in range(200):
+            if "runner-0" in router.replicas:
+                break
+            await asyncio.sleep(0.01)
+        stop.set()
+        pub.cancel()
+        sub.cancel()
+        for task in (pub, sub):
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+        assert router.replicas["runner-0"].seq >= 1
+
+    asyncio.run(scenario())
+
+
+# ---------------------------------------------------------------------- #
+# operator scale verb + autoscaler
+# ---------------------------------------------------------------------- #
+def _statefulset(kube, replicas=2, name="runner", namespace="fleet"):
+    kube.apply({
+        "kind": "StatefulSet",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {"replicas": replicas},
+    })
+
+
+def test_operator_scale_patches_statefulset_and_agent_status():
+    kube = MockKubeApi()
+    operator = Operator(kube)
+    _statefulset(kube, replicas=2)
+    kube.apply({
+        "kind": "Agent",
+        "metadata": {"name": "runner", "namespace": "fleet"},
+        "spec": {},
+    })
+    assert operator.scale("fleet", "runner", 5) == 5
+    assert kube.get("StatefulSet", "fleet", "runner")["spec"]["replicas"] == 5
+    assert kube.get("Agent", "fleet", "runner")["status"]["replicas"] == 5
+    # idempotent apply: no generation churn on a no-op scale
+    gen = kube.get("StatefulSet", "fleet", "runner")["metadata"]["generation"]
+    operator.scale("fleet", "runner", 5)
+    assert kube.get(
+        "StatefulSet", "fleet", "runner"
+    )["metadata"]["generation"] == gen
+    with pytest.raises(LookupError):
+        operator.scale("fleet", "nope", 1)
+
+
+def _replica_view(router):
+    return sorted(router.replicas.values(), key=lambda s: s.replica_id)
+
+
+def test_autoscaler_scales_up_on_burn_with_cooldown_hysteresis():
+    policy = AutoscalePolicy(
+        min_replicas=1, max_replicas=4, up_cooldown_s=10.0,
+        down_cooldown_s=30.0, idle_evals=2,
+    )
+    autoscaler = SLOAutoscaler(policy)
+    router = FleetRouter()
+    hot = {"jax_engine_slo_ttft_burn_rate_5m": 3.0}
+    router.observe(hb("r0", 1, queue=1, gauges=hot), now=0.0)
+    decision = autoscaler.evaluate(_replica_view(router), 1, now=0.0)
+    assert decision.target == 2 and "scale-up" in decision.reason
+    autoscaler._last_up_at = 0.0
+    # still hot inside the cooldown: hold, don't ratchet every eval
+    router.observe(hb("r0", 2, queue=1, gauges=hot), now=5.0)
+    decision = autoscaler.evaluate(_replica_view(router), 2, now=5.0)
+    assert decision.target == 2 and "cooldown" in decision.reason
+    # cooldown elapsed, still hot: one more step
+    decision = autoscaler.evaluate(_replica_view(router), 2, now=12.0)
+    assert decision.target == 3
+
+
+def test_autoscaler_shed_delta_is_pressure():
+    autoscaler = SLOAutoscaler(AutoscalePolicy(up_cooldown_s=0.0))
+    router = FleetRouter()
+    shed = {'requests_shed_total{reason="queue_timeout"}': 2.0}
+    router.observe(hb("r0", 1, gauges=shed), now=0.0)
+    # first eval establishes the baseline (a restart must not read the
+    # lifetime counter as a fresh spike)
+    first = autoscaler.evaluate(_replica_view(router), 1, now=0.0)
+    assert first.target == 1
+    router.observe(
+        hb("r0", 2, gauges={
+            'requests_shed_total{reason="queue_timeout"}': 5.0
+        }), now=1.0,
+    )
+    assert autoscaler.evaluate(_replica_view(router), 1, now=1.0).target == 2
+
+
+def test_autoscaler_scale_down_drains_before_shrinking():
+    policy = AutoscalePolicy(
+        min_replicas=1, max_replicas=4, up_cooldown_s=1.0,
+        down_cooldown_s=5.0, idle_evals=2,
+    )
+    scaled = []
+    autoscaler = SLOAutoscaler(policy, scale=scaled.append)
+    router = FleetRouter()
+    router.observe(hb("r0", 1, queue=0), now=100.0)
+    router.observe(hb("r1", 1, queue=0, active=2), now=100.0)
+    # calm eval #1: no decision yet (idle_evals=2)
+    autoscaler.step(router, 2, now=100.0)
+    assert not scaled
+    # calm eval #2: scale-down decided -> r1 (highest ordinal) drains,
+    # but the StatefulSet is NOT shrunk while sessions are live
+    decision = autoscaler.step(router, 2, now=110.0)
+    assert decision.draining == ["r1"]
+    assert router.replicas["r1"].draining
+    assert not scaled
+    # r1 finishes its sessions -> next step applies the shrink
+    router.observe(hb("r1", 2, queue=0, active=0), now=120.0)
+    decision = autoscaler.step(router, 2, now=120.0)
+    assert scaled == [1]
+    assert "drained r1" in decision.reason
+    # the pod keeps heartbeating until kube terminates it: it must
+    # STAY known-but-draining (unroutable), not re-register fresh
+    router.observe(hb("r1", 3, queue=0, active=0), now=125.0)
+    assert router.replicas["r1"].draining
+    assert "r1" not in {
+        s.replica_id for s in router.routable(now=125.1)
+    }
+    # once its gossip goes stale (pod actually gone) the reaper
+    # forgets it
+    router.observe(hb("r0", 2), now=140.0)
+    autoscaler.step(router, 1, now=140.0)
+    assert "r1" not in router.replicas
+
+
+def test_operator_scale_survives_reconcile_agent():
+    """The autoscaled replica count must not be snapped back to the
+    plan's parallelism by the next level-based reconcile pass (HPA
+    ownership semantics via the fleet-replicas annotation)."""
+    from langstream_tpu.deployer.crds import AgentCustomResource
+
+    kube = MockKubeApi()
+    operator = Operator(kube)
+    agent = AgentCustomResource(
+        name="app-agent", namespace="fleet", application_id="app",
+        agent_node={"id": "agent", "resources": {}},
+        streaming_cluster={"type": "memory"},
+        parallelism=2,
+    )
+    kube.apply(agent.to_manifest())
+    operator.reconcile_agent(kube.get("Agent", "fleet", "app-agent"))
+    sts = kube.get("StatefulSet", "fleet", "app-agent")
+    assert sts["spec"]["replicas"] == 2
+    operator.scale("fleet", "app-agent", 5)
+    # a re-reconcile (operator restart, spec checksum sweep) keeps the
+    # autoscaler's count, not the plan's parallelism
+    operator.reconcile_agent(kube.get("Agent", "fleet", "app-agent"))
+    sts = kube.get("StatefulSet", "fleet", "app-agent")
+    assert sts["spec"]["replicas"] == 5, sts
+
+
+def test_scale_down_unwedges_when_draining_victim_dies():
+    """A victim that crashes mid-drain (heartbeats stop, last gossip
+    frozen at queue>0) must still complete the drain once stale — a
+    wedged drain would block every future scale-down."""
+    policy = AutoscalePolicy(
+        min_replicas=1, max_replicas=4, up_cooldown_s=1.0,
+        down_cooldown_s=5.0, idle_evals=1,
+    )
+    scaled = []
+    autoscaler = SLOAutoscaler(policy, scale=scaled.append)
+    router = FleetRouter(heartbeat_timeout_s=5.0)
+    router.observe(hb("r0", 1), now=100.0)
+    router.observe(hb("r1", 1, queue=0, active=2), now=100.0)
+    decision = autoscaler.step(router, 2, now=100.0)
+    assert decision.draining == ["r1"] and not scaled
+    # r1 crashes: no more heartbeats, frozen queue_depth=3 in the view
+    router.observe(hb("r0", 2), now=110.0)
+    decision = autoscaler.step(router, 2, now=110.0)
+    assert scaled == [1], decision
+    assert autoscaler._draining == []
+
+
+def test_replayed_heartbeats_cannot_resurrect_a_condemned_replica():
+    """At-least-once transports can redeliver a dead process's last
+    heartbeats after the router condemned it: without epochs, an old
+    record must at most rebase the condemnation (never clear it);
+    with epochs, old-epoch records drop outright and only a genuinely
+    NEW process re-enters."""
+    router = FleetRouter(heartbeat_timeout_s=5.0)
+    # --- epoch-less sender (legacy) -------------------------------- #
+    router.observe(hb("r0", 100), now=0.0)
+    router.mark_unroutable("r0", reason="crashed")
+    # stale, then a replayed batch of its old heartbeats (98, 99): the
+    # first is accepted as a possible restart but stays condemned, and
+    # the second must NOT clear the rebased condemnation... it is a
+    # newer-seq serving beat, so this is exactly the best-effort limit
+    # of seq-only gossip — assert at least the single-record case:
+    assert router.observe(hb("r0", 98), now=10.0)
+    assert router.routable(now=10.1) == []  # still condemned
+    # --- epoch-stamped sender -------------------------------------- #
+    beats = lambda seq, epoch: dict(hb("r1", seq), epoch=epoch)  # noqa: E731
+    router.observe(beats(100, "proc-A"), now=0.0)
+    router.mark_unroutable("r1", reason="crashed")
+    # a replayed same-epoch record after the timeout is at most
+    # accepted-but-condemned (the rebase) — never routable
+    router.observe(beats(98, "proc-A"), now=20.0)
+    assert "r1" not in {s.replica_id for s in router.routable(now=20.1)}
+    # the RESTARTED pod (new epoch, fresh counter) re-enters at once
+    assert router.observe(beats(1, "proc-B"), now=21.0)
+    assert "r1" in {s.replica_id for s in router.routable(now=21.1)}
+    # and proc-A replays arriving AFTER the new epoch are dropped cold
+    assert not router.observe(beats(99, "proc-A"), now=22.0)
+    state = router.state_of("r1")
+    assert state.epoch == "proc-B" and state.seq == 1
+
+
+def test_drain_cancelled_by_pressure_even_at_max_replicas():
+    """Pressure during an in-progress drain must cancel it — including
+    at max_replicas, where no actuated scale-up fires to do it as a
+    side effect. Otherwise a hot fleet at max shrinks below max when
+    the victim drains, then flaps straight back up."""
+    policy = AutoscalePolicy(
+        min_replicas=1, max_replicas=2, up_cooldown_s=1.0,
+        down_cooldown_s=1.0, idle_evals=1,
+    )
+    scaled = []
+    autoscaler = SLOAutoscaler(policy, scale=scaled.append)
+    router = FleetRouter()
+    router.observe(hb("r0", 1), now=100.0)
+    router.observe(hb("r1", 1, active=2), now=100.0)
+    decision = autoscaler.step(router, 2, now=100.0)
+    assert decision.draining == ["r1"] and not scaled
+    # burst arrives at max_replicas while r1 drains
+    hot = {"jax_engine_slo_ttft_burn_rate_5m": 5.0}
+    router.observe(hb("r0", 2, gauges=hot), now=101.0)
+    router.observe(hb("r1", 2, active=0, gauges=hot), now=101.0)
+    decision = autoscaler.step(router, 2, now=101.0)
+    # the now-idle victim must NOT be shrunk away under pressure
+    assert scaled == [], decision
+    assert not router.replicas["r1"].draining
+    assert "r1" in {s.replica_id for s in router.routable(now=101.2)}
+
+
+def test_same_epoch_replay_never_marks_a_stale_replica_serving():
+    """A dead pod's own records replayed by the transport carry its
+    epoch: same epoch + lower seq is provably a replay and must drop
+    even once the replica is stale (it must not look alive again)."""
+    router = FleetRouter(heartbeat_timeout_s=5.0)
+    beat = lambda seq: dict(hb("r0", seq), epoch="proc-A")  # noqa: E731
+    router.observe(beat(100), now=0.0)
+    # crash, silence past the timeout, then a replay of seq 50
+    assert not router.observe(beat(50), now=20.0)
+    assert router.routable(now=20.1) == []
+    # the real restart (new epoch) still re-enters immediately
+    assert router.observe(dict(hb("r0", 1), epoch="proc-B"), now=21.0)
+    assert [s.replica_id for s in router.routable(now=21.1)] == ["r0"]
+
+
+def test_regrown_ordinal_sheds_predecessors_drain_mark():
+    """StatefulSets reuse ordinals: a replica re-grown after a
+    drain-and-shrink arrives with a new epoch and must not inherit the
+    dead predecessor's draining flag."""
+    router = FleetRouter()
+    router.observe(dict(hb("r2", 9), epoch="old-proc"), now=0.0)
+    router.mark_draining("r2")
+    assert router.routable(now=0.1) == []
+    router.observe(dict(hb("r2", 1), epoch="new-proc"), now=1.0)
+    assert [s.replica_id for s in router.routable(now=1.1)] == ["r2"]
+
+
+def test_digest_memo_key_validation_heals_racy_writeback():
+    """A memo entry attached to a recycled block id (e.g. a heartbeat
+    write-back racing an eviction) carries the OLD chain key and must
+    be ignored, not advertised."""
+    manager = PagedKVManager(num_blocks=8, block_size=BS)
+    tokens = list(range(2 * BS))
+    blocks = manager.allocate(2)
+    manager.publish(tokens, blocks)
+    digests_from_keys(manager.published_keys(), memo=manager.digest_memo)
+    poisoned_block = blocks[0]
+    stale_entry = manager.digest_memo[poisoned_block]
+    # simulate the race: eviction popped the entry, the id was
+    # recycled onto a different chain, and a late write-back restored
+    # the stale entry
+    manager.release(blocks)
+    drained = manager.allocate(7)
+    manager.release(drained)
+    other = list(range(7_000, 7_000 + 2 * BS))
+    blocks2 = manager.allocate(2)
+    manager.publish(other, blocks2)
+    manager.digest_memo[poisoned_block] = stale_entry
+    advertised = digests_from_keys(
+        manager.published_keys(), memo=manager.digest_memo
+    )
+    assert advertised == set(prompt_digests(other, BS))
+    assert prompt_digests(tokens, BS)[0] not in advertised
+
+
+def test_shed_baseline_survives_heartbeat_blips():
+    """A replica dropping out of one eval's fresh set and rejoining
+    must not re-count its lifetime shed counter as a fresh spike."""
+    autoscaler = SLOAutoscaler(
+        AutoscalePolicy(up_cooldown_s=0.0, idle_evals=99)
+    )
+    router = FleetRouter()
+    shed = {'requests_shed_total{reason="queue_timeout"}': 5.0}
+    router.observe(hb("r0", 1), now=0.0)
+    router.observe(hb("r1", 1, gauges=shed), now=0.0)
+    assert autoscaler.evaluate(_replica_view(router), 2, now=0.0).target == 2
+    # r1 blinks out of the evaluated set (late heartbeat) ...
+    only_r0 = [s for s in _replica_view(router) if s.replica_id == "r0"]
+    assert autoscaler.evaluate(only_r0, 2, now=1.0).target == 2
+    # ... and rejoins with the SAME lifetime counter: no phantom spike
+    decision = autoscaler.evaluate(_replica_view(router), 2, now=2.0)
+    assert decision.target == 2, decision
+    # a real increase still registers as pressure
+    router.observe(
+        hb("r1", 2, gauges={
+            'requests_shed_total{reason="queue_timeout"}': 7.0
+        }), now=3.0,
+    )
+    assert autoscaler.evaluate(_replica_view(router), 2, now=3.0).target == 3
+
+
+def test_fleet_with_no_capacity_surfaces_client_errors():
+    """The zero-500 assertions are falsifiable: a fleet that can never
+    place a session DOES produce client-visible errors once the retry
+    budget runs out."""
+
+    async def scenario():
+        fleet = SimFleet(
+            1, policy="affinity", block_size=BS,
+            unrouted_patience_ticks=5,
+        )
+        await fleet._pump_heartbeats()
+        session = fleet.submit([9] * (2 * BS), max_new_tokens=4)
+        fleet.kill("runner-0")  # and never revived
+        await fleet.run(10)
+        assert session.errors, "exhausted retries must surface a failure"
+        assert fleet.client_errors() == 1
+
+    asyncio.run(scenario())
+
+
+def test_autoscaler_never_flaps_inside_the_hysteresis_band():
+    policy = AutoscalePolicy(
+        burn_up=1.0, burn_down=0.25, up_cooldown_s=0.0,
+        down_cooldown_s=0.0, idle_evals=1,
+    )
+    autoscaler = SLOAutoscaler(policy)
+    router = FleetRouter()
+    # burn oscillating between the thresholds: neither hot nor calm
+    for i, burn in enumerate([0.5, 0.9, 0.4, 0.8, 0.6, 0.3]):
+        router.observe(
+            hb("r0", i + 1,
+               gauges={"jax_engine_slo_ttft_burn_rate_5m": burn}),
+            now=float(i),
+        )
+        decision = autoscaler.evaluate(
+            _replica_view(router), 2, now=float(i)
+        )
+        assert decision.target == 2, (i, burn, decision)
+
+
+def test_autoscale_policy_rejects_inverted_thresholds():
+    with pytest.raises(ValueError):
+        AutoscalePolicy(burn_up=1.0, burn_down=1.0)
+    with pytest.raises(ValueError):
+        AutoscalePolicy(queue_up=1.0, queue_down=2.0)
+    with pytest.raises(ValueError):
+        AutoscalePolicy(min_replicas=0)
+
+
+# ---------------------------------------------------------------------- #
+# simulated fleet: the acceptance criteria
+# ---------------------------------------------------------------------- #
+def test_affinity_routing_beats_round_robin_on_shared_prefix_traffic():
+    """Fleet-wide prefix_cache_hit_tokens_total is STRICTLY higher
+    under affinity routing than round-robin on identical shared-prefix
+    traffic, with zero client-visible errors on either leg."""
+    spec = TrafficSpec(groups=6, sessions_per_group=12, seed=99)
+    routed = asyncio.run(run_leg("affinity", spec, replicas=4))
+    rr = asyncio.run(run_leg("round_robin", spec, replicas=4))
+    assert routed["client_errors"] == 0 and rr["client_errors"] == 0
+    assert routed["sessions"] == rr["sessions"] == 72
+    assert routed["prefix_hit_tokens"] > rr["prefix_hit_tokens"], (
+        routed, rr,
+    )
+    # the hits are real pool economics, not router bookkeeping: the
+    # delta comes from PagedKVManager.stats across the fleet
+    assert routed["prefix_hit_tokens"] > 0
+
+
+def test_kill_mid_stream_reroutes_without_client_errors():
+    """One runner dies with live streams: every session finishes its
+    EXACT token sequence elsewhere (the sim's bitwise-resurrection
+    analogue), the client sees zero errors, and the healed replica
+    re-enters rotation."""
+
+    async def scenario():
+        fleet = SimFleet(3, policy="affinity", block_size=BS)
+        await fleet._pump_heartbeats()
+        prefix = [11] * (4 * BS)
+        sessions = [
+            fleet.submit(prefix + [100 + i] * BS, max_new_tokens=12)
+            for i in range(9)
+        ]
+        # let streams start (some tokens delivered, none finished)
+        await fleet.run(3)
+        victim = next(
+            name for name, r in fleet.replicas.items() if r.active
+        )
+        assert any(s.tokens for s in sessions)
+        fleet.kill(victim)
+        # killed replica is condemned immediately — routing continues
+        assert victim not in {
+            s.replica_id for s in fleet.router.routable(now=fleet.now)
+        }
+        await fleet.run(2)
+        fleet.revive(victim)
+        await fleet.run_until_idle()
+        for session in sessions:
+            assert session.errors == []
+            assert session.done
+            assert session.tokens == session.expected_tokens(), session.id
+        assert fleet.reroutes > 0
+        assert fleet.client_errors() == 0
+        # the revived replica gossiped serving at a newer seq: back in
+        # rotation for new sessions
+        assert victim in {
+            s.replica_id for s in fleet.router.routable(now=fleet.now)
+        }
+
+    asyncio.run(scenario())
+
+
+def test_autoscaler_scales_up_on_burst_and_down_when_idle():
+    """Burn-rate spike -> replicas up (through Operator.scale on the
+    MockKubeApi StatefulSet); sustained idle -> drain + scale down to
+    min. Hysteresis: the applied-scale sequence is monotone up then
+    monotone down — no flapping."""
+
+    async def scenario():
+        fleet = SimFleet(
+            1,
+            policy="affinity",
+            block_size=BS,
+            slots=2,
+            autoscale=AutoscalePolicy(
+                min_replicas=1, max_replicas=3, up_cooldown_s=10.0,
+                down_cooldown_s=30.0, idle_evals=2,
+            ),
+            autoscale_interval_s=5.0,
+            ttft_target_s=1.0,
+        )
+        await fleet._pump_heartbeats()
+        # burst: way more sessions than one 2-slot replica can admit
+        # inside the TTFT target
+        for i in range(24):
+            fleet.submit([3] * (2 * BS) + [50 + i] * BS, max_new_tokens=8)
+        await fleet.run(200)  # 50 sim-seconds of burst processing
+        sts = fleet.kube.get("StatefulSet", "fleet", "runner")
+        assert sts["spec"]["replicas"] > 1, "burn spike must scale up"
+        peak = sts["spec"]["replicas"]
+        assert len(fleet.replicas) == peak
+        assert fleet.autoscaler.events["up"] >= 1
+        # idle long enough for the burst's violations to age out of
+        # the 5m burn window, plus drain + down-cooldowns
+        await fleet.run_until_idle()
+        await fleet.run(1800)  # 450 idle sim-seconds
+        sts = fleet.kube.get("StatefulSet", "fleet", "runner")
+        assert sts["spec"]["replicas"] == 1, "idle fleet must shrink to min"
+        assert set(fleet.replicas) == {"runner-0"}
+        assert fleet.autoscaler.events["down"] >= 1
+        # no flapping: every scale-up decision precedes every applied
+        # scale-down, and no session ever errored
+        kinds = [
+            "up" if "scale-up" in d.reason else "down"
+            for d in fleet.autoscaler.decisions
+            if "scale-up" in d.reason or "applied" in d.reason
+        ]
+        assert kinds == sorted(kinds, key=lambda k: k == "down"), kinds
+        assert fleet.client_errors() == 0
+
+    asyncio.run(scenario())
+
+
+def test_sim_backpressure_and_shed_reroute_are_not_client_errors():
+    """A tiny pool + admission deadline: sheds happen, the fleet
+    re-routes them (503-with-retry semantics), and every session still
+    finishes exactly."""
+
+    async def scenario():
+        fleet = SimFleet(
+            2, policy="round_robin", block_size=BS,
+            num_blocks=24, slots=2, queue_timeout_s=2.0,
+        )
+        await fleet._pump_heartbeats()
+        sessions = [
+            fleet.submit([7] * (2 * BS) + [200 + i] * BS,
+                         max_new_tokens=8)
+            for i in range(16)
+        ]
+        await fleet.run_until_idle(max_ticks=4000)
+        for session in sessions:
+            assert session.done and session.errors == []
+            assert session.tokens == session.expected_tokens()
+
+    asyncio.run(scenario())
+
+
+def test_generated_tokens_are_replica_independent():
+    prompt = [1, 2, 3]
+    replica_a = SimReplica("a", block_size=BS)
+    replica_b = SimReplica("b", block_size=BS)
+    del replica_a, replica_b  # construction must not affect the stream
+    assert [generated_token(prompt, i) for i in range(4)] == [
+        generated_token(list(prompt), i) for i in range(4)
+    ]
+
+
+# ---------------------------------------------------------------------- #
+# gateway + tooling integration
+# ---------------------------------------------------------------------- #
+def test_gateway_stamps_replica_header_and_serves_fleet_metrics():
+    from langstream_tpu.api.metrics import parse_prometheus_text
+    from langstream_tpu.fleet.router import REPLICA_HEADER
+    from langstream_tpu.gateway.server import GatewayServer
+
+    async def scenario():
+        server = GatewayServer()
+        router = FleetRouter()
+        tokens = list(range(500, 500 + 2 * BS))
+        # wall-clock observes: the gateway routes on real time
+        router.observe(hb("runner-0", 1, digests=prompt_digests(tokens, BS)))
+        router.observe(hb("runner-1", 1, queue=5))
+        controller = FleetController(router)
+        server.register_fleet(controller)
+        headers = server._fleet_headers({"tokens": tokens})
+        assert headers == ((REPLICA_HEADER, "runner-0"),)
+        # token-less payloads still route (least queue depth)
+        headers = server._fleet_headers({"value": "plain"})
+        assert headers and headers[0][0] == REPLICA_HEADER
+        # an unroutable fleet degrades to the blind path, never fails
+        empty = GatewayServer()
+        empty.register_fleet(FleetController(FleetRouter()))
+        assert empty._fleet_headers({"tokens": tokens}) == ()
+        response = await server._metrics(None)
+        parsed = parse_prometheus_text(response.text)
+        assert "fleet_replica_queue_depth" in parsed
+        assert "fleet_replicas_current" in parsed
+        assert parsed["gateway_fleet_routed_total"][0][1] == 2.0
+
+    asyncio.run(scenario())
+
+
+def test_fleet_controller_merges_autoscaler_gauges():
+    router = FleetRouter()
+    router.observe(hb("r0", 1), now=0.0)
+    autoscaler = SLOAutoscaler(AutoscalePolicy())
+    autoscaler.evaluate(_replica_view(router), 1, now=0.0)
+    controller = FleetController(
+        router, autoscaler, replicas_current=lambda: 1
+    )
+    gauges = controller.gauges(now=0.1)
+    assert gauges["fleet_replicas_current"] == 1.0
+    assert gauges["fleet_replicas_target"] == 1.0
+    assert 'fleet_autoscale_events_total{direction="up"}' in gauges
+
+
+def test_ab_analyze_digests_fleet_legs(tmp_path):
+    import json
+    import subprocess
+    import sys
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    (tmp_path / "bench_fleet_routed.json").write_text(json.dumps({
+        "metric": "fleet_sim", "policy": "affinity", "sessions": 64,
+        "prefix_hit_tokens": 1800, "requests_shed": 1, "reroutes": 0,
+        "client_errors": 0,
+    }) + "\n")
+    (tmp_path / "bench_fleet_rr.json").write_text(json.dumps({
+        "metric": "fleet_sim", "policy": "round_robin", "sessions": 64,
+        "prefix_hit_tokens": 1500, "requests_shed": 4, "reroutes": 0,
+        "client_errors": 0,
+    }) + "\n")
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "ab_analyze.py"),
+         str(tmp_path)],
+        capture_output=True, text=True, check=True,
+    ).stdout
+    assert "prefix-affinity routing (sim)" in out
+    assert "1800 prefix-hit tokens" in out
+    assert "ENABLE prefix-affinity routing" in out
+    assert "+20.0%" in out
+    assert "sheds 4 -> 1" in out
+
+
+def test_fleet_sim_cli_writes_ab_artifacts(tmp_path):
+    import json
+    import subprocess
+    import sys
+
+    subprocess.run(
+        [sys.executable, "-m", "langstream_tpu.fleet.sim",
+         "--out", str(tmp_path), "--replicas", "3",
+         "--sessions-per-group", "8", "--groups", "5"],
+        check=True, capture_output=True, text=True,
+    )
+    routed = json.loads(
+        (tmp_path / "bench_fleet_routed.json").read_text()
+    )
+    rr = json.loads((tmp_path / "bench_fleet_rr.json").read_text())
+    assert routed["policy"] == "affinity"
+    assert rr["policy"] == "round_robin"
+    assert routed["prefix_hit_tokens"] > rr["prefix_hit_tokens"]
+
+
+def test_top_renders_fleet_panel(capsys):
+    import argparse
+
+    from aiohttp import web
+
+    from langstream_tpu.api.metrics import prometheus_text
+    from langstream_tpu.cli.main import _top_cmd
+
+    router = FleetRouter()
+    tokens = list(range(800, 800 + 3 * BS))
+    router.observe(
+        hb("runner-0", 3, queue=2, digests=prompt_digests(tokens, BS))
+    )
+    router.observe(hb("runner-1", 3, state="rebuilding", queue=7))
+    router.route(tokens)
+    router.route(None)
+    autoscaler = SLOAutoscaler(AutoscalePolicy())
+    autoscaler.evaluate(_replica_view(router), 2)
+    controller = FleetController(router, autoscaler)
+
+    async def main():
+        async def metrics(request):
+            return web.Response(
+                text=prometheus_text({}, controller.gauges()),
+                content_type="text/plain",
+            )
+
+        app = web.Application()
+        app.router.add_get("/metrics", metrics)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]  # noqa: SLF001
+        try:
+            await _top_cmd(argparse.Namespace(
+                url=f"http://127.0.0.1:{port}/metrics",
+                interval=0.01, count=1,
+            ))
+        finally:
+            await runner.cleanup()
+
+    asyncio.run(main())
+    out = capsys.readouterr().out
+    assert "-- fleet --" in out
+    # the eval saw mean queue 5.5 >= queue_up: target already 3
+    assert "replicas 2 (target 3, routable 1)" in out
+    assert "affinity hit rate" in out
+    assert "affinity=1" in out and "least_queue=1" in out
+    assert "runner-0" in out and "[serving]" in out
+    assert "runner-1" in out and "[rebuilding]" in out
+
+
+def test_ci_shard_owns_fleet_tests():
+    import os
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo, "tools"))
+    import ci_shard
+
+    assert ci_shard.assign("test_fleet.py") == "fleet"

@@ -62,6 +62,9 @@ def _sample_exposition() -> str:
         "sessions_resurrected_total": 2.0,
         "engine_degraded": 0.0,
         'requests_shed_total{reason="queue_timeout"}': 3.0,
+        # fleet layer (ISSUE 11): the admission backlog the router's
+        # least-queue fallback and the autoscaler's pressure math read
+        "jax_engine_queue_depth": 2.0,
     }
     return prometheus_text(
         reporter.snapshot(), gauges, reporter.histogram_snapshots(),
@@ -105,6 +108,9 @@ def _sample_exposition() -> str:
             "requests_shed_total":
                 "pending requests failed fast at the admission deadline,"
                 " by reason",
+            "jax_engine_queue_depth":
+                "requests waiting for a decode slot (submit queue +"
+                " admission pending); the fleet routing/scaling signal",
         },
     )
 

@@ -35,6 +35,11 @@ LEGS = {
     "bench_heal_paged_ref_tp2.json": "paged KV, gather reference, tp=2 mesh",
     "bench_heal_chaos.json":
         "chaos: mid-run engine crash + supervisor recovery (--chaos)",
+    # fleet A/B (langstream_tpu/fleet/sim.py): same synthetic
+    # shared-prefix traffic through the prefix-affinity router vs
+    # blind round-robin — CPU legs, so they exist on every machine
+    "bench_fleet_routed.json": "fleet: prefix-affinity routing (sim)",
+    "bench_fleet_rr.json": "fleet: round-robin baseline (sim)",
 }
 
 
@@ -59,6 +64,17 @@ def last_json_line(path: str) -> Optional[Dict[str, Any]]:
 def describe(record: Dict[str, Any]) -> str:
     if record.get("error"):
         return f"FAILED @{record.get('phase')}: {record['error'][:60]}"
+    if record.get("metric") == "fleet_sim":
+        # fleet sim legs measure cache economics, not tok/s
+        bits = [
+            f"{record.get('prefix_hit_tokens', 0):.0f} prefix-hit tokens",
+            f"shed {record.get('requests_shed', 0)}",
+            f"reroutes {record.get('reroutes', 0)}",
+            f"500s {record.get('client_errors', 0)}",
+        ]
+        if record.get("ttft_p50_s") is not None:
+            bits.append(f"TTFT p50 {record['ttft_p50_s']:.2f}s")
+        return " ".join(bits)
     bits = [f"{record.get('value', 0):.0f} tok/s"]
     if record.get("provisional"):
         bits.append("(provisional)")
@@ -543,6 +559,38 @@ def main() -> None:
             recommendations.append(
                 f"keep admission-chunk off (TTFT cut {cut:.1%}, "
                 f"throughput {tput:+.1%})" + note
+            )
+
+    routed = records["bench_fleet_routed.json"]
+    rr = records["bench_fleet_rr.json"]
+    if (
+        routed and rr
+        and routed.get("metric") == "fleet_sim"
+        and rr.get("metric") == "fleet_sim"
+        and routed.get("sessions") == rr.get("sessions")
+    ):
+        # affinity-vs-round-robin at identical traffic: the affinity
+        # verdict is the FLEET-WIDE prefix-hit-token delta (tokens the
+        # routed fleet never re-prefilled) read next to the shed delta
+        # (backlog the saved prefill work prevented)
+        base_hits = max(1, int(rr.get("prefix_hit_tokens", 0)))
+        hit_delta = routed.get("prefix_hit_tokens", 0) / base_hits - 1
+        shed_routed = int(routed.get("requests_shed", 0))
+        shed_rr = int(rr.get("requests_shed", 0))
+        if hit_delta > 0.03 and shed_routed <= shed_rr:
+            recommendations.append(
+                f"ENABLE prefix-affinity routing: {hit_delta:+.1%} "
+                f"fleet prefix-hit tokens "
+                f"({rr.get('prefix_hit_tokens', 0):.0f} -> "
+                f"{routed.get('prefix_hit_tokens', 0):.0f}), sheds "
+                f"{shed_rr} -> {shed_routed}; register a FleetRouter "
+                "on the gateway (docs/fleet.md)"
+            )
+        else:
+            recommendations.append(
+                f"keep round-robin routing ({hit_delta:+.1%} prefix-hit "
+                f"tokens, sheds {shed_rr} -> {shed_routed}): traffic "
+                "has too little prefix sharing for affinity to pay"
             )
 
     print("# Recommendations\n")

@@ -92,6 +92,12 @@ SHARDS: Dict[str, List[str]] = {
         "test_isolation",
         "test_plugins",
     ],
+    # fleet layer: prefix-affinity routing, SLO autoscaling, simulated
+    # fleet — pure-CPU (no JAX), so its own shard keeps the JAX-heavy
+    # shards' wall time flat as the fleet suite grows
+    "fleet": [
+        "test_fleet",
+    ],
     # compiler, runner, examples, docs — everything else lands here via
     # the catch-all marker (must stay LAST)
     "core-runner": ["*"],
