@@ -923,6 +923,19 @@ def build_parser() -> argparse.ArgumentParser:
              "reference oracle (docs/perf.md 'Ragged paged attention')",
     )
     serve.add_argument(
+        "--prefill-mode", default="split", choices=["split", "mixed"],
+        help="paged prefill scheduling: split (dedicated bucketed "
+             "prefill dispatches) or mixed (token-budget chunked "
+             "prefill fused into the decode step — bounds every "
+             "dispatch, docs/perf.md 'Chunked prefill & mixed "
+             "dispatch')",
+    )
+    serve.add_argument(
+        "--prefill-chunk", type=int, default=64,
+        help="mixed prefill mode: max prompt tokens any single decode "
+             "step carries",
+    )
+    serve.add_argument(
         "--spec-decode", default="off", choices=["off", "ngram"],
         help="speculative decoding: self-drafting prompt-lookup drafts "
              "spec-k tokens per decode step, one batched forward "

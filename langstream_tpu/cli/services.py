@@ -288,6 +288,8 @@ async def serve_main(args) -> None:
             "spec-decode": getattr(args, "spec_decode", "off"),
             "spec-k": getattr(args, "spec_k", 4),
             "spec-ngram": getattr(args, "spec_ngram", 2),
+            "prefill-mode": getattr(args, "prefill_mode", "split"),
+            "prefill-chunk": getattr(args, "prefill_chunk", 64),
             # decode-stall watchdog: on by default for serve (the
             # provider starts it; --no-watchdog disables)
             "watchdog": not getattr(args, "no_watchdog", False),

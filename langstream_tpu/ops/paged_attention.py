@@ -111,12 +111,19 @@ def _ragged_kernel_body(
     kv_heads: int,
     group: int,
     softcap: Optional[float],
+    ragged_q: bool = False,
 ):
     """One online-softmax recurrence for both pool dtypes. Rows of the
     score/accumulator tiles are kv-head-major: row ``h·(block_q·G) +
     t·G + g`` is query token ``t`` of query head ``h·G + g`` — the
     per-head q·kᵀ matmuls concatenate along axis 0 and the finalize
-    un-permutes back to ``[block_q, H, D]``."""
+    un-permutes back to ``[block_q, H, D]``.
+
+    ``ragged_q`` is the token-ragged q formulation (mixed prefill+decode
+    dispatch): each row's live query count is ``total - start`` and may
+    differ per row, so q tiles past a row's live count gate off their
+    compute AND their finalize — their (clamped) output block belongs to
+    the row's last live tile, which already wrote it."""
     quantized = ks_ref is not None
     b = pl.program_id(0)
     qi = pl.program_id(1)
@@ -133,11 +140,25 @@ def _ragged_kernel_body(
     start = starts_ref[b]
     total = totals_ref[b]
     window = win_ref[0]
+    # live-tile gate for the ragged-q grid: a tile whose first query
+    # index is past the row's live count is dead (its q/KV index maps
+    # clamp into the live range, so its DMAs are elided; compute and
+    # the live finalize are gated off, and the tile's OWN output block
+    # — out tiles never clamp — is zeroed instead, so masked positions
+    # are deterministic zeros rather than uninitialized VMEM: the
+    # mixed dispatch's null-block writes derive from them, and the
+    # mirror replays must be bitwise)
+    live = (qi * block_q < total - start) if ragged_q else True
+    if ragged_q:
+
+        @pl.when((j == num_j - 1) & jnp.logical_not(live))
+        def _zero_dead():
+            out_ref[0] = jnp.zeros_like(out_ref[0])
     first, last = _block_bounds(
         start, total, window, qi, block_q=block_q, block_size=block_size
     )
 
-    @pl.when((j >= first) & (j <= last))
+    @pl.when((j >= first) & (j <= last) & live)
     def _compute():
         q = q_ref[0]  # [block_q, H, D]
         # int8 pool values are exactly representable in bf16/f32, so the
@@ -208,7 +229,7 @@ def _ragged_kernel_body(
         acc_scratch[:] = acc_scratch[:] * alpha + pv
         m_scratch[:] = jnp.broadcast_to(m_new, m_scratch.shape)
 
-    @pl.when(j == num_j - 1)
+    @pl.when((j == num_j - 1) & live)
     def _finalize():
         l = l_scratch[:, :1]
         l_safe = jnp.where(l == 0.0, 1.0, l)
@@ -236,6 +257,30 @@ def _ragged_kernel_quant(tables_ref, starts_ref, totals_ref, win_ref,
     _ragged_kernel_body(
         tables_ref, starts_ref, totals_ref, win_ref, q_ref, k_ref, v_ref,
         ks_ref, vs_ref, out_ref, m_scratch, l_scratch, acc_scratch, **kw,
+    )
+
+
+def _ragged_q_kernel(tables_ref, starts_ref, totals_ref, qoff_ref, win_ref,
+                     q_ref, k_ref, v_ref, out_ref, m_scratch, l_scratch,
+                     acc_scratch, **kw):
+    # qoff_ref is consumed by the index maps only (it addresses the
+    # flattened q tile); the recurrence itself needs just starts/totals
+    del qoff_ref
+    _ragged_kernel_body(
+        tables_ref, starts_ref, totals_ref, win_ref, q_ref, k_ref, v_ref,
+        None, None, out_ref, m_scratch, l_scratch, acc_scratch,
+        ragged_q=True, **kw,
+    )
+
+
+def _ragged_q_kernel_quant(tables_ref, starts_ref, totals_ref, qoff_ref,
+                           win_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
+                           out_ref, m_scratch, l_scratch, acc_scratch, **kw):
+    del qoff_ref
+    _ragged_kernel_body(
+        tables_ref, starts_ref, totals_ref, win_ref, q_ref, k_ref, v_ref,
+        ks_ref, vs_ref, out_ref, m_scratch, l_scratch, acc_scratch,
+        ragged_q=True, **kw,
     )
 
 
@@ -372,6 +417,243 @@ def ragged_paged_attention_quant(
         q, k_pool, v_pool, block_tables, starts, lengths,
         k_scale=k_scale, v_scale=v_scale, **kwargs,
     )
+
+
+def ragged_q_paged_attention(
+    q: jnp.ndarray,             # [Q, H, D] flattened new-token tile
+    k_pool: jnp.ndarray,        # [N, Bs, KVH, D] (bf16/f32; int8 w/ scales)
+    v_pool: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, M] pool block per sequence block
+    starts: jnp.ndarray,        # [B] global position of each row's query 0
+    lengths: jnp.ndarray,       # [B] TOTAL live context (prefix + new)
+    q_offsets: jnp.ndarray,     # [B] row offsets into the flat q tile
+    *,
+    max_q_len: int,             # static per-row span capacity in q
+    k_scale: Optional[jnp.ndarray] = None,  # [N, Bs, KVH] — int8 pools
+    v_scale: Optional[jnp.ndarray] = None,
+    softcap: Optional[float] = None,
+    window: Optional[jnp.ndarray] = None,   # scalar; None/0 = full attn
+    scale: Optional[float] = None,
+    block_q: Optional[int] = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Token-ragged q formulation: ONE grid serves rows with
+    Tq ∈ {0..max_q_len} — the mixed prefill+decode dispatch shape
+    (Sarathi/DeepServe chunked-prefill batching on the RPA schedule).
+
+    Row ``b``'s live queries are ``lengths[b] - starts[b]`` tokens
+    (decode rows carry 1, admitting rows carry a prefill window, idle
+    rows 0) living at ``q_offsets[b] .. q_offsets[b]+live-1`` of the
+    flattened ``q`` tile — cu_q_lens-style row offsets, carried as a
+    scalar-prefetch operand next to the existing starts/lengths. Each
+    row's span must be ``block_q``-aligned (``q_offsets`` multiples of
+    ``block_q``, spans padded up to it); q tiles past a row's live
+    count clamp their index maps into the row's LAST live tile — the
+    repeated mapped indices elide the q/KV DMAs — and gate off both
+    compute and finalize, so attention work is ∝ live tokens per row,
+    not ∝ the padded span. Returns the flat [Q, H, D] outputs; padding
+    positions within a live tile compute garbage exactly like the XLA
+    paths (callers index by the row's live count)."""
+    total_q, heads, dim = q.shape
+    batch, num_blocks_table = block_tables.shape
+    block_size, kv_heads = k_pool.shape[1], k_pool.shape[2]
+    group = heads // kv_heads
+    scale = dim ** -0.5 if scale is None else scale
+    quantized = k_scale is not None
+    block_q = min(block_q or 8, max_q_len)
+    if max_q_len % block_q or total_q % block_q:
+        raise ValueError(
+            f"ragged-q spans must tile by block_q={block_q} "
+            f"(max_q_len={max_q_len}, flat q={total_q})"
+        )
+    num_q_tiles = max_q_len // block_q
+    # 4-d view so the shared kernel body's [1, block_q, H, D] ref shape
+    # (and the scratch layout) match the fixed-Tq kernel exactly
+    q_tiles = q.reshape(total_q // block_q, block_q, heads, dim)
+
+    tables = block_tables.astype(jnp.int32)
+    starts = starts.astype(jnp.int32)
+    totals = lengths.astype(jnp.int32)
+    qoffs = q_offsets.astype(jnp.int32)
+    window_arr = jnp.reshape(
+        jnp.asarray(0 if window is None else window, dtype=jnp.int32), (1,)
+    )
+
+    def live_tile(b, qi, starts, totals):
+        # last live q tile of row b (>=0 so fully-dead rows clamp to
+        # tile 0 — gated off in the kernel)
+        q_len = totals[b] - starts[b]
+        tiles = jnp.maximum(1, (q_len + block_q - 1) // block_q)
+        return jnp.minimum(qi, tiles - 1)
+
+    def q_index(b, qi, j, tables, starts, totals, qoffs, win):
+        return (
+            qoffs[b] // block_q + live_tile(b, qi, starts, totals),
+            0, 0, 0,
+        )
+
+    def out_index(b, qi, j, tables, starts, totals, qoffs, win):
+        # out tiles do NOT clamp: a dead tile owns its span position and
+        # writes zeros there (see the kernel's _zero_dead), so padding
+        # positions are deterministic instead of uninitialized
+        return (qoffs[b] // block_q + qi, 0, 0, 0)
+
+    def kv_block(b, qi, j, tables, starts, totals, qoffs, win):
+        qi_live = live_tile(b, qi, starts, totals)
+        first, last = _block_bounds(
+            starts[b], totals[b], win[0], qi_live,
+            block_q=block_q, block_size=block_size,
+        )
+        # dead q tiles AND dead kv blocks clamp into the live range:
+        # repeated mapped indices elide the DMA entirely
+        return tables[b, jnp.clip(j, first, last)]
+
+    def kv_index(b, qi, j, tables, starts, totals, qoffs, win):
+        return (kv_block(b, qi, j, tables, starts, totals, qoffs, win),
+                0, 0, 0)
+
+    def scale_index(b, qi, j, tables, starts, totals, qoffs, win):
+        return (kv_block(b, qi, j, tables, starts, totals, qoffs, win),
+                0, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, block_q, heads, dim), q_index),
+        pl.BlockSpec((1, block_size, kv_heads, dim), kv_index),
+        pl.BlockSpec((1, block_size, kv_heads, dim), kv_index),
+    ]
+    operands = [q_tiles, k_pool, v_pool]
+    kernel_kw = dict(
+        scale=scale, block_q=block_q, block_size=block_size,
+        kv_heads=kv_heads, group=group, softcap=softcap,
+    )
+    if quantized:
+        kernel = functools.partial(_ragged_q_kernel_quant, **kernel_kw)
+        in_specs += [
+            pl.BlockSpec((1, block_size, kv_heads), scale_index),
+            pl.BlockSpec((1, block_size, kv_heads), scale_index),
+        ]
+        operands += [
+            k_scale.astype(jnp.float32), v_scale.astype(jnp.float32),
+        ]
+        kv_bytes = k_pool.size + v_pool.size + (
+            k_scale.size + v_scale.size
+        ) * 4
+    else:
+        kernel = functools.partial(_ragged_q_kernel, **kernel_kw)
+        kv_bytes = (k_pool.size + v_pool.size) * k_pool.dtype.itemsize
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=5,
+        grid=(batch, num_q_tiles, num_blocks_table),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, block_q, heads, dim), out_index),
+        scratch_shapes=[
+            pltpu.VMEM((block_q * heads, 128), jnp.float32),
+            pltpu.VMEM((block_q * heads, 128), jnp.float32),
+            pltpu.VMEM((block_q * heads, dim), jnp.float32),
+        ],
+    )
+    ctx = num_blocks_table * block_size
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(
+            (total_q // block_q, block_q, heads, dim), q.dtype
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * batch * max_q_len * heads * ctx * dim,
+            bytes_accessed=q.size * q.dtype.itemsize * 2 + kv_bytes // 2,
+            transcendentals=batch * max_q_len * heads * ctx,
+        ),
+        interpret=interpret,
+    )(tables, starts, totals, qoffs, window_arr, *operands)
+    return out.reshape(total_q, heads, dim)
+
+
+def ragged_q_paged_attention_quant(
+    q: jnp.ndarray,
+    k_pool: jnp.ndarray,     # [N, Bs, KVH, D] int8
+    k_scale: jnp.ndarray,    # [N, Bs, KVH] f32
+    v_pool: jnp.ndarray,
+    v_scale: jnp.ndarray,
+    block_tables: jnp.ndarray,
+    starts: jnp.ndarray,
+    lengths: jnp.ndarray,
+    q_offsets: jnp.ndarray,
+    **kwargs,
+) -> jnp.ndarray:
+    """Int8-pool twin of :func:`ragged_q_paged_attention` (argument
+    ordering matches the other ``*_quant`` wrappers)."""
+    return ragged_q_paged_attention(
+        q, k_pool, v_pool, block_tables, starts, lengths, q_offsets,
+        k_scale=k_scale, v_scale=v_scale, **kwargs,
+    )
+
+
+def ragged_q_paged_attention_sharded(
+    q: jnp.ndarray,             # [Q, H, D] — H sharded over ``axis_name``
+    k_pool: jnp.ndarray,        # [N, Bs, KVH, D] — KVH sharded
+    v_pool: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, M] (replicated host metadata)
+    starts: jnp.ndarray,        # [B]
+    lengths: jnp.ndarray,       # [B]
+    q_offsets: jnp.ndarray,     # [B] (replicated)
+    mesh,
+    *,
+    max_q_len: int,
+    k_scale: Optional[jnp.ndarray] = None,
+    v_scale: Optional[jnp.ndarray] = None,
+    softcap: Optional[float] = None,
+    window: Optional[jnp.ndarray] = None,
+    scale: Optional[float] = None,
+    block_q: Optional[int] = None,
+    axis_name: str = "tp",
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Token-ragged q kernel under tensor parallelism — the shard_map
+    twin, exactly like :func:`ragged_paged_attention_sharded`: one
+    independent launch per kv-head shard, tables/starts/lengths/
+    q_offsets replicated scalar-prefetch, pool/q/out split on their
+    kv-head/head axes (attention never mixes kv heads, so no
+    collective)."""
+    from jax.sharding import PartitionSpec as P
+
+    head_spec = P(None, axis_name, None)         # flat q / out [Q, H, D]
+    pool_spec = P(None, None, axis_name, None)   # [N, Bs, KVH, D]
+    scale_spec = P(None, None, axis_name)        # [N, Bs, KVH]
+    quantized = k_scale is not None
+    window_arr = jnp.asarray(
+        0 if window is None else window, dtype=jnp.int32
+    )
+
+    def local(q_l, k_l, v_l, tables_l, starts_l, totals_l, qoffs_l,
+              window_l, *scales):
+        return ragged_q_paged_attention(
+            q_l, k_l, v_l, tables_l, starts_l, totals_l, qoffs_l,
+            max_q_len=max_q_len, interpret=interpret, softcap=softcap,
+            window=window_l, scale=scale, block_q=block_q,
+            **(
+                {"k_scale": scales[0], "v_scale": scales[1]}
+                if scales else {}
+            ),
+        )
+
+    in_specs = [
+        head_spec, pool_spec, pool_spec,
+        P(None, None), P(None), P(None), P(None), P(),
+    ]
+    operands = [
+        q, k_pool, v_pool, block_tables, starts, lengths, q_offsets,
+        window_arr,
+    ]
+    if quantized:
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scale, v_scale]
+    from langstream_tpu.ops.flash_attention import compat_shard_map
+
+    return compat_shard_map(
+        local, mesh, tuple(in_specs), head_spec
+    )(*operands)
 
 
 def ragged_paged_attention_sharded(

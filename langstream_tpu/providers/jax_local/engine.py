@@ -142,6 +142,11 @@ def engines_snapshot() -> Dict[str, float]:
             # supervisor resurrection: tokens re-prefilled to fast-
             # forward a crashed session back to its pre-crash state
             "crash_replay",
+            # prompt-padding ghosts: split-path bucket rounding (up to
+            # ~2x a prompt's FLOPs at the worst bucket edge) vs the
+            # mixed path's ≤ width−1 per window — the padding win the
+            # chunked-prefill A/B is judged on
+            "prefill_padding",
         )
     }
     shed_engines = 0
@@ -384,6 +389,14 @@ class _Slot:
                                          # pipelined results for recycled slots
     prefilling: bool = False             # prefill dispatched, first token
                                          # not yet harvested
+    # mixed dispatch (prefill_mode: mixed): next prompt index a mixed
+    # step should teach (None = not admitting through the mixed path);
+    # successive decode steps carry prefill_chunk-token windows until
+    # the watermark reaches the prompt end
+    prefill_pos: Optional[int] = None
+    prefill_seq: int = 0                 # admission order (FIFO budget share)
+    prefill_t0: float = 0.0              # admission ts (prefill_time anchor)
+    prefill_reused: int = 0              # cache-served prefix at admission
 
     @property
     def active(self) -> bool:
@@ -459,6 +472,13 @@ class DecodeEngine:
                                           # tokens verified per step)
         spec_k: int = 4,                 # drafted tokens per decode step
         spec_ngram: int = 2,             # suffix n-gram the drafter matches
+        prefill_mode: str = "split",     # paged prefill scheduling:
+                                          # "split" (dedicated bucketed
+                                          # prefill dispatches) | "mixed"
+                                          # (token-budget chunked prefill
+                                          # fused into the decode step)
+        prefill_chunk: int = 64,         # mixed: max prefill tokens any
+                                          # single step carries
         pipeline_decode: bool = False,
         prefix_cache: bool = True,
         logprobs_topk: int = 0,
@@ -577,6 +597,39 @@ class DecodeEngine:
         # tokens a single scan step can emit (verify block width): the
         # context/budget arithmetic everywhere else keys off this
         self.spec_block = (self.spec_k + 1) if self.spec else 1
+        # mixed prefill+decode dispatch (ROADMAP item 1 / ISSUE 12): on
+        # the paged path, prefill stops being its own dispatch shape —
+        # admitting slots park at a `prefill_pos` watermark and every
+        # decode step carries up to `prefill_chunk` of their prompt
+        # tokens alongside the Tq=1 decode rows in ONE fused ragged
+        # launch (Sarathi-style stall-free batching), bounding any
+        # single dispatch's duration and capping padding at the mixed
+        # width instead of a power-of-two bucket. "split" keeps the
+        # dedicated prefill dispatch + harvest machinery (the oracle
+        # the mixed path is token-parity-tested against, and the
+        # on-chip A/B's other leg).
+        if prefill_mode not in ("split", "mixed"):
+            raise ValueError(f"unknown prefill mode {prefill_mode!r}")
+        if prefill_mode == "mixed" and kv_layout != "paged":
+            raise ValueError(
+                "prefill_mode 'mixed' requires kv_layout 'paged' — the "
+                "dense cache has no per-row table indirection for the "
+                "token-ragged mixed dispatch to address"
+            )
+        self.prefill_mode = prefill_mode
+        self.mixed = prefill_mode == "mixed"
+        self.prefill_chunk = max(1, int(prefill_chunk))
+        # mixed width ladder: power-of-two [S, W] dispatch widths up to
+        # the (rounded-up) budget, so compilations stay logarithmic and
+        # every width tiles evenly by the ragged kernel's q tile
+        cap = 1
+        while cap < self.prefill_chunk:
+            cap *= 2
+        widths = [min(8, cap)]
+        while widths[-1] < cap:
+            widths.append(widths[-1] * 2)
+        self._mixed_widths = widths
+        self._admit_seq = 0
         if self.paged_kernel == "fused" and not model_lib._use_fused_paged(
             config, config.dims_per_head, config.num_heads,
             config.num_kv_heads, self.mesh,
@@ -710,6 +763,7 @@ class DecodeEngine:
         self._prefill_offset_fns: Dict[int, Any] = {}
         self._decode_fns: Dict[int, Any] = {}
         self._spec_decode_fns: Dict[int, Any] = {}
+        self._mixed_fns: Dict[int, Any] = {}
         self._copy_fns: Dict[int, Any] = {}
         self._block_copy_fn: Optional[Any] = None
         # prefill dispatches whose first tokens are not yet harvested
@@ -721,6 +775,12 @@ class DecodeEngine:
         # per-chunk dispatch log: (steps, active_slots, wall_seconds) —
         # the occupancy/step-time evidence the bench prints (bounded)
         self.chunk_log: List[Tuple[int, int, float]] = []
+        # token-denominated twin of chunk_log covering EVERY device
+        # dispatch (prefill windows included): the interference-bound
+        # evidence — in mixed mode no entry's prefill_tokens may exceed
+        # prefill_chunk, while a split-path cold prompt logs its whole
+        # bucket in one entry (bounded like chunk_log)
+        self.dispatch_log: List[Dict[str, Any]] = []
         # multi-host SPMD serving: when set (serving/mirror.py), every
         # device dispatch is also published as a compact record so
         # follower hosts replay the identical jit sequence on their
@@ -748,6 +808,8 @@ class DecodeEngine:
             paged_kernel_requested=self.paged_kernel_requested or "",
             spec_decode=self.spec_decode,
             spec_k=self.spec_k if self.spec else 0,
+            prefill_mode=self.prefill_mode,
+            prefill_chunk=self.prefill_chunk if self.mixed else 0,
         )
         _LIVE_ENGINES.add(self)
 
@@ -796,6 +858,7 @@ class DecodeEngine:
         """Zero the counters (e.g. after warmup, before measurement)."""
         self.stats = self._fresh_stats()
         self.chunk_log = []
+        self.dispatch_log = []
 
     def _default_buckets(self) -> List[int]:
         buckets, size = [], 64
@@ -1187,6 +1250,76 @@ class DecodeEngine:
             self._spec_decode_fns[steps] = fn
         return fn
 
+    def _get_mixed(self, width: int):
+        """Jitted mixed prefill+decode step (``prefill_mode: mixed``):
+        ONE fused dispatch where every ready slot rides as a Tq=1
+        decode row and admitting slots carry ``width``-capped prefill
+        windows — :func:`model.paged_mixed_step` plus in-jit sampling
+        with the split paths' EXACT semantics, so mixed and split legs
+        are token-parity comparable:
+
+        - decode rows sample like the decode scan body: penalties over
+          the slot's count row, logit bias, keys from (seed, post-write
+          length);
+        - a window that COMPLETES its prompt samples like the prefill
+          paths' ``sample_first``: counts reset then the first token
+          counted, NO penalties (fresh request), keys from (seed,
+          total prompt length);
+        - mid-prefill and idle rows discard their sample and leave the
+          count row untouched."""
+        fn = self._mixed_fns.get(width)
+        if fn is None:
+            config, freqs = self.config, self.freqs
+            mesh = self._tp_mesh()
+            topk = self.logprobs_topk
+            paged_kernel = self.paged_kernel
+
+            @functools.partial(jax.jit, donate_argnums=(1, 9))
+            def run(params, cache, tokens, offsets, num_tokens,
+                    write_mask, decode_mask, completes, tables, counts,
+                    temperature, top_k, top_p, presence, frequency,
+                    seeds, bias_ids, bias_vals):
+                cache, logits = model_lib.paged_mixed_step(
+                    config, params, cache, tokens, offsets, num_tokens,
+                    tables, freqs, write_mask=write_mask, mesh=mesh,
+                    kernel=paged_kernel,
+                )
+                slots = tokens.shape[0]
+                rows = jnp.arange(slots)
+                sample_mask = decode_mask | completes
+                # completing rows reset their penalty counts FIRST
+                # (sample_first semantics — order is irrelevant for the
+                # sample itself since penalties don't apply to them)
+                counts = jnp.where(completes[:, None], 0, counts)
+                penalized = (
+                    logits
+                    - presence[:, None] * (counts > 0)
+                    - frequency[:, None] * counts
+                )
+                adjusted = jnp.where(
+                    decode_mask[:, None], penalized, logits
+                )
+                adjusted = adjusted.at[rows[:, None], bias_ids].add(
+                    bias_vals
+                )
+                # key position = the row's TOTAL cache length after this
+                # step: decode rows match the scan body's `lengths`,
+                # completing windows match sample_first's prompt length
+                keys = _sampling_keys(seeds, offsets + num_tokens)
+                sampled = _sample(adjusted, temperature, top_k, keys,
+                                  top_p)
+                lp = _token_logprob(logits, sampled)
+                tops = _top_logprobs(logits, topk) if topk else None
+                sampled = jnp.where(sample_mask, sampled, 0)
+                counts = counts.at[rows, sampled].add(
+                    sample_mask.astype(jnp.int32)
+                )
+                return cache, counts, sampled, lp, tops
+
+            fn = run
+            self._mixed_fns[width] = fn
+        return fn
+
     def _get_copy_prefix(self, bucket: int):
         """Jitted cross-slot KV copy: move ``bucket`` cache rows starting
         at ``offset`` from slot ``src`` to slot ``dst``. Pure device-side
@@ -1333,7 +1466,11 @@ class DecodeEngine:
 
         jobs: List[Tuple[Any, Tuple[Any, ...]]] = []
         size = 1
-        while size <= self.max_slots:
+        # mixed mode retires the bucketed prefill dispatches entirely:
+        # prompts enter through the mixed decode-step windows below, so
+        # compiling the (bucket × group-size) prefill lattice would be
+        # pure waste (and followers never receive those records either)
+        while not self.mixed and size <= self.max_slots:
             for bucket in self.prefill_buckets:
                 sampling = (
                     vec(size, jnp.float32), vec(size, jnp.int32),
@@ -1396,6 +1533,28 @@ class DecodeEngine:
                     (slots, self.MAX_LOGIT_BIAS), jnp.float32
                 ),
             )))
+        if self.mixed:
+            for width in self._mixed_widths:
+                jobs.append((self._get_mixed(width), (
+                    params_aval, cache_aval,
+                    jax.ShapeDtypeStruct((slots, width), jnp.int32),
+                    vec(slots, jnp.int32), vec(slots, jnp.int32),
+                    vec(slots, jnp.bool_), vec(slots, jnp.bool_),
+                    vec(slots, jnp.bool_),
+                    jax.ShapeDtypeStruct(
+                        (slots, self.max_blocks), jnp.int32
+                    ),
+                    counts_aval,
+                    vec(slots, jnp.float32), vec(slots, jnp.int32),
+                    vec(slots, jnp.float32), vec(slots, jnp.float32),
+                    vec(slots, jnp.float32), vec(slots, jnp.uint32),
+                    jax.ShapeDtypeStruct(
+                        (slots, self.MAX_LOGIT_BIAS), jnp.int32
+                    ),
+                    jax.ShapeDtypeStruct(
+                        (slots, self.MAX_LOGIT_BIAS), jnp.float32
+                    ),
+                )))
         return jobs
 
     def precompile(self, workers: int = 4, execute: bool = True) -> None:
@@ -1621,18 +1780,29 @@ class DecodeEngine:
                         and not self._pending
                         and inflight is None
                         and not self._prefill_inflight
+                        and not self._any_admitting()
                     )
                     if not self._running:
                         break
-                    if self._pending and any(not s.active for s in self.slots):
+                    if (
+                        self._pending
+                        and inflight is None
+                        and any(not s.active for s in self.slots)
+                    ):
                         # admission linger: give a burst of submissions a
                         # beat to land so prefill batches fill up and decode
-                        # waves stay aligned (amortizes dispatch latency)
+                        # waves stay aligned (amortizes dispatch latency).
+                        # Skipped while a chunk is in flight — lingering
+                        # then would add 3 ms to THAT chunk's harvest
+                        # latency, taxing every running stream's TPOT for
+                        # a batching benefit the next dispatch gets anyway
                         time.sleep(0.003)
                         self._drain_queue(block=False)
                     # dispatch prefills WITHOUT blocking: they queue behind
                     # the in-flight decode chunk and overlap with the next
-                    # ones; their slots join decode once harvested
+                    # ones; their slots join decode once harvested. (mixed
+                    # mode: admission only parks the slot at its watermark
+                    # — the windows ride the decode steps below)
                     self._admit()
                     if inflight is not None:
                         # overlap: chain the next chunk off the device-side
@@ -1646,10 +1816,16 @@ class DecodeEngine:
                     # only when decode has nothing to run anyway
                     self._harvest_prefills(
                         block=inflight is None and not self._any_ready()
+                        and not self._any_admitting()
                     )
-                    if inflight is None and self._any_ready():
+                    if inflight is None and (
+                        self._any_ready() or self._any_admitting()
+                    ):
                         inflight = self._dispatch_decode()
-                        if not self.pipeline_decode:
+                        if not self.pipeline_decode or inflight.get("mixed"):
+                            # mixed steps are never pipelined: the next
+                            # window's content depends on THIS step's
+                            # completion bookkeeping
                             self._process_decode(inflight)
                             inflight = None
                             self._harvest_prefills(block=False)
@@ -1682,6 +1858,14 @@ class DecodeEngine:
 
     def _any_ready(self) -> bool:
         return any(slot.ready for slot in self.slots)
+
+    def _any_admitting(self) -> bool:
+        """Mixed mode: slots parked at a prefill watermark, waiting for
+        decode steps to carry their prompt windows."""
+        return self.mixed and any(
+            slot.prefill_pos is not None and slot.request is not None
+            for slot in self.slots
+        )
 
     def _drain_queue(self, block: bool) -> None:
         try:
@@ -1929,6 +2113,8 @@ class DecodeEngine:
         follow-ups sharing a suffix bucket likewise batch into one
         prefill-at-offset dispatch (batches split into power-of-two group
         sizes so compilations stay bounded)."""
+        if self.mixed:
+            return self._admit_mixed()
         if self.paged:
             return self._admit_paged()
         self._shed_expired()
@@ -2139,24 +2325,9 @@ class DecodeEngine:
             long_entries: List[Tuple[int, GenerationRequest, int]] = []
             progressed = False
             while self._pending:
-                # warm-first session scan, same bounds as the dense path
-                position, index, session_lcp = 0, None, None
-                head = self._pending[0]
-                if getattr(head, "_skipped", 0) < self.MAX_HEAD_SKIPS:
-                    depth = max(2 * self.max_slots, 8)
-                    for p, queued in enumerate(self._pending[:depth]):
-                        warm_index = self._find_warm_slot(queued)
-                        if warm_index is None:
-                            continue
-                        lcp = self._session_warm(warm_index, queued)
-                        if lcp is not None:
-                            position, index, session_lcp = p, warm_index, lcp
-                            break
+                # warm-first session scan (shared with _admit_mixed)
+                position, index, session_lcp = self._scan_admission()
                 request = self._pending[position]
-                if index is None:
-                    index = self._find_slot(request)
-                    if index is not None:
-                        session_lcp = self._session_warm(index, request)
                 if index is None:
                     break
                 # probe the resume offset WITHOUT committing, so the
@@ -2197,6 +2368,7 @@ class DecodeEngine:
                     # referenced by running work — wait for releases
                     break
                 if position > 0:
+                    head = self._pending[0]
                     head._skipped = getattr(head, "_skipped", 0) + 1
                 self._pending.pop(position)
                 self.slots[index].request = request  # reserve the slot
@@ -2225,12 +2397,97 @@ class DecodeEngine:
             if not progressed:
                 return
 
+    def _scan_admission(self):
+        """Warm-first admission selection shared by the paged admission
+        paths: prefer a pending request with a warm session slot (scan
+        bounded to 2×slots deep; a head skipped MAX_HEAD_SKIPS times is
+        force-admitted so warm traffic can't starve cold arrivals),
+        else the queue head into any free/evictable slot. Returns
+        (position in _pending, slot index or None, session lcp or
+        None) — ONE policy, so mixed- and split-mode admission
+        ordering can never diverge under identical traffic (the A/B's
+        equal-traffic premise)."""
+        position, index, session_lcp = 0, None, None
+        head = self._pending[0]
+        if getattr(head, "_skipped", 0) < self.MAX_HEAD_SKIPS:
+            depth = max(2 * self.max_slots, 8)
+            for p, queued in enumerate(self._pending[:depth]):
+                warm_index = self._find_warm_slot(queued)
+                if warm_index is None:
+                    continue
+                lcp = self._session_warm(warm_index, queued)
+                if lcp is not None:
+                    position, index, session_lcp = p, warm_index, lcp
+                    break
+        request = self._pending[position]
+        if index is None:
+            index = self._find_slot(request)
+            if index is not None:
+                session_lcp = self._session_warm(index, request)
+        return position, index, session_lcp
+
+    def _admit_mixed(self) -> None:
+        """Token-budget admission (``prefill_mode: mixed``): a request
+        claims a slot and its worst-case block reservation exactly like
+        the split paged path, but NO prefill dispatch happens here —
+        the slot parks as ADMITTING (``prefill_pos`` watermark) and
+        successive mixed decode steps carry ``prefill_chunk``-token
+        windows of its prompt alongside the decoding rows
+        (:meth:`_dispatch_mixed`), so no stream ever stalls behind a
+        monolithic bucket-sized prefill. Cold prompts are NOT published
+        at admission: their blocks fill across several dispatches, and
+        a duplicate matching the chain early would attend over rows not
+        yet written (the split path's cold-batch-before-warm-suffix
+        dispatch ordering does not exist here) — they publish at finish
+        like every partially-matched prompt."""
+        self._shed_expired()
+        self._drop_cancelled()
+        while self._pending:
+            position, index, session_lcp = self._scan_admission()
+            request = self._pending[position]
+            if index is None:
+                return
+            probe_match = None
+            if session_lcp is None and self.prefix_cache:
+                probe_match = self.kv_manager.match(request.prompt_tokens)
+            resume = self._paged_reserve(
+                index, request, session_lcp, probe_match,
+                publish_cold=False,
+            )
+            if resume is None:
+                # pool exhausted even after eviction: every block is
+                # referenced by running work — wait for releases
+                return
+            if position > 0:
+                head = self._pending[0]
+                head._skipped = getattr(head, "_skipped", 0) + 1
+            self._pending.pop(position)
+            slot = self.slots[index]
+            slot.request = request
+            if session_lcp is not None:
+                self.stats["session_hits"] += 1
+            self._assign_slot(index, request, reused=resume)
+            slot.prefilling = True
+            slot.prefill_pos = resume
+            slot.prefill_reused = resume
+            self._admit_seq += 1
+            slot.prefill_seq = self._admit_seq
+            slot.prefill_t0 = time.perf_counter()
+            flight.record(
+                "mixed_admit",
+                slot=index,
+                prompt_tokens=len(request.prompt_tokens),
+                reused_tokens=resume,
+                queue_depth=len(self._pending),
+            )
+
     def _paged_reserve(
         self,
         index: int,
         request: GenerationRequest,
         session_lcp: Optional[int],
         match: Optional[Tuple[List[int], int]] = None,
+        publish_cold: bool = True,
     ) -> Optional[int]:
         """Commit pool blocks for a request before it is admitted.
         Returns the resume offset — tokens already resident for this
@@ -2315,13 +2572,16 @@ class DecodeEngine:
                 self.stats["prefix_hits"] += 1
                 self.stats["prefix_tokens_reused"] += matched_tokens
                 manager.stats["hit_tokens"] += matched_tokens
-            if self.prefix_cache and not matched_tokens:
+            if self.prefix_cache and publish_cold and not matched_tokens:
                 # publish a fully-cold prompt's blocks NOW so same-round
                 # duplicates share them — safe because the cold batch
                 # (which writes every one of these blocks) dispatches
                 # before any warm suffix this round. Partially-matched
                 # prompts publish their divergent tail at finish instead
                 # (their suffix prefill dispatches in the warm wave).
+                # Mixed admission passes publish_cold=False: its blocks
+                # fill across several dispatches, so early publication
+                # would let a duplicate read unwritten rows.
                 manager.publish(prompt, slot.blocks)
             resume = matched_tokens
         table = self._block_tables[index]
@@ -2395,6 +2655,8 @@ class DecodeEngine:
         slot.length = len(request.prompt_tokens)
         slot.last_used = time.monotonic()
         slot.epoch += 1
+        slot.prefill_pos = None   # mixed admission re-parks it after this
+        slot.prefill_reused = 0
 
     def _request_seed(self, request: GenerationRequest) -> int:
         """The request's sampling seed: explicit (OpenAI `seed`) or a
@@ -2514,6 +2776,16 @@ class DecodeEngine:
                 for _, r in group
             )
             self.stats["prefill_flops"] += dispatch_flops
+            # goodput ledger: bucket-rounding ghosts — positions the
+            # padded [size, bucket] dispatch computes past each prompt's
+            # end (up to ~2x a prompt's FLOPs at the worst bucket edge;
+            # the mixed path caps the same waste at width−1 per window)
+            live = sum(len(r.prompt_tokens) for _, r in group)
+            self._waste("prefill_padding", size * bucket - live)
+            self._log_dispatch(
+                "prefill", tokens=live, rows=size, wall=0.0,
+                prefill_tokens=live,
+            )
             flight.record(
                 "prefill",
                 bucket=bucket,
@@ -2593,6 +2865,14 @@ class DecodeEngine:
                 for _, r, reused in group
             )
             self.stats["prefill_flops"] += dispatch_flops
+            live = sum(
+                len(r.prompt_tokens) - reused for _, r, reused in group
+            )
+            self._waste("prefill_padding", size * bucket - live)
+            self._log_dispatch(
+                "prefill", tokens=live, rows=size, wall=0.0,
+                prefill_tokens=live,
+            )
             flight.record(
                 "prefill",
                 bucket=bucket,
@@ -2688,6 +2968,18 @@ class DecodeEngine:
             )
             for offset, bucket in windows
         )
+        # goodput: window positions beyond the new suffix — the shifted
+        # tail's re-taught overlap (identical KV, wasted FLOPs)
+        self._waste(
+            "prefill_padding",
+            sum(bucket for _, bucket in windows) - (total - reused),
+        )
+        for offset, bucket in windows:
+            taught = min(bucket, total - offset)
+            self._log_dispatch(
+                "prefill", tokens=taught, rows=1,
+                wall=0.0, prefill_tokens=taught,
+            )
 
     def _check_mirror_layout(self) -> None:
         """Engine features the follower replay protocol cannot speak
@@ -2874,9 +3166,10 @@ class DecodeEngine:
         """A chunk may be pre-dispatched off the in-flight carry only when
         no admission is waiting and every active slot has ≥2 chunks of
         budget and context left (so the blind chunk can't overrun)."""
-        if self._pending or self._prefill_inflight:
+        if self._pending or self._prefill_inflight or self._any_admitting():
             # harvested prefill slots should join the NEXT chunk, not wait
-            # out a blind pre-dispatched one
+            # out a blind pre-dispatched one (and mixed admitting slots
+            # need every next dispatch to be a fresh mixed step)
             return False
         # worst-case tokens a chunk can emit per slot: each spec step
         # may accept every draft plus the bonus token
@@ -2897,7 +3190,11 @@ class DecodeEngine:
         self, carry: Optional[Dict[str, Any]] = None
     ) -> Dict[str, Any]:
         """Dispatch one decode chunk. With ``carry`` (a previous chunk's
-        record), tokens/lengths chain on-device — no host round trip."""
+        record), tokens/lengths chain on-device — no host round trip.
+        In mixed mode, while any slot is admitting, the dispatch is a
+        single mixed step instead (:meth:`_dispatch_mixed`)."""
+        if carry is None and self._any_admitting():
+            return self._dispatch_mixed()
         faults.check("dispatch_error")
         # chaos: a dispatch that WEDGES instead of erroring (stuck_step
         # sleeps `dur` seconds here) — the watchdog/escalation test shape
@@ -3105,7 +3402,315 @@ class DecodeEngine:
             "prefix_hit_tokens": prefix_hit_tokens,
         }
 
+    def _log_dispatch(
+        self, kind: str, *, tokens: int, rows: int, wall: float,
+        steps: int = 0, prefill_tokens: int = 0,
+    ) -> None:
+        """Token-denominated dispatch log (every device dispatch, prefill
+        included): the interference-bound evidence the mixed A/B and the
+        regression test read — ``prefill_tokens`` is the prompt work a
+        single dispatch serializes in front of every running stream.
+        ``wall`` is the dispatch-to-harvest time for SYNCHRONOUS entries
+        (decode chunks, mixed steps) and 0.0 for the split path's
+        non-blocking prefill dispatches (their device time overlaps
+        decode and is unobservable at dispatch) — token counts, not
+        walls, are the cross-kind comparison this log exists for."""
+        if len(self.dispatch_log) < 65536:
+            self.dispatch_log.append({
+                "kind": kind,
+                "tokens": int(tokens),
+                "rows": int(rows),
+                "steps": int(steps),
+                "prefill_tokens": int(prefill_tokens),
+                "wall": wall,
+            })
+
+    def _dispatch_mixed(self) -> Dict[str, Any]:
+        """Dispatch ONE mixed step: every ready slot rides as a Tq=1
+        decode row, and up to ``prefill_chunk`` prompt tokens from
+        admitting slots ride alongside as prefill windows — one fused
+        token-ragged launch, one weight pass, one bounded dispatch. The
+        budget is shared FIFO by admission order, so an early prompt is
+        never starved by a later burst; a window that reaches its
+        prompt's end samples the request's first token in the same
+        dispatch (no separate harvest)."""
+        faults.check("dispatch_error")
+        faults.maybe_sleep("stuck_step")
+        started = time.perf_counter()
+        slots_n = self.max_slots
+        budget = self.prefill_chunk
+        admitting = sorted(
+            (
+                i for i, s in enumerate(self.slots)
+                if s.prefill_pos is not None and s.request is not None
+            ),
+            key=lambda i: self.slots[i].prefill_seq,
+        )
+        plan: Dict[int, Tuple[int, int]] = {}
+        max_n = 1
+        for i in admitting:
+            if budget <= 0:
+                break
+            slot = self.slots[i]
+            remaining = len(slot.request.prompt_tokens) - slot.prefill_pos
+            n = min(remaining, budget)
+            if n <= 0:
+                continue
+            plan[i] = (slot.prefill_pos, n)
+            budget -= n
+            max_n = max(max_n, n)
+        width = next(w for w in self._mixed_widths if w >= max_n)
+
+        tokens = np.zeros((slots_n, width), dtype=np.int32)
+        offsets = np.zeros((slots_n,), dtype=np.int32)
+        num_tokens = np.zeros((slots_n,), dtype=np.int32)
+        write_mask = np.zeros((slots_n,), dtype=bool)
+        decode_mask = np.zeros((slots_n,), dtype=bool)
+        completes = np.zeros((slots_n,), dtype=bool)
+        temperature = np.zeros((slots_n,), dtype=np.float32)
+        top_k = np.zeros((slots_n,), dtype=np.int32)
+        top_p = np.zeros((slots_n,), dtype=np.float32)
+        seeds = np.zeros((slots_n,), dtype=np.uint32)
+        requests: List[Optional[GenerationRequest]] = [None] * slots_n
+        epochs = [0] * slots_n
+        kv_tokens = 0          # decode rows' (block-padded) context reads
+        prefill_kv_tokens = 0  # windows' prefix+window reads
+        prefill_tokens = 0
+        padding = 0
+        for i, slot in enumerate(self.slots):
+            epochs[i] = slot.epoch
+            if i in plan:
+                pos, n = plan[i]
+                prompt = slot.request.prompt_tokens
+                tokens[i, :n] = prompt[pos:pos + n]
+                offsets[i] = pos
+                num_tokens[i] = n
+                write_mask[i] = True
+                completes[i] = pos + n == len(prompt)
+                requests[i] = slot.request
+                prefill_tokens += n
+                padding += width - n
+                prefill_kv_tokens += self.cost_model.kv_read_tokens(pos + n)
+            elif slot.ready:
+                tokens[i, 0] = slot.history[-1]
+                offsets[i] = slot.length
+                num_tokens[i] = 1
+                write_mask[i] = True
+                decode_mask[i] = True
+                requests[i] = slot.request
+                kv_tokens += self.cost_model.kv_read_tokens(slot.length + 1)
+            else:
+                continue
+            request = requests[i]
+            temperature[i] = request.sampling.temperature
+            top_k[i] = request.sampling.top_k
+            top_p[i] = request.sampling.top_p
+            seeds[i] = self._request_seed(request)
+        # advance the taught watermarks NOW: mixed steps are processed
+        # before the next one is built, and the window content is final
+        for i, (pos, n) in plan.items():
+            self.slots[i].prefill_pos = pos + n
+        presence, frequency = self._penalty_arrays(self.slots)
+        bias_ids, bias_vals = self._bias_rows(requests)
+        # goodput: ghost positions the padded [S, W] grid computes for a
+        # short window — the mixed analogue of bucket padding, capped at
+        # width−1 per admitting row per step (vs up to ~bucket/2 − 1 per
+        # PROMPT on the split path)
+        self._waste("prefill_padding", padding)
+        host_args = [
+            tokens, offsets, num_tokens, write_mask, decode_mask,
+            completes, self._block_tables,
+        ]
+        sampling_args = [
+            temperature, top_k, top_p, presence, frequency, seeds,
+            bias_ids, bias_vals,
+        ]
+        if self.mirror is not None:
+            self._check_mirror_layout()
+            # mixed records carry per-row token counts (offsets /
+            # num_tokens / the mask trio) in dispatch-arg position —
+            # small int32/bool host metadata, like the table rows
+            self.mirror.publish(
+                "mixed", {"width": width}, [*host_args, *sampling_args]
+            )
+        run = self._get_mixed(width)
+        self.cache, self._counts, sampled, lps, tops = run(
+            self.params, self.cache, *host_args, self._counts,
+            *sampling_args,
+        )
+        return {
+            "mixed": True,
+            "width": width,
+            "plan": plan,
+            "sampled": sampled,
+            "lps": lps,
+            "out_tops": tops,
+            "decode_mask": decode_mask,
+            "completes": completes,
+            "epochs": epochs,
+            "steps": 1,
+            "started": started,
+            "kv_tokens": kv_tokens,
+            "prefill_kv_tokens": prefill_kv_tokens,
+            "prefill_tokens": prefill_tokens,
+            "n_decode": int(decode_mask.sum()),
+            "queue_depth": len(self._pending),
+        }
+
+    def _process_mixed(self, inflight: Dict[str, Any]) -> None:
+        sampled = np.asarray(inflight["sampled"])
+        lps = np.asarray(inflight["lps"])
+        tops = inflight.get("out_tops")
+        if tops is not None:
+            tops = (np.asarray(tops[0]), np.asarray(tops[1]))
+        ended = time.perf_counter()
+        wall = ended - inflight["started"]
+        decode_mask = inflight["decode_mask"]
+        completes = inflight["completes"]
+        plan = inflight["plan"]
+        n_decode = inflight["n_decode"]
+        prefill_toks = inflight["prefill_tokens"]
+        # the mixed step IS a decode step for its riders; its whole wall
+        # is decode time — there is no separate prefill dispatch or
+        # harvest stall to bill, which is the point of the fusion
+        self.stats["decode_steps"] += 1
+        self.stats["decode_chunks"] += 1
+        self.stats["decode_token_steps"] += 1.0
+        self.stats["active_slot_steps"] += n_decode
+        self.stats["decode_time"] += max(
+            0.0, ended - max(inflight["started"], self._decode_busy_until)
+        )
+        self._decode_busy_until = max(self._decode_busy_until, ended)
+        if len(self.chunk_log) < 65536:
+            self.chunk_log.append((1, n_decode, wall))
+        self._log_dispatch(
+            "mixed", tokens=n_decode + prefill_toks,
+            rows=n_decode + len(plan), wall=wall, steps=1,
+            prefill_tokens=prefill_toks,
+        )
+        self._step_ewma = (
+            wall if self._step_ewma is None
+            else 0.8 * self._step_ewma + 0.2 * wall
+        )
+        DECODE_STEP_SECONDS.observe(wall)
+        windows = list(plan.values())
+        chunk_flops = self.cost_model.mixed_step_flops(
+            n_decode, inflight["kv_tokens"], windows
+        )
+        chunk_bytes = self.cost_model.mixed_step_bytes(
+            inflight["kv_tokens"] + inflight["prefill_kv_tokens"],
+            n_decode + prefill_toks,
+        )
+        self.stats["decode_flops"] += chunk_flops
+        self.stats["decode_bytes"] += chunk_bytes
+        mfu = accounting.CostModel.mfu(chunk_flops, wall, self.peaks)
+        mbu = accounting.CostModel.mbu(chunk_bytes, wall, self.peaks)
+        if n_decode or plan:
+            MFU_PER_CHUNK.observe(mfu)
+            MBU_PER_CHUNK.observe(mbu)
+        if self.tracer.enabled or flight.RECORDER.enabled:
+            trace_ids = ",".join(
+                slot.request.trace_id
+                for i, slot in enumerate(self.slots)
+                if decode_mask[i] and slot.active and slot.request.trace_id
+            )
+            self.tracer.event(
+                "engine.decode_chunk",
+                wall,
+                start_wall=time.time() - wall,
+                trace_ids=trace_ids,
+                steps=1,
+                active=n_decode,
+                step_ms=round(wall * 1e3, 3),
+                mfu=round(mfu, 6),
+                mbu=round(mbu, 6),
+            )
+            flight.record(
+                "decode_chunk",
+                steps=1,
+                active=n_decode,
+                slots=self.max_slots,
+                step_ms=round(wall * 1e3, 3),
+                queue_depth=inflight["queue_depth"],
+                kv_frac=round(
+                    self.kv_manager.blocks_in_use / float(self.num_blocks),
+                    4,
+                ),
+                tokens=self.stats["tokens_generated"],
+                mfu=round(mfu, 6),
+                mbu=round(mbu, 6),
+                tokens_useful=self.stats["tokens_useful"],
+                tokens_wasted=sum(self.stats["tokens_wasted"].values()),
+                kv_blocks_in_use=self.kv_manager.blocks_in_use,
+                kv_blocks_total=self.num_blocks,
+                prefix_hit_tokens=self.kv_manager.stats["hit_tokens"],
+                # mixed-dispatch series: how much prompt work rode this
+                # step (ab_analyze reads these next to step_ms — the
+                # stall-free-batching evidence)
+                mixed=1,
+                width=inflight["width"],
+                prefill_rows=len(plan),
+                prefill_tokens=prefill_toks,
+            )
+        emit_started = time.perf_counter()
+        for i, slot in enumerate(self.slots):
+            if slot.epoch != inflight["epochs"][i] or not slot.active:
+                continue
+            top = (
+                (tops[0][i].tolist(), tops[1][i].tolist())
+                if tops is not None else None
+            )
+            if decode_mask[i]:
+                slot.length += 1
+                self._emit_token(i, int(sampled[i]), float(lps[i]), top=top)
+            elif i in plan and completes[i]:
+                request = slot.request
+                slot.prefilling = False
+                slot.prefill_pos = None
+                request._prefill_time = (  # type: ignore[attr-defined]
+                    ended - slot.prefill_t0
+                )
+                self.stats[
+                    "warm_prefill_calls" if slot.prefill_reused
+                    else "prefill_calls"
+                ] += 1
+                if self.tracer.enabled:
+                    submit_ts = getattr(
+                        request, "_submit_ts", slot.prefill_t0
+                    )
+                    self.tracer.event(
+                        "engine.prefill",
+                        max(0.0, ended - slot.prefill_t0),
+                        trace_id=request.trace_id or "",
+                        start_wall=time.time() - (ended - slot.prefill_t0),
+                        slot=i,
+                        prompt_tokens=len(request.prompt_tokens),
+                        reused_tokens=slot.prefill_reused,
+                        prefill_tokens=(
+                            len(request.prompt_tokens)
+                            - slot.prefill_reused
+                        ),
+                        ttft_ms=round((ended - submit_ts) * 1e3, 3),
+                    )
+                if request.replay_tokens:
+                    # resurrected session: fast-forward through the
+                    # accepted history instead of emitting the window's
+                    # own sample (see _resume_replay)
+                    self._resume_replay(
+                        i, request, reused=slot.prefill_reused
+                    )
+                else:
+                    self._emit_token(
+                        i, int(sampled[i]), float(lps[i]), top=top
+                    )
+        self.stats["emit_time"] += time.perf_counter() - emit_started
+        # chaos: deterministic engine-thread death AFTER this step's
+        # tokens reached their callers (same point as _process_decode)
+        faults.check("engine_thread_crash")
+
     def _process_decode(self, inflight: Dict[str, Any]) -> None:
+        if inflight.get("mixed"):
+            return self._process_mixed(inflight)
         steps = inflight["steps"]
         active = inflight["active"]
         spec = self.spec
@@ -3156,6 +3761,13 @@ class DecodeEngine:
         self.stats["active_slot_steps"] += n_active * steps
         if len(self.chunk_log) < 65536:
             self.chunk_log.append((steps, n_active, wall))
+        self._log_dispatch(
+            "decode",
+            tokens=(
+                emitted_total if spec else steps * n_active
+            ),
+            rows=n_active, wall=wall, steps=steps,
+        )
         step_s = wall / max(steps, 1)
         # EWMA step time: the Retry-After estimator for shed requests
         # and degraded-mode 503s (coarse but self-calibrating)
@@ -3582,6 +4194,7 @@ class DecodeEngine:
             # thread finds the slot inactive and skips emission
             slot.request = None
             slot.prefilling = False
+            slot.prefill_pos = None
             slot.epoch += 1
             original = (
                 request.prompt_len if request.prompt_len is not None

@@ -822,17 +822,32 @@ def _constrain_kv_shard(pool, mesh, *, scale: bool = False):
     )
 
 
+def _mixed_block_q(width: int) -> int:
+    """q-tile granularity for the token-ragged mixed dispatch: spans
+    are ``width`` tokens per row, so the tile must divide the span —
+    power-of-two widths take 8-row tiles, anything smaller (or odd)
+    collapses to one tile per row."""
+    return 8 if width % 8 == 0 else width
+
+
 def _paged_attn(config, q, k_pool, v_pool, tables, starts, totals, *,
-                window, kernel, mesh=None):
-    """Paged attention dispatch, ONE seam for all three ragged cases:
+                window, kernel, mesh=None, q_lens=None):
+    """Paged attention dispatch, ONE seam for all the ragged cases:
     decode (q [S, H, D], starts = lengths-1), prefill-at-offset and cold
-    paged prefill (q [B, T, H, D]). ``kernel == "fused"`` (and shapes /
-    backend permitting — see :func:`_use_fused_paged`) runs the single
-    fused Pallas launch that streams table-addressed pool blocks; under
-    tp>1 that launch runs per kv-head shard through the shard_map twin
-    (a bare Mosaic call has no SPMD partitioning rule). The
-    gather/scatter composition in ``ops/attention.py`` stays as the
-    reference oracle."""
+    paged prefill (q [B, T, H, D]), and — with ``q_lens`` — the MIXED
+    prefill+decode dispatch, where every row carries its own new-token
+    count (decode rows 1, admitting rows a prefill window, idle rows 0)
+    and the fused path runs the token-ragged q formulation
+    (:func:`langstream_tpu.ops.paged_attention.ragged_q_paged_attention`
+    — flattened q tile + cu_q_lens-style row offsets, dead q tiles
+    skipped). ``kernel == "fused"`` (and shapes / backend permitting —
+    see :func:`_use_fused_paged`) runs the single fused Pallas launch
+    that streams table-addressed pool blocks; under tp>1 that launch
+    runs per kv-head shard through the shard_map twin (a bare Mosaic
+    call has no SPMD partitioning rule). The gather/scatter composition
+    in ``ops/attention.py`` stays as the reference oracle (it already
+    speaks per-row starts/totals, so mixed rows need no new reference
+    path — positions past a row's count compute discarded garbage)."""
     family = dict(
         softcap=config.attn_logit_softcap, window=window,
         scale=_attn_scale(config),
@@ -846,9 +861,32 @@ def _paged_attn(config, q, k_pool, v_pool, tables, starts, totals, *,
         from langstream_tpu.ops.paged_attention import (
             ragged_paged_attention,
             ragged_paged_attention_sharded,
+            ragged_q_paged_attention,
+            ragged_q_paged_attention_sharded,
         )
 
         tp_sharded = mesh is not None and dict(mesh.shape).get("tp", 1) > 1
+        if q_lens is not None and not decode:
+            # token-ragged q: rows at uniform stride in the flattened
+            # tile (q_offsets = b·W — the cu_q_lens special case the
+            # engine's static [S, W] dispatch shape produces)
+            batch, width = q.shape[:2]
+            q_flat = q.reshape(batch * width, heads, dim)
+            qoffs = jnp.arange(batch, dtype=jnp.int32) * width
+            block_q = _mixed_block_q(width)
+            if tp_sharded:
+                out = ragged_q_paged_attention_sharded(
+                    q_flat, k_pool, v_pool, tables, starts, totals,
+                    qoffs, mesh, max_q_len=width, block_q=block_q,
+                    interpret=config.flash_interpret, **family,
+                )
+            else:
+                out = ragged_q_paged_attention(
+                    q_flat, k_pool, v_pool, tables, starts, totals,
+                    qoffs, max_q_len=width, block_q=block_q,
+                    interpret=config.flash_interpret, **family,
+                )
+            return out.reshape(batch, width, heads, dim)
         q_in = q[:, None] if decode else q
         if tp_sharded:
             out = ragged_paged_attention_sharded(
@@ -871,9 +909,11 @@ def _paged_attn(config, q, k_pool, v_pool, tables, starts, totals, *,
 
 
 def _paged_attn_quant(config, q, k_pool, k_scale, v_pool, v_scale, tables,
-                      starts, totals, *, window, kernel, mesh=None):
+                      starts, totals, *, window, kernel, mesh=None,
+                      q_lens=None):
     """Int8-pool twin of :func:`_paged_attn` (scales stream through the
-    same table-addressed index maps)."""
+    same table-addressed index maps; ``q_lens`` selects the token-ragged
+    mixed formulation exactly like the bf16 seam)."""
     family = dict(
         softcap=config.attn_logit_softcap, window=window,
         scale=_attn_scale(config),
@@ -887,9 +927,31 @@ def _paged_attn_quant(config, q, k_pool, k_scale, v_pool, v_scale, tables,
         from langstream_tpu.ops.paged_attention import (
             ragged_paged_attention_quant,
             ragged_paged_attention_quant_sharded,
+            ragged_q_paged_attention_quant,
+            ragged_q_paged_attention_sharded,
         )
 
         tp_sharded = mesh is not None and dict(mesh.shape).get("tp", 1) > 1
+        if q_lens is not None and not decode:
+            batch, width = q.shape[:2]
+            q_flat = q.reshape(batch * width, heads, dim)
+            qoffs = jnp.arange(batch, dtype=jnp.int32) * width
+            block_q = _mixed_block_q(width)
+            if tp_sharded:
+                out = ragged_q_paged_attention_sharded(
+                    q_flat, k_pool, v_pool, tables, starts, totals,
+                    qoffs, mesh, max_q_len=width, block_q=block_q,
+                    k_scale=k_scale, v_scale=v_scale,
+                    interpret=config.flash_interpret, **family,
+                )
+            else:
+                out = ragged_q_paged_attention_quant(
+                    q_flat, k_pool, k_scale, v_pool, v_scale,
+                    tables, starts, totals, qoffs,
+                    max_q_len=width, block_q=block_q,
+                    interpret=config.flash_interpret, **family,
+                )
+            return out.reshape(batch, width, heads, dim)
         q_in = q[:, None] if decode else q
         if tp_sharded:
             out = ragged_paged_attention_quant_sharded(
@@ -1744,6 +1806,127 @@ def paged_verify_step(
         out["k"], out["v"] = kv_caches
     x = _norm(config, x, params["final_norm"])
     return out, _logits(config, params, x)  # [S, B, V]
+
+
+def paged_mixed_step(
+    config: LlamaConfig,
+    params: Dict[str, jnp.ndarray],
+    cache: Dict[str, jnp.ndarray],   # paged pool
+    tokens: jnp.ndarray,             # [S, W] int32 per-row new tokens
+    offsets: jnp.ndarray,            # [S] existing valid rows per slot
+    num_tokens: jnp.ndarray,         # [S] live new tokens (0 = idle row)
+    block_tables: jnp.ndarray,       # [S, M]
+    freqs: jnp.ndarray,
+    write_mask: Optional[jnp.ndarray] = None,  # [S] bool
+    mesh=None,
+    kernel: str = "fused",
+) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
+    """Unified mixed prefill+decode dispatch — ``decode_step`` and
+    ``prefill_at_offset`` as ONE seam over per-row token counts
+    (Sarathi-style chunked-prefill batching): a decode row carries its
+    pending token (``offsets = length, num_tokens = 1``), an admitting
+    row carries a ``prefill_chunk``-token window of its prompt
+    (``offsets = taught-so-far``), an idle row carries nothing
+    (``num_tokens = 0``). KV scatters through the block tables with
+    per-position masking (padding/idle rows route to the null block —
+    the :func:`paged_verify_step` machinery, which already proved this
+    formulation token-exact against the split paths), attention runs
+    the token-ragged fused launch (or the gather reference) through
+    :func:`_paged_attn`, and ONE weight pass serves every row — the
+    whole point: admitting a prompt costs decode riders a bounded
+    mixed step, never a monolithic bucket-sized prefill dispatch.
+
+    Returns (cache, logits [S, V]) of each row's LAST live token — the
+    only position the engine samples (decode rows sample their next
+    token; an admitting row's sample is meaningful only on the window
+    that completes its prompt; idle/mid-prefill rows are discarded)."""
+    slots, width = tokens.shape
+    hd = config.dims_per_head
+    positions = offsets[:, None] + jnp.arange(width)[None, :]  # [S, W]
+    mask = jnp.arange(width)[None, :] < num_tokens[:, None]    # [S, W]
+    totals = offsets + num_tokens                              # [S]
+    if write_mask is None:
+        write_mask = jnp.ones((slots,), dtype=bool)
+    wmask = mask & write_mask[:, None]
+    x = _embed(config, params, tokens)                         # [S, W, H]
+
+    layer_inputs = _stack_layer_params(params, config)
+    windows = layer_windows(config)
+    quantized = "k_scale" in cache
+
+    def write(pool, new, scale=False):
+        return _constrain_kv_shard(
+            paged_write_rows(pool, new, block_tables, offsets, wmask),
+            mesh, scale=scale,
+        )
+
+    def layer_fn(carry, inputs):
+        x = carry
+        if quantized:
+            layer, kp, vp, ks, vs, win = inputs
+        else:
+            layer, kp, vp, win = inputs
+        (attn_norm, wq, wk, wv, biases, wo, post_attn, mlp_norm, post_mlp,
+         mlp_weights) = layer
+        normed = _norm(config, x, attn_norm)
+        q, k, v = _project_qkv(normed, wq, wk, wv, biases)
+        q = q.reshape(slots, width, config.num_heads, hd)
+        k = k.reshape(slots, width, config.num_kv_heads, hd)
+        v = v.reshape(slots, width, config.num_kv_heads, hd)
+        q = apply_rope(q, freqs, positions)
+        k = apply_rope(k, freqs, positions)
+        if quantized:
+            k_q, k_s = quantize_kv(k)
+            v_q, v_s = quantize_kv(v)
+            kp = write(kp, k_q)
+            ks = write(ks, k_s, scale=True)
+            vp = write(vp, v_q)
+            vs = write(vs, v_s, scale=True)
+            attn = _paged_attn_quant(
+                config, q, kp, ks, vp, vs, block_tables, offsets, totals,
+                window=win, kernel=kernel, mesh=mesh, q_lens=num_tokens,
+            )
+            kv_out = (kp, vp, ks, vs)
+        else:
+            kp = write(kp, k)
+            vp = write(vp, v)
+            attn = _paged_attn(
+                config, q, kp, vp, block_tables, offsets, totals,
+                window=win, kernel=kernel, mesh=mesh, q_lens=num_tokens,
+            )
+            kv_out = (kp, vp)
+        attn = qeinsum(
+            "sbd,dh->sbh",
+            attn.reshape(slots, width, config.num_heads * hd), wo,
+        )
+        if post_attn is not None:
+            attn = _norm(config, attn, post_attn)
+        x = x + attn
+        normed = _norm(config, x, mlp_norm)
+        delta, _ = _mlp_block(config, normed, mlp_weights, valid=mask,
+                              dropless=True)
+        if post_mlp is not None:
+            delta = _norm(config, delta, post_mlp)
+        x = x + delta
+        return x, kv_out
+
+    if quantized:
+        xs = (layer_inputs, cache["k"], cache["v"],
+              cache["k_scale"], cache["v_scale"], windows)
+    else:
+        xs = (layer_inputs, cache["k"], cache["v"], windows)
+    x, kv_caches = jax.lax.scan(layer_fn, x, xs, unroll=_decode_unroll())
+    out = dict(cache)
+    if quantized:
+        out["k"], out["v"], out["k_scale"], out["v_scale"] = kv_caches
+    else:
+        out["k"], out["v"] = kv_caches
+    x = _norm(config, x, params["final_norm"])
+    last = x[
+        jnp.arange(slots),
+        jnp.clip(num_tokens - 1, 0, width - 1).astype(jnp.int32),
+    ]  # [S, H] — each row's last live token
+    return out, _logits(config, params, last)  # [S, V]
 
 
 def apply_layers(

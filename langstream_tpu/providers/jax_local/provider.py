@@ -185,6 +185,13 @@ class JaxCompletionsService(CompletionsService):
             ).lower(),
             spec_k=int(engine_config.get("spec-k") or 4),
             spec_ngram=int(engine_config.get("spec-ngram") or 2),
+            # mixed prefill+decode dispatch (paged only): chunked
+            # prefill windows fused into the decode step — the
+            # tail-TPOT A/B knob, threaded exactly like paged-kernel
+            prefill_mode=str(
+                engine_config.get("prefill-mode") or "split"
+            ).lower(),
+            prefill_chunk=int(engine_config.get("prefill-chunk") or 64),
             pipeline_decode=str(
                 engine_config.get("pipeline-decode", "")
             ).lower() in ("1", "true", "yes"),

@@ -265,6 +265,43 @@ class CostModel:
         )
 
     # ------------------------------------------------------------------ #
+    # mixed prefill+decode dispatch (prefill_mode: mixed)
+    # ------------------------------------------------------------------ #
+    def mixed_step_flops(
+        self,
+        decode_rows: int,
+        decode_kv_tokens: int,
+        prefill_windows,  # [(offset, new_tokens), ...]
+    ) -> float:
+        """FLOPs for one mixed step: the decode riders' single-step
+        chunk plus each admitting row's prefill window at its offset.
+        Only LIVE tokens are billed (like every other accessor) — the
+        padded [S, W] grid's ghost positions burn real device FLOPs but
+        modeled-useful-work-over-wall is what MFU means, so padding
+        shows up as lower MFU (and in the ``prefill_padding`` goodput
+        reason), never as inflated utilization."""
+        flops = self.decode_chunk_flops(1, decode_rows, decode_kv_tokens)
+        for offset, new_tokens in prefill_windows:
+            flops += self.prefill_flops(new_tokens, offset=offset)
+        return flops
+
+    def mixed_step_bytes(
+        self, kv_tokens: float, rows_written: int
+    ) -> float:
+        """HBM bytes for one mixed step: ONE weight pass serves every
+        row — decode riders AND prefill windows share it, which is the
+        fusion's whole point (the split path streams the weights once
+        for the prefill dispatch and again for the decode step) — plus
+        the kernel-aware KV reads (decode contexts + window prefixes,
+        block-padded, summed into ``kv_tokens``) and the new rows
+        written (decode tokens + prefill window tokens)."""
+        return (
+            float(self.weight_bytes)
+            + self.kv_read_bytes(kv_tokens)
+            + float(self.kv_row_bytes) * rows_written
+        )
+
+    # ------------------------------------------------------------------ #
     # utilization
     # ------------------------------------------------------------------ #
     @staticmethod

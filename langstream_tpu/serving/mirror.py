@@ -31,6 +31,12 @@ at >8-chip scale):
   mutations to its kv-head shard without running the block
   allocator/prefix-cache/LRU bookkeeping itself — those are host-0
   decisions already baked into the tables it receives.
+- ``prefill_mode: mixed`` replays as ``mixed`` records: one per fused
+  prefill+decode step, carrying the per-row token counts (offsets /
+  num_tokens / write/decode/completes masks) plus the tables and
+  sampling arrays — the follower enters the same ``_get_mixed(width)``
+  jit with identical args, so the chunked-prefill schedule host 0
+  chose is baked into the stream like every other timing decision.
 
 Transport is a length-prefixed JSON-header + raw-array-bytes frame
 stream over TCP (deliberately NOT pickle — nothing executable crosses
@@ -350,6 +356,17 @@ class FollowerExecutor:
                 # on this process's kv-head shard
                 run = engine._get_block_copy()
                 (engine.cache,) = run(engine.params, engine.cache, *arrays)
+            elif kind == "mixed":
+                # mixed prefill+decode step (prefill_mode: mixed): the
+                # record carries per-row token counts + the mask trio +
+                # the full block tables in dispatch-arg position; the
+                # sampled tokens are host-0 outputs and are dropped here
+                # like every other dispatch's
+                run = engine._get_mixed(meta["width"])
+                engine.cache, engine._counts, _, _, _ = run(
+                    engine.params, engine.cache, *arrays[:7],
+                    engine._counts, *arrays[7:],
+                )
             elif kind == "decode":
                 tokens, lengths, active = arrays[:3]
                 tables = arrays[3] if extra else None

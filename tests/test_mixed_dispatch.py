@@ -1,0 +1,740 @@
+"""Unified mixed prefill+decode dispatch (ISSUE 12).
+
+Three layers, mirroring the tiers every paged kernel feature shipped
+with (tests/test_paged_kernel.py / test_multichip_paged.py):
+
+- op level: the token-ragged q formulation
+  (``ops/paged_attention.py::ragged_q_paged_attention`` — flattened q
+  tile, cu_q_lens-style row offsets, per-row q lens from the existing
+  starts/lengths scalar-prefetch) against the gather/scatter reference
+  composition across GQA × int8 × window × softcap, including the
+  all-decode and all-prefill degenerate batches, and BITWISE against
+  the fixed-Tq fused kernel (same recurrence, different grid).
+- engine level: a ``prefill_mode: mixed`` engine produces tokens
+  identical to the split-path oracle — greedy AND seeded (penalties,
+  top-k/p, per-request seeds) across bf16/int8 pools, mid-decode
+  admission of a long cold prompt, a ≥256-token prefix-cache hit,
+  mid-stream stop tokens, spec-decode on, and a supervisor
+  crash→rebuild→resume whose replay prefill rides the mixed windows.
+- scheduling level: the interference bound — with a max-bucket cold
+  prompt admitted mid-decode, NO dispatch in the mixed engine's
+  dispatch log carries more than ``prefill_chunk`` prefill tokens,
+  while the split path's monolithic prefill logs the whole prompt in
+  one dispatch; the prefill-inflight/harvest machinery is retired on
+  the mixed path; padding lands in the ``prefill_padding`` goodput
+  reason; the mixed dispatch replays over the mirror; and under tp=2
+  the mixed variant's compiled HLO contains no full-pool all-gather.
+"""
+
+import asyncio
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from langstream_tpu.ops.attention import (
+    paged_chunk_attention,
+    paged_chunk_attention_quant,
+    paged_decode_attention,
+    quantize_kv,
+)
+from langstream_tpu.ops.paged_attention import (
+    ragged_paged_attention,
+    ragged_q_paged_attention,
+    ragged_q_paged_attention_quant,
+)
+from langstream_tpu.providers.jax_local.engine import (
+    DecodeEngine,
+    SamplingParams,
+    engines_snapshot,
+)
+from langstream_tpu.providers.jax_local.model import (
+    LlamaConfig,
+    init_params,
+)
+
+needs_two_devices = pytest.mark.skipif(
+    len(jax.devices()) < 2,
+    reason="needs >=2 devices (tests/conftest.py forces 8 virtual "
+    "CPU devices)",
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    """Every test starts and ends disarmed with zeroed arrival counters
+    (the registry is process-global — same shape as
+    tests/test_recovery.py)."""
+    from langstream_tpu.runtime import faults
+
+    faults.reset()
+    yield
+    faults.reset()
+
+
+BLOCK = 8
+
+
+# ---------------------------------------------------------------------- #
+# op level: token-ragged q kernel vs the reference composition
+# ---------------------------------------------------------------------- #
+def _mixed_case(seed=0, heads=4, kv_heads=2, dim=16, width=8):
+    """A mixed batch over a shuffled block pool: one decode row, one
+    warm prefill window, one cold prefill window, one idle row."""
+    rng = np.random.RandomState(seed)
+    batch, blocks_per_row = 4, 6
+    total = batch * blocks_per_row
+    order = rng.permutation(total) + 1  # block 0 stays the null block
+    tables = jnp.asarray(
+        order.reshape(batch, blocks_per_row).astype(np.int32)
+    )
+    k_pool = jnp.asarray(
+        rng.randn(total + 1, BLOCK, kv_heads, dim).astype(np.float32)
+    )
+    v_pool = jnp.asarray(
+        rng.randn(total + 1, BLOCK, kv_heads, dim).astype(np.float32)
+    )
+    # rows: decode @ctx 21 | warm window of 5 @offset 11 | cold window
+    # of `width` @0 | idle
+    starts = jnp.asarray([20, 11, 0, 0], jnp.int32)
+    totals = jnp.asarray([21, 16, width, 0], jnp.int32)
+    q = jnp.asarray(
+        rng.randn(batch, width, heads, dim).astype(np.float32)
+    )
+    return q, k_pool, v_pool, tables, starts, totals
+
+
+def _flat(q):
+    batch, width = q.shape[:2]
+    qoffs = jnp.arange(batch, dtype=jnp.int32) * width
+    return q.reshape(batch * width, *q.shape[2:]), qoffs, width
+
+
+@pytest.mark.parametrize("heads,kv_heads", [(4, 4), (4, 2), (8, 2)])
+@pytest.mark.parametrize("softcap", [None, 30.0])
+def test_ragged_q_matches_reference(heads, kv_heads, softcap):
+    q, k_pool, v_pool, tables, starts, totals = _mixed_case(
+        heads=heads, kv_heads=kv_heads
+    )
+    q_flat, qoffs, width = _flat(q)
+    out = ragged_q_paged_attention(
+        q_flat, k_pool, v_pool, tables, starts, totals, qoffs,
+        max_q_len=width, block_q=4, softcap=softcap, interpret=True,
+    ).reshape(q.shape)
+    ref = paged_chunk_attention(
+        q, k_pool, v_pool, tables, starts, totals, softcap=softcap
+    )
+    for row in range(q.shape[0]):
+        live = int(totals[row] - starts[row])
+        np.testing.assert_allclose(
+            np.asarray(out[row, :live]), np.asarray(ref[row, :live]),
+            rtol=2e-6, atol=2e-6,
+        )
+
+
+def test_ragged_q_window_matches_reference():
+    q, k_pool, v_pool, tables, starts, totals = _mixed_case(seed=3)
+    q_flat, qoffs, width = _flat(q)
+    window = jnp.asarray(12, jnp.int32)
+    out = ragged_q_paged_attention(
+        q_flat, k_pool, v_pool, tables, starts, totals, qoffs,
+        max_q_len=width, block_q=4, window=window, interpret=True,
+    ).reshape(q.shape)
+    ref = paged_chunk_attention(
+        q, k_pool, v_pool, tables, starts, totals, window=window
+    )
+    for row in range(q.shape[0]):
+        live = int(totals[row] - starts[row])
+        np.testing.assert_allclose(
+            np.asarray(out[row, :live]), np.asarray(ref[row, :live]),
+            rtol=2e-6, atol=2e-6,
+        )
+
+
+def test_ragged_q_all_decode_degenerate():
+    """Every row Tq=1 (a pure-decode mixed step) matches the decode
+    oracle — the degenerate batch the mixed engine dispatches whenever
+    admissions drain mid-plan."""
+    q, k_pool, v_pool, tables, _, _ = _mixed_case(seed=5)
+    lengths = jnp.asarray([21, 16, 9, 30], jnp.int32)
+    starts = lengths - 1
+    q_flat, qoffs, width = _flat(q)
+    out = ragged_q_paged_attention(
+        q_flat, k_pool, v_pool, tables, starts, lengths, qoffs,
+        max_q_len=width, block_q=4, interpret=True,
+    ).reshape(q.shape)
+    ref = paged_decode_attention(q[:, 0], k_pool, v_pool, tables, lengths)
+    np.testing.assert_allclose(
+        np.asarray(out[:, 0]), np.asarray(ref), rtol=2e-6, atol=2e-6
+    )
+
+
+def test_ragged_q_all_prefill_degenerate():
+    """Every row a full-width cold window (offset 0) — the all-prefill
+    degenerate batch (burst admission with no decoding riders)."""
+    q, k_pool, v_pool, tables, _, _ = _mixed_case(seed=7)
+    width = q.shape[1]
+    starts = jnp.zeros((q.shape[0],), jnp.int32)
+    totals = jnp.full((q.shape[0],), width, jnp.int32)
+    q_flat, qoffs, _ = _flat(q)
+    out = ragged_q_paged_attention(
+        q_flat, k_pool, v_pool, tables, starts, totals, qoffs,
+        max_q_len=width, block_q=4, interpret=True,
+    ).reshape(q.shape)
+    ref = paged_chunk_attention(q, k_pool, v_pool, tables, starts, totals)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-6, atol=2e-6
+    )
+
+
+def test_ragged_q_quant_matches_reference():
+    q, k_pool, v_pool, tables, starts, totals = _mixed_case(seed=9)
+    k_q, k_s = quantize_kv(k_pool)
+    v_q, v_s = quantize_kv(v_pool)
+    q_flat, qoffs, width = _flat(q)
+    out = ragged_q_paged_attention_quant(
+        q_flat, k_q, k_s, v_q, v_s, tables, starts, totals, qoffs,
+        max_q_len=width, block_q=4, softcap=30.0, interpret=True,
+    ).reshape(q.shape)
+    ref = paged_chunk_attention_quant(
+        q, k_q, k_s, v_q, v_s, tables, starts, totals, softcap=30.0
+    )
+    for row in range(q.shape[0]):
+        live = int(totals[row] - starts[row])
+        np.testing.assert_allclose(
+            np.asarray(out[row, :live]), np.asarray(ref[row, :live]),
+            rtol=2e-6, atol=2e-6,
+        )
+
+
+def test_ragged_q_bitwise_vs_fixed_tq_kernel():
+    """The ragged-q grid is the SAME online-softmax recurrence as the
+    fixed-Tq fused kernel, tiled differently — per-row outputs must be
+    bitwise identical, which is what makes mixed-vs-split engine
+    parity a schedule property rather than a numerical accident."""
+    q, k_pool, v_pool, tables, starts, totals = _mixed_case(seed=11)
+    q_flat, qoffs, width = _flat(q)
+    out = ragged_q_paged_attention(
+        q_flat, k_pool, v_pool, tables, starts, totals, qoffs,
+        max_q_len=width, block_q=4, interpret=True,
+    ).reshape(q.shape)
+    # decode row vs the split decode path's Tq=1 launch
+    dec = ragged_paged_attention(
+        q[0:1, :1], k_pool, v_pool, tables[0:1], starts[0:1],
+        totals[0:1], interpret=True,
+    )
+    assert (np.asarray(out[0, 0]) == np.asarray(dec[0, 0])).all()
+    # warm window vs the split warm-prefill path's Tq=W launch
+    warm = ragged_paged_attention(
+        q[1:2], k_pool, v_pool, tables[1:2], starts[1:2], totals[1:2],
+        interpret=True,
+    )
+    assert (np.asarray(out[1, :5]) == np.asarray(warm[0, :5])).all()
+
+
+def test_ragged_q_rejects_unaligned_spans():
+    q, k_pool, v_pool, tables, starts, totals = _mixed_case()
+    q_flat, qoffs, width = _flat(q)
+    with pytest.raises(ValueError, match="tile"):
+        ragged_q_paged_attention(
+            q_flat, k_pool, v_pool, tables, starts, totals, qoffs,
+            max_q_len=width, block_q=3, interpret=True,
+        )
+
+
+# ---------------------------------------------------------------------- #
+# engine level: mixed vs the split-path oracle
+# ---------------------------------------------------------------------- #
+@pytest.fixture(scope="module")
+def tiny():
+    config = dataclasses.replace(
+        LlamaConfig.tiny(max_seq_len=512), flash_interpret=True
+    )
+    return config, init_params(config)
+
+
+def _engine(tiny, mode, *, kv_quant=None, kernel="fused", spec="off",
+            prefill_chunk=16, max_seq_len=384, **overrides):
+    config, params = tiny
+    if kernel == "reference" or not overrides.pop("interpret", True):
+        config = dataclasses.replace(config, flash_interpret=False)
+    kwargs = dict(
+        max_slots=4, max_seq_len=max_seq_len,
+        prefill_buckets=[16, 32, 64], kv_quant=kv_quant,
+        kv_layout="paged", kv_block_size=8, paged_kernel=kernel,
+        spec_decode=spec, spec_k=3, prefill_mode=mode,
+        prefill_chunk=prefill_chunk, seed=11,
+    )
+    kwargs.update(overrides)
+    return DecodeEngine(config, params, **kwargs)
+
+
+GREEDY = SamplingParams(max_new_tokens=6)
+SEEDED = SamplingParams(
+    max_new_tokens=8, temperature=0.9, top_k=20, top_p=0.9, seed=1234,
+    presence_penalty=0.4, frequency_penalty=0.2,
+)
+
+
+async def _drive(engine):
+    first = await engine.generate(list(range(1, 40)), GREEDY)
+    # shares 32 block-aligned tokens with the first prompt → prefix-hit
+    # admission resumes the mixed windows mid-prompt
+    second = await engine.generate(
+        list(range(1, 33)) + [99, 98], GREEDY
+    )
+    third = await engine.generate(list(range(3, 30)), SEEDED)
+    return first.tokens, second.tokens, third.tokens
+
+
+@pytest.mark.parametrize("kv_quant", [None, "int8"])
+def test_engine_mixed_matches_split(tiny, kv_quant):
+    """THE acceptance A/B: greedy AND seeded (penalties, truncation,
+    per-request seeds) outputs bitwise-match the split-path oracle on
+    bf16 and int8 pools, through cold chunked admission, a prefix-cache
+    hit, and decode."""
+    mixed = _engine(tiny, "mixed", kv_quant=kv_quant)
+    split = _engine(tiny, "split", kv_quant=kv_quant)
+    mixed.start()
+    split.start()
+    try:
+        assert asyncio.run(_drive(mixed)) == asyncio.run(_drive(split))
+        # the mixed leg actually served through the prefix pool
+        assert mixed.kv_manager.stats["hit_tokens"] >= 32
+        # ...and through mixed dispatches, not hidden split prefills
+        assert any(
+            d["kind"] == "mixed" for d in mixed.dispatch_log
+        )
+        assert not any(
+            d["kind"] == "prefill" for d in mixed.dispatch_log
+        )
+    finally:
+        mixed.stop()
+        split.stop()
+
+
+def test_engine_mixed_matches_split_reference_kernel(tiny):
+    """Same A/B on the gather/scatter reference kernel: the mixed
+    scheduler must not depend on the fused launch being available
+    (CPU-sans-interpret deployments resolve to reference)."""
+    mixed = _engine(tiny, "mixed", kernel="reference")
+    split = _engine(tiny, "split", kernel="reference")
+    assert mixed.paged_kernel == "reference"
+    mixed.start()
+    split.start()
+    try:
+        assert asyncio.run(_drive(mixed)) == asyncio.run(_drive(split))
+    finally:
+        mixed.stop()
+        split.stop()
+
+
+def test_engine_mixed_mid_decode_admission_and_stop_parity(tiny):
+    """One engine pair, two scheduling edges: (a) a long cold prompt
+    admitted while another stream decodes — the interference case the
+    tentpole exists for; (b) a mid-stream stop token hit during an
+    admission window (surplus positions discarded, stop excluded from
+    the history). Tokens must match the split oracle exactly."""
+
+    async def contended(engine):
+        t1 = asyncio.ensure_future(
+            engine.generate(
+                list(range(1, 20)), SamplingParams(max_new_tokens=24)
+            )
+        )
+        await asyncio.sleep(0.15)
+        t2 = asyncio.ensure_future(
+            engine.generate(list(range(5, 150)), GREEDY)
+        )
+        r1, r2 = await asyncio.gather(t1, t2)
+        return r1.tokens, r2.tokens
+
+    async def stopped(engine):
+        base = await engine.generate(list(range(1, 24)), GREEDY)
+        stop = {base.tokens[3]}
+        result = await engine.generate(
+            list(range(1, 24)),
+            SamplingParams(max_new_tokens=16),
+            stop_tokens=stop,
+        )
+        return result.tokens, result.finish_reason
+
+    mixed = _engine(tiny, "mixed")
+    split = _engine(tiny, "split")
+    mixed.start()
+    split.start()
+    try:
+        assert asyncio.run(contended(mixed)) == asyncio.run(
+            contended(split)
+        )
+        got_mixed = asyncio.run(stopped(mixed))
+        assert got_mixed == asyncio.run(stopped(split))
+        assert got_mixed[1] == "stop"
+    finally:
+        mixed.stop()
+        split.stop()
+
+
+def test_engine_mixed_prefix_hit_256(tiny):
+    """≥256-token prefix-cache hit through the mixed path: the second
+    prompt's windows resume AT the matched offset (acceptance
+    criterion), with bitwise token parity against split."""
+    shared = list(np.arange(280) % 250 + 1)
+
+    async def run(engine):
+        first = await engine.generate(shared + [7, 8], GREEDY)
+        second = await engine.generate(shared + [9, 10, 11], GREEDY)
+        return first.tokens, second.tokens
+
+    mixed = _engine(tiny, "mixed")
+    split = _engine(tiny, "split")
+    mixed.start()
+    split.start()
+    try:
+        assert asyncio.run(run(mixed)) == asyncio.run(run(split))
+        assert mixed.kv_manager.stats["hit_tokens"] >= 256
+    finally:
+        mixed.stop()
+        split.stop()
+
+
+def test_engine_mixed_spec_on_parity(tiny):
+    """spec-decode composes: admission windows ride plain mixed steps,
+    speculative chunks resume once the batch is all-decode — token
+    stream identical to the split+spec oracle."""
+
+    async def run(engine):
+        prompt = list(range(1, 9)) * 6  # repetition → drafts accepted
+        a = await engine.generate(prompt, SamplingParams(max_new_tokens=12))
+        b = await engine.generate(list(range(2, 100)), GREEDY)
+        return a.tokens, b.tokens
+
+    mixed = _engine(tiny, "mixed", spec="ngram")
+    split = _engine(tiny, "split", spec="ngram")
+    mixed.start()
+    split.start()
+    try:
+        assert asyncio.run(run(mixed)) == asyncio.run(run(split))
+        assert mixed.stats["tokens_drafted"] > 0
+    finally:
+        mixed.stop()
+        split.stop()
+
+
+@pytest.mark.parametrize(
+    "sampling", [GREEDY, SEEDED], ids=["greedy", "seeded"]
+)
+def test_mixed_crash_resumes_bitwise(tiny, sampling):
+    """Supervisor resurrection through the mixed path: the replay
+    prefill (prompt + generated[:-1]) chunks through mixed windows on
+    the rebuilt engine, and the continuation is bitwise the uncrashed
+    oracle — greedy and seeded-with-penalties."""
+    from langstream_tpu.runtime import faults
+    from langstream_tpu.runtime.supervisor import EngineSupervisor
+
+    def factory():
+        return _engine(tiny, "mixed", prefill_chunk=16)
+
+    oracle = factory()
+    oracle.start()
+
+    async def run(engine):
+        return await engine.generate(list(range(1, 30)), sampling)
+
+    expected = asyncio.run(run(oracle))
+    oracle.stop()
+    assert len(expected.tokens) == sampling.max_new_tokens
+
+    faults.configure("engine_thread_crash@step=2")
+    supervisor = EngineSupervisor(factory)
+    try:
+        result = asyncio.run(run(supervisor.engine))
+        assert supervisor.restarts == 1
+        assert result.tokens == expected.tokens
+        assert result.finish_reason == expected.finish_reason
+        stats = supervisor.engine.stats
+        assert stats["tokens_wasted"].get("crash_replay", 0) > 0
+    finally:
+        supervisor.stop()
+
+
+def test_dense_mixed_rejected(tiny):
+    config, params = tiny
+    with pytest.raises(ValueError, match="paged"):
+        DecodeEngine(
+            config, params, max_slots=2, max_seq_len=64,
+            prefill_mode="mixed",
+        )
+    with pytest.raises(ValueError, match="prefill mode"):
+        DecodeEngine(
+            config, params, max_slots=2, max_seq_len=64,
+            kv_layout="paged", kv_block_size=8, prefill_mode="fused",
+        )
+
+
+# ---------------------------------------------------------------------- #
+# scheduling level: interference bound, padding ledger, retired paths
+# ---------------------------------------------------------------------- #
+def _interference(engine):
+    """One stream decoding, then a max-bucket cold prompt admitted
+    mid-decode — the TPOT-interference traffic shape."""
+
+    async def run():
+        t1 = asyncio.ensure_future(
+            engine.generate(
+                list(range(1, 16)), SamplingParams(max_new_tokens=30)
+            )
+        )
+        await asyncio.sleep(0.2)
+        t2 = asyncio.ensure_future(
+            engine.generate(list(range(2, 250)), GREEDY)
+        )
+        await asyncio.gather(t1, t2)
+
+    asyncio.run(run())
+
+
+def test_interference_bound_and_padding_ledger(tiny):
+    """THE regression the tentpole is judged on: admitting a long cold
+    prompt mid-decode must not produce any single dispatch carrying
+    more than ``prefill_chunk`` prefill tokens on the mixed engine —
+    while the split path serializes a monolithic window of the full
+    bucket size in front of every running stream. Plus the goodput
+    satellite on the same engine pair: split bills bucket-rounding
+    ghosts to ``prefill_padding``, mixed bills ≤ width−1 per window,
+    and the reason is on the /metrics snapshot."""
+    mixed = _engine(tiny, "mixed", prefill_chunk=16, decode_chunk=4)
+    split = _engine(tiny, "split", decode_chunk=4)
+    mixed.start()
+    split.start()
+    try:
+        async def one(engine):
+            # 39 tokens → split pads to the 64 bucket (25 ghosts)
+            await engine.generate(list(range(1, 40)), GREEDY)
+
+        asyncio.run(one(mixed))
+        asyncio.run(one(split))
+        split_pad = split.stats["tokens_wasted"]["prefill_padding"]
+        mixed_pad = mixed.stats["tokens_wasted"].get("prefill_padding", 0)
+        assert split_pad == 64 - 39
+        # mixed windows 16+16+7: pads 9 on the 16-wide tail window
+        assert mixed_pad < split_pad
+        snapshot = engines_snapshot()
+        assert (
+            'jax_engine_tokens_wasted_total{reason="prefill_padding"}'
+            in snapshot
+        )
+        _interference(mixed)
+        _interference(split)
+        worst_mixed = max(
+            d["prefill_tokens"] for d in mixed.dispatch_log
+        )
+        worst_split = max(
+            d["prefill_tokens"] for d in split.dispatch_log
+        )
+        assert worst_mixed <= mixed.prefill_chunk
+        # the split oracle's monolithic windows exceed the budget by
+        # construction (248-token prompt, 64-token largest bucket)
+        assert worst_split > mixed.prefill_chunk
+        # every mixed dispatch also bounds its total live tokens at
+        # riders + budget — the budgeted step bound
+        assert all(
+            d["tokens"] <= mixed.max_slots + mixed.prefill_chunk
+            for d in mixed.dispatch_log
+            if d["kind"] == "mixed"
+        )
+        # the prefill-inflight/harvest machinery is retired: nothing
+        # was ever dispatched through it, and no engine-thread stall
+        # was billed to prefill
+        assert not mixed._prefill_inflight
+        assert mixed.stats["prefill_time"] == 0.0
+        assert mixed.stats["prefill_calls"] >= 1  # completions counted
+    finally:
+        mixed.stop()
+        split.stop()
+
+
+def test_mixed_cost_model_goldens(tiny):
+    """Hand-computed FLOPs/bytes for the mixed dispatch shape: one
+    weight pass shared by decode riders and prefill windows."""
+    mixed = _engine(tiny, "mixed")
+    try:
+        cm = mixed.cost_model
+        # FLOPs: a 1-step decode chunk for the riders + each window at
+        # its offset
+        windows = [(8, 16), (0, 5)]
+        expected = cm.decode_chunk_flops(1, 2, 40)
+        for offset, n in windows:
+            expected += cm.prefill_flops(n, offset=offset)
+        assert cm.mixed_step_flops(2, 40, windows) == expected
+        # bytes: weights ONCE + kernel-aware KV reads + rows written
+        kv_tokens, rows = 72, 2 + 21
+        assert cm.mixed_step_bytes(kv_tokens, rows) == (
+            float(cm.weight_bytes)
+            + cm.kv_read_bytes(kv_tokens)
+            + float(cm.kv_row_bytes) * rows
+        )
+        # the split path pays the weight stream twice for the same
+        # work — the fusion's bandwidth claim, as modeled
+        split_bytes = (
+            cm.decode_chunk_bytes(1, 2, 40) + cm.prefill_bytes(21, 0)
+        )
+        assert cm.mixed_step_bytes(40 + 32, rows) < split_bytes
+    finally:
+        mixed.stop()
+
+
+def test_mixed_flight_and_variant_jobs(tiny, tmp_path):
+    """Mixed decode_chunk flight records carry the per-step prefill
+    load (the stall-free-batching evidence ab_analyze reads), and the
+    variant list compiles the mixed width ladder while retiring the
+    bucketed prefill lattice."""
+    from langstream_tpu.runtime import flight
+
+    mixed = _engine(tiny, "mixed", prefill_chunk=16)
+    try:
+        jobs = len(mixed._variant_jobs())
+        # widths {8, 16} + decode {1, decode_chunk} + block_copy
+        assert len(mixed._mixed_widths) == 2
+        split = _engine(tiny, "split")
+        try:
+            assert jobs < len(split._variant_jobs())
+        finally:
+            split.stop()
+        saved = flight.RECORDER.path
+        flight.RECORDER.path = None
+        flight.RECORDER._pending.clear()
+        path = flight.configure(str(tmp_path / "flight"))
+        try:
+            mixed.start()
+
+            async def one():
+                await mixed.generate(list(range(1, 40)), GREEDY)
+
+            asyncio.run(one())
+            flight.RECORDER.flush()
+            entries = flight.read_artifact(path)
+        finally:
+            flight.RECORDER.path = saved
+        records = [
+            r for r in entries
+            if r.get("kind") == "decode_chunk" and r.get("mixed")
+        ]
+        assert records
+        assert any(r["prefill_tokens"] > 0 for r in records)
+        assert all(
+            r["prefill_tokens"] <= mixed.prefill_chunk for r in records
+        )
+        admits = [r for r in entries if r.get("kind") == "mixed_admit"]
+        assert admits and admits[0]["prompt_tokens"] == 39
+    finally:
+        mixed.stop()
+
+
+def test_mixed_mirror_replay(tiny):
+    """Mirror satellite: every mixed dispatch publishes a ``mixed``
+    record carrying the per-row token counts, and a follower replaying
+    the captured stream converges on a BITWISE-identical pool."""
+    from langstream_tpu.serving.mirror import FollowerExecutor
+
+    class CaptureMirror:
+        def __init__(self):
+            self.records = []
+
+        def publish(self, kind, meta, arrays):
+            self.records.append(
+                (kind, dict(meta), [np.copy(np.asarray(a)) for a in arrays])
+            )
+
+        def close(self):
+            pass
+
+    leader = _engine(tiny, "mixed", prefill_chunk=16)
+    capture = CaptureMirror()
+    leader.mirror = capture
+    follower = _engine(tiny, "mixed", prefill_chunk=16)
+    leader.start()
+    try:
+        async def one():
+            await leader.generate(list(range(1, 40)), GREEDY)
+
+        asyncio.run(one())
+    finally:
+        leader.mirror = None  # stop() must not publish into the capture
+        leader.stop()
+    kinds = {kind for kind, _, _ in capture.records}
+    assert "mixed" in kinds and "prefill" not in kinds
+    executor = FollowerExecutor(follower)
+    for kind, meta, arrays in capture.records:
+        executor._execute(kind, meta, arrays)
+    try:
+        for leaf in leader.cache:
+            assert (
+                np.asarray(leader.cache[leaf])
+                == np.asarray(follower.cache[leaf])
+            ).all(), f"cache leaf {leaf} diverged"
+        assert (
+            np.asarray(leader._counts) == np.asarray(follower._counts)
+        ).all()
+    finally:
+        follower.stop()
+
+
+@needs_two_devices
+def test_tp2_mixed_no_full_pool_collective(tiny):
+    """tp=2 acceptance: the mixed dispatch's compiled HLO contains no
+    all-gather materializing a full (unsharded) pool block — the
+    sharding constraints hold through the new seam."""
+    from langstream_tpu.parallel.mesh import MeshConfig
+
+    engine = _engine(
+        tiny, "mixed", prefill_chunk=16, mesh_config=MeshConfig(tp=2)
+    )
+    try:
+        config = engine.config
+        full_pool_dims = (
+            f"{engine.num_blocks},{engine.block_size},"
+            f"{config.num_kv_heads},{config.dims_per_head}"
+        )
+        for width in engine._mixed_widths:
+            fn = engine._get_mixed(width)
+            jobs = [(f, a) for f, a in engine._variant_jobs() if f is fn]
+            assert jobs, "mixed variant missing from the job list"
+            fn, avals = jobs[0]
+            with engine.mesh:
+                text = fn.lower(*avals).compile().as_text()
+            bad = [
+                line for line in text.splitlines()
+                if "all-gather" in line and full_pool_dims in line
+            ]
+            assert not bad, (
+                f"tp=2 mixed (width {width}) gathers a full pool "
+                "block:\n" + "\n".join(bad[:4])
+            )
+    finally:
+        engine.stop()
+
+
+def test_provider_plumbs_prefill_mode():
+    """engine: {prefill-mode/prefill-chunk} flows compiler globals →
+    provider → engine (string-coerced like every other knob)."""
+    from langstream_tpu.providers.jax_local.provider import (
+        JaxCompletionsService,
+    )
+
+    service = JaxCompletionsService({
+        "model": {"preset": "tiny"},
+        "engine": {
+            "max-slots": "2", "max-seq-len": "64",
+            "kv-layout": "paged", "kv-block-size": "8",
+            "prefill-mode": "mixed", "prefill-chunk": "24",
+        },
+    })
+    try:
+        assert service.engine.prefill_mode == "mixed"
+        assert service.engine.mixed
+        assert service.engine.prefill_chunk == 24
+    finally:
+        service.engine.stop()

@@ -48,6 +48,9 @@ def _sample_exposition() -> str:
         # speculative decoding (ISSUE 7): drafted/accepted counters +
         # acceptance rate, and rejected drafts as a wasted reason
         'jax_engine_tokens_wasted_total{reason="draft_rejected"}': 24.0,
+        # chunked mixed prefill (ISSUE 12): prompt-padding ghosts —
+        # split-path bucket rounding vs the mixed path's width cap
+        'jax_engine_tokens_wasted_total{reason="prefill_padding"}': 40.0,
         "spec_tokens_drafted_total": 96.0,
         "spec_tokens_accepted_total": 72.0,
         "spec_acceptance_rate": 0.75,
@@ -85,7 +88,8 @@ def _sample_exposition() -> str:
                 "useful tokens / all generated tokens",
             "jax_engine_tokens_wasted_total":
                 "tokens burned on cancelled requests, evicted-session"
-                " recompute, or rejected speculative drafts, by reason",
+                " recompute, rejected speculative drafts, or prefill"
+                " bucket/width padding, by reason",
             "spec_tokens_drafted_total":
                 "speculative-decode candidate tokens proposed by the"
                 " prompt-lookup drafter",

@@ -31,6 +31,8 @@ LEGS = {
     "bench_heal_paged.json": "paged KV, fused ragged kernel (--kv-layout paged)",
     "bench_heal_paged_ref.json": "paged KV, gather reference (--paged-kernel reference)",
     "bench_heal_spec.json": "speculative decoding (--spec-decode ngram)",
+    "bench_heal_mixed.json":
+        "paged KV, mixed prefill+decode dispatch (--prefill-mode mixed)",
     "bench_heal_paged_tp2.json": "paged KV, fused kernel, tp=2 mesh (--tp 2)",
     "bench_heal_paged_ref_tp2.json": "paged KV, gather reference, tp=2 mesh",
     "bench_heal_chaos.json":
@@ -96,6 +98,11 @@ def describe(record: Dict[str, Any]) -> str:
         bits.append(f"spec={record['spec_decode']}")
         if record.get("spec_acceptance") is not None:
             bits.append(f"accept {record['spec_acceptance'] * 100:.0f}%")
+    # prefill-mode column: which prefill scheduling produced the leg
+    # (mixed = chunked prefill fused into the decode step) — read next
+    # to the tail columns below, which are what the pair is judged on
+    if record.get("prefill_mode") and record["prefill_mode"] != "split":
+        bits.append(f"prefill={record['prefill_mode']}")
     # chaos column: which leg ran with the fault registry armed — a
     # recovery-under-load number must never read as a clean regression
     if record.get("chaos"):
@@ -114,6 +121,16 @@ def describe(record: Dict[str, Any]) -> str:
         bits.append(f"p50 RTT {record['p50_rtt_ms']:.0f} ms")
     if record.get("p50_ttft_ms"):
         bits.append(f"TTFT {record['p50_ttft_ms']:.0f} ms")
+    # tail columns (ISSUE 12): p95 TTFT + the worst inter-token gap any
+    # closed-loop client saw — the numbers the mixed-vs-split prefill
+    # pair is actually judged on (interference hides in the tail, not
+    # the mean)
+    if record.get("p95_ttft_ms"):
+        bits.append(f"TTFT p95 {record['p95_ttft_ms']:.0f} ms")
+    if record.get("max_tpot_excursion_ms"):
+        bits.append(
+            f"max TPOT exc {record['max_tpot_excursion_ms']:.0f} ms"
+        )
     if record.get("attempt"):
         bits.append(f"attempt {record['attempt']}")
     return " ".join(bits)
@@ -245,6 +262,19 @@ def flight_summary(art_dir: str) -> Optional[str]:
                     "per generated token"
                 )
             lines.append(line)
+        # mixed prefill+decode series (prefill_mode: mixed): how much
+        # prompt work rode each decode step — read next to step_ms for
+        # the stall-free-batching verdict (a flat excursion with large
+        # per-step prefill_tokens means the budget exceeds the decode
+        # step's headroom: lower --prefill-chunk)
+        mixed_chunks = [c for c in chunks if c.get("mixed")]
+        if mixed_chunks:
+            loads = [c.get("prefill_tokens", 0) for c in mixed_chunks]
+            lines.append(
+                f"  mixed dispatch: {len(mixed_chunks)}/{len(chunks)} "
+                f"steps carried prefill windows, prefill tokens/step "
+                f"p50 {_percentile(loads, 0.5)} / max {max(loads)}"
+            )
         # paged-KV series (kv_layout: paged): pool pressure + cumulative
         # prefix-cache hit tokens ride each decode_chunk record
         pool = [
@@ -505,6 +535,51 @@ def main() -> None:
                 f"{rate_note}; verify-step overhead is not being "
                 "repaid — try a smaller --spec-k)" + note
             )
+    mixed = records["bench_heal_mixed.json"]
+    if usable(paged) and usable(mixed):
+        # mixed-vs-split prefill at equal (paged) layout: the verdict is
+        # the TAIL — p95 TTFT and the max TPOT excursion (a monolithic
+        # prefill stalls every running stream for its whole dispatch;
+        # the mixed path bounds each dispatch at the token budget) —
+        # read at roughly equal throughput. A throughput win alone is
+        # not the claim; a tail win at flat throughput is.
+        tput = mixed["value"] / paged["value"] - 1
+        note = caveat(paged, mixed)
+        exc_split = paged.get("max_tpot_excursion_ms")
+        exc_mixed = mixed.get("max_tpot_excursion_ms")
+        p95_split = paged.get("p95_ttft_ms")
+        p95_mixed = mixed.get("p95_ttft_ms")
+        if not exc_split or not exc_mixed:
+            recommendations.append(
+                "mixed prefill: excursion columns missing on one leg "
+                f"(throughput {tput:+.1%}); re-run both legs on a bench "
+                "with max_tpot_excursion_ms (ISSUE 12) for the tail "
+                "verdict" + note
+            )
+        else:
+            exc_cut = (exc_split - exc_mixed) / exc_split
+            ttft_note = ""
+            if p95_split and p95_mixed:
+                ttft_note = (
+                    f", p95 TTFT {p95_split:.0f} -> {p95_mixed:.0f} ms"
+                )
+            if exc_cut > 0.15 and tput > -0.03:
+                recommendations.append(
+                    f"FLIP prefill-mode default to mixed (paged): max "
+                    f"TPOT excursion cut {exc_cut:.1%} ({exc_split:.0f} "
+                    f"-> {exc_mixed:.0f} ms){ttft_note} for {tput:+.1%} "
+                    "throughput; set engine prefill-mode default + "
+                    "jax-completions globals" + note
+                )
+            else:
+                recommendations.append(
+                    f"keep prefill-mode split (excursion cut {exc_cut:.1%}"
+                    f"{ttft_note}, throughput {tput:+.1%}) — if the "
+                    "excursion is flat, check prefill_tokens in the "
+                    "flight decode_chunk records: a budget larger than "
+                    "the decode step's headroom just moves the stall "
+                    "inside the mixed step (lower --prefill-chunk)" + note
+                )
     chaos = records["bench_heal_chaos.json"]
     if usable(main_rec) and usable(chaos):
         # chaos-vs-clean pair: the delta prices one crash/rebuild/resume

@@ -35,6 +35,9 @@ SHARDS: Dict[str, List[str]] = {
         "test_spec_decode",
         "test_paged_kernel",
         "test_paged_kv",
+        # unified mixed prefill+decode dispatch (token-ragged kernel +
+        # engine scheduler A/Bs) constructs DecodeEngines — JAX-heavy
+        "test_mixed_dispatch",
         # multi-chip paged serving (shard_map'd fused kernel, tp=2
         # engine A/Bs, compiled-HLO collective assertions) — JAX-heavy
         "test_multichip_paged",

@@ -166,6 +166,27 @@ y.block_until_ready()" 2>/dev/null
                     echo "$(date -u +%FT%TZ) paged tp2 A/B $kernel failed (non-fatal: needs a 2-chip relay window)" >> "$LOG"
                 fi
             done
+            # 2b-mixed) chunked mixed prefill+decode A/B (ISSUE 12):
+            #    token-budget prefill windows fused into the decode
+            #    step vs the split-path paged leg above (same layout —
+            #    bench_heal_paged.json IS the split leg of this pair).
+            #    Judged on p95_ttft_ms + max_tpot_excursion_ms at equal
+            #    tok/s, not throughput alone (ab_analyze reads both).
+            if BENCH_KV_LAYOUT=paged BENCH_PREFILL_MODE=mixed \
+                BENCH_COMPILE_ONLY=1 BENCH_DEADLINE=3000 \
+                BENCH_INIT_TIMEOUT=600 \
+                python bench.py > /dev/null 2>> "$LOG"; then
+                :
+            else
+                echo "$(date -u +%FT%TZ) mixed-prefill warm interrupted (entries kept)" >> "$LOG"
+            fi
+            if BENCH_KV_LAYOUT=paged BENCH_PREFILL_MODE=mixed \
+                BENCH_DEADLINE=3600 BENCH_INIT_TIMEOUT=600 \
+                python bench.py > "${OUT%.json}_mixed.json" 2>> "$LOG"; then
+                echo "$(date -u +%FT%TZ) mixed-prefill A/B done: $(cat "${OUT%.json}_mixed.json")" >> "$LOG"
+            else
+                echo "$(date -u +%FT%TZ) mixed-prefill A/B failed (non-fatal)" >> "$LOG"
+            fi
             # 2c) speculative-decoding A/B: self-drafting prompt-lookup
             #    (ngram) vs the oracle scan (the main run is the OFF
             #    leg — same traffic shape). Warm the spec jit graphs
