@@ -1,0 +1,36 @@
+"""Static-analysis passes for the serving runtime (`langstream-tpu check`).
+
+Three passes, one Finding vocabulary, one suppression grammar
+(docs/analysis.md):
+
+- :mod:`.lock_discipline` — AST lock/thread-ownership checking driven by
+  ``# guarded-by:`` / ``# owned-by:`` attribute annotations on the
+  threaded classes (engine device thread, supervisor, watchdog, fleet
+  router, mirror, flight recorder, metrics registry ...).
+- :mod:`.jit_hazards` — host-sync and retrace hazards in functions
+  reachable from ``jax.jit`` / ``shard_map`` call sites (tracer
+  ``.item()``/``float()``/``np.asarray``, Python branching on runtime
+  tensor values, closure-captured mutable config).
+- :mod:`.hlo_lint` — the compiled/lowered-HLO invariant rule library
+  (no-full-pool-all-gather, no-pool-shaped-gather, donation-respected,
+  collective census) shared by the engine-dispatch tests and the
+  ``langstream-tpu check`` config-matrix driver.
+
+Every PR since the paged pool landed had re-implemented the HLO scans by
+copy-paste and re-found lock bugs by review; these passes make both
+machine-checked (ISSUE 13).
+"""
+
+from langstream_tpu.analysis.common import (  # noqa: F401
+    Finding,
+    iter_py_files,
+)
+from langstream_tpu.analysis.jit_hazards import run_jit_pass  # noqa: F401
+from langstream_tpu.analysis.lock_discipline import run_lock_pass  # noqa: F401
+
+__all__ = [
+    "Finding",
+    "iter_py_files",
+    "run_jit_pass",
+    "run_lock_pass",
+]

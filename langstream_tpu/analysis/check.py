@@ -1,0 +1,163 @@
+"""``langstream-tpu check`` — run the three analysis passes and gate on
+unsuppressed findings (non-zero exit), so the same invariants that run
+as the CI ``analysis`` shard can gate locally before a push.
+
+Default scope: the installed ``langstream_tpu`` package tree for the two
+AST passes, plus the engine config matrix for the HLO pass. ``--skip
+hlo`` keeps the sub-second AST passes for tight edit loops (the HLO
+matrix jit-compiles ~30 tiny dispatches and takes a couple of minutes
+on CPU).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Dict, List, Optional
+
+from langstream_tpu.analysis.common import Finding
+
+PASSES = ("lock", "jit", "hlo")
+
+
+def _package_root() -> str:
+    import langstream_tpu
+
+    return os.path.dirname(os.path.abspath(langstream_tpu.__file__))
+
+
+def build_parser(parser: Optional[argparse.ArgumentParser] = None):
+    parser = parser or argparse.ArgumentParser(prog="langstream-tpu check")
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files/directories for the AST passes "
+             "(default: the langstream_tpu package)",
+    )
+    parser.add_argument(
+        "--skip", action="append", default=[], choices=list(PASSES),
+        help="skip a pass (repeatable); e.g. --skip hlo for the "
+             "sub-second AST-only gate",
+    )
+    parser.add_argument(
+        "--show-suppressed", action="store_true",
+        help="also print suppressed findings with their reasons "
+             "(the audit view)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="machine-readable output (findings + collective census)",
+    )
+    parser.add_argument(
+        "--platform", default="cpu",
+        help="jax platform for the HLO pass (default cpu — the "
+             "deterministic gate CI runs; empty string = jax default)",
+    )
+    return parser
+
+
+def run_check(args: argparse.Namespace) -> int:
+    paths = args.paths or [_package_root()]
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        # a typo'd path must not gate CLEAN over zero analyzed files
+        print(f"langstream-tpu check: no such path(s): {missing}")
+        return 2
+    skip = set(args.skip)
+    if {"lock", "jit"} - skip:
+        from langstream_tpu.analysis.common import iter_py_files
+
+        if not iter_py_files(paths):
+            # an existing-but-Python-free scope is the same trap: the
+            # gate would pass without having analyzed anything
+            print(
+                f"langstream-tpu check: no Python files under {paths}"
+            )
+            return 2
+    report: Dict[str, List[Finding]] = {}
+    census: Dict[str, Dict[str, int]] = {}
+
+    if "lock" not in skip:
+        from langstream_tpu.analysis.lock_discipline import run_lock_pass
+
+        report["lock-discipline"] = run_lock_pass(paths)
+    if "jit" not in skip:
+        from langstream_tpu.analysis.jit_hazards import run_jit_pass
+
+        report["jit-hazards"] = run_jit_pass(paths)
+    if "hlo" not in skip:
+        # the virtual multi-device mesh must be configured BEFORE jax
+        # initializes its backend (same dance as tests/conftest.py) so
+        # the tp=2 matrix legs exist off-TPU
+        xla_flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in xla_flags:
+            os.environ["XLA_FLAGS"] = (
+                xla_flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        import jax
+
+        if args.platform:
+            jax.config.update("jax_platforms", args.platform)
+        from langstream_tpu.analysis.hlo_lint import run_hlo_pass
+
+        progress = None if args.as_json else (
+            lambda message: print(f"  {message}", flush=True)
+        )
+        hlo_findings, census = run_hlo_pass(progress=progress)
+        report["hlo-invariants"] = hlo_findings
+
+    failures = 0
+    if args.as_json:
+        payload = {
+            "passes": {
+                name: [vars(f) for f in findings]
+                for name, findings in report.items()
+            },
+            "census": census,
+        }
+        print(json.dumps(payload, indent=2))
+        failures = sum(
+            1
+            for findings in report.values()
+            for f in findings
+            if not f.suppressed
+        )
+        return 1 if failures else 0
+
+    for name, findings in report.items():
+        open_findings = [f for f in findings if not f.suppressed]
+        suppressed = [f for f in findings if f.suppressed]
+        print(
+            f"{name}: {len(open_findings)} finding(s)"
+            f" ({len(suppressed)} suppressed)"
+        )
+        for finding in open_findings:
+            print(f"  {finding.format()}")
+        if args.show_suppressed:
+            for finding in suppressed:
+                print(f"  {finding.format()}")
+        failures += len(open_findings)
+    if census:
+        collectives = {
+            dispatch: c for dispatch, c in census.items() if c
+        }
+        if collectives:
+            print("collective census (tp>1 dispatches):")
+            for dispatch, counts in sorted(collectives.items()):
+                detail = " ".join(
+                    f"{op}x{n}" for op, n in sorted(counts.items())
+                )
+                print(f"  {dispatch}: {detail}")
+    print(
+        "langstream-tpu check: "
+        + ("CLEAN" if not failures else f"{failures} FINDING(S)")
+    )
+    return 1 if failures else 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    return run_check(build_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

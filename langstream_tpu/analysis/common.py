@@ -1,0 +1,218 @@
+"""Shared scaffolding for the AST passes: findings, comment extraction,
+and the one suppression grammar.
+
+A finding names (rule, file, line, message). Suppressions are explicit
+and auditable — the grammar REQUIRES a reason so a clean run documents
+every accepted risk:
+
+    x = self._cache[key]  # lint: allow(guarded-by-violation) -- benign
+                          #   stale read; writer holds the lock
+
+An allow comment covers its own line and the next code line; when that
+next line opens a ``def`` or ``class``, it covers the whole definition
+(method-level suppression for e.g. a drain method that runs only after
+the owning thread is joined). An allow WITHOUT a reason is itself a
+finding (``suppression-missing-reason``) — the audit trail is the point.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    message: str
+    suppressed: bool = False
+    reason: str = ""
+
+    def format(self) -> str:
+        mark = " (suppressed: %s)" % self.reason if self.suppressed else ""
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}{mark}"
+
+
+def iter_py_files(paths: Sequence[str]) -> List[str]:
+    """Expand files/directories into a sorted .py file list (skipping
+    __pycache__ and anything that is not Python source). A path that
+    does not exist raises — a typo'd `langstream-tpu check <path>` must
+    fail loudly, not report CLEAN over zero files."""
+    out: List[str] = []
+    for path in paths:
+        if not os.path.exists(path):
+            raise FileNotFoundError(f"no such file or directory: {path!r}")
+        if os.path.isfile(path):
+            if path.endswith(".py"):
+                out.append(path)
+            continue
+        for root, dirs, names in os.walk(path):
+            dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+            for name in sorted(names):
+                if name.endswith(".py"):
+                    out.append(os.path.join(root, name))
+    return sorted(set(out))
+
+
+def file_comments(source: str) -> Dict[int, str]:
+    """``line -> comment text`` (without the leading ``#``) via tokenize,
+    so strings containing ``#`` never read as comments. A file with a
+    tokenization error (analyzed before it parses) yields no comments —
+    the AST pass will report the syntax error instead."""
+    comments: Dict[int, str] = {}
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for token in tokens:
+            if token.type == tokenize.COMMENT:
+                text = token.string.lstrip("#").strip()
+                line = token.start[0]
+                # two comment tokens on consecutive wrapped lines of one
+                # block each keep their own line number
+                comments[line] = (
+                    comments[line] + " " + text if line in comments else text
+                )
+    except tokenize.TokenError:
+        pass
+    return comments
+
+
+_ALLOW_RE = re.compile(
+    r"lint:\s*allow\(\s*([\w\-, ]+?)\s*\)\s*(?:--\s*(.+))?$"
+)
+
+
+class Suppressions:
+    """Per-file suppression index built from the comments + the AST (the
+    AST supplies def/class spans for definition-level allows)."""
+
+    def __init__(self, source: str, tree: Optional[ast.AST] = None) -> None:
+        comments = file_comments(source)
+        if tree is None:
+            try:
+                tree = ast.parse(source)
+            except SyntaxError:
+                tree = ast.Module(body=[], type_ignores=[])
+        code_lines = sorted(
+            {
+                node.lineno
+                for node in ast.walk(tree)
+                if hasattr(node, "lineno")
+            }
+        )
+        spans: List[Tuple[int, int]] = [
+            (
+                min(
+                    [node.lineno]
+                    + [d.lineno for d in node.decorator_list]
+                ),
+                node.end_lineno or node.lineno,
+            )
+            for node in ast.walk(tree)
+            if isinstance(
+                node,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+            )
+        ]
+        # rule -> sorted covered line ranges, with reasons per anchor
+        self._covered: Dict[str, List[Tuple[int, int, str]]] = {}
+        self.missing_reason: List[int] = []
+        for line, text in sorted(comments.items()):
+            match = _ALLOW_RE.search(text)
+            if not match:
+                continue
+            rules = [r.strip() for r in match.group(1).split(",") if r.strip()]
+            reason = (match.group(2) or "").strip()
+            if not reason:
+                self.missing_reason.append(line)
+            anchor = line
+            following = [l for l in code_lines if l > line]
+            nxt = following[0] if following else line
+            end = max(line, nxt)
+            # definition-level: the allow covers the whole def/class it
+            # introduces
+            for start, stop in spans:
+                if start == nxt:
+                    end = max(end, stop)
+            for rule in rules:
+                self._covered.setdefault(rule, []).append(
+                    (anchor, end, reason)
+                )
+
+    def lookup(self, rule: str, line: int) -> Optional[str]:
+        """Reason string when (rule, line) is suppressed, else None."""
+        for start, end, reason in self._covered.get(rule, []):
+            if start <= line <= end:
+                return reason or "(no reason given)"
+        return None
+
+    def apply(self, finding: Finding) -> Finding:
+        reason = self.lookup(finding.rule, finding.line)
+        if reason is not None:
+            finding.suppressed = True
+            finding.reason = reason
+        return finding
+
+
+def attach_comment_annotations(
+    pattern: "re.Pattern[str]",
+    comments: Dict[int, str],
+    tree: ast.AST,
+) -> Dict[int, "re.Match[str]"]:
+    """Match annotation comments and key each by the code line it
+    annotates: the comment's own line when code shares it, else the next
+    code line (standalone comment above the statement)."""
+    code_lines = sorted(
+        {node.lineno for node in ast.walk(tree) if hasattr(node, "lineno")}
+    )
+    out: Dict[int, "re.Match[str]"] = {}
+    code_set = set(code_lines)
+    for line, text in comments.items():
+        match = pattern.search(text)
+        if not match:
+            continue
+        if line in code_set:
+            out[line] = match
+        else:
+            following = [l for l in code_lines if l > line]
+            if following:
+                out[following[0]] = match
+    return out
+
+
+def parse_file(path: str) -> Tuple[str, Optional[ast.AST], List[Finding]]:
+    """Read + parse one file; a syntax error becomes a finding instead of
+    an analyzer crash."""
+    with open(path, encoding="utf-8") as handle:
+        source = handle.read()
+    try:
+        return source, ast.parse(source), []
+    except SyntaxError as error:
+        return source, None, [
+            Finding(
+                "syntax-error", path, error.lineno or 0,
+                f"file does not parse: {error.msg}",
+            )
+        ]
+
+
+def finalize(
+    findings: Iterable[Finding], suppressions: Suppressions, path: str
+) -> List[Finding]:
+    """Apply suppressions and surface reason-less allows."""
+    out = [suppressions.apply(f) for f in findings]
+    for line in suppressions.missing_reason:
+        out.append(
+            Finding(
+                "suppression-missing-reason", path, line,
+                "lint: allow(...) without a '-- reason' — suppressions "
+                "must document why the finding is acceptable",
+            )
+        )
+    return out

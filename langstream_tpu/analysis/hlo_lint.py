@@ -1,0 +1,392 @@
+"""Compiled-HLO invariant engine: the rule library the engine-dispatch
+tests and ``langstream-tpu check`` share.
+
+Three PRs in a row copy-pasted the same ``lower(...).as_text()`` scans
+(``tests/test_multichip_paged.py``, ``tests/test_mixed_dispatch.py``,
+``tests/test_paged_kernel.py``); this module owns the scans so the
+assertions cannot drift apart, and adds a config-matrix driver that
+evaluates every rule against every engine dispatch builder
+(dense/paged × fused/reference × tp ∈ {1, 2} × spec × mixed).
+
+Rule catalog (docs/analysis.md):
+
+- ``no-full-pool-all-gather`` (compiled HLO, paged × tp>1) — no
+  ``all-gather`` whose result is a FULL (unsharded) pool block: that
+  collective is exactly the tp× HBM blow-up the sharding constraints on
+  ``paged_write_rows`` / ``_get_block_copy`` exist to forbid.
+  Activation-level collectives (einsum partials) are expected and pass.
+- ``no-pool-shaped-gather`` (lowered StableHLO, paged × fused) — no
+  ``gather`` whose operand is the per-layer pool: the signature of the
+  reference leg's materialized ``gather_blocks`` copy (3× KV traffic)
+  leaking back into a fused dispatch.
+- ``donation-respected`` (compiled HLO) — every dispatch aliases at
+  least its donated cache buffers (``input_output_alias`` present): a
+  dropped donation silently doubles peak cache memory.
+- ``collective-census`` (compiled HLO) — per-dispatch counts of
+  all-gather / all-reduce / reduce-scatter / collective-permute /
+  all-to-all; on a tp=1 mesh ANY cross-partition collective is a
+  finding (there is nothing to communicate with).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from langstream_tpu.analysis.common import Finding
+
+
+# ---------------------------------------------------------------------- #
+# text scans (pure string → lines; unit-testable without an engine)
+# ---------------------------------------------------------------------- #
+@dataclasses.dataclass(frozen=True)
+class PoolDims:
+    """The paged pool's per-layer block shape [N, Bs, KVH, D]."""
+
+    num_blocks: int
+    block_size: int
+    kv_heads: int
+    head_dim: int
+    dtype: str = "f32"  # stablehlo element type of the pool
+
+
+def pool_dims(engine) -> PoolDims:
+    config = engine.config
+    return PoolDims(
+        num_blocks=engine.num_blocks,
+        block_size=engine.block_size,
+        kv_heads=config.num_kv_heads,
+        head_dim=config.dims_per_head,
+        dtype="i8" if engine.kv_quant else "f32",
+    )
+
+
+def full_pool_allgather_lines(text: str, dims: PoolDims) -> List[str]:
+    """Compiled (post-SPMD) HLO lines all-gathering a full pool block.
+    Post-SPMD HLO spells shapes with comma-separated dims; the full
+    (unsharded) per-layer pool is [N, Bs, KVH, D] and the layer-stacked
+    one [L, N, Bs, KVH, D] — both contain this run."""
+    pattern = (
+        f"{dims.num_blocks},{dims.block_size},"
+        f"{dims.kv_heads},{dims.head_dim}"
+    )
+    return [
+        line for line in text.splitlines()
+        if "all-gather" in line and pattern in line
+    ]
+
+
+def pool_gather_lines(text: str, dims: PoolDims) -> List[str]:
+    """Lowered StableHLO lines gathering the per-layer pool
+    [N, Bs, KVH, D] — the signature of the reference's materialized
+    ``gather_blocks`` copy. Other gathers (embedding lookup, table row
+    lookup) have different operand shapes and don't count."""
+    pool_type = (
+        f"{dims.num_blocks}x{dims.block_size}"
+        f"x{dims.kv_heads}x{dims.head_dim}x{dims.dtype}"
+    )
+    return [
+        line for line in text.splitlines()
+        if "gather" in line and pool_type in line
+    ]
+
+
+_COLLECTIVE_RE = re.compile(
+    r"=\s+\S*\s*(all-gather|all-reduce|reduce-scatter|"
+    r"collective-permute|all-to-all)"
+)
+
+
+def collective_census(text: str) -> Dict[str, int]:
+    """Per-op counts of cross-partition collectives in compiled HLO
+    (op-definition lines only, not metadata mentions)."""
+    census: Dict[str, int] = {}
+    for line in text.splitlines():
+        match = _COLLECTIVE_RE.search(line)
+        if match:
+            census[match.group(1)] = census.get(match.group(1), 0) + 1
+    return census
+
+
+def donation_alias_present(text: str) -> bool:
+    """Compiled HLO advertises buffer donation in the module header
+    (``input_output_alias={ {0}: (1, {}, may-alias) ... }``). An EMPTY
+    alias map does not count — that is exactly the dropped-donation
+    failure this rule exists to catch."""
+    stripped = text.replace(" ", "")
+    marker = "input_output_alias={"
+    index = stripped.find(marker)
+    return index >= 0 and not stripped[index + len(marker):].startswith("}")
+
+
+# ---------------------------------------------------------------------- #
+# engine plumbing (the helpers the tests import)
+# ---------------------------------------------------------------------- #
+def variant_avals(engine, fn) -> Tuple[Any, Tuple[Any, ...]]:
+    """The (fn, arg avals) pair ``engine._variant_jobs()`` lowers this
+    dispatch with — the same avals precompile uses, so the linted HLO is
+    the HLO that serves."""
+    jobs = [(f, a) for f, a in engine._variant_jobs() if f is fn]
+    assert jobs, "variant not in the engine's job list"
+    return jobs[0]
+
+
+def lowered_text(engine, fn) -> str:
+    """StableHLO text of a jitted engine variant (pre-compile)."""
+    fn, avals = variant_avals(engine, fn)
+    with engine.mesh:
+        return fn.lower(*avals).as_text()
+
+
+def compiled_text(engine, fn) -> str:
+    """Post-SPMD compiled HLO text of a jitted engine variant."""
+    fn, avals = variant_avals(engine, fn)
+    with engine.mesh:
+        return fn.lower(*avals).compile().as_text()
+
+
+def named_dispatches(engine) -> Dict[str, Any]:
+    """The curated dispatch set every rule is evaluated on: the builders
+    an engine of this configuration actually serves traffic through."""
+    out: Dict[str, Any] = {}
+    if getattr(engine, "mixed", False):
+        for width in engine._mixed_widths:
+            out[f"mixed[{width}]"] = engine._get_mixed(width)
+    else:
+        bucket = min(engine.prefill_buckets)
+        out[f"prefill[{bucket}]"] = engine._get_prefill(bucket)
+        out[f"prefill_offset[{bucket}]"] = engine._get_prefill_offset(bucket)
+    out["decode[1]"] = engine._get_decode(1)
+    if engine.decode_chunk != 1:
+        out[f"decode[{engine.decode_chunk}]"] = engine._get_decode(
+            engine.decode_chunk
+        )
+    if getattr(engine, "paged", False):
+        out["block_copy"] = engine._get_block_copy()
+    return out
+
+
+# ---------------------------------------------------------------------- #
+# rules
+# ---------------------------------------------------------------------- #
+@dataclasses.dataclass
+class HloRule:
+    name: str
+    needs: str  # "lowered" | "compiled"
+    description: str
+    applies: Callable[[Any], bool]
+    check: Callable[[Any, str, str], List[Finding]]
+
+
+def _tp(engine) -> int:
+    return dict(engine.mesh.shape).get("tp", 1)
+
+
+def _rule_no_full_pool_all_gather(engine, dispatch: str, text: str):
+    dims = pool_dims(engine)
+    lines = full_pool_allgather_lines(text, dims)
+    if not lines:
+        return []
+    return [
+        Finding(
+            "no-full-pool-all-gather", f"<hlo:{dispatch}>", 0,
+            f"tp={_tp(engine)} {dispatch} gathers a full pool block "
+            f"[{dims.num_blocks},{dims.block_size},{dims.kv_heads},"
+            f"{dims.head_dim}] — the tp× HBM blow-up the kv-shard "
+            "constraints forbid:\n" + "\n".join(lines[:4]),
+        )
+    ]
+
+
+def _rule_no_pool_shaped_gather(engine, dispatch: str, text: str):
+    dims = pool_dims(engine)
+    lines = pool_gather_lines(text, dims)
+    if not lines:
+        return []
+    return [
+        Finding(
+            "no-pool-shaped-gather", f"<hlo:{dispatch}>", 0,
+            f"fused {dispatch} still gathers the pool (the reference "
+            "leg's 3x-KV-traffic copy):\n" + "\n".join(lines[:4]),
+        )
+    ]
+
+
+def _rule_donation_respected(engine, dispatch: str, text: str):
+    if donation_alias_present(text):
+        return []
+    return [
+        Finding(
+            "donation-respected", f"<hlo:{dispatch}>", 0,
+            f"{dispatch} compiled without any input/output alias — the "
+            "donated cache is being copied, doubling peak cache memory",
+        )
+    ]
+
+
+def _rule_collective_census(engine, dispatch: str, text: str):
+    census = collective_census(text)
+    if _tp(engine) > 1 or not census:
+        return []  # tp>1 collectives are reported, not flagged
+    detail = ", ".join(f"{op}×{n}" for op, n in sorted(census.items()))
+    return [
+        Finding(
+            "collective-census", f"<hlo:{dispatch}>", 0,
+            f"tp=1 {dispatch} contains cross-partition collectives "
+            f"({detail}) — on a single-shard mesh there is nothing to "
+            "communicate with",
+        )
+    ]
+
+
+RULES: List[HloRule] = [
+    HloRule(
+        "no-full-pool-all-gather", "compiled",
+        "no all-gather materializes a full (unsharded) pool block",
+        applies=lambda e: getattr(e, "paged", False) and _tp(e) > 1,
+        check=_rule_no_full_pool_all_gather,
+    ),
+    HloRule(
+        "no-pool-shaped-gather", "lowered",
+        "fused paged dispatches contain no pool-shaped gather",
+        applies=lambda e: (
+            getattr(e, "paged", False) and e.paged_kernel == "fused"
+        ),
+        check=_rule_no_pool_shaped_gather,
+    ),
+    HloRule(
+        "donation-respected", "compiled",
+        "every dispatch aliases its donated cache buffers",
+        applies=lambda e: True,
+        check=_rule_donation_respected,
+    ),
+    HloRule(
+        "collective-census", "compiled",
+        "collective op counts per dispatch; any collective on tp=1 fails",
+        applies=lambda e: True,
+        check=_rule_collective_census,
+    ),
+]
+
+
+def check_engine(
+    engine,
+    dispatches: Optional[Dict[str, Any]] = None,
+    rules: Optional[List[HloRule]] = None,
+    config_name: str = "",
+) -> Tuple[List[Finding], Dict[str, Dict[str, int]]]:
+    """Evaluate the rule library against one engine's dispatch builders.
+    Returns (findings, census-per-dispatch). Lowered text is always
+    produced; compiled text only when a compiled-HLO rule applies (the
+    compile is the expensive step)."""
+    rules = RULES if rules is None else rules
+    dispatches = named_dispatches(engine) if dispatches is None else dispatches
+    active = [r for r in rules if r.applies(engine)]
+    findings: List[Finding] = []
+    census: Dict[str, Dict[str, int]] = {}
+    prefix = f"{config_name}:" if config_name else ""
+    for name, fn in dispatches.items():
+        # lower ONCE per dispatch; both text forms derive from the same
+        # Lowered object (re-tracing for the compiled form would double
+        # the matrix's trace time)
+        texts: Dict[str, str] = {}
+        if active:
+            jit_fn, avals = variant_avals(engine, fn)
+            with engine.mesh:
+                lowered = jit_fn.lower(*avals)
+                if any(r.needs == "lowered" for r in active):
+                    texts["lowered"] = lowered.as_text()
+                if any(r.needs == "compiled" for r in active):
+                    texts["compiled"] = lowered.compile().as_text()
+        if "compiled" in texts:
+            census[prefix + name] = collective_census(texts["compiled"])
+        for rule in active:
+            for finding in rule.check(engine, prefix + name, texts[rule.needs]):
+                findings.append(finding)
+    return findings, census
+
+
+# ---------------------------------------------------------------------- #
+# config-matrix driver (`langstream-tpu check --hlo`)
+# ---------------------------------------------------------------------- #
+def default_matrix(device_count: int) -> List[Tuple[str, Dict[str, Any]]]:
+    """The engine configurations worth linting: every serving-relevant
+    combination of layout × kernel × tp × spec × mixed that differs at
+    the HLO level. tp=2 legs need ≥2 devices (CI forces an 8-device
+    virtual CPU mesh; a 1-chip host just skips them)."""
+    paged = dict(kv_layout="paged", kv_block_size=8)
+    matrix: List[Tuple[str, Dict[str, Any]]] = [
+        ("dense-tp1", {}),
+        ("paged-fused-tp1", dict(paged, paged_kernel="fused")),
+        ("paged-reference-tp1", dict(paged, paged_kernel="reference")),
+        ("paged-fused-spec-tp1",
+         dict(paged, paged_kernel="fused", spec_decode="ngram", spec_k=2)),
+        ("paged-fused-mixed-tp1",
+         dict(paged, paged_kernel="fused", prefill_mode="mixed",
+              prefill_chunk=16)),
+    ]
+    if device_count >= 2:
+        matrix += [
+            ("paged-fused-tp2", dict(paged, paged_kernel="fused", tp=2)),
+            ("paged-fused-mixed-tp2",
+             dict(paged, paged_kernel="fused", prefill_mode="mixed",
+                  prefill_chunk=16, tp=2)),
+        ]
+    return matrix
+
+
+def build_engine(overrides: Dict[str, Any]):
+    """A tiny CPU-lintable engine for one matrix entry. Fused paged
+    kernels gate on the Pallas interpret hook off-TPU, exactly like the
+    engine-dispatch tests."""
+    import dataclasses as _dc
+
+    from langstream_tpu.parallel.mesh import MeshConfig
+    from langstream_tpu.providers.jax_local.engine import DecodeEngine
+    from langstream_tpu.providers.jax_local.model import (
+        LlamaConfig,
+        init_params,
+    )
+
+    overrides = dict(overrides)
+    tp = overrides.pop("tp", 1)
+    config = LlamaConfig.tiny(max_seq_len=128)
+    if overrides.get("paged_kernel") == "fused":
+        config = _dc.replace(config, flash_interpret=True)
+    params = init_params(config)
+    kwargs: Dict[str, Any] = dict(
+        max_slots=4, max_seq_len=128, prefill_buckets=[16, 32],
+        decode_chunk=4,
+    )
+    kwargs.update(overrides)
+    if tp > 1:
+        kwargs["mesh_config"] = MeshConfig(tp=tp)
+    return DecodeEngine(config, params, **kwargs)
+
+
+def run_hlo_pass(
+    matrix: Optional[List[Tuple[str, Dict[str, Any]]]] = None,
+    progress: Optional[Callable[[str], None]] = None,
+) -> Tuple[List[Finding], Dict[str, Dict[str, int]]]:
+    """Evaluate the rule library across the engine config matrix.
+    Engines are constructed but never started (lowering needs no device
+    thread) and retired from the /metrics registry afterwards."""
+    import jax
+
+    matrix = default_matrix(len(jax.devices())) if matrix is None else matrix
+    findings: List[Finding] = []
+    census: Dict[str, Dict[str, int]] = {}
+    for name, overrides in matrix:
+        if progress:
+            progress(f"hlo: linting {name}")
+        engine = build_engine(overrides)
+        try:
+            engine_findings, engine_census = check_engine(
+                engine, config_name=name
+            )
+            findings.extend(engine_findings)
+            census.update(engine_census)
+        finally:
+            engine.retire()
+    return findings, census

@@ -1,0 +1,449 @@
+"""Jit-hazard pass: host-sync and retrace hazards in device-context
+functions.
+
+Device context = any function reachable (same-module, via direct-name
+calls and ``self.<m>()`` calls) from:
+
+- a ``@jax.jit`` / ``@partial(jax.jit, ...)`` decorated def,
+- the function argument of a ``jax.jit(...)`` / ``shard_map(...)`` /
+  ``compat_shard_map(...)`` call,
+- a def annotated ``# jit: device-context`` (for modules like
+  ``providers/jax_local/model.py`` whose functions are jitted by their
+  CALLERS in another module — cross-module reachability is out of scope
+  for an AST pass, the annotation closes the gap explicitly).
+
+Taint: parameters of a device-context function (minus ``static_argnums``
+/ ``static_argnames`` of the wrapping jit and parameters whose names are
+conventionally static — ``self``, ``config``, ``mesh``, ``kernel``) are
+runtime tracers; so is anything produced by ``jnp.*`` / ``jax.*`` /
+``lax.*`` calls or arithmetic over tainted values. ``x.shape`` /
+``x.dtype`` / ``len(x)`` / ``x is None`` escape taint (static under
+trace).
+
+Rules:
+
+- ``tracer-host-sync`` — ``.item()`` anywhere in device context, or
+  ``float()``/``int()``/``bool()``/``np.asarray()``/``np.array()``
+  applied to a tainted value: each forces a device→host transfer that
+  serializes the dispatch pipeline (and fails outright under jit).
+- ``tracer-branch`` — ``if``/``while``/ternary conditions on tainted
+  values: Python control flow on runtime tensor values is a
+  ConcretizationError under jit and a retrace-per-value hazard with
+  static args; ``jnp.where``/``lax.cond`` are the device-side forms.
+- ``closure-mutable-config`` — a device-context function closes over a
+  name bound to a mutable literal (``dict``/``list``/``set``) in the
+  enclosing function scope: jit bakes the value at trace time, later
+  mutations are silently ignored, and passing it as a static arg raises
+  unhashable-type (module-level tables are fine — they are constants by
+  convention).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from langstream_tpu.analysis.common import (
+    Finding,
+    Suppressions,
+    file_comments,
+    finalize,
+    parse_file,
+)
+
+_DEVICE_CONTEXT_RE = re.compile(r"jit:\s*device-context")
+
+# names whose call results are tainted (runtime arrays)
+_ARRAY_MODULES = ("jnp", "lax", "jax")
+# parameters that are static config by convention in this codebase even
+# inside jitted closures (they are closure-bound, not traced, when the
+# builder partials them in)
+_STATIC_PARAM_NAMES = frozenset(("self", "cls", "config", "mesh", "kernel"))
+_SHAPE_ATTRS = frozenset(("shape", "dtype", "ndim", "size"))
+
+
+def _dotted(node: ast.AST) -> str:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        return f"{base}.{node.attr}" if base else node.attr
+    return ""
+
+
+def _is_jit_expr(node: ast.AST) -> Optional[ast.Call]:
+    """The Call node configuring jit, for ``jax.jit`` / ``jit`` /
+    ``partial(jax.jit, ...)`` / ``functools.partial(jax.jit, ...)``."""
+    if isinstance(node, ast.Call):
+        name = _dotted(node.func)
+        if name in ("jax.jit", "jit"):
+            return node
+        if name.endswith("partial") and node.args:
+            inner = _dotted(node.args[0])
+            if inner in ("jax.jit", "jit"):
+                return node
+    return None
+
+
+_SCALAR_TYPES = frozenset(("int", "float", "bool", "str", "bytes"))
+
+
+def _scalar_annotated(ann: Optional[ast.AST]) -> bool:
+    """True for parameter annotations naming Python scalars — ``x: int``,
+    ``x: Optional[float]`` — which are host config by construction, not
+    tracers (shapes, block sizes, flags)."""
+    if ann is None:
+        return False
+    if isinstance(ann, ast.Name):
+        return ann.id in _SCALAR_TYPES
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        # forward-reference string: match whole type names only —
+        # substring matching would read "Interval" as int
+        return any(
+            re.search(rf"\b{t}\b", ann.value) for t in _SCALAR_TYPES
+        )
+    if isinstance(ann, ast.Subscript):  # Optional[int], Union[int, None]
+        return any(
+            isinstance(n, ast.Name) and n.id in _SCALAR_TYPES
+            for n in ast.walk(ann.slice)
+        )
+    return False
+
+
+def _static_params(jit_call: Optional[ast.Call], fn: ast.FunctionDef) -> Set[str]:
+    """Parameter names pinned static by the jit configuration."""
+    static: Set[str] = set()
+    if jit_call is None:
+        return static
+    params = [a.arg for a in fn.args.args]
+    for keyword in jit_call.keywords:
+        if keyword.arg == "static_argnums":
+            for value in ast.walk(keyword.value):
+                if isinstance(value, ast.Constant) and isinstance(
+                    value.value, int
+                ):
+                    if 0 <= value.value < len(params):
+                        static.add(params[value.value])
+        elif keyword.arg == "static_argnames":
+            for value in ast.walk(keyword.value):
+                if isinstance(value, ast.Constant) and isinstance(
+                    value.value, str
+                ):
+                    static.add(value.value)
+    return static
+
+
+class _Scope:
+    """One module's function defs, call edges, and jit roots. Defs are
+    tracked as NODES (the engine defines eight nested ``run_impl``s —
+    keying by name would collapse them); call edges resolve a name to
+    every same-named def (over-approximation is the right direction for
+    a lint)."""
+
+    def __init__(self, tree: ast.AST, comments: Dict[int, str]) -> None:
+        self.defs_by_name: Dict[str, List[ast.FunctionDef]] = {}
+        self.jit_of: Dict[int, Optional[ast.Call]] = {}
+        self.roots: List[ast.FunctionDef] = []
+        root_ids: Set[int] = set()
+
+        def add_root(fn: ast.FunctionDef, jit: Optional[ast.Call]) -> None:
+            if id(fn) not in root_ids:
+                root_ids.add(id(fn))
+                self.roots.append(fn)
+            if jit is not None:
+                self.jit_of.setdefault(id(fn), jit)
+
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.defs_by_name.setdefault(node.name, []).append(node)
+                for decorator in node.decorator_list:
+                    jit = _is_jit_expr(decorator)
+                    if jit is not None or _dotted(decorator) in (
+                        "jax.jit", "jit"
+                    ):
+                        add_root(node, jit)
+                # explicit device-context annotation on the def line or
+                # the line above it
+                for line in (node.lineno, node.lineno - 1):
+                    text = comments.get(line, "")
+                    if _DEVICE_CONTEXT_RE.search(text):
+                        add_root(node, None)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                name = _dotted(node.func)
+                is_jit = name in ("jax.jit", "jit")
+                is_smap = name.split(".")[-1] in (
+                    "shard_map", "compat_shard_map", "_shard_map"
+                )
+                if (is_jit or is_smap) and node.args:
+                    target = node.args[0]
+                    bare = (
+                        target.id if isinstance(target, ast.Name)
+                        else target.attr
+                        if isinstance(target, ast.Attribute) else None
+                    )
+                    if bare:
+                        for fn in self.defs_by_name.get(bare, []):
+                            add_root(fn, node if is_jit else None)
+
+    def reachable(self) -> List[ast.FunctionDef]:
+        seen: Set[int] = set()
+        out: List[ast.FunctionDef] = []
+        stack = list(self.roots)
+        while stack:
+            fn = stack.pop()
+            if id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            out.append(fn)
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    callee = None
+                    if isinstance(node.func, ast.Name):
+                        callee = node.func.id
+                    elif isinstance(node.func, ast.Attribute) and isinstance(
+                        node.func.value, ast.Name
+                    ) and node.func.value.id == "self":
+                        callee = node.func.attr
+                    if callee:
+                        for target in self.defs_by_name.get(callee, []):
+                            if id(target) not in seen:
+                                stack.append(target)
+        return out
+
+
+def _analyze_function(
+    path: str,
+    fn: ast.FunctionDef,
+    jit_call: Optional[ast.Call],
+    enclosing_mutables: Dict[str, int],
+) -> List[Finding]:
+    findings: List[Finding] = []
+    static = _static_params(jit_call, fn) | _STATIC_PARAM_NAMES
+    tainted: Set[str] = {
+        a.arg
+        for a in (
+            fn.args.args + fn.args.posonlyargs + fn.args.kwonlyargs
+        )
+        if a.arg not in static and not _scalar_annotated(a.annotation)
+    }
+
+    def is_tainted(node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in tainted
+        if isinstance(node, ast.Attribute):
+            if node.attr in _SHAPE_ATTRS:
+                return False
+            return is_tainted(node.value)
+        if isinstance(node, ast.Subscript):
+            return is_tainted(node.value)
+        if isinstance(node, ast.BinOp):
+            return is_tainted(node.left) or is_tainted(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return is_tainted(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(is_tainted(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            # identity tests are static under trace (`x is None` is the
+            # optional-operand idiom, not a value branch)
+            if all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+            ):
+                return False
+            return is_tainted(node.left) or any(
+                is_tainted(c) for c in node.comparators
+            )
+        if isinstance(node, ast.IfExp):
+            return is_tainted(node.body) or is_tainted(node.orelse)
+        if isinstance(node, ast.Tuple):
+            return any(is_tainted(e) for e in node.elts)
+        if isinstance(node, ast.Call):
+            name = _dotted(node.func)
+            head = name.split(".")[0]
+            if head in _ARRAY_MODULES and "ShapeDtypeStruct" not in name:
+                return True
+            if name == "len":
+                return False
+            # method call on a tainted receiver stays tainted
+            # (x.astype(...), x.reshape(...), x.at[...].set(...))
+            if isinstance(node.func, ast.Attribute):
+                return is_tainted(node.func.value)
+            return False
+        return False
+
+    # flow-insensitive propagation to convergence: assignments of
+    # tainted expressions taint their targets
+    for _ in range(4):
+        grew = False
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and is_tainted(node.value):
+                for target in node.targets:
+                    for name_node in ast.walk(target):
+                        if (
+                            isinstance(name_node, ast.Name)
+                            and name_node.id not in tainted
+                        ):
+                            tainted.add(name_node.id)
+                            grew = True
+            elif isinstance(node, ast.AugAssign) and is_tainted(node.value):
+                if isinstance(node.target, ast.Name) and (
+                    node.target.id not in tainted
+                ):
+                    tainted.add(node.target.id)
+                    grew = True
+        if not grew:
+            break
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            name = _dotted(node.func)
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "item"
+                and not node.args
+            ):
+                findings.append(
+                    Finding(
+                        "tracer-host-sync", path, node.lineno,
+                        f"`.item()` in device context {fn.name}() — a "
+                        "blocking device→host sync (and a trace error "
+                        "under jit)",
+                    )
+                )
+            elif name in ("float", "int", "bool") and node.args and (
+                is_tainted(node.args[0])
+            ):
+                findings.append(
+                    Finding(
+                        "tracer-host-sync", path, node.lineno,
+                        f"`{name}(...)` on a traced value in "
+                        f"{fn.name}() — concretizes the tracer "
+                        "(host sync / trace error)",
+                    )
+                )
+            elif name in (
+                "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+                "onp.asarray", "onp.array",
+            ) and node.args and is_tainted(node.args[0]):
+                findings.append(
+                    Finding(
+                        "tracer-host-sync", path, node.lineno,
+                        f"`{name}(...)` on a traced value in "
+                        f"{fn.name}() — materializes the array on host "
+                        "mid-dispatch; use jnp equivalents",
+                    )
+                )
+        elif isinstance(node, (ast.If, ast.While)) and is_tainted(node.test):
+            keyword = "while" if isinstance(node, ast.While) else "if"
+            findings.append(
+                Finding(
+                    "tracer-branch", path, node.lineno,
+                    f"Python `{keyword}` on a traced value in "
+                    f"{fn.name}() — runtime tensor values cannot drive "
+                    "host control flow (jnp.where / lax.cond / "
+                    "lax.while_loop are the device-side forms)",
+                )
+            )
+        elif isinstance(node, ast.Assert) and is_tainted(node.test):
+            findings.append(
+                Finding(
+                    "tracer-branch", path, node.lineno,
+                    f"`assert` on a traced value in {fn.name}() — "
+                    "asserts concretize under trace; use "
+                    "checkify/debug.check or assert on static shapes",
+                )
+            )
+
+    # closure-captured mutable config
+    for name, line in sorted(enclosing_mutables.items()):
+        used = any(
+            isinstance(node, ast.Name)
+            and node.id == name
+            and isinstance(node.ctx, ast.Load)
+            for node in ast.walk(fn)
+        )
+        local = any(
+            isinstance(node, ast.Name)
+            and node.id == name
+            and isinstance(node.ctx, ast.Store)
+            for node in ast.walk(fn)
+        ) or name in {a.arg for a in fn.args.args}
+        if used and not local:
+            findings.append(
+                Finding(
+                    "closure-mutable-config", path, fn.lineno,
+                    f"device-context {fn.name}() closes over mutable "
+                    f"{name!r} (bound at line {line}): jit bakes the "
+                    "value at trace time, later mutations are silently "
+                    "ignored, and static-arg use raises unhashable",
+                )
+            )
+    return findings
+
+
+def _mutable_bindings(fn: ast.FunctionDef) -> Dict[str, int]:
+    """Names bound to dict/list/set literals directly in this function's
+    body (not inside nested defs)."""
+    out: Dict[str, int] = {}
+    for stmt in fn.body:
+        if isinstance(stmt, ast.Assign) and isinstance(
+            stmt.value, (ast.Dict, ast.List, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp)
+        ):
+            for target in stmt.targets:
+                if isinstance(target, ast.Name):
+                    out[target.id] = stmt.lineno
+    return out
+
+
+def analyze_source(path: str, source: str, tree: ast.AST) -> List[Finding]:
+    comments = file_comments(source)
+    scope = _Scope(tree, comments)
+    device = scope.reachable()
+    if not device:
+        return []
+    device_ids = {id(fn) for fn in device}
+    # map each device-context def to its enclosing function's mutable
+    # literal bindings (builder-closure pattern: `def _get_x(): cfg = {}
+    # ... @jax.jit def run(...): use(cfg)`)
+    enclosing: Dict[int, Dict[str, int]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            bindings = _mutable_bindings(node)
+            if not bindings:
+                continue
+            for child in ast.walk(node):
+                if (
+                    isinstance(
+                        child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    )
+                    and child is not node
+                    and id(child) in device_ids
+                ):
+                    enclosing.setdefault(id(child), {}).update(bindings)
+    findings: List[Finding] = []
+    for fn in sorted(device, key=lambda f: f.lineno):
+        findings.extend(
+            _analyze_function(
+                path, fn, scope.jit_of.get(id(fn)),
+                enclosing.get(id(fn), {}),
+            )
+        )
+    return findings
+
+
+def run_jit_pass(paths: Sequence[str]) -> List[Finding]:
+    from langstream_tpu.analysis.common import iter_py_files
+
+    out: List[Finding] = []
+    for path in iter_py_files(paths):
+        source, tree, errors = parse_file(path)
+        out.extend(errors)
+        if tree is None:
+            continue
+        suppressions = Suppressions(source, tree)
+        out.extend(
+            finalize(analyze_source(path, source, tree), suppressions, path)
+        )
+    return out

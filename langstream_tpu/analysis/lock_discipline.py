@@ -1,0 +1,458 @@
+"""Lock-discipline pass: annotation-driven AST checking of the threaded
+classes.
+
+Annotation grammar (full catalog in docs/analysis.md):
+
+- ``# guarded-by: <lock>`` on an attribute assignment: every read AND
+  write of ``self.<attr>`` anywhere in the class must happen inside a
+  ``with self.<lock>:`` block (or in a method annotated
+  ``# requires-lock: <lock>`` — the caller holds it).
+- ``# guarded-by: <lock> (writes)``: only writes need the lock; lock-free
+  reads are declared stale-tolerant (single-word snapshots a reader may
+  observe one update late — e.g. a 503-availability check that must not
+  block behind a multi-second rebuild held under the lock).
+- ``# owned-by: <method>`` on an attribute assignment: the attribute is
+  thread-confined to the thread whose body is ``<method>`` (typically a
+  ``threading.Thread(target=self.<method>)`` body). Writes from methods
+  not reachable from ``<method>`` via the intra-class call graph are
+  findings (reads are allowed: cross-thread reads of owned state are
+  point-in-time snapshots, the pattern the engine documents for
+  ``queue_depth``).
+- ``# requires-lock: <lock>`` anywhere inside a method: the method is
+  only ever called with ``<lock>`` held.
+
+Rules:
+
+- ``guarded-by-violation`` — guarded attribute touched outside the lock.
+- ``owned-by-violation`` — owned attribute mutated off its thread.
+- ``cross-thread-mutation`` — in a class that SPAWNS threads, an
+  attribute with no annotation at all is mutated both from a
+  thread-body-reachable method and from an external (caller-thread)
+  method. This is the rule that would have caught PR 10's
+  ``build_heartbeat`` dict-resize race class before review did.
+- ``unknown-lock`` / ``unknown-owner`` — an annotation names a lock or
+  thread-body method the class never defines (typo guard: a misspelled
+  annotation must not silently disable checking).
+
+``__init__`` is exempt everywhere: construction happens-before
+publication of ``self``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from langstream_tpu.analysis.common import (
+    Finding,
+    Suppressions,
+    attach_comment_annotations,
+    file_comments,
+    finalize,
+    parse_file,
+)
+
+_GUARDED_RE = re.compile(
+    r"guarded-by:\s*([A-Za-z_]\w*)\s*(\(writes\))?"
+)
+_OWNED_RE = re.compile(r"owned-by:\s*([A-Za-z_]\w*)")
+_REQUIRES_RE = re.compile(r"requires-lock:\s*([A-Za-z_]\w*)")
+
+# method calls that mutate the receiver in place — the dict/list/set/
+# deque surface the runtime actually uses; a resize racing an iterator
+# is exactly the build_heartbeat failure class
+_MUTATORS = frozenset((
+    "append", "appendleft", "extend", "insert", "remove", "pop",
+    "popleft", "clear", "update", "add", "discard", "setdefault",
+    "sort", "reverse",
+))
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+class _ClassInfo:
+    def __init__(self, node: ast.ClassDef) -> None:
+        self.node = node
+        self.name = node.name
+        # method name -> def node (class-body level only; nested defs
+        # belong to their enclosing method)
+        self.methods: Dict[str, ast.FunctionDef] = {
+            stmt.name: stmt
+            for stmt in node.body
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        # guarded: attr -> (lock, writes_only, annotation line)
+        self.guarded: Dict[str, Tuple[str, bool, int]] = {}
+        # owned: attr -> (owner method, annotation line)
+        self.owned: Dict[str, Tuple[str, int]] = {}
+        self.requires: Dict[str, Set[str]] = {}
+        self.thread_bodies: Set[str] = set()
+        self.calls: Dict[str, Set[str]] = {}
+
+    def reachable(self, roots: Sequence[str]) -> Set[str]:
+        seen: Set[str] = set()
+        stack = [r for r in roots if r in self.methods]
+        while stack:
+            name = stack.pop()
+            if name in seen:
+                continue
+            seen.add(name)
+            stack.extend(
+                callee for callee in self.calls.get(name, ())
+                if callee in self.methods and callee not in seen
+            )
+        return seen
+
+
+def _collect_annotations(
+    info: _ClassInfo, comments: Dict[int, str], path: str
+) -> List[Finding]:
+    """Attach guarded-by/owned-by comments to the ``self.X`` assignment
+    they annotate (same line, or the next code line for standalone
+    comments)."""
+    findings: List[Finding] = []
+    # scope to THIS class's span: a trailing annotation in the previous
+    # class must not attach to this one's first statement
+    end = info.node.end_lineno or info.node.lineno
+    comments = {
+        line: text
+        for line, text in comments.items()
+        if info.node.lineno <= line <= end
+    }
+    guarded_lines = attach_comment_annotations(
+        _GUARDED_RE, comments, info.node
+    )
+    owned_lines = attach_comment_annotations(_OWNED_RE, comments, info.node)
+    targets_by_line: Dict[int, List[str]] = {}
+    for node in ast.walk(info.node):
+        attr = None
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            for target in targets:
+                attr = _self_attr(target)
+                if attr:
+                    targets_by_line.setdefault(node.lineno, []).append(attr)
+    for line, match in guarded_lines.items():
+        attrs = targets_by_line.get(line, [])
+        for attr in attrs:
+            info.guarded[attr] = (
+                match.group(1), match.group(2) is not None, line
+            )
+        if not attrs:
+            findings.append(
+                Finding(
+                    "unanchored-annotation", path, line,
+                    f"guarded-by annotation in {info.name} attaches to "
+                    "no `self.<attr>` assignment — the contract it "
+                    "declares checks nothing",
+                )
+            )
+    for line, match in owned_lines.items():
+        attrs = targets_by_line.get(line, [])
+        for attr in attrs:
+            info.owned[attr] = (match.group(1), line)
+        if not attrs:
+            findings.append(
+                Finding(
+                    "unanchored-annotation", path, line,
+                    f"owned-by annotation in {info.name} attaches to "
+                    "no `self.<attr>` assignment — the contract it "
+                    "declares checks nothing",
+                )
+            )
+    return findings
+
+
+def _scan_methods(info: _ClassInfo, comments: Dict[int, str]) -> None:
+    """Fill per-method call edges, requires-lock marks, and thread-body
+    targets (``threading.Thread(target=self.<m>)``)."""
+    for name, method in info.methods.items():
+        called: Set[str] = set()
+        for node in ast.walk(method):
+            if isinstance(node, ast.Call):
+                attr = _self_attr(node.func)
+                if attr:
+                    called.add(attr)
+                # threading.Thread(target=self.<m>) / Thread(target=...)
+                func = node.func
+                callee = (
+                    func.attr if isinstance(func, ast.Attribute)
+                    else func.id if isinstance(func, ast.Name) else ""
+                )
+                if callee == "Thread":
+                    for keyword in node.keywords:
+                        if keyword.arg == "target":
+                            target = _self_attr(keyword.value)
+                            if target:
+                                info.thread_bodies.add(target)
+        info.calls[name] = called
+        marks: Set[str] = set()
+        end = method.end_lineno or method.lineno
+        # include the line above the def (and any decorators): the
+        # natural place to write the contract is above the signature
+        start = min(
+            [method.lineno]
+            + [d.lineno for d in method.decorator_list]
+        ) - 1
+        for line in range(start, end + 1):
+            text = comments.get(line)
+            if text:
+                match = _REQUIRES_RE.search(text)
+                if match:
+                    marks.add(match.group(1))
+        info.requires[name] = marks
+
+
+class _Access:
+    __slots__ = ("attr", "line", "write", "method", "held")
+
+    def __init__(self, attr: str, line: int, write: bool, method: str,
+                 held: frozenset) -> None:
+        self.attr = attr
+        self.line = line
+        self.write = write
+        self.method = method
+        self.held = held
+
+
+def _with_locks(node: ast.With) -> Set[str]:
+    locks: Set[str] = set()
+    for item in node.items:
+        expr = item.context_expr
+        attr = _self_attr(expr)
+        if attr:
+            locks.add(attr)
+    return locks
+
+
+def _collect_accesses(info: _ClassInfo) -> List[_Access]:
+    accesses: List[_Access] = []
+
+    def classify(node: ast.Attribute, parents: Dict[int, ast.AST]) -> bool:
+        """True when this self.X occurrence mutates X."""
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            return True
+        parent = parents.get(id(node))
+        # self.X[...] = v  /  del self.X[...]
+        if (
+            isinstance(parent, ast.Subscript)
+            and parent.value is node
+            and isinstance(parent.ctx, (ast.Store, ast.Del))
+        ):
+            return True
+        # self.X.append(...) and friends
+        if (
+            isinstance(parent, ast.Attribute)
+            and parent.value is node
+            and parent.attr in _MUTATORS
+        ):
+            grand = parents.get(id(parent))
+            if isinstance(grand, ast.Call) and grand.func is parent:
+                return True
+        # self.X.attr = v (mutating a member of the referenced object):
+        #   counts as a write to the OBJECT, which guarded-by covers
+        if (
+            isinstance(parent, ast.Attribute)
+            and parent.value is node
+            and isinstance(parent.ctx, (ast.Store, ast.Del))
+        ):
+            return True
+        return False
+
+    for name, method in info.methods.items():
+        parents: Dict[int, ast.AST] = {}
+        for node in ast.walk(method):
+            for child in ast.iter_child_nodes(node):
+                parents[id(child)] = node
+
+        def visit(node: ast.AST, held: frozenset) -> None:
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                inner = frozenset(held | _with_locks(node))
+                for item in node.items:
+                    visit(item.context_expr, held)
+                for stmt in node.body:
+                    visit(stmt, inner)
+                return
+            attr = _self_attr(node)
+            if attr is not None:
+                accesses.append(
+                    _Access(
+                        attr, node.lineno,
+                        classify(node, parents), name, held,
+                    )
+                )
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        held0 = frozenset(info.requires.get(name, ()))
+        for stmt in method.body:
+            visit(stmt, held0)
+    return accesses
+
+
+def _check_class(
+    info: _ClassInfo, path: str, comments: Dict[int, str]
+) -> List[Finding]:
+    findings = _collect_annotations(info, comments, path)
+    _scan_methods(info, comments)
+    accesses = _collect_accesses(info)
+    defined_attrs = {a.attr for a in accesses}
+
+    # annotation typo guards; an attr guarded by a lock the class never
+    # references reports ONLY the typo (per-access violations against a
+    # misspelled lock would be noise on top of the actionable finding)
+    unknown_locks: Set[str] = set()
+    for attr, (lock, _writes, line) in info.guarded.items():
+        if lock not in defined_attrs:
+            unknown_locks.add(attr)
+            findings.append(
+                Finding(
+                    "unknown-lock", path, line,
+                    f"{info.name}.{attr} is guarded-by {lock!r} but the "
+                    "class never references such a lock attribute",
+                )
+            )
+    # same policy as unknown-lock: a typo'd owner reports ONLY the typo
+    # (per-write violations against a method that does not exist would
+    # be noise on top of the actionable finding)
+    unknown_owners: Set[str] = set()
+    for attr, (owner, line) in info.owned.items():
+        if owner not in info.methods:
+            unknown_owners.add(attr)
+            findings.append(
+                Finding(
+                    "unknown-owner", path, line,
+                    f"{info.name}.{attr} is owned-by {owner!r} but the "
+                    "class defines no such method",
+                )
+            )
+
+    # rule 1: guarded-by
+    for access in accesses:
+        if access.method == "__init__":
+            continue
+        annotation = info.guarded.get(access.attr)
+        if annotation is None or access.attr in unknown_locks:
+            continue
+        lock, writes_only, _line = annotation
+        if writes_only and not access.write:
+            continue
+        if lock in access.held:
+            continue
+        kind = "write" if access.write else "read"
+        findings.append(
+            Finding(
+                "guarded-by-violation", path, access.line,
+                f"{kind} of {info.name}.{access.attr} (guarded-by "
+                f"{lock}) outside `with self.{lock}:` in "
+                f"{access.method}()",
+            )
+        )
+
+    # rule 2: owned-by (mutations off the owning thread)
+    owner_reach: Dict[str, Set[str]] = {}
+    for attr, (owner, _line) in info.owned.items():
+        if owner not in owner_reach and owner in info.methods:
+            owner_reach[owner] = info.reachable([owner])
+    for access in accesses:
+        if not access.write or access.method == "__init__":
+            continue
+        annotation = info.owned.get(access.attr)
+        if annotation is None or access.attr in unknown_owners:
+            continue
+        owner, _line = annotation
+        if access.method in owner_reach.get(owner, {owner}):
+            continue
+        findings.append(
+            Finding(
+                "owned-by-violation", path, access.line,
+                f"{info.name}.{access.attr} is owned by the {owner}() "
+                f"thread but is mutated from {access.method}(), which "
+                f"{owner}() never reaches",
+            )
+        )
+
+    # rule 3: unannotated cross-thread mutation (thread-spawning
+    # classes only — the heuristic needs a thread boundary to reason
+    # about)
+    if info.thread_bodies:
+        reach: Dict[str, Set[str]] = {
+            body: info.reachable([body]) for body in info.thread_bodies
+        }
+        writes_by_attr: Dict[str, List[_Access]] = {}
+        for access in accesses:
+            if not access.write or access.method == "__init__":
+                continue
+            if access.attr in info.guarded or access.attr in info.owned:
+                continue
+            writes_by_attr.setdefault(access.attr, []).append(access)
+        for attr, writes in sorted(writes_by_attr.items()):
+            domains: Dict[str, List[_Access]] = {}
+            for access in writes:
+                owners = [
+                    body for body, members in reach.items()
+                    if access.method in members
+                ]
+                for domain in owners or ["<caller>"]:
+                    domains.setdefault(domain, []).append(access)
+            if len(domains) < 2:
+                continue
+            # anchor the finding on a caller-side write when one exists
+            # (that is the line a suppression most likely belongs on)
+            anchor = min(
+                domains.get("<caller>", writes),
+                key=lambda a: a.line,
+            )
+            names = ", ".join(
+                f"{domain}:{sorted({a.method for a in sub})}"
+                for domain, sub in sorted(domains.items())
+            )
+            findings.append(
+                Finding(
+                    "cross-thread-mutation", path, anchor.line,
+                    f"{info.name}.{attr} is mutated from multiple "
+                    f"thread contexts ({names}) with no guarded-by/"
+                    "owned-by annotation",
+                )
+            )
+    return findings
+
+
+def analyze_source(path: str, source: str, tree: ast.AST) -> List[Finding]:
+    comments = file_comments(source)
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            findings.extend(_check_class(_ClassInfo(node), path, comments))
+    return findings
+
+
+def run_lock_pass(paths: Sequence[str]) -> List[Finding]:
+    """Analyze every file (annotation-driven: classes without
+    annotations and without threads produce nothing). Returns ALL
+    findings; suppressed ones carry their reason."""
+    from langstream_tpu.analysis.common import iter_py_files
+
+    out: List[Finding] = []
+    for path in iter_py_files(paths):
+        source, tree, errors = parse_file(path)
+        out.extend(errors)
+        if tree is None:
+            continue
+        suppressions = Suppressions(source, tree)
+        out.extend(
+            finalize(analyze_source(path, source, tree), suppressions, path)
+        )
+    return out

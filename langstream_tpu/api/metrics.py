@@ -24,7 +24,10 @@ class Counter:
 
     def __init__(self, name: str) -> None:
         self.name = name
-        self._value = 0
+        # increments serialize under the lock; value() reads lock-free
+        # (a scrape observing a count one tick late is correct
+        # Prometheus semantics)
+        self._value = 0  # guarded-by: _lock (writes)
         self._lock = threading.Lock()
 
     def count(self, amount: int = 1) -> None:
@@ -50,9 +53,9 @@ class Histogram:
     def __init__(self, name: str, buckets=None) -> None:
         self.name = name
         self.buckets = tuple(buckets or self.DEFAULT_BUCKETS)
-        self._counts = [0] * (len(self.buckets) + 1)  # +inf bucket
-        self._sum = 0.0
-        self._count = 0
+        self._counts = [0] * (len(self.buckets) + 1)  # guarded-by: _lock
+        self._sum = 0.0  # guarded-by: _lock
+        self._count = 0  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def observe(self, value: float) -> None:
@@ -88,8 +91,11 @@ class MetricsReporter:
 
     def __init__(self, prefix: str = "") -> None:
         self.prefix = prefix
-        self._counters: Dict[str, Counter] = {}
-        self._histograms: Dict[str, Histogram] = {}
+        # registry dicts: inserts hold the lock (get-or-create races
+        # must not lose a counter); with_prefix SHARES the dicts with
+        # the child reporter by reference, which is a lock-free read
+        self._counters: Dict[str, Counter] = {}  # guarded-by: _lock (writes)
+        self._histograms: Dict[str, Histogram] = {}  # guarded-by: _lock (writes)
         self._lock = threading.Lock()
 
     def with_prefix(self, prefix: str) -> "MetricsReporter":

@@ -798,6 +798,17 @@ def build_parser() -> argparse.ArgumentParser:
         help="stop after N polls (0 = until interrupted)",
     )
 
+    check = sub.add_parser(
+        "check",
+        help="static analysis over the runtime: lock-discipline + "
+             "jit-hazard AST passes and the compiled-HLO invariant "
+             "matrix; non-zero exit on unsuppressed findings "
+             "(docs/analysis.md)",
+    )
+    from langstream_tpu.analysis.check import build_parser as _check_parser
+
+    _check_parser(check)
+
     profile = sub.add_parser(
         "profile",
         help="trigger an on-demand device-profiler capture on a serving "
@@ -1069,6 +1080,10 @@ def main(argv: Optional[List[str]] = None) -> None:
             asyncio.run(_top_cmd(args))
         except KeyboardInterrupt:
             pass
+    elif args.command == "check":
+        from langstream_tpu.analysis.check import run_check
+
+        raise SystemExit(run_check(args))
     elif args.command == "profile":
         asyncio.run(_profile_cmd(args))
     elif args.command == "agent-runner":

@@ -218,13 +218,17 @@ class FleetRouter:
             raise ValueError(f"unknown routing policy {policy!r}")
         self.policy = policy
         self.heartbeat_timeout_s = float(heartbeat_timeout_s)
-        self.replicas: Dict[str, ReplicaState] = {}
+        # the replica view and routing counters: heartbeats (consumer
+        # task) and request handlers touch them concurrently, so every
+        # read AND write holds the lock (prompt hashing stays outside
+        # it — see route())
+        self.replicas: Dict[str, ReplicaState] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
-        self._rr = 0
-        self._routed: Dict[str, int] = {
+        self._rr = 0  # guarded-by: _lock
+        self._routed: Dict[str, int] = {  # guarded-by: _lock
             "affinity": 0, "least_queue": 0, "round_robin": 0,
         }
-        self._matched_tokens = 0
+        self._matched_tokens = 0  # guarded-by: _lock
 
     # ------------------------------------------------------------------ #
     # heartbeat view

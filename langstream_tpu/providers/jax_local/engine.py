@@ -155,7 +155,15 @@ def engines_snapshot() -> Dict[str, float]:
     spec_drafted = spec_accepted = 0
     decode_flops = decode_bytes = prefill_flops = 0.0
     peaks: Optional[accounting.PeakSpecs] = None
-    live_engines = list(_LIVE_ENGINES)
+    # snapshot-tolerant reads of engine-thread-owned state: a supervisor
+    # rebuild registers the replacement engine FROM the dying engine
+    # thread, and the engine thread inserts new wasted/shed reasons
+    # lazily — iterating either container live from a scrape thread can
+    # raise "changed size during iteration" (the build_heartbeat race
+    # class, PR 10). stable_list/stable_items retry the snapshot.
+    from langstream_tpu.utils.threadsafe import stable_items, stable_list
+
+    live_engines = stable_list(_LIVE_ENGINES)
     for engine in live_engines:
         stats = engine.stats
         tokens += stats["tokens_generated"]
@@ -169,11 +177,11 @@ def engines_snapshot() -> Dict[str, float]:
         prefix_hits += stats["prefix_hits"]
         prefix_tokens += stats["prefix_tokens_reused"]
         useful_tokens += stats["tokens_useful"]
-        for reason, count in stats["tokens_wasted"].items():
+        for reason, count in stable_items(stats["tokens_wasted"]):
             wasted[reason] = wasted.get(reason, 0) + count
         if engine.queue_timeout_s:
             shed_engines += 1
-        for reason, count in stats.get("requests_shed", {}).items():
+        for reason, count in stable_items(stats.get("requests_shed", {})):
             shed[reason] = shed.get(reason, 0) + count
         decode_flops += stats["decode_flops"]
         decode_bytes += stats["decode_bytes"]
@@ -667,6 +675,9 @@ class DecodeEngine:
                 model_lib.paged_cache_logical_axes(self.kv_quant), self.mesh
             )
             with self.mesh:
+                # device-thread state: rethreaded (donated) through
+                # every dispatch on _run_loop
+                # owned-by: _run_loop
                 self.cache = jax.device_put(
                     model_lib.init_paged_cache(
                         config, self.num_blocks, self.block_size,
@@ -683,6 +694,7 @@ class DecodeEngine:
                 model_lib.cache_logical_axes(self.kv_quant), self.mesh
             )
             with self.mesh:
+                # owned-by: _run_loop
                 self.cache = jax.device_put(
                     model_lib.init_cache(
                         config, max_slots, self.max_seq_len,
@@ -739,7 +751,10 @@ class DecodeEngine:
             )
 
         self._queue: "queue.Queue[Optional[GenerationRequest]]" = queue.Queue()
-        self._pending: List[GenerationRequest] = []
+        # admission backlog, popped only by the device thread (submit()
+        # hands off through the thread-safe queue; len() reads from
+        # other threads are point-in-time snapshots)
+        self._pending: List[GenerationRequest] = []  # owned-by: _run_loop
         self._thread: Optional[threading.Thread] = None
         self._running = False
         self._crashed: Optional[BaseException] = None
@@ -768,19 +783,22 @@ class DecodeEngine:
         self._block_copy_fn: Optional[Any] = None
         # prefill dispatches whose first tokens are not yet harvested
         # (FIFO — the device executes dispatches in order)
-        self._prefill_inflight: List[Dict[str, Any]] = []
+        self._prefill_inflight: List[Dict[str, Any]] = []  # owned-by: _run_loop
         # end of the latest accounted decode interval (busy-time union)
         self._decode_busy_until = 0.0
-        self.stats = self._fresh_stats()
+        # counters mutated only on the device thread; cross-thread
+        # readers (engines_snapshot, build_heartbeat, the watchdog)
+        # take snapshot-tolerant reads — see _stable_items there
+        self.stats = self._fresh_stats()  # owned-by: _run_loop
         # per-chunk dispatch log: (steps, active_slots, wall_seconds) —
         # the occupancy/step-time evidence the bench prints (bounded)
-        self.chunk_log: List[Tuple[int, int, float]] = []
+        self.chunk_log: List[Tuple[int, int, float]] = []  # owned-by: _run_loop
         # token-denominated twin of chunk_log covering EVERY device
         # dispatch (prefill windows included): the interference-bound
         # evidence — in mixed mode no entry's prefill_tokens may exceed
         # prefill_chunk, while a split-path cold prompt logs its whole
         # bucket in one entry (bounded like chunk_log)
-        self.dispatch_log: List[Dict[str, Any]] = []
+        self.dispatch_log: List[Dict[str, Any]] = []  # owned-by: _run_loop
         # multi-host SPMD serving: when set (serving/mirror.py), every
         # device dispatch is also published as a compact record so
         # follower hosts replay the identical jit sequence on their
@@ -854,6 +872,10 @@ class DecodeEngine:
             "decode_token_steps": 0.0,
         }
 
+    # lint: allow(owned-by-violation) -- bench/warmup contract: callers
+    #   reset counters only while the engine is idle (no dispatch in
+    #   flight); a concurrent reset would at worst lose a sample, and
+    #   the replacement dicts/lists are fully formed before publication
     def reset_stats(self) -> None:
         """Zero the counters (e.g. after warmup, before measurement)."""
         self.stats = self._fresh_stats()
@@ -1557,6 +1579,9 @@ class DecodeEngine:
                 )))
         return jobs
 
+    # lint: allow(owned-by-violation) -- pre-traffic by contract (see
+    #   docstring): must run before the engine thread serves requests,
+    #   while the device thread is idle or not yet started
     def precompile(self, workers: int = 4, execute: bool = True) -> None:
         """Compile-and-execute every (bucket, pow2-group-size) prefill
         variant and the decode chunks BEFORE serving traffic. Group sizes
@@ -1634,6 +1659,11 @@ class DecodeEngine:
             raise RuntimeError("decode engine crashed") from self._crashed
         if self._thread is not None:
             return
+        # monotone bool handshake with the loop: start/stop own the
+        # True/False transitions, the loop only reads it (and clears it
+        # on crash exit); a stale read costs one idle-poll iteration
+        # lint: allow(cross-thread-mutation) -- single-word flag store;
+        #   readers tolerate one-iteration staleness by design
         self._running = True
         self._thread = threading.Thread(
             target=self._run_loop, name="jax-local-engine", daemon=True
@@ -4156,6 +4186,11 @@ class DecodeEngine:
     # ------------------------------------------------------------------ #
     # supervisor takeover (runtime/supervisor.py)
     # ------------------------------------------------------------------ #
+    # lint: allow(owned-by-violation) -- supervisor heal arc: runs only
+    #   after the device thread has exited (crash hook fires on the
+    #   dying thread itself) or was condemned + joined (request_restart);
+    #   slot neutralization here fences any wedged zombie that survives
+    #   the join timeout
     def drain_for_recovery(self) -> List[GenerationRequest]:
         """Turn every live session of this (dead or condemned) engine
         into a request the supervisor can resubmit to a rebuilt one.
@@ -4219,6 +4254,9 @@ class DecodeEngine:
         replacement while awaiting GC)."""
         _LIVE_ENGINES.discard(self)
 
+    # lint: allow(owned-by-violation) -- supervisor heal arc: runs on
+    #   the rebuilt engine BEFORE start(), so its device thread does not
+    #   exist yet (no concurrent mutator)
     def absorb_stats(self, previous: Dict[str, Any]) -> None:
         """Carry a crashed predecessor's cumulative counters into this
         engine so every /metrics series stays monotonic across a

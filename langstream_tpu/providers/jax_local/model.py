@@ -1059,6 +1059,7 @@ def _last_token_logits(
     return _logits(config, params, last)
 
 
+# jit: device-context — runs inside the engine's jitted dispatches
 def prefill(
     config: LlamaConfig,
     params: Dict[str, jnp.ndarray],
@@ -1103,6 +1104,7 @@ def prefill(
     return out, _last_token_logits(config, params, x, lengths)
 
 
+# jit: device-context — runs inside the engine's jitted dispatches
 def prefill_at_offset(
     config: LlamaConfig,
     params: Dict[str, jnp.ndarray],
@@ -1222,6 +1224,7 @@ def prefill_at_offset(
     return out, logits
 
 
+# jit: device-context — runs inside the engine's jitted dispatches
 def paged_prefill(
     config: LlamaConfig,
     params: Dict[str, jnp.ndarray],
@@ -1280,6 +1283,7 @@ def paged_prefill(
     return out, _last_token_logits(config, params, x, lengths)
 
 
+# jit: device-context — runs inside the engine's jitted dispatches
 def paged_prefill_at_offset(
     config: LlamaConfig,
     params: Dict[str, jnp.ndarray],
@@ -1382,6 +1386,7 @@ def paged_prefill_at_offset(
     return out, _last_token_logits(config, params, x, lengths)
 
 
+# jit: device-context — runs inside the engine's jitted dispatches
 def paged_decode_step(
     config: LlamaConfig,
     params: Dict[str, jnp.ndarray],
@@ -1484,6 +1489,7 @@ def paged_decode_step(
     return out, logits
 
 
+# jit: device-context — runs inside the engine's jitted dispatches
 def decode_step(
     config: LlamaConfig,
     params: Dict[str, jnp.ndarray],
@@ -1588,6 +1594,7 @@ def _decode_unroll() -> int:
     return max(1, int(os.environ.get("LS_DECODE_UNROLL", "1")))
 
 
+# jit: device-context — runs inside the engine's jitted dispatches
 def verify_step(
     config: LlamaConfig,
     params: Dict[str, jnp.ndarray],
@@ -1704,6 +1711,7 @@ def verify_step(
     return out, _logits(config, params, x)  # [S, B, V]
 
 
+# jit: device-context — runs inside the engine's jitted dispatches
 def paged_verify_step(
     config: LlamaConfig,
     params: Dict[str, jnp.ndarray],
@@ -1808,6 +1816,7 @@ def paged_verify_step(
     return out, _logits(config, params, x)  # [S, B, V]
 
 
+# jit: device-context — runs inside the engine's jitted dispatches
 def paged_mixed_step(
     config: LlamaConfig,
     params: Dict[str, jnp.ndarray],
@@ -1929,6 +1938,7 @@ def paged_mixed_step(
     return out, _logits(config, params, last)  # [S, V]
 
 
+# jit: device-context — runs inside the engine's jitted dispatches
 def apply_layers(
     config: LlamaConfig,
     layer_inputs,          # stacked layer params (from _stack_layer_params),
@@ -1999,6 +2009,7 @@ def apply_layers(
     return x, aux
 
 
+# jit: device-context — runs inside the engine's jitted dispatches
 def forward(
     config: LlamaConfig,
     params: Dict[str, jnp.ndarray],

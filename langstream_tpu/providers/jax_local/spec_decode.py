@@ -47,6 +47,7 @@ import jax
 import jax.numpy as jnp
 
 
+# jit: device-context — runs inside the engine's jitted dispatches
 def draft_ngram(
     history: jnp.ndarray,   # [S, T] int32 — token at cache position t
     lengths: jnp.ndarray,   # [S] valid history INCLUDING the pending token
@@ -162,6 +163,7 @@ def _accept_or_fallback(
     return accept, fallback
 
 
+# jit: device-context — runs inside the engine's jitted dispatches
 def accept_block(
     logits: jnp.ndarray,       # [S, B, V] raw verify logits
     block: jnp.ndarray,        # [S, B] verified tokens (t0 + drafts)

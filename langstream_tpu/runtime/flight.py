@@ -55,12 +55,15 @@ class FlightRecorder:
     ) -> None:
         self.capacity = capacity
         self.flush_interval = flush_interval
-        self.path: Optional[str] = None
-        self.dropped = 0
-        self._pending: Deque[Dict[str, Any]] = deque(maxlen=capacity)
+        # armed-path latch: written once under the lock by configure();
+        # record()'s lock-free read is the zero-cost disabled gate (a
+        # racing enable loses at most the samples of that instant)
+        self.path: Optional[str] = None  # guarded-by: _lock (writes)
+        self.dropped = 0  # guarded-by: _lock
+        self._pending: Deque[Dict[str, Any]] = deque(maxlen=capacity)  # guarded-by: _lock
         self._lock = threading.Lock()
-        self._last_flush = 0.0
-        self._atexit_registered = False
+        self._last_flush = 0.0  # guarded-by: _lock
+        self._atexit_registered = False  # guarded-by: _lock
 
     @property
     def enabled(self) -> bool:

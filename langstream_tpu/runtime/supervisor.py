@@ -74,7 +74,11 @@ def supervisor_gauges() -> Dict[str, float]:
     once one does, the series exist from construction (0 included) —
     rate() alerts need the family BEFORE the first restart, and the
     degraded gauge matters precisely while zero engines are live."""
-    supervisors = list(_ACTIVE)
+    # snapshot-tolerant WeakSet read: supervisors register from the
+    # crash path (the dying engine thread) while scrape threads iterate
+    from langstream_tpu.utils.threadsafe import stable_list
+
+    supervisors = stable_list(_ACTIVE)
     if not supervisors and ENGINE_RESTARTS.value() == 0:
         return {}
     # degraded = actively rebuilding or terminally failed; a cleanly
@@ -117,15 +121,23 @@ class EngineSupervisor:
         self.max_restarts = max(0, int(max_restarts))
         self.restart_window_s = float(restart_window_s)
         self.watchdog_factory = watchdog_factory
-        self.state = "serving"  # serving | rebuilding | failed | stopped
-        self.restarts = 0
-        self.last_recovery_s: Optional[float] = None
-        self._restart_times: Deque[float] = collections.deque()
+        # lifecycle state machine: transitions hold the lock; readers
+        # (accepting(), heartbeats) take lock-free stale-tolerant
+        # snapshots — blocking a 503-availability check behind a
+        # multi-second rebuild held under the lock would freeze every
+        # handler exactly when fast failure matters
+        self.state = "serving"  # guarded-by: _lock (writes)
+        self.restarts = 0  # guarded-by: _lock (writes)
+        self.last_recovery_s: Optional[float] = None  # guarded-by: _lock (writes)
+        self._restart_times: Deque[float] = collections.deque()  # guarded-by: _lock
         self._lock = threading.RLock()
         self.tracer = get_tracer("engine")
-        self._engine = factory()
+        # the engine generation pointer: swapped under the lock by the
+        # heal arc; the serving-surface property reads it lock-free (a
+        # stale engine is condemned and fails fast on submit)
+        self._engine = factory()  # guarded-by: _lock (writes)
         self._engine.on_crash = self._make_crash_hook(self._engine)
-        self.watchdog = self._build_watchdog(self._engine)
+        self.watchdog = self._build_watchdog(self._engine)  # guarded-by: _lock (writes)
         self._engine.start()
         if self.watchdog is not None:
             self.watchdog.start()

@@ -90,17 +90,20 @@ class EngineWatchdog:
         self.capture_min_interval_s = capture_min_interval_s
         self.capture_seconds = capture_seconds
         self.profile_dir = profile_dir
-        self.trips = 0
-        self.baseline_step_s: Optional[float] = None
-        self._baseline_chunks = 0
+        # detector state is confined to the watchdog thread (tests
+        # drive check() synchronously with no thread running — same
+        # single-writer discipline)
+        self.trips = 0  # owned-by: _loop
+        self.baseline_step_s: Optional[float] = None  # owned-by: _loop
+        self._baseline_chunks = 0  # owned-by: _loop
         # (ts, decode_chunks, decode_token_steps, decode_time,
         # prefill_calls) — token_steps is the per-accepted-token
         # normalizer (== decode_steps for a non-speculative engine)
-        self._last: Optional[Tuple[float, int, float, float, int]] = None
-        self._stall_anchor: Optional[float] = None
-        self._livelock_anchor: Optional[float] = None
-        self._last_trip: Dict[str, float] = {}
-        self._last_capture: Optional[float] = None
+        self._last: Optional[Tuple[float, int, float, float, int]] = None  # owned-by: _loop
+        self._stall_anchor: Optional[float] = None  # owned-by: _loop
+        self._livelock_anchor: Optional[float] = None  # owned-by: _loop
+        self._last_trip: Dict[str, float] = {}  # owned-by: _loop
+        self._last_capture: Optional[float] = None  # owned-by: _loop
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # escalation (the supervisor's second detection signal):
@@ -114,8 +117,8 @@ class EngineWatchdog:
         self.escalate_trips = max(1, int(escalate_trips))
         self.escalate_window_s = float(escalate_window_s)
         self.on_escalate: Optional[Any] = None
-        self._trip_times: List[float] = []
-        self._escalated_at: Optional[float] = None
+        self._trip_times: List[float] = []  # owned-by: _loop
+        self._escalated_at: Optional[float] = None  # owned-by: _loop
 
     # ------------------------------------------------------------------ #
     # lifecycle

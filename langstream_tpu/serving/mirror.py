@@ -234,6 +234,10 @@ class DispatchMirror:
                 timeout=self.PUBLISH_TIMEOUT_S,
             )
         except queue.Full:
+            # lint: allow(cross-thread-mutation) -- benign latched
+            #   error: each writer performs a single None→exception
+            #   transition on a word-sized slot; a reader seeing a stale
+            #   None enqueues at most one extra record before failing
             self._error = RuntimeError(
                 f"mirror publish queue full for {self.PUBLISH_TIMEOUT_S:.0f}s"
                 " — follower cannot keep up with the dispatch rate"

@@ -686,29 +686,23 @@ def test_mixed_mirror_replay(tiny):
 def test_tp2_mixed_no_full_pool_collective(tiny):
     """tp=2 acceptance: the mixed dispatch's compiled HLO contains no
     all-gather materializing a full (unsharded) pool block — the
-    sharding constraints hold through the new seam."""
+    sharding constraints hold through the new seam. (Shared rule
+    library: langstream_tpu/analysis/hlo_lint.py.)"""
+    from langstream_tpu.analysis.hlo_lint import (
+        compiled_text,
+        full_pool_allgather_lines,
+        pool_dims,
+    )
     from langstream_tpu.parallel.mesh import MeshConfig
 
     engine = _engine(
         tiny, "mixed", prefill_chunk=16, mesh_config=MeshConfig(tp=2)
     )
     try:
-        config = engine.config
-        full_pool_dims = (
-            f"{engine.num_blocks},{engine.block_size},"
-            f"{config.num_kv_heads},{config.dims_per_head}"
-        )
+        dims = pool_dims(engine)
         for width in engine._mixed_widths:
             fn = engine._get_mixed(width)
-            jobs = [(f, a) for f, a in engine._variant_jobs() if f is fn]
-            assert jobs, "mixed variant missing from the job list"
-            fn, avals = jobs[0]
-            with engine.mesh:
-                text = fn.lower(*avals).compile().as_text()
-            bad = [
-                line for line in text.splitlines()
-                if "all-gather" in line and full_pool_dims in line
-            ]
+            bad = full_pool_allgather_lines(compiled_text(engine, fn), dims)
             assert not bad, (
                 f"tp=2 mixed (width {width}) gathers a full pool "
                 "block:\n" + "\n".join(bad[:4])

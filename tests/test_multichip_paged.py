@@ -225,15 +225,9 @@ def test_engine_tp2_tp1_fused_token_parity():
 
 # ---------------------------------------------------------------------- #
 # compiled HLO: nothing materializes a full (unsharded) pool block
+# (rule library: langstream_tpu/analysis/hlo_lint.py — shared with
+# test_mixed_dispatch / test_paged_kernel and `langstream-tpu check`)
 # ---------------------------------------------------------------------- #
-def _compiled_text(engine, fn):
-    jobs = [(f, a) for f, a in engine._variant_jobs() if f is fn]
-    assert jobs, "variant not in the engine's job list"
-    fn, avals = jobs[0]
-    with engine.mesh:
-        return fn.lower(*avals).compile().as_text()
-
-
 @needs_two_devices
 def test_tp2_dispatches_have_no_full_pool_collective():
     """The multi-chip acceptance check: on the tp=2 mesh the pool shards
@@ -243,25 +237,20 @@ def test_tp2_dispatches_have_no_full_pool_collective():
     ``paged_write_rows`` / ``_get_block_copy`` exist to forbid.
     Activation-level collectives (einsum partials) are expected and not
     flagged."""
+    from langstream_tpu.analysis.hlo_lint import (
+        compiled_text,
+        full_pool_allgather_lines,
+        pool_dims,
+    )
+
     engine = _paged_engine(2, "fused")
     try:
-        config = engine.config
-        # post-SPMD HLO spells shapes with comma-separated dims; the
-        # full (unsharded) per-layer pool is [N, Bs, KVH, D] and the
-        # layer-stacked one [L, N, Bs, KVH, D] — both contain this run
-        full_pool_dims = (
-            f"{engine.num_blocks},{engine.block_size},"
-            f"{config.num_kv_heads},{config.dims_per_head}"
-        )
+        dims = pool_dims(engine)
         for name, fn in (
             ("decode", engine._get_decode(1)),
             ("block_copy", engine._get_block_copy()),
         ):
-            text = _compiled_text(engine, fn)
-            bad = [
-                line for line in text.splitlines()
-                if "all-gather" in line and full_pool_dims in line
-            ]
+            bad = full_pool_allgather_lines(compiled_text(engine, fn), dims)
             assert not bad, (
                 f"tp=2 {name} gathers a full pool block:\n"
                 + "\n".join(bad[:4])

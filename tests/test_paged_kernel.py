@@ -345,29 +345,18 @@ def test_engine_fused_matches_reference_greedy(kv_quant):
         reference.stop()
 
 
-def _lowered_text(engine, fn):
-    """StableHLO text of a jitted engine variant, via the avals
-    _variant_jobs builds (the same avals precompile lowers with)."""
-    jobs = [(f, a) for f, a in engine._variant_jobs() if f is fn]
-    assert jobs, "variant not in the engine's job list"
-    fn, avals = jobs[0]
-    return fn.lower(*avals).as_text()
-
-
 def _pool_gather_lines(engine, text):
-    """Lines gathering the per-layer pool [N, Bs, KVH, D] — the
-    signature of the reference's materialized ``gather_blocks`` copy.
-    Other gathers (embedding lookup, table row lookup) have different
-    operand shapes and don't count."""
-    config = engine.config
-    pool_type = (
-        f"{engine.num_blocks}x{engine.block_size}"
-        f"x{config.num_kv_heads}x{config.dims_per_head}xf32"
+    """Shared HLO rule helpers (langstream_tpu/analysis/hlo_lint.py):
+    lines gathering the per-layer pool [N, Bs, KVH, D] — the signature
+    of the reference's materialized ``gather_blocks`` copy. Other
+    gathers (embedding lookup, table row lookup) have different operand
+    shapes and don't count."""
+    from langstream_tpu.analysis.hlo_lint import (
+        pool_dims,
+        pool_gather_lines,
     )
-    return [
-        line for line in text.splitlines()
-        if "gather" in line and pool_type in line
-    ]
+
+    return pool_gather_lines(text, pool_dims(engine))
 
 
 def test_fused_dispatches_contain_no_pool_gather():
@@ -375,6 +364,8 @@ def test_fused_dispatches_contain_no_pool_gather():
     decode, warm prefill-at-offset, AND cold paged prefill lower without
     a single pool-shaped gather on the fused leg, while every reference
     dispatch carries them (k and v per layer scan)."""
+    from langstream_tpu.analysis.hlo_lint import lowered_text
+
     fused = _paged_engine("fused")
     reference = _paged_engine("reference", interpret=False)
     try:
@@ -385,7 +376,7 @@ def test_fused_dispatches_contain_no_pool_gather():
                 "prefill_offset": engine._get_prefill_offset(16),
             }
             for name, fn in variants.items():
-                lines = _pool_gather_lines(engine, _lowered_text(engine, fn))
+                lines = _pool_gather_lines(engine, lowered_text(engine, fn))
                 if engine is fused:
                     assert not lines, (
                         f"fused {name} still gathers the pool:\n"

@@ -470,6 +470,15 @@ def test_flight_and_metrics_acceptance_evidence(flight_recorder):
     )
 
     flight, path = flight_recorder
+    # the spec gauges sum over every engine still in _LIVE_ENGINES (a
+    # WeakSet): cycle-pinned engines from EARLIER tests linger until a
+    # gc pass and inflate the absolute totals, so collect first and
+    # assert the DELTA this engine contributed (full-suite runs saw
+    # exactly that flake at ~700 tests of gc pressure)
+    import gc
+
+    gc.collect()
+    before = engines_snapshot()
     engine = _engine("ngram", max_seq_len=256, decode_chunk=4)
     engine.start()
     try:
@@ -487,10 +496,16 @@ def test_flight_and_metrics_acceptance_evidence(flight_recorder):
     drafted = engine.stats["tokens_drafted"]
     accepted = engine.stats["tokens_draft_accepted"]
     assert drafted > 0 and accepted > 0
-    assert gauges["spec_tokens_drafted_total"] == float(drafted)
-    assert gauges["spec_tokens_accepted_total"] == float(accepted)
+    total_drafted = gauges["spec_tokens_drafted_total"]
+    total_accepted = gauges["spec_tokens_accepted_total"]
+    assert total_drafted - before.get(
+        "spec_tokens_drafted_total", 0.0
+    ) == float(drafted)
+    assert total_accepted - before.get(
+        "spec_tokens_accepted_total", 0.0
+    ) == float(accepted)
     assert gauges["spec_acceptance_rate"] == pytest.approx(
-        accepted / drafted, abs=1e-4
+        total_accepted / total_drafted, abs=1e-4
     )
     rendered = prometheus_text({}, gauges)
     parsed = parse_prometheus_text(rendered)
@@ -499,7 +514,10 @@ def test_flight_and_metrics_acceptance_evidence(flight_recorder):
         (labels["reason"], value)
         for labels, value in parsed["jax_engine_tokens_wasted_total"]
     )
-    assert wasted["draft_rejected"] == drafted - accepted
+    before_rejected = before.get(
+        'jax_engine_tokens_wasted_total{reason="draft_rejected"}', 0.0
+    )
+    assert wasted["draft_rejected"] - before_rejected == drafted - accepted
 
     chunks = [
         e for e in flight.read_artifact(path)

@@ -101,6 +101,12 @@ SHARDS: Dict[str, List[str]] = {
     "fleet": [
         "test_fleet",
     ],
+    # static analysis (`langstream-tpu check`): lock-discipline +
+    # jit-hazard AST fixtures, the HLO rule library, and the repo-wide
+    # clean-run gate — mostly AST-light with two tiny engine builds
+    "analysis": [
+        "test_analysis",
+    ],
     # compiler, runner, examples, docs — everything else lands here via
     # the catch-all marker (must stay LAST)
     "core-runner": ["*"],
