@@ -8,7 +8,7 @@
 #   --build-arg BASE=python:3.12-slim          (CPU agents)
 #   --build-arg BASE=<jax-tpu base image>      (TPU agents)
 ARG BASE=python:3.12-slim
-FROM ${BASE}
+FROM ${BASE} AS runtime
 
 WORKDIR /app
 
@@ -29,3 +29,27 @@ EXPOSE 8080 8000
 
 ENTRYPOINT ["python", "-m", "langstream_tpu"]
 CMD ["--help"]
+
+# ---------------------------------------------------------------------
+# dev image: the runtime plus the machine-checked-invariant gate wired
+# in as a git pre-commit hook (docs/analysis.md "Pre-commit hook").
+#
+#   docker build --target dev -t langstream-tpu/dev:latest .
+#
+# core.hooksPath is set globally, so ANY checkout mounted/cloned inside
+# the container runs `langstream-tpu check --skip hlo` (lock discipline
+# + jit hazards + retrace budget — seconds, no XLA compile) before a
+# commit lands; CI's `analysis` shard still runs the full HLO matrix.
+FROM runtime AS dev
+COPY tools/githooks /app/tools/githooks
+RUN apt-get update && apt-get install -y --no-install-recommends git \
+    && rm -rf /var/lib/apt/lists/* \
+    && chmod +x /app/tools/githooks/pre-commit \
+    && git config --global core.hooksPath /app/tools/githooks
+CMD ["check", "--skip", "hlo"]
+
+# the DEFAULT build target must stay the runtime image: docker builds
+# the LAST stage when no --target is given, and the documented
+# `docker build -t langstream-tpu/runtime:latest .` (README) must not
+# silently produce the dev image (git + pre-commit hook + check CMD)
+FROM runtime

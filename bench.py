@@ -164,6 +164,24 @@ PREFILL_CHUNK = int(
     or os.environ.get("BENCH_PREFILL_CHUNK", "")
     or "64"
 )
+# Mixed-step carry: on (pipeline consecutive mixed steps off the
+# previous step's device-resident outputs — the default the engine
+# ships) | off (host-built dispatch every step — the control leg that
+# isolates the carry's contribution). Judged on chain rate + host-gap
+# collapse at equal tokens; bitwise-neutral by construction, so this is
+# a pure step-time A/B. Also settable as BENCH_MIXED_CARRY for the heal
+# watcher's bench_heal_mixed_carry.json control leg.
+MIXED_CARRY = (
+    _cli_flag("mixed-carry")
+    or os.environ.get("BENCH_MIXED_CARRY", "")
+    or "on"
+).lower()
+if MIXED_CARRY not in ("on", "off"):
+    print(
+        f"unknown --mixed-carry {MIXED_CARRY!r} (on|off)",
+        file=sys.stderr,
+    )
+    sys.exit(2)
 # Tensor parallelism: chips in the engine's tp mesh (1 = single chip).
 # One flag for the multi-chip legs (--tp 2 / BENCH_TP=2): threaded into
 # the engine's mesh config (engine mode) and the e2e app's `tp` global,
@@ -450,6 +468,7 @@ def emit_failure(reason: str) -> bool:
         paged_kernel=PAGED_KERNEL,
         spec_decode=SPEC_DECODE,
         prefill_mode=PREFILL_MODE,
+        mixed_carry=MIXED_CARRY,
         chaos=CHAOS,
         tp=TP,
         decode_kernel=os.environ.get("LS_DECODE_FLASH", "") or "auto",
@@ -483,6 +502,7 @@ def emit_provisional(metric: str, tok_s: float, **extra) -> None:
         "paged_kernel": PAGED_KERNEL,
         "spec_decode": SPEC_DECODE,
         "prefill_mode": PREFILL_MODE,
+        "mixed_carry": MIXED_CARRY,
         "chaos": CHAOS,
         "tp": TP,
     }
@@ -491,6 +511,33 @@ def emit_provisional(metric: str, tok_s: float, **extra) -> None:
     line.update(extra)
     print(json.dumps(line), flush=True)
     _EMITTED_SUCCESS = True
+
+
+def mixed_carry_extras(stats: dict) -> dict:
+    """Mixed-step-carry evidence columns for artifact records (mixed
+    legs only): chain rate (chained steps / mixed steps — how often the
+    two-step window plan held), total invalidations (why it broke), and
+    the mean host gap between consecutive mixed steps (the per-step
+    host tax the carry hides; ~0 while chains hold). ab_analyze judges
+    the carry-on-vs-off pair on these next to tok/s."""
+    if PREFILL_MODE != "mixed":
+        return {}
+    mixed_steps = stats.get("mixed_steps", 0)
+    chained = stats.get("mixed_steps_chained", 0)
+    invalidations = dict(stats.get("mixed_carry_invalidations", {}))
+    return {
+        "mixed_carry": MIXED_CARRY,
+        "mixed_steps": mixed_steps,
+        "mixed_steps_chained": chained,
+        "mixed_chain_rate": (
+            round(chained / mixed_steps, 4) if mixed_steps else 0.0
+        ),
+        "mixed_carry_invalidations": sum(invalidations.values()),
+        "mixed_host_gap_ms_mean": (
+            round(stats.get("mixed_gap_time", 0.0) / mixed_steps * 1e3, 3)
+            if mixed_steps else 0.0
+        ),
+    }
 
 
 def emit_success(tok_s: float, extras: dict) -> None:
@@ -668,6 +715,7 @@ def run_compile_only() -> int:
         paged_kernel=PAGED_KERNEL,
         prefill_mode=PREFILL_MODE,
         prefill_chunk=PREFILL_CHUNK,
+        mixed_carry=MIXED_CARRY == "on",
         mesh_config=_mesh_config(),
         pipeline_decode=PIPELINE,
     )
@@ -926,6 +974,7 @@ async def run_bench():
         spec_k=SPEC_K,
         prefill_mode=PREFILL_MODE,
         prefill_chunk=PREFILL_CHUNK,
+        mixed_carry=MIXED_CARRY == "on",
         mesh_config=_mesh_config(),
         pipeline_decode=PIPELINE,
     )
@@ -970,6 +1019,7 @@ async def run_bench():
             "tp": TP,
             "chaos": CHAOS,
             "decode_kernel": os.environ.get("LS_DECODE_FLASH", "") or "auto",
+            **mixed_carry_extras(stats),
         })
     finally:
         # release the engine thread + device buffers even on OOM so the
@@ -1060,6 +1110,7 @@ async def run_bench_e2e():
                 "spec-k": SPEC_K,
                 "prefill-mode": PREFILL_MODE,
                 "prefill-chunk": PREFILL_CHUNK,
+                "mixed-carry": MIXED_CARRY,
             },
         }
     }
@@ -1347,6 +1398,7 @@ async def _drive_e2e(runner, gateway, port, get_engine):
         extras["spec_acceptance"] = round(
             extras["spec_accepted"] / drafted, 4
         ) if drafted else 0.0
+    extras.update(mixed_carry_extras(stats))
     emit_success(tok_s, extras)
     return tok_s, extras
 

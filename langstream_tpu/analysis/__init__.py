@@ -1,6 +1,6 @@
 """Static-analysis passes for the serving runtime (`langstream-tpu check`).
 
-Three passes, one Finding vocabulary, one suppression grammar
+Four passes, one Finding vocabulary, one suppression grammar
 (docs/analysis.md):
 
 - :mod:`.lock_discipline` — AST lock/thread-ownership checking driven by
@@ -15,6 +15,10 @@ Three passes, one Finding vocabulary, one suppression grammar
   (no-full-pool-all-gather, no-pool-shaped-gather, donation-respected,
   collective census) shared by the engine-dispatch tests and the
   ``langstream-tpu check`` config-matrix driver.
+- :mod:`.retrace` — the retrace-count budget: every engine dispatch
+  builder returns the identical jit closure per static key (probed
+  twice over ``_variant_jobs`` on tiny never-started engines — a
+  broken memo re-lowers the same program per dispatch).
 
 Every PR since the paged pool landed had re-implemented the HLO scans by
 copy-paste and re-found lock bugs by review; these passes make both
@@ -27,10 +31,12 @@ from langstream_tpu.analysis.common import (  # noqa: F401
 )
 from langstream_tpu.analysis.jit_hazards import run_jit_pass  # noqa: F401
 from langstream_tpu.analysis.lock_discipline import run_lock_pass  # noqa: F401
+from langstream_tpu.analysis.retrace import run_retrace_pass  # noqa: F401
 
 __all__ = [
     "Finding",
     "iter_py_files",
     "run_jit_pass",
     "run_lock_pass",
+    "run_retrace_pass",
 ]

@@ -3,10 +3,11 @@ unsuppressed findings (non-zero exit), so the same invariants that run
 as the CI ``analysis`` shard can gate locally before a push.
 
 Default scope: the installed ``langstream_tpu`` package tree for the two
-AST passes, plus the engine config matrix for the HLO pass. ``--skip
-hlo`` keeps the sub-second AST passes for tight edit loops (the HLO
-matrix jit-compiles ~30 tiny dispatches and takes a couple of minutes
-on CPU).
+AST passes, plus the engine config matrix for the HLO and retrace
+passes. ``--skip hlo`` keeps the fast passes for tight edit loops and
+pre-commit hooks (the HLO matrix jit-compiles ~30 tiny dispatches and
+takes a couple of minutes on CPU; the retrace pass only builds two tiny
+engines and checks builder-memo identity — seconds, never a compile).
 """
 
 from __future__ import annotations
@@ -18,7 +19,7 @@ from typing import Dict, List, Optional
 
 from langstream_tpu.analysis.common import Finding
 
-PASSES = ("lock", "jit", "hlo")
+PASSES = ("lock", "jit", "retrace", "hlo")
 
 
 def _package_root() -> str:
@@ -85,10 +86,12 @@ def run_check(args: argparse.Namespace) -> int:
         from langstream_tpu.analysis.jit_hazards import run_jit_pass
 
         report["jit-hazards"] = run_jit_pass(paths)
-    if "hlo" not in skip:
+    if {"retrace", "hlo"} - skip:
         # the virtual multi-device mesh must be configured BEFORE jax
         # initializes its backend (same dance as tests/conftest.py) so
-        # the tp=2 matrix legs exist off-TPU
+        # the tp=2 matrix legs exist off-TPU — and the retrace pass
+        # builds engines (importing jax) too, so this must run before
+        # EITHER engine-building pass touches jax
         xla_flags = os.environ.get("XLA_FLAGS", "")
         if "xla_force_host_platform_device_count" not in xla_flags:
             os.environ["XLA_FLAGS"] = (
@@ -98,6 +101,18 @@ def run_check(args: argparse.Namespace) -> int:
 
         if args.platform:
             jax.config.update("jax_platforms", args.platform)
+    if "retrace" not in skip:
+        # builder-memo identity over tiny never-started engines: cheap
+        # enough for the pre-commit gate (no lowering, no compile), but
+        # it does import jax — keep it after the pure-AST passes so
+        # their findings print even when the import environment is sick
+        from langstream_tpu.analysis.retrace import run_retrace_pass
+
+        progress = None if args.as_json else (
+            lambda message: print(f"  {message}", flush=True)
+        )
+        report["retrace-budget"] = run_retrace_pass(progress=progress)
+    if "hlo" not in skip:
         from langstream_tpu.analysis.hlo_lint import run_hlo_pass
 
         progress = None if args.as_json else (

@@ -1,0 +1,157 @@
+"""Retrace-count budget rule (PR 13 REMAINING → ISSUE 14 satellite).
+
+Every engine dispatch builder (``_get_decode``, ``_get_mixed``, ...)
+memoizes its jitted closure per static key — ``_get_decode(8)`` must
+return the SAME function object every call. A builder that rebuilds the
+closure hands XLA a fresh Python callable per dispatch: jax's jit cache
+keys on function identity, so the same program is lowered (and, cache
+miss by cache miss, compiled) again and again — a silent serving stall
+that no output ever betrays, because the re-lowered program computes the
+identical thing. The rule name is the budget: ONE lowering per
+(builder, static key).
+
+Two checks, both over the engine's own :meth:`_variant_jobs` contract
+(the single source of truth for what the engine can ever dispatch):
+
+- ``retrace-budget`` per builder accessor: calling the accessor twice
+  with the same static key must return the identical object. A second
+  object is a second static closure over the same dispatch — exactly
+  "lowered more than once with different static closures".
+- ``retrace-budget`` over ``_variant_jobs()`` called twice: the fn in
+  every job slot must be pairwise identical (catches a broken memo in
+  any builder the accessor list does not name, since ``_variant_jobs``
+  calls them all).
+
+The pass builds tiny never-started CPU engines (no jit is ever lowered
+— only Python object identity is inspected), so it runs in seconds and
+rides the pre-commit ``langstream-tpu check --skip hlo`` gate.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from langstream_tpu.analysis.common import Finding
+
+RULE = "retrace-budget"
+
+
+def _builder_probes(engine) -> List[Tuple[str, Callable[[], Any]]]:
+    """(name, zero-arg accessor) per cached dispatch builder this
+    engine configuration actually serves through — mirrors the builder
+    set :meth:`DecodeEngine._variant_jobs` drives."""
+    probes: List[Tuple[str, Callable[[], Any]]] = []
+    if getattr(engine, "mixed", False):
+        for width in engine._mixed_widths:
+            probes.append(
+                (f"_get_mixed({width})",
+                 lambda w=width: engine._get_mixed(w))
+            )
+    else:
+        bucket = min(engine.prefill_buckets)
+        probes.append(
+            (f"_get_prefill({bucket})",
+             lambda b=bucket: engine._get_prefill(b))
+        )
+        probes.append(
+            (f"_get_prefill_offset({bucket})",
+             lambda b=bucket: engine._get_prefill_offset(b))
+        )
+    for steps in sorted({1, engine.decode_chunk}):
+        probes.append(
+            (f"_get_decode({steps})",
+             lambda s=steps: engine._get_decode(s))
+        )
+    if getattr(engine, "paged", False):
+        probes.append(("_get_block_copy()", engine._get_block_copy))
+    elif engine.prefix_cache:
+        bucket = min(engine.prefill_buckets)
+        probes.append(
+            (f"_get_copy_prefix({bucket})",
+             lambda b=bucket: engine._get_copy_prefix(b))
+        )
+    return probes
+
+
+def check_engine(engine, config_name: str = "") -> List[Finding]:
+    """Evaluate the retrace budget against one engine. Pure host-side
+    object-identity checks — nothing is lowered or compiled."""
+    findings: List[Finding] = []
+    prefix = f"{config_name}:" if config_name else ""
+    for name, probe in _builder_probes(engine):
+        first, second = probe(), probe()
+        if first is not second:
+            findings.append(
+                Finding(
+                    RULE, f"<retrace:{prefix}{name}>", 0,
+                    f"{name} returned a NEW jit closure on the second "
+                    "call — the builder memo is broken, so every "
+                    "dispatch re-lowers (and cold-cache recompiles) "
+                    "the same program under a different static closure",
+                )
+            )
+    jobs_a = engine._variant_jobs()
+    jobs_b = engine._variant_jobs()
+    if len(jobs_a) != len(jobs_b):
+        findings.append(
+            Finding(
+                RULE, f"<retrace:{prefix}_variant_jobs>", 0,
+                f"_variant_jobs() is unstable: {len(jobs_a)} jobs on "
+                f"the first call vs {len(jobs_b)} on the second — "
+                "precompile and the HLO lint would cover different "
+                "programs than the ones serving traffic",
+            )
+        )
+        return findings
+    for index, ((fn_a, avals), (fn_b, _)) in enumerate(zip(jobs_a, jobs_b)):
+        if fn_a is not fn_b:
+            shapes = ", ".join(
+                str(getattr(a, "shape", "?")) for a in avals[2:5]
+            )
+            findings.append(
+                Finding(
+                    RULE, f"<retrace:{prefix}job[{index}]>", 0,
+                    f"_variant_jobs()[{index}] (args {shapes}, ...) "
+                    "resolved to a different fn object on the second "
+                    "call — some builder in the job list rebuilds its "
+                    "closure per call and will be lowered more than "
+                    "once for the same static key",
+                )
+            )
+    return findings
+
+
+# the cheap retrace matrix: two engines cover every builder family —
+# dense (bucketed prefill lattice + prefix copy + plain decode) and
+# paged/fused/mixed/spec (mixed width ladder + spec decode scan +
+# block copy). Kept smaller than the HLO matrix on purpose: this pass
+# rides the pre-commit gate, so construction cost is the budget.
+def default_matrix() -> List[Tuple[str, Dict[str, Any]]]:
+    paged = dict(kv_layout="paged", kv_block_size=8)
+    return [
+        ("dense-tp1", {}),
+        ("paged-fused-mixed-spec-tp1",
+         dict(paged, paged_kernel="fused", prefill_mode="mixed",
+              prefill_chunk=16, spec_decode="ngram", spec_k=2)),
+    ]
+
+
+def run_retrace_pass(
+    matrix: Optional[List[Tuple[str, Dict[str, Any]]]] = None,
+    progress: Optional[Callable[[str], None]] = None,
+) -> List[Finding]:
+    """Evaluate the retrace budget across the engine matrix. Engines
+    are constructed but never started and retired from the /metrics
+    registry afterwards (same discipline as the HLO pass)."""
+    from langstream_tpu.analysis.hlo_lint import build_engine
+
+    findings: List[Finding] = []
+    for name, overrides in matrix if matrix is not None else default_matrix():
+        if progress:
+            progress(f"retrace: probing {name}")
+        engine = build_engine(overrides)
+        try:
+            findings.extend(check_engine(engine, config_name=name))
+        finally:
+            engine.retire()
+    return findings

@@ -947,6 +947,13 @@ def build_parser() -> argparse.ArgumentParser:
              "step carries",
     )
     serve.add_argument(
+        "--mixed-carry", default="on", choices=["on", "off"],
+        help="mixed prefill mode: pipeline consecutive mixed steps off "
+             "the previous step's device-resident outputs (two-step "
+             "window plan — hides the per-step host round trip; "
+             "docs/perf.md 'Mixed-step carry')",
+    )
+    serve.add_argument(
         "--spec-decode", default="off", choices=["off", "ngram"],
         help="speculative decoding: self-drafting prompt-lookup drafts "
              "spec-k tokens per decode step, one batched forward "

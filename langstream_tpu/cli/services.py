@@ -290,6 +290,7 @@ async def serve_main(args) -> None:
             "spec-ngram": getattr(args, "spec_ngram", 2),
             "prefill-mode": getattr(args, "prefill_mode", "split"),
             "prefill-chunk": getattr(args, "prefill_chunk", 64),
+            "mixed-carry": getattr(args, "mixed_carry", "on"),
             # decode-stall watchdog: on by default for serve (the
             # provider starts it; --no-watchdog disables)
             "watchdog": not getattr(args, "no_watchdog", False),

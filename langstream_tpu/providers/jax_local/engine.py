@@ -147,12 +147,27 @@ def engines_snapshot() -> Dict[str, float]:
             # mixed path's ≤ width−1 per window — the padding win the
             # chunked-prefill A/B is judged on
             "prefill_padding",
+            # mixed-step carry: tokens a speculatively chained step
+            # sampled for rows whose request had already stopped or
+            # been cancelled by the time the step was host-processed
+            "carry_invalidated",
         )
     }
     shed_engines = 0
     shed: Dict[str, int] = {"queue_timeout": 0}
     spec_engines = 0
     spec_drafted = spec_accepted = 0
+    mixed_engines = 0
+    mixed_chained = 0
+    # mixed-step carry: why speculative chains broke — pre-seeded so
+    # every series exists before the first event (rate() alerts)
+    carry_invalidations: Dict[str, int] = {
+        reason: 0
+        for reason in (
+            "admission", "replay", "budget", "epoch", "condemned",
+            "width", "drained", "stale_row",
+        )
+    }
     decode_flops = decode_bytes = prefill_flops = 0.0
     peaks: Optional[accounting.PeakSpecs] = None
     # snapshot-tolerant reads of engine-thread-owned state: a supervisor
@@ -195,6 +210,15 @@ def engines_snapshot() -> Dict[str, float]:
             spec_engines += 1
             spec_drafted += stats["tokens_drafted"]
             spec_accepted += stats["tokens_draft_accepted"]
+        if getattr(engine, "mixed", False):
+            mixed_engines += 1
+            mixed_chained += stats.get("mixed_steps_chained", 0)
+            for reason, count in stable_items(
+                stats.get("mixed_carry_invalidations", {})
+            ):
+                carry_invalidations[reason] = (
+                    carry_invalidations.get(reason, 0) + count
+                )
         if getattr(engine, "kv_manager", None) is not None:
             paged_engines += 1
             kv_blocks_in_use += engine.kv_manager.blocks_in_use
@@ -236,6 +260,18 @@ def engines_snapshot() -> Dict[str, float]:
         out["spec_acceptance_rate"] = round(
             spec_accepted / spec_drafted, 4
         ) if spec_drafted else 0.0
+    if mixed_engines:
+        # mixed-step carry (prefill_mode: mixed): chained-step counter +
+        # per-reason chain-break counters — exposed from construction so
+        # the carry A/B never scrapes no-data, and a chain rate stuck at
+        # zero (carry off / constant invalidation) is visible without
+        # reading a flight artifact. NOTE process-global gauges: tests
+        # must assert DELTAS, not absolutes (other live engines count).
+        out["jax_engine_mixed_steps_chained_total"] = float(mixed_chained)
+        for reason, count in sorted(carry_invalidations.items()):
+            out[
+                f'mixed_carry_invalidations_total{{reason="{reason}"}}'
+            ] = float(count)
     if shed_engines or any(shed.values()):
         # admission deadlines armed (or sheds already happened): the
         # series must exist BEFORE the first shed so rate() alerts work
@@ -487,6 +523,11 @@ class DecodeEngine:
                                           # fused into the decode step)
         prefill_chunk: int = 64,         # mixed: max prefill tokens any
                                           # single step carries
+        mixed_carry: bool = True,        # mixed: pipeline consecutive
+                                          # mixed steps off the previous
+                                          # step's device-resident
+                                          # outputs (two-step window
+                                          # plan); needs pipeline_decode
         pipeline_decode: bool = False,
         prefix_cache: bool = True,
         logprobs_topk: int = 0,
@@ -627,6 +668,20 @@ class DecodeEngine:
         self.prefill_mode = prefill_mode
         self.mixed = prefill_mode == "mixed"
         self.prefill_chunk = max(1, int(prefill_chunk))
+        # device-resident mixed-step carry (ROADMAP item 1 / ISSUE 14):
+        # while admissions are chunking through mixed steps, the NEXT
+        # step's window content is host-predictable from the watermark
+        # bookkeeping advanced at plan time, so the engine speculatively
+        # plans step N+1 and dispatches it off step N's device-resident
+        # outputs (sampled tokens / cache / counts / tables / sampling
+        # arrays stay on device; only the small prompt-window token
+        # delta uploads) BEFORE host-processing N — hiding the host
+        # round trip exactly like _dispatch_decode(carry=...). Chained
+        # and unchained steps share ONE compiled program per width (the
+        # fresh dispatch passes an all-False chain mask), so chaining
+        # is bitwise-neutral by construction. Gated like decode
+        # pipelining: both knobs must be on.
+        self.mixed_carry = self.mixed and bool(mixed_carry)
         # mixed width ladder: power-of-two [S, W] dispatch widths up to
         # the (rounded-up) budget, so compilations stay logarithmic and
         # every width tiles evenly by the ragged kernel's q tile
@@ -786,6 +841,10 @@ class DecodeEngine:
         self._prefill_inflight: List[Dict[str, Any]] = []  # owned-by: _run_loop
         # end of the latest accounted decode interval (busy-time union)
         self._decode_busy_until = 0.0
+        # end of the latest processed mixed step (host-gap evidence for
+        # the mixed-step carry: unchained steps pay the gap, chained
+        # steps collapse it)  # owned-by: _run_loop
+        self._last_mixed_end = 0.0
         # counters mutated only on the device thread; cross-thread
         # readers (engines_snapshot, build_heartbeat, the watchdog)
         # take snapshot-tolerant reads — see _stable_items there
@@ -870,6 +929,16 @@ class DecodeEngine:
             # decode_steps for plain decode, grows ~(1+accept·k) faster
             # under speculation, so per-token latency stays comparable
             "decode_token_steps": 0.0,
+            # mixed-step carry (prefill_mode: mixed): total mixed steps,
+            # how many were dispatched off the previous step's device
+            # carry, and why chains broke (reason -> events) — the
+            # chain-rate evidence the carry A/B is judged on
+            "mixed_steps": 0,
+            "mixed_steps_chained": 0,
+            "mixed_carry_invalidations": {},
+            # summed device idle between consecutive mixed steps (the
+            # per-step host tax; ~0 while chains hold)
+            "mixed_gap_time": 0.0,
         }
 
     # lint: allow(owned-by-violation) -- bench/warmup contract: callers
@@ -1288,7 +1357,16 @@ class DecodeEngine:
           counted, NO penalties (fresh request), keys from (seed,
           total prompt length);
         - mid-prefill and idle rows discard their sample and leave the
-          count row untouched."""
+          count row untouched.
+
+        Mixed-step carry: the program additionally takes the PREVIOUS
+        step's device-resident sampled tokens plus a host ``chain_mask``
+        and splices them into column 0 of chained rows — a fresh
+        dispatch passes zeros + an all-False mask (integer identity), so
+        chained and unchained steps run the SAME compiled program per
+        width and chaining is bitwise-neutral by construction (the
+        decode carry's contract). The returned ``sampled`` array is the
+        next chain's device-resident token operand."""
         fn = self._mixed_fns.get(width)
         if fn is None:
             config, freqs = self.config, self.freqs
@@ -1299,8 +1377,14 @@ class DecodeEngine:
             @functools.partial(jax.jit, donate_argnums=(1, 9))
             def run(params, cache, tokens, offsets, num_tokens,
                     write_mask, decode_mask, completes, tables, counts,
+                    prev_sampled, chain_mask,
                     temperature, top_k, top_p, presence, frequency,
                     seeds, bias_ids, bias_vals):
+                # chained rows ride the previous mixed step's on-device
+                # sample as their pending token (host never saw it yet)
+                tokens = tokens.at[:, 0].set(
+                    jnp.where(chain_mask, prev_sampled, tokens[:, 0])
+                )
                 cache, logits = model_lib.paged_mixed_step(
                     config, params, cache, tokens, offsets, num_tokens,
                     tables, freqs, write_mask=write_mask, mesh=mesh,
@@ -1567,6 +1651,10 @@ class DecodeEngine:
                         (slots, self.max_blocks), jnp.int32
                     ),
                     counts_aval,
+                    # mixed-step carry operands: the previous step's
+                    # sampled tokens + the chain mask (zeros/False on a
+                    # fresh dispatch — one program serves both)
+                    vec(slots, jnp.int32), vec(slots, jnp.bool_),
                     vec(slots, jnp.float32), vec(slots, jnp.int32),
                     vec(slots, jnp.float32), vec(slots, jnp.float32),
                     vec(slots, jnp.float32), vec(slots, jnp.uint32),
@@ -1838,7 +1926,23 @@ class DecodeEngine:
                         # overlap: chain the next chunk off the device-side
                         # carry BEFORE blocking on this one's tokens
                         chained = None
-                        if self.pipeline_decode and self._can_chain(inflight):
+                        if inflight.get("mixed"):
+                            # mixed-step carry: the next window's content
+                            # is host-predictable from the watermark
+                            # bookkeeping advanced at dispatch, so plan
+                            # step N+1 and dispatch it off N's device
+                            # outputs; any contradiction falls back to
+                            # the host-built dispatch (and is counted)
+                            plan_next = self._plan_mixed_chain(inflight)
+                            if isinstance(plan_next, dict):
+                                chained = self._dispatch_mixed(
+                                    carry=inflight, plan_next=plan_next
+                                )
+                            else:
+                                self._note_carry_invalidation(plan_next)
+                        elif self.pipeline_decode and self._can_chain(
+                            inflight
+                        ):
                             chained = self._dispatch_decode(carry=inflight)
                         self._process_decode(inflight)
                         inflight = chained
@@ -1852,10 +1956,13 @@ class DecodeEngine:
                         self._any_ready() or self._any_admitting()
                     ):
                         inflight = self._dispatch_decode()
-                        if not self.pipeline_decode or inflight.get("mixed"):
-                            # mixed steps are never pipelined: the next
-                            # window's content depends on THIS step's
-                            # completion bookkeeping
+                        if not self.pipeline_decode or (
+                            inflight.get("mixed") and not self.mixed_carry
+                        ):
+                            # unpipelined engines (and mixed engines with
+                            # the carry off) process immediately: the
+                            # next window's content then depends on THIS
+                            # step's completion bookkeeping
                             self._process_decode(inflight)
                             inflight = None
                             self._harvest_prefills(block=False)
@@ -1900,6 +2007,10 @@ class DecodeEngine:
     def _drain_queue(self, block: bool) -> None:
         try:
             if block:
+                # idle: the engine is between busy phases — the next
+                # mixed step's inter-dispatch gap would measure idle
+                # time, not the per-step host tax (see _process_mixed)
+                self._last_mixed_end = 0.0
                 started = time.perf_counter()
                 try:
                     item = self._queue.get(timeout=0.05)
@@ -3455,30 +3566,93 @@ class DecodeEngine:
                 "wall": wall,
             })
 
-    def _dispatch_mixed(self) -> Dict[str, Any]:
-        """Dispatch ONE mixed step: every ready slot rides as a Tq=1
-        decode row, and up to ``prefill_chunk`` prompt tokens from
-        admitting slots ride alongside as prefill windows — one fused
-        token-ragged launch, one weight pass, one bounded dispatch. The
-        budget is shared FIFO by admission order, so an early prompt is
-        never starved by a later burst; a window that reaches its
-        prompt's end samples the request's first token in the same
-        dispatch (no separate harvest)."""
-        faults.check("dispatch_error")
-        faults.maybe_sleep("stuck_step")
-        started = time.perf_counter()
-        slots_n = self.max_slots
+    def _plan_mixed_chain(self, inflight: Dict[str, Any]):
+        """Two-step window plan (mixed-step carry): decide whether the
+        NEXT mixed step is host-predictable from the in-flight one and,
+        if so, name its rows. Window content for step N+1 is derivable
+        at plan time — watermarks advanced deterministically when N was
+        dispatched and ``completes`` is part of N's plan — so the only
+        host-unknown input is N's sampled tokens, which stay on device
+        (:meth:`_get_mixed`'s ``prev_sampled`` operand). Returns a plan
+        dict (``riders`` = rows chained off N's device sample,
+        ``windows`` = prompt windows, ``width``) or the invalidation
+        reason that forces the next dispatch back to host-built:
+
+        - ``admission``: queued/admitted work N's carried sampling
+          arrays don't cover;
+        - ``replay``: a resurrected session completes at N — its next
+          token is teacher-forced, not N's speculated sample;
+        - ``budget``: a rider could finish by length during N;
+        - ``width``: the window ladder changes width at N+1;
+        - ``condemned``: the supervisor condemned this engine;
+        - ``drained``: no windows remain — the mixed phase is over and
+          plain (decode-carry-chainable) chunks take back over;
+        - ``epoch``: a carried row's slot was recycled (paranoia guard).
+        """
+        if not self._running or self._crashed is not None:
+            return "condemned"
+        if self._pending or self._prefill_inflight:
+            return "admission"
+        prev_plan = inflight["plan"]
+        prev_completes = inflight["completes"]
+        prev_decode = inflight["decode_mask"]
+        prev_offsets = inflight["offsets"]
+        prev_num = inflight["num_tokens"]
+        epochs = inflight["epochs"]
+        riders: List[int] = []
+        admitting: List[int] = []
+        for i, slot in enumerate(self.slots):
+            carried = prev_decode[i] or (i in prev_plan)
+            if slot.request is None:
+                if carried:
+                    return "epoch"
+                continue
+            if slot.epoch != epochs[i]:
+                # the slot acquired a request AFTER the in-flight step
+                # was planned — its sampling params are not in the
+                # carried device arrays
+                return "admission" if not carried else "epoch"
+            if prev_decode[i] or (i in prev_plan and prev_completes[i]):
+                if i in prev_plan and slot.request.replay_tokens:
+                    return "replay"
+                riders.append(i)
+            elif slot.prefill_pos is not None:
+                admitting.append(i)
+        for i in riders:
+            slot = self.slots[i]
+            # the speculated step emits one more token per rider on top
+            # of the in-flight one: require room for both, so a rider
+            # can only ever finish mid-chain by a (host-unpredictable)
+            # stop/cancel — never by length (the _can_chain rule)
+            generated = len(slot.generated) if slot.generated else 0
+            if generated + 2 > slot.request.sampling.max_new_tokens:
+                return "budget"
+            if int(prev_offsets[i]) + int(prev_num[i]) + 2 >= self.max_seq_len:
+                return "budget"
+        windows, width = self._plan_windows(admitting)
+        if not windows:
+            return "drained"
+        if width != inflight["width"]:
+            # chain only across equal-width steps: the speculative
+            # dispatch reuses the in-flight step's exact compiled
+            # variant, and a ladder transition costs one host round
+            # trip instead of a mid-stream variant swap
+            return "width"
+        return {"riders": riders, "windows": windows, "width": width}
+
+    def _plan_windows(
+        self, admitting: List[int]
+    ) -> Tuple[Dict[int, Tuple[int, int]], int]:
+        """FIFO token-budget window plan over admitting slot indices:
+        ``{slot: (pos, n)}`` plus the pow2 dispatch width. ONE
+        implementation serves the fresh dispatch AND the two-step chain
+        plan — the chained ≡ unchained bitwise contract depends on the
+        two schedules never diverging, so there must be nothing to keep
+        in lockstep."""
         budget = self.prefill_chunk
-        admitting = sorted(
-            (
-                i for i, s in enumerate(self.slots)
-                if s.prefill_pos is not None and s.request is not None
-            ),
-            key=lambda i: self.slots[i].prefill_seq,
-        )
-        plan: Dict[int, Tuple[int, int]] = {}
+        windows: Dict[int, Tuple[int, int]] = {}
         max_n = 1
-        for i in admitting:
+        for i in sorted(admitting, key=lambda i: self.slots[i].prefill_seq):
             if budget <= 0:
                 break
             slot = self.slots[i]
@@ -3486,10 +3660,52 @@ class DecodeEngine:
             n = min(remaining, budget)
             if n <= 0:
                 continue
-            plan[i] = (slot.prefill_pos, n)
+            windows[i] = (slot.prefill_pos, n)
             budget -= n
             max_n = max(max_n, n)
         width = next(w for w in self._mixed_widths if w >= max_n)
+        return windows, width
+
+    def _note_carry_invalidation(self, reason: str, events: int = 1) -> None:
+        invalidations = self.stats["mixed_carry_invalidations"]
+        invalidations[reason] = invalidations.get(reason, 0) + events
+
+    def _dispatch_mixed(
+        self,
+        carry: Optional[Dict[str, Any]] = None,
+        plan_next: Optional[Dict[str, Any]] = None,
+    ) -> Dict[str, Any]:
+        """Dispatch ONE mixed step: every ready slot rides as a Tq=1
+        decode row, and up to ``prefill_chunk`` prompt tokens from
+        admitting slots ride alongside as prefill windows — one fused
+        token-ragged launch, one weight pass, one bounded dispatch. The
+        budget is shared FIFO by admission order, so an early prompt is
+        never starved by a later burst; a window that reaches its
+        prompt's end samples the request's first token in the same
+        dispatch (no separate harvest).
+
+        With ``carry`` (the in-flight previous step's record) and
+        ``plan_next`` (from :meth:`_plan_mixed_chain`), the step chains
+        on-device: riders take the previous step's device-resident
+        sample as their pending token, tables and sampling arrays are
+        reused from the carry, and only the small prompt-window token
+        delta uploads — no host round trip between consecutive mixed
+        steps, exactly like ``_dispatch_decode(carry=...)``."""
+        faults.check("dispatch_error")
+        faults.maybe_sleep("stuck_step")
+        started = time.perf_counter()
+        slots_n = self.max_slots
+        chained = carry is not None
+        if chained:
+            plan = plan_next["windows"]
+            riders = plan_next["riders"]
+            width = plan_next["width"]
+        else:
+            plan, width = self._plan_windows([
+                i for i, s in enumerate(self.slots)
+                if s.prefill_pos is not None and s.request is not None
+            ])
+            riders = [i for i, s in enumerate(self.slots) if s.ready]
 
         tokens = np.zeros((slots_n, width), dtype=np.int32)
         offsets = np.zeros((slots_n,), dtype=np.int32)
@@ -3497,79 +3713,134 @@ class DecodeEngine:
         write_mask = np.zeros((slots_n,), dtype=bool)
         decode_mask = np.zeros((slots_n,), dtype=bool)
         completes = np.zeros((slots_n,), dtype=bool)
-        temperature = np.zeros((slots_n,), dtype=np.float32)
-        top_k = np.zeros((slots_n,), dtype=np.int32)
-        top_p = np.zeros((slots_n,), dtype=np.float32)
-        seeds = np.zeros((slots_n,), dtype=np.uint32)
-        requests: List[Optional[GenerationRequest]] = [None] * slots_n
-        epochs = [0] * slots_n
+        chain_mask = np.zeros((slots_n,), dtype=bool)
+        epochs = [slot.epoch for slot in self.slots]
         kv_tokens = 0          # decode rows' (block-padded) context reads
         prefill_kv_tokens = 0  # windows' prefix+window reads
         prefill_tokens = 0
         padding = 0
-        for i, slot in enumerate(self.slots):
-            epochs[i] = slot.epoch
-            if i in plan:
-                pos, n = plan[i]
-                prompt = slot.request.prompt_tokens
-                tokens[i, :n] = prompt[pos:pos + n]
-                offsets[i] = pos
-                num_tokens[i] = n
-                write_mask[i] = True
-                completes[i] = pos + n == len(prompt)
-                requests[i] = slot.request
-                prefill_tokens += n
-                padding += width - n
-                prefill_kv_tokens += self.cost_model.kv_read_tokens(pos + n)
-            elif slot.ready:
+        for i, (pos, n) in plan.items():
+            slot = self.slots[i]
+            prompt = slot.request.prompt_tokens
+            tokens[i, :n] = prompt[pos:pos + n]
+            offsets[i] = pos
+            num_tokens[i] = n
+            write_mask[i] = True
+            completes[i] = pos + n == len(prompt)
+            prefill_tokens += n
+            padding += width - n
+            prefill_kv_tokens += self.cost_model.kv_read_tokens(pos + n)
+        for i in riders:
+            slot = self.slots[i]
+            if chained:
+                # pending token = the in-flight step's device-resident
+                # sample (spliced in-jit via prev_sampled); next cache
+                # position = the in-flight row's offset + its count
+                chain_mask[i] = True
+                offsets[i] = int(carry["offsets"][i]) + int(
+                    carry["num_tokens"][i]
+                )
+            else:
                 tokens[i, 0] = slot.history[-1]
                 offsets[i] = slot.length
-                num_tokens[i] = 1
-                write_mask[i] = True
-                decode_mask[i] = True
-                requests[i] = slot.request
-                kv_tokens += self.cost_model.kv_read_tokens(slot.length + 1)
-            else:
-                continue
-            request = requests[i]
-            temperature[i] = request.sampling.temperature
-            top_k[i] = request.sampling.top_k
-            top_p[i] = request.sampling.top_p
-            seeds[i] = self._request_seed(request)
-        # advance the taught watermarks NOW: mixed steps are processed
-        # before the next one is built, and the window content is final
+            num_tokens[i] = 1
+            write_mask[i] = True
+            decode_mask[i] = True
+            kv_tokens += self.cost_model.kv_read_tokens(int(offsets[i]) + 1)
+        # advance the taught watermarks NOW: the window content is final
+        # once dispatched, and the NEXT step's plan (chained or fresh)
+        # derives from the advanced bookkeeping
         for i, (pos, n) in plan.items():
             self.slots[i].prefill_pos = pos + n
-        presence, frequency = self._penalty_arrays(self.slots)
-        bias_ids, bias_vals = self._bias_rows(requests)
+        # telemetry snapshot AT DISPATCH (the decode-path rule): with
+        # the carry, this step is processed only after the previous
+        # one's processing may have finished a rider and recycled its
+        # slot — live-slot reads at processing time would attribute the
+        # step to a request whose tokens were never in it
+        trace_ids = ""
+        if self.tracer.enabled or flight.RECORDER.enabled:
+            trace_ids = ",".join(
+                self.slots[i].request.trace_id
+                for i in riders
+                if self.slots[i].request is not None
+                and self.slots[i].request.trace_id
+            )
         # goodput: ghost positions the padded [S, W] grid computes for a
         # short window — the mixed analogue of bucket padding, capped at
         # width−1 per admitting row per step (vs up to ~bucket/2 − 1 per
         # PROMPT on the split path)
         self._waste("prefill_padding", padding)
+        if chained:
+            # device-resident carry: tables, sampling arrays, and the
+            # previous sample never leave the device — only the window
+            # token delta above uploads
+            tables_dev = carry["tables_dev"]
+            sampling_dev = carry["sampling_dev"]
+            prev_sampled = carry["sampled"]
+        else:
+            # sampling params are per-request constants, filled for
+            # EVERY live row (planned or not) so a chained step can
+            # reuse these device arrays verbatim even when the FIFO
+            # budget reaches a row this step skipped
+            temperature = np.zeros((slots_n,), dtype=np.float32)
+            top_k = np.zeros((slots_n,), dtype=np.int32)
+            top_p = np.zeros((slots_n,), dtype=np.float32)
+            seeds = np.zeros((slots_n,), dtype=np.uint32)
+            requests: List[Optional[GenerationRequest]] = [None] * slots_n
+            for i, slot in enumerate(self.slots):
+                request = slot.request
+                if request is None:
+                    continue
+                requests[i] = request
+                temperature[i] = request.sampling.temperature
+                top_k[i] = request.sampling.top_k
+                top_p[i] = request.sampling.top_p
+                seeds[i] = self._request_seed(request)
+            presence, frequency = self._penalty_arrays(self.slots)
+            bias_ids, bias_vals = self._bias_rows(requests)
+            sampling_dev = tuple(
+                jnp.asarray(a) for a in (
+                    temperature, top_k, top_p, presence, frequency,
+                    seeds, bias_ids, bias_vals,
+                )
+            )
+            tables_dev = jnp.asarray(self._block_tables)
+            prev_sampled = np.zeros((slots_n,), dtype=np.int32)
         host_args = [
             tokens, offsets, num_tokens, write_mask, decode_mask,
-            completes, self._block_tables,
-        ]
-        sampling_args = [
-            temperature, top_k, top_p, presence, frequency, seeds,
-            bias_ids, bias_vals,
+            completes,
         ]
         if self.mirror is not None:
             self._check_mirror_layout()
-            # mixed records carry per-row token counts (offsets /
-            # num_tokens / the mask trio) in dispatch-arg position —
-            # small int32/bool host metadata, like the table rows
-            self.mirror.publish(
-                "mixed", {"width": width}, [*host_args, *sampling_args]
-            )
+            if chained:
+                # chained records carry ONLY the window-delta metadata:
+                # followers reuse tables/sampling/the previous sample
+                # from their own carry — same contract as chained
+                # decode, whose records carry nothing at all
+                self.mirror.publish(
+                    "mixed_chained", {"width": width},
+                    [*host_args, chain_mask],
+                )
+            else:
+                # mixed records carry per-row token counts (offsets /
+                # num_tokens / the mask trio) in dispatch-arg position —
+                # small int32/bool host metadata, like the table rows
+                self.mirror.publish(
+                    "mixed", {"width": width},
+                    [
+                        *host_args, self._block_tables, prev_sampled,
+                        chain_mask,
+                        *(np.asarray(a) for a in sampling_dev),
+                    ],
+                )
         run = self._get_mixed(width)
         self.cache, self._counts, sampled, lps, tops = run(
-            self.params, self.cache, *host_args, self._counts,
-            *sampling_args,
+            self.params, self.cache, *host_args, tables_dev,
+            self._counts, prev_sampled, chain_mask, *sampling_dev,
         )
         return {
             "mixed": True,
+            "chained": chained,
             "width": width,
             "plan": plan,
             "sampled": sampled,
@@ -3577,6 +3848,10 @@ class DecodeEngine:
             "out_tops": tops,
             "decode_mask": decode_mask,
             "completes": completes,
+            "offsets": offsets,
+            "num_tokens": num_tokens,
+            "sampling_dev": sampling_dev,
+            "tables_dev": tables_dev,
             "epochs": epochs,
             "steps": 1,
             "started": started,
@@ -3585,6 +3860,7 @@ class DecodeEngine:
             "prefill_tokens": prefill_tokens,
             "n_decode": int(decode_mask.sum()),
             "queue_depth": len(self._pending),
+            "trace_ids": trace_ids,
         }
 
     def _process_mixed(self, inflight: Dict[str, Any]) -> None:
@@ -3606,6 +3882,19 @@ class DecodeEngine:
         self.stats["decode_steps"] += 1
         self.stats["decode_chunks"] += 1
         self.stats["decode_token_steps"] += 1.0
+        self.stats["mixed_steps"] += 1
+        if inflight.get("chained"):
+            self.stats["mixed_steps_chained"] += 1
+        # host-gap evidence: device idle between the previous mixed
+        # step's host processing and this step's dispatch — ~0 for
+        # chained steps (dispatched before the previous harvest), the
+        # per-step host tax for unchained ones (what the carry hides)
+        gap_ms = (
+            max(0.0, inflight["started"] - self._last_mixed_end) * 1e3
+            if self._last_mixed_end else 0.0
+        )
+        self._last_mixed_end = ended
+        self.stats["mixed_gap_time"] += gap_ms / 1e3
         self.stats["active_slot_steps"] += n_decode
         self.stats["decode_time"] += max(
             0.0, ended - max(inflight["started"], self._decode_busy_until)
@@ -3639,16 +3928,11 @@ class DecodeEngine:
             MFU_PER_CHUNK.observe(mfu)
             MBU_PER_CHUNK.observe(mbu)
         if self.tracer.enabled or flight.RECORDER.enabled:
-            trace_ids = ",".join(
-                slot.request.trace_id
-                for i, slot in enumerate(self.slots)
-                if decode_mask[i] and slot.active and slot.request.trace_id
-            )
             self.tracer.event(
                 "engine.decode_chunk",
                 wall,
                 start_wall=time.time() - wall,
-                trace_ids=trace_ids,
+                trace_ids=inflight["trace_ids"],
                 steps=1,
                 active=n_decode,
                 step_ms=round(wall * 1e3, 3),
@@ -3676,15 +3960,27 @@ class DecodeEngine:
                 prefix_hit_tokens=self.kv_manager.stats["hit_tokens"],
                 # mixed-dispatch series: how much prompt work rode this
                 # step (ab_analyze reads these next to step_ms — the
-                # stall-free-batching evidence)
+                # stall-free-batching evidence); `chained`/`gap_ms` are
+                # the carry's pipelining proof (chained steps overlap
+                # the previous harvest, so their gap collapses to ~0)
                 mixed=1,
                 width=inflight["width"],
                 prefill_rows=len(plan),
                 prefill_tokens=prefill_toks,
+                chained=1 if inflight.get("chained") else 0,
+                gap_ms=round(gap_ms, 3),
             )
         emit_started = time.perf_counter()
+        stale_rows = 0
         for i, slot in enumerate(self.slots):
             if slot.epoch != inflight["epochs"][i] or not slot.active:
+                if inflight.get("chained") and (
+                    decode_mask[i] or (i in plan and completes[i])
+                ):
+                    # the speculated step sampled for a row whose
+                    # request stopped/was cancelled while it was in
+                    # flight — bill the discarded work to the ledger
+                    stale_rows += 1
                 continue
             top = (
                 (tops[0][i].tolist(), tops[1][i].tolist())
@@ -3733,6 +4029,9 @@ class DecodeEngine:
                     self._emit_token(
                         i, int(sampled[i]), float(lps[i]), top=top
                     )
+        if stale_rows:
+            self._waste("carry_invalidated", stale_rows)
+            self._note_carry_invalidation("stale_row", stale_rows)
         self.stats["emit_time"] += time.perf_counter() - emit_started
         # chaos: deterministic engine-thread death AFTER this step's
         # tokens reached their callers (same point as _process_decode)
@@ -3741,6 +4040,9 @@ class DecodeEngine:
     def _process_decode(self, inflight: Dict[str, Any]) -> None:
         if inflight.get("mixed"):
             return self._process_mixed(inflight)
+        # a plain chunk ends any contiguous mixed phase: the next mixed
+        # step's gap should not span the decode chunks in between
+        self._last_mixed_end = 0.0
         steps = inflight["steps"]
         active = inflight["active"]
         spec = self.spec

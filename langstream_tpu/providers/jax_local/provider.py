@@ -192,6 +192,12 @@ class JaxCompletionsService(CompletionsService):
                 engine_config.get("prefill-mode") or "split"
             ).lower(),
             prefill_chunk=int(engine_config.get("prefill-chunk") or 64),
+            # mixed-step carry: pipeline consecutive mixed steps off the
+            # previous step's device-resident outputs (on by default —
+            # bitwise-neutral; the A/B knob isolates its contribution)
+            mixed_carry=str(
+                engine_config.get("mixed-carry", "on")
+            ).lower() not in ("0", "false", "no", "off"),
             pipeline_decode=str(
                 engine_config.get("pipeline-decode", "")
             ).lower() in ("1", "true", "yes"),

@@ -37,6 +37,12 @@ at >8-chip scale):
   sampling arrays — the follower enters the same ``_get_mixed(width)``
   jit with identical args, so the chunked-prefill schedule host 0
   chose is baked into the stream like every other timing decision.
+- the mixed-step carry chains too: a ``mixed_chained`` record carries
+  ONLY the window-delta metadata (token windows + per-row counts +
+  masks) — the follower reuses tables/sampling arrays and the previous
+  step's sampled tokens from its own mixed carry, which hold identical
+  values by SPMD determinism (the ``decode_chained`` contract, plus the
+  small host-predictable delta the mixed step inherently needs).
 
 Transport is a length-prefixed JSON-header + raw-array-bytes frame
 stream over TCP (deliberately NOT pickle — nothing executable crosses
@@ -292,6 +298,10 @@ class FollowerExecutor:
         # (final_tokens, final_lengths, active_arg, tables, sampling)
         # — tables is None on dense engines
         self._carry: Optional[Tuple[Any, Any, Any, Any, tuple]] = None
+        # previous mixed-step output, for mixed_chained records:
+        # (sampled, tables, sampling) — the device-resident operands a
+        # chained mixed record deliberately does not carry
+        self._mixed_carry: Optional[Tuple[Any, Any, tuple]] = None
         self.records = 0
 
     def connect(
@@ -363,14 +373,30 @@ class FollowerExecutor:
             elif kind == "mixed":
                 # mixed prefill+decode step (prefill_mode: mixed): the
                 # record carries per-row token counts + the mask trio +
-                # the full block tables in dispatch-arg position; the
-                # sampled tokens are host-0 outputs and are dropped here
-                # like every other dispatch's
+                # the full block tables + carry operands in dispatch-arg
+                # position; the sampled tokens become this process's
+                # mixed carry (identical to host 0's by SPMD
+                # determinism) so mixed_chained records can chain
                 run = engine._get_mixed(meta["width"])
-                engine.cache, engine._counts, _, _, _ = run(
+                engine.cache, engine._counts, sampled, _, _ = run(
                     engine.params, engine.cache, *arrays[:7],
                     engine._counts, *arrays[7:],
                 )
+                # arrays: 0-5 window/count metadata, 6 tables,
+                # 7 prev_sampled, 8 chain_mask, 9.. sampling arrays
+                self._mixed_carry = (
+                    sampled, arrays[6], tuple(arrays[9:])
+                )
+            elif kind == "mixed_chained":
+                assert self._mixed_carry is not None, \
+                    "chained mixed step before any mixed step"
+                prev_sampled, tables, sampling = self._mixed_carry
+                run = engine._get_mixed(meta["width"])
+                engine.cache, engine._counts, sampled, _, _ = run(
+                    engine.params, engine.cache, *arrays[:6], tables,
+                    engine._counts, prev_sampled, arrays[6], *sampling,
+                )
+                self._mixed_carry = (sampled, tables, sampling)
             elif kind == "decode":
                 tokens, lengths, active = arrays[:3]
                 tables = arrays[3] if extra else None

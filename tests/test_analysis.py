@@ -494,6 +494,69 @@ def test_named_dispatches_cover_the_serving_surface(engine_pair):
 
 
 # ---------------------------------------------------------------------- #
+# retrace-count budget (analysis/retrace.py)
+# ---------------------------------------------------------------------- #
+def test_retrace_budget_negative_and_positive(engine_pair, monkeypatch):
+    """Negative: a healthy engine's builders are memo-stable (zero
+    findings). Positive: a builder whose memo is broken — the closure
+    is rebuilt per call, so the same dispatch would be lowered more
+    than once under different static closures — is flagged both by the
+    direct probe and by the _variant_jobs stability sweep."""
+    import functools
+
+    from langstream_tpu.analysis import retrace
+
+    fused, _ = engine_pair
+    assert retrace.check_engine(fused, config_name="fused") == []
+
+    class BrokenMemo:
+        """Proxy whose _get_decode forgets its memo (fresh closure per
+        call) — the exact bug class the budget exists to catch."""
+
+        def __init__(self, engine):
+            self._engine = engine
+
+        def __getattr__(self, name):
+            return getattr(self._engine, name)
+
+        def _get_decode(self, steps):
+            return functools.partial(self._engine._get_decode(steps))
+
+        def _variant_jobs(self):
+            return self._engine._variant_jobs()
+
+    findings = retrace.check_engine(BrokenMemo(fused), config_name="broken")
+    assert findings
+    assert all(f.rule == "retrace-budget" for f in findings)
+    assert any("_get_decode" in f.path for f in findings)
+
+    # _variant_jobs-level instability (a memo the probe list does not
+    # name): clearing the block-copy memo before each call makes the
+    # job list resolve to a different fn object per sweep
+    original = fused._get_block_copy
+
+    def amnesiac():
+        fused._block_copy_fn = None
+        return original()
+
+    monkeypatch.setattr(fused, "_get_block_copy", amnesiac)
+    findings = retrace.check_engine(fused, config_name="amnesiac")
+    monkeypatch.undo()
+    fused._block_copy_fn = None  # drop the poisoned memo for later tests
+    assert any("job[" in f.path or "_get_block_copy" in f.path
+               for f in findings)
+
+
+def test_retrace_pass_repo_clean():
+    """The repo gate: every builder across the retrace matrix (dense +
+    paged/fused/mixed/spec — all builder families) holds the one-
+    lowering-per-static-key budget."""
+    from langstream_tpu.analysis.retrace import run_retrace_pass
+
+    assert run_retrace_pass() == []
+
+
+# ---------------------------------------------------------------------- #
 # the true-positive fix: snapshot-tolerant cross-thread reads
 # ---------------------------------------------------------------------- #
 class _FlakyDict(dict):
@@ -632,18 +695,21 @@ def test_check_cli_gates_on_findings(tmp_path):
                 self._items.append(1)
     """)
     parser = build_parser()
-    assert run_check(parser.parse_args([dirty, "--skip", "hlo"])) == 1
-    assert run_check(parser.parse_args([PKG, "--skip", "hlo"])) == 0
+    # --skip retrace keeps these CLI-contract checks AST-only (the
+    # retrace pass builds engines; it has its own tests below)
+    fast = ["--skip", "hlo", "--skip", "retrace"]
+    assert run_check(parser.parse_args([dirty, *fast])) == 1
+    assert run_check(parser.parse_args([PKG, *fast])) == 0
     assert run_check(
-        parser.parse_args([dirty, "--skip", "hlo", "--json"])
+        parser.parse_args([dirty, *fast, "--json"])
     ) == 1
     # a typo'd path must fail loudly, never gate CLEAN over zero files
     assert run_check(
-        parser.parse_args([str(tmp_path / "nope"), "--skip", "hlo"])
+        parser.parse_args([str(tmp_path / "nope"), *fast])
     ) == 2
     # ... and so must an existing directory with no Python in it
     empty = tmp_path / "empty"
     empty.mkdir()
     assert run_check(
-        parser.parse_args([str(empty), "--skip", "hlo"])
+        parser.parse_args([str(empty), *fast])
     ) == 2

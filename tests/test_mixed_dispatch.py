@@ -289,54 +289,17 @@ async def _drive(engine):
     return first.tokens, second.tokens, third.tokens
 
 
-@pytest.mark.parametrize("kv_quant", [None, "int8"])
-def test_engine_mixed_matches_split(tiny, kv_quant):
-    """THE acceptance A/B: greedy AND seeded (penalties, truncation,
-    per-request seeds) outputs bitwise-match the split-path oracle on
-    bf16 and int8 pools, through cold chunked admission, a prefix-cache
-    hit, and decode."""
-    mixed = _engine(tiny, "mixed", kv_quant=kv_quant)
-    split = _engine(tiny, "split", kv_quant=kv_quant)
-    mixed.start()
-    split.start()
-    try:
-        assert asyncio.run(_drive(mixed)) == asyncio.run(_drive(split))
-        # the mixed leg actually served through the prefix pool
-        assert mixed.kv_manager.stats["hit_tokens"] >= 32
-        # ...and through mixed dispatches, not hidden split prefills
-        assert any(
-            d["kind"] == "mixed" for d in mixed.dispatch_log
-        )
-        assert not any(
-            d["kind"] == "prefill" for d in mixed.dispatch_log
-        )
-    finally:
-        mixed.stop()
-        split.stop()
-
-
-def test_engine_mixed_matches_split_reference_kernel(tiny):
-    """Same A/B on the gather/scatter reference kernel: the mixed
-    scheduler must not depend on the fused launch being available
-    (CPU-sans-interpret deployments resolve to reference)."""
-    mixed = _engine(tiny, "mixed", kernel="reference")
-    split = _engine(tiny, "split", kernel="reference")
-    assert mixed.paged_kernel == "reference"
-    mixed.start()
-    split.start()
-    try:
-        assert asyncio.run(_drive(mixed)) == asyncio.run(_drive(split))
-    finally:
-        mixed.stop()
-        split.stop()
-
-
-def test_engine_mixed_mid_decode_admission_and_stop_parity(tiny):
-    """One engine pair, two scheduling edges: (a) a long cold prompt
-    admitted while another stream decodes — the interference case the
-    tentpole exists for; (b) a mid-stream stop token hit during an
-    admission window (surplus positions discarded, stop excluded from
-    the history). Tokens must match the split oracle exactly."""
+def test_engine_mixed_matches_split(tiny):
+    """THE acceptance A/B on ONE bf16 engine pair (tier-1 wall-clock:
+    one construction, four traffic phases — the int8 pool leg keeps
+    its own pair below): greedy AND seeded (penalties, truncation,
+    per-request seeds) outputs bitwise-match the split-path oracle
+    through (1) cold chunked admission + a 32-token prefix hit +
+    decode, (2) a long cold prompt admitted mid-decode (the
+    interference case the tentpole exists for), (3) a mid-stream stop
+    hit during an admission window (surplus discarded, stop excluded
+    from history), (4) a ≥256-token prefix-cache hit whose windows
+    resume AT the matched offset."""
 
     async def contended(engine):
         t1 = asyncio.ensure_future(
@@ -361,29 +324,9 @@ def test_engine_mixed_mid_decode_admission_and_stop_parity(tiny):
         )
         return result.tokens, result.finish_reason
 
-    mixed = _engine(tiny, "mixed")
-    split = _engine(tiny, "split")
-    mixed.start()
-    split.start()
-    try:
-        assert asyncio.run(contended(mixed)) == asyncio.run(
-            contended(split)
-        )
-        got_mixed = asyncio.run(stopped(mixed))
-        assert got_mixed == asyncio.run(stopped(split))
-        assert got_mixed[1] == "stop"
-    finally:
-        mixed.stop()
-        split.stop()
-
-
-def test_engine_mixed_prefix_hit_256(tiny):
-    """≥256-token prefix-cache hit through the mixed path: the second
-    prompt's windows resume AT the matched offset (acceptance
-    criterion), with bitwise token parity against split."""
     shared = list(np.arange(280) % 250 + 1)
 
-    async def run(engine):
+    async def prefix256(engine):
         first = await engine.generate(shared + [7, 8], GREEDY)
         second = await engine.generate(shared + [9, 10, 11], GREEDY)
         return first.tokens, second.tokens
@@ -393,17 +336,72 @@ def test_engine_mixed_prefix_hit_256(tiny):
     mixed.start()
     split.start()
     try:
-        assert asyncio.run(run(mixed)) == asyncio.run(run(split))
+        assert asyncio.run(_drive(mixed)) == asyncio.run(_drive(split))
+        # the mixed leg actually served through the prefix pool
+        assert mixed.kv_manager.stats["hit_tokens"] >= 32
+        # ...and through mixed dispatches, not hidden split prefills
+        assert any(
+            d["kind"] == "mixed" for d in mixed.dispatch_log
+        )
+        assert not any(
+            d["kind"] == "prefill" for d in mixed.dispatch_log
+        )
+        assert asyncio.run(contended(mixed)) == asyncio.run(
+            contended(split)
+        )
+        got_mixed = asyncio.run(stopped(mixed))
+        assert got_mixed == asyncio.run(stopped(split))
+        assert got_mixed[1] == "stop"
+        assert asyncio.run(prefix256(mixed)) == asyncio.run(
+            prefix256(split)
+        )
         assert mixed.kv_manager.stats["hit_tokens"] >= 256
     finally:
         mixed.stop()
         split.stop()
 
 
+def test_engine_mixed_matches_split_int8(tiny):
+    """The int8-pool leg of the acceptance A/B (quant axis
+    representative)."""
+    mixed = _engine(tiny, "mixed", kv_quant="int8")
+    split = _engine(tiny, "split", kv_quant="int8")
+    mixed.start()
+    split.start()
+    try:
+        assert asyncio.run(_drive(mixed)) == asyncio.run(_drive(split))
+    finally:
+        mixed.stop()
+        split.stop()
+
+
+@pytest.mark.slow
+def test_engine_mixed_matches_split_reference_kernel(tiny):
+    """Same A/B on the gather/scatter reference kernel: the mixed
+    scheduler must not depend on the fused launch being available
+    (CPU-sans-interpret deployments resolve to reference). Slow-tier:
+    the reference kernel's engine A/B representative in tier 1 is
+    test_paged_kernel's fused-vs-reference pair."""
+    mixed = _engine(tiny, "mixed", kernel="reference")
+    split = _engine(tiny, "split", kernel="reference")
+    assert mixed.paged_kernel == "reference"
+    mixed.start()
+    split.start()
+    try:
+        assert asyncio.run(_drive(mixed)) == asyncio.run(_drive(split))
+    finally:
+        mixed.stop()
+        split.stop()
+
+
+@pytest.mark.slow
 def test_engine_mixed_spec_on_parity(tiny):
     """spec-decode composes: admission windows ride plain mixed steps,
     speculative chunks resume once the batch is all-decode — token
-    stream identical to the split+spec oracle."""
+    stream identical to the split+spec oracle. Slow-tier: the spec ×
+    mixed representative in tier 1 is test_mixed_carry_spec_and_
+    prefix_hit (carry-on vs carry-off, where carry-off ≡ this split
+    parity by the fast A/B above)."""
 
     async def run(engine):
         prompt = list(range(1, 9)) * 6  # repetition → drafts accepted
@@ -424,13 +422,20 @@ def test_engine_mixed_spec_on_parity(tiny):
 
 
 @pytest.mark.parametrize(
-    "sampling", [GREEDY, SEEDED], ids=["greedy", "seeded"]
+    "sampling",
+    [
+        pytest.param(GREEDY, id="greedy", marks=pytest.mark.slow),
+        pytest.param(SEEDED, id="seeded", marks=pytest.mark.slow),
+    ],
 )
 def test_mixed_crash_resumes_bitwise(tiny, sampling):
-    """Supervisor resurrection through the mixed path: the replay
-    prefill (prompt + generated[:-1]) chunks through mixed windows on
-    the rebuilt engine, and the continuation is bitwise the uncrashed
-    oracle — greedy and seeded-with-penalties."""
+    """Supervisor resurrection through the (unpipelined) mixed path:
+    the replay prefill (prompt + generated[:-1]) chunks through mixed
+    windows on the rebuilt engine, and the continuation is bitwise the
+    uncrashed oracle — greedy and seeded-with-penalties. Slow-tier:
+    tier 1's crash × mixed representative is
+    test_mixed_carry_crash_resumes_bitwise (seeded, carry-on crashed
+    vs carry-off uncrashed — the strictly stronger assertion)."""
     from langstream_tpu.runtime import faults
     from langstream_tpu.runtime.supervisor import EngineSupervisor
 
@@ -711,6 +716,316 @@ def test_tp2_mixed_no_full_pool_collective(tiny):
         engine.stop()
 
 
+# ---------------------------------------------------------------------- #
+# mixed-step carry (ISSUE 14): two-step window planning pipelines
+# consecutive mixed dispatches off device-resident outputs
+# ---------------------------------------------------------------------- #
+def _carry_pair(tiny, **overrides):
+    """(carry-on, carry-off) engines — identical but for the carry knob;
+    both pipeline so the only difference is the speculative chain."""
+    on = _engine(
+        tiny, "mixed", pipeline_decode=True, mixed_carry=True, **overrides
+    )
+    off = _engine(
+        tiny, "mixed", pipeline_decode=True, mixed_carry=False, **overrides
+    )
+    return on, off
+
+
+async def _contended_stop(engine):
+    """Both prompts submitted back-to-back so they admit in one round:
+    the long prompt keeps the engine in chained mixed steps while the
+    short one decodes and then hits a mid-stream stop — the stop lands
+    with a speculated step in flight (stale_row invalidation)."""
+    base = await engine.generate(list(range(1, 20)), GREEDY)
+    stop = {base.tokens[4]}
+    t1 = asyncio.ensure_future(
+        engine.generate(
+            list(range(1, 20)), SamplingParams(max_new_tokens=24),
+            stop_tokens=stop,
+        )
+    )
+    t2 = asyncio.ensure_future(
+        engine.generate(list(range(5, 150)), GREEDY)
+    )
+    r1, r2 = await asyncio.gather(t1, t2)
+    return base.tokens, r1.tokens, r1.finish_reason, r2.tokens
+
+
+def test_mixed_carry_bitwise_and_stop_invalidation(tiny):
+    """THE carry acceptance A/B (bf16 pool): chained mixed steps
+    produce BITWISE the unchained oracle's tokens (hence split's —
+    unchained≡split is asserted above), through greedy + seeded
+    traffic, a prefix-hit resume, and a mid-stream stop that lands
+    with a speculated step in flight. The carry engine must actually
+    have chained (steady-state evidence) and must have billed the
+    contradicted speculation to the invalidation counters + ledger."""
+    on, off = _carry_pair(tiny)
+    on.start()
+    off.start()
+    try:
+        assert asyncio.run(_drive(on)) == asyncio.run(_drive(off))
+        got_on = asyncio.run(_contended_stop(on))
+        got_off = asyncio.run(_contended_stop(off))
+        assert got_on == got_off
+        assert got_on[2] == "stop"
+        assert on.stats["mixed_steps_chained"] > 0
+        assert off.stats["mixed_steps_chained"] == 0
+        invalidations = on.stats["mixed_carry_invalidations"]
+        # the long admission drains eventually (deterministic), and the
+        # mid-stream stop contradicted an in-flight speculated step
+        assert invalidations.get("drained", 0) >= 1
+        assert invalidations.get("stale_row", 0) >= 1
+        assert on.stats["tokens_wasted"].get("carry_invalidated", 0) >= 1
+        # the interference bound survives chaining: no dispatch carries
+        # more than the budget in prefill tokens
+        assert all(
+            d["prefill_tokens"] <= on.prefill_chunk
+            for d in on.dispatch_log
+        )
+    finally:
+        on.stop()
+        off.stop()
+
+
+def test_mixed_carry_bitwise_int8(tiny):
+    """The int8-pool leg of the carry A/B (quant axis representative:
+    greedy + seeded + prefix-hit resume through chained steps on a
+    quantized pool)."""
+    on, off = _carry_pair(tiny, kv_quant="int8")
+    on.start()
+    off.start()
+    try:
+        assert asyncio.run(_drive(on)) == asyncio.run(_drive(off))
+        assert on.stats["mixed_steps_chained"] > 0
+    finally:
+        on.stop()
+        off.stop()
+
+
+def test_mixed_carry_spec_and_prefix_hit(tiny):
+    """spec-on × ≥256-token prefix hit through the carry: admission
+    windows chain as plain mixed steps (spec chunks resume once the
+    batch is all-decode), and a prefix-hit resume chains mid-prompt —
+    tokens bitwise the carry-off oracle's, with real chained steps and
+    a real pool hit."""
+    shared = list(np.arange(280) % 250 + 1)
+
+    async def run(engine):
+        a = await engine.generate(
+            list(range(1, 9)) * 6, SamplingParams(max_new_tokens=12)
+        )
+        b = await engine.generate(shared + [7, 8], GREEDY)
+        c = await engine.generate(shared + [9, 10, 11], GREEDY)
+        return a.tokens, b.tokens, c.tokens
+
+    on, off = _carry_pair(tiny, spec="ngram")
+    on.start()
+    off.start()
+    try:
+        assert asyncio.run(run(on)) == asyncio.run(run(off))
+        assert on.stats["mixed_steps_chained"] > 0
+        assert on.stats["tokens_drafted"] > 0
+        assert on.kv_manager.stats["hit_tokens"] >= 256
+    finally:
+        on.stop()
+        off.stop()
+
+
+def test_mixed_carry_crash_resumes_bitwise(tiny):
+    """Supervisor crash-replay × carry: the rebuilt CARRY engine's
+    replay prefill chunks through (chained) mixed windows and the
+    continuation is bitwise the UNCHAINED uncrashed oracle — the
+    chained-vs-unchained acceptance criterion through the crash arc,
+    plus the replay invalidation path (completing replay rows are
+    never chained) composing with resurrection."""
+    from langstream_tpu.runtime import faults
+    from langstream_tpu.runtime.supervisor import EngineSupervisor
+
+    def factory():
+        return _engine(
+            tiny, "mixed", prefill_chunk=16,
+            pipeline_decode=True, mixed_carry=True,
+        )
+
+    # the oracle deliberately runs UNCHAINED (carry off): tokens equal
+    # means the crashed-and-resumed chained engine is bitwise the
+    # unchained, uncrashed stream
+    oracle = _engine(
+        tiny, "mixed", prefill_chunk=16,
+        pipeline_decode=True, mixed_carry=False,
+    )
+    oracle.start()
+
+    async def run(engine):
+        return await engine.generate(list(range(1, 30)), SEEDED)
+
+    expected = asyncio.run(run(oracle))
+    oracle.stop()
+    assert len(expected.tokens) == SEEDED.max_new_tokens
+
+    faults.configure("engine_thread_crash@step=2")
+    supervisor = EngineSupervisor(factory)
+    try:
+        result = asyncio.run(run(supervisor.engine))
+        assert supervisor.restarts == 1
+        assert result.tokens == expected.tokens
+        assert result.finish_reason == expected.finish_reason
+        assert supervisor.engine.stats["tokens_wasted"].get(
+            "crash_replay", 0
+        ) > 0
+    finally:
+        supervisor.stop()
+
+
+def test_mixed_carry_flight_and_gauge_deltas(tiny, tmp_path):
+    """Steady-state chained evidence on every surface: flight
+    decode_chunk records prove consecutive mixed steps chained
+    (``chained: 1`` with collapsed ``gap_ms``), and the process-global
+    gauges move by this engine's counters — asserted as DELTAS against
+    a pre-drive snapshot (other live engines count too: the PR 13
+    flake lesson)."""
+    from langstream_tpu.runtime import flight
+
+    on = _engine(
+        tiny, "mixed", prefill_chunk=16,
+        pipeline_decode=True, mixed_carry=True,
+    )
+    try:
+        # the gauges are process-global over _LIVE_ENGINES (a WeakSet):
+        # collect stopped engines from earlier tests NOW, or one dying
+        # between the two snapshots shrinks the totals and breaks the
+        # delta arithmetic (the PR 13 flake lesson, GC edition)
+        import gc
+
+        gc.collect()
+        before = engines_snapshot()
+        chained_before = before.get(
+            "jax_engine_mixed_steps_chained_total", 0.0
+        )
+        drained_before = before.get(
+            'mixed_carry_invalidations_total{reason="drained"}', 0.0
+        )
+        # the series exist from construction, before any traffic
+        assert (
+            'mixed_carry_invalidations_total{reason="stale_row"}' in before
+        )
+        saved = flight.RECORDER.path
+        flight.RECORDER.path = None
+        flight.RECORDER._pending.clear()
+        path = flight.configure(str(tmp_path / "flight"))
+        try:
+            on.start()
+
+            async def steady():
+                t1 = asyncio.ensure_future(
+                    on.generate(
+                        list(range(1, 16)),
+                        SamplingParams(max_new_tokens=20),
+                    )
+                )
+                t2 = asyncio.ensure_future(
+                    on.generate(list(range(2, 150)), GREEDY)
+                )
+                await asyncio.gather(t1, t2)
+
+            asyncio.run(steady())
+            flight.RECORDER.flush()
+            entries = flight.read_artifact(path)
+        finally:
+            flight.RECORDER.path = saved
+        records = [
+            r for r in entries
+            if r.get("kind") == "decode_chunk" and r.get("mixed")
+        ]
+        chained = [r for r in records if r.get("chained")]
+        assert chained, "no mixed step chained in steady state"
+        assert all("gap_ms" in r for r in records)
+        after = engines_snapshot()
+        chained_delta = after.get(
+            "jax_engine_mixed_steps_chained_total", 0.0
+        ) - chained_before
+        assert chained_delta == float(on.stats["mixed_steps_chained"])
+        assert chained_delta >= len(chained)
+        drained_delta = after.get(
+            'mixed_carry_invalidations_total{reason="drained"}', 0.0
+        ) - drained_before
+        assert drained_delta == float(
+            on.stats["mixed_carry_invalidations"].get("drained", 0)
+        )
+    finally:
+        on.stop()
+
+
+def test_mixed_carry_mirror_replay(tiny):
+    """Chained mirror contract: ``mixed_chained`` records carry ONLY
+    the window-delta metadata (7 small host arrays — no tables, no
+    sampling arrays, no sampled tokens); a follower replaying the
+    captured stream chains from its own carry and converges on a
+    BITWISE-identical pool + counts."""
+    from langstream_tpu.serving.mirror import FollowerExecutor
+
+    class CaptureMirror:
+        def __init__(self):
+            self.records = []
+
+        def publish(self, kind, meta, arrays):
+            self.records.append(
+                (kind, dict(meta), [np.copy(np.asarray(a)) for a in arrays])
+            )
+
+        def close(self):
+            pass
+
+    leader = _engine(
+        tiny, "mixed", prefill_chunk=16,
+        pipeline_decode=True, mixed_carry=True,
+    )
+    capture = CaptureMirror()
+    leader.mirror = capture
+    follower = _engine(tiny, "mixed", prefill_chunk=16)
+    leader.start()
+    try:
+        async def one():
+            t1 = asyncio.ensure_future(
+                leader.generate(
+                    list(range(1, 16)), SamplingParams(max_new_tokens=8)
+                )
+            )
+            t2 = asyncio.ensure_future(
+                leader.generate(list(range(2, 80)), GREEDY)
+            )
+            await asyncio.gather(t1, t2)
+
+        asyncio.run(one())
+    finally:
+        leader.mirror = None
+        leader.stop()
+    kinds = [kind for kind, _, _ in capture.records]
+    assert "mixed_chained" in kinds
+    chained_records = [
+        r for r in capture.records if r[0] == "mixed_chained"
+    ]
+    assert all(len(arrays) == 7 for _, _, arrays in chained_records)
+    fresh_records = [r for r in capture.records if r[0] == "mixed"]
+    # fresh records carry tables + carry operands + 8 sampling arrays
+    assert all(len(arrays) == 17 for _, _, arrays in fresh_records)
+    executor = FollowerExecutor(follower)
+    for kind, meta, arrays in capture.records:
+        executor._execute(kind, meta, arrays)
+    try:
+        for leaf in leader.cache:
+            assert (
+                np.asarray(leader.cache[leaf])
+                == np.asarray(follower.cache[leaf])
+            ).all(), f"cache leaf {leaf} diverged"
+        assert (
+            np.asarray(leader._counts) == np.asarray(follower._counts)
+        ).all()
+    finally:
+        follower.stop()
+
+
 def test_provider_plumbs_prefill_mode():
     """engine: {prefill-mode/prefill-chunk} flows compiler globals →
     provider → engine (string-coerced like every other knob)."""
@@ -724,11 +1039,14 @@ def test_provider_plumbs_prefill_mode():
             "max-slots": "2", "max-seq-len": "64",
             "kv-layout": "paged", "kv-block-size": "8",
             "prefill-mode": "mixed", "prefill-chunk": "24",
+            "mixed-carry": "off",
         },
     })
     try:
         assert service.engine.prefill_mode == "mixed"
         assert service.engine.mixed
         assert service.engine.prefill_chunk == 24
+        # mixed-carry coerces like every other knob ("off" string)
+        assert service.engine.mixed_carry is False
     finally:
         service.engine.stop()

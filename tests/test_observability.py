@@ -51,6 +51,13 @@ def _sample_exposition() -> str:
         # chunked mixed prefill (ISSUE 12): prompt-padding ghosts —
         # split-path bucket rounding vs the mixed path's width cap
         'jax_engine_tokens_wasted_total{reason="prefill_padding"}': 40.0,
+        # mixed-step carry (ISSUE 14): speculatively chained steps,
+        # per-reason chain-break counters, and the tokens a chained
+        # step sampled for rows that had already stopped
+        'jax_engine_tokens_wasted_total{reason="carry_invalidated"}': 2.0,
+        "jax_engine_mixed_steps_chained_total": 57.0,
+        'mixed_carry_invalidations_total{reason="admission"}': 4.0,
+        'mixed_carry_invalidations_total{reason="stale_row"}': 1.0,
         "spec_tokens_drafted_total": 96.0,
         "spec_tokens_accepted_total": 72.0,
         "spec_acceptance_rate": 0.75,
@@ -93,6 +100,11 @@ def _sample_exposition() -> str:
             "spec_tokens_drafted_total":
                 "speculative-decode candidate tokens proposed by the"
                 " prompt-lookup drafter",
+            "jax_engine_mixed_steps_chained_total":
+                "mixed steps dispatched off the previous step's"
+                " device-resident carry (two-step window plan)",
+            "mixed_carry_invalidations_total":
+                "mixed-step chains broken or contradicted, by reason",
             "spec_acceptance_rate":
                 "fraction of drafted tokens the verify step accepted",
             "jax_engine_slo_ttft_burn_rate_5m":

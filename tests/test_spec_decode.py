@@ -129,8 +129,22 @@ def _run_pair(spec_engine, oracle, coro_factory):
         oracle.stop()
 
 
-@pytest.mark.parametrize("paged", [False, True], ids=["dense", "paged"])
-@pytest.mark.parametrize("kv_quant", [None, "int8"], ids=["bf16", "int8"])
+@pytest.mark.parametrize(
+    "paged,kv_quant",
+    [
+        # tier-1 representatives: one per layout axis and one per pool
+        # axis (bf16-dense, int8-paged); the remaining diagonal legs
+        # run in the slow tier — each engine pair here costs ~10s
+        pytest.param(False, None, id="bf16-dense"),
+        pytest.param(True, "int8", id="int8-paged"),
+        pytest.param(
+            True, None, id="bf16-paged", marks=pytest.mark.slow
+        ),
+        pytest.param(
+            False, "int8", id="int8-dense", marks=pytest.mark.slow
+        ),
+    ],
+)
 def test_greedy_parity_with_warm_session(paged, kv_quant):
     """spec-decode: ngram emits the exact oracle token stream — cold
     prefill, decode, and a warm continuation (paged prefix-hit / dense

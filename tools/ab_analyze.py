@@ -33,6 +33,8 @@ LEGS = {
     "bench_heal_spec.json": "speculative decoding (--spec-decode ngram)",
     "bench_heal_mixed.json":
         "paged KV, mixed prefill+decode dispatch (--prefill-mode mixed)",
+    "bench_heal_mixed_carry.json":
+        "mixed dispatch, device carry OFF control (--mixed-carry off)",
     "bench_heal_paged_tp2.json": "paged KV, fused kernel, tp=2 mesh (--tp 2)",
     "bench_heal_paged_ref_tp2.json": "paged KV, gather reference, tp=2 mesh",
     "bench_heal_chaos.json":
@@ -103,6 +105,18 @@ def describe(record: Dict[str, Any]) -> str:
     # to the tail columns below, which are what the pair is judged on
     if record.get("prefill_mode") and record["prefill_mode"] != "split":
         bits.append(f"prefill={record['prefill_mode']}")
+        # carry column: whether consecutive mixed steps chained off the
+        # previous step's device outputs, plus the leg's own chain-rate
+        # and host-gap evidence (a carry-on leg with a collapsed chain
+        # rate explains a flat delta — read the invalidation counters)
+        if record.get("mixed_carry"):
+            bits.append(f"carry={record['mixed_carry']}")
+        if record.get("mixed_chain_rate") is not None:
+            bits.append(f"chain {record['mixed_chain_rate'] * 100:.0f}%")
+        if record.get("mixed_host_gap_ms_mean") is not None:
+            bits.append(
+                f"host gap {record['mixed_host_gap_ms_mean']:.1f} ms/step"
+            )
     # chaos column: which leg ran with the fault registry armed — a
     # recovery-under-load number must never read as a clean regression
     if record.get("chaos"):
@@ -275,6 +289,39 @@ def flight_summary(art_dir: str) -> Optional[str]:
                 f"steps carried prefill windows, prefill tokens/step "
                 f"p50 {_percentile(loads, 0.5)} / max {max(loads)}"
             )
+            # mixed-step carry series: chained steps overlap the
+            # previous harvest, so their inter-dispatch host gap
+            # collapses to ~0 — the gap split between chained and
+            # unchained steps IS the per-step host tax the carry hides
+            chained = [c for c in mixed_chunks if c.get("chained")]
+            gaps = [
+                c["gap_ms"] for c in mixed_chunks
+                if c.get("gap_ms") is not None
+            ]
+            if chained or gaps:
+                line = (
+                    f"  mixed carry: {len(chained)}/{len(mixed_chunks)} "
+                    "steps chained"
+                )
+                chained_gaps = [
+                    c["gap_ms"] for c in chained
+                    if c.get("gap_ms") is not None
+                ]
+                fresh_gaps = [
+                    c["gap_ms"] for c in mixed_chunks
+                    if c.get("gap_ms") is not None and not c.get("chained")
+                ]
+                if chained_gaps:
+                    line += (
+                        f"; host gap p50 chained "
+                        f"{_percentile(chained_gaps, 0.5):.2f} ms"
+                    )
+                if fresh_gaps:
+                    line += (
+                        f" vs unchained "
+                        f"{_percentile(fresh_gaps, 0.5):.2f} ms"
+                    )
+                lines.append(line)
         # paged-KV series (kv_layout: paged): pool pressure + cumulative
         # prefix-cache hit tokens ride each decode_chunk record
         pool = [
@@ -580,6 +627,45 @@ def main() -> None:
                     "the decode step's headroom just moves the stall "
                     "inside the mixed step (lower --prefill-chunk)" + note
                 )
+    carry_off = records["bench_heal_mixed_carry.json"]
+    if usable(mixed) and usable(carry_off):
+        # carry-on-vs-off at equal mixed scheduling: the carry is
+        # bitwise-neutral, so this is a pure step-time/tail pair — the
+        # verdict is throughput + host-gap collapse, sanity-checked
+        # against the on-leg's own chain rate (a collapsed chain rate
+        # means constant invalidation, not a broken carry: read the
+        # mixed_carry_invalidations counters on /metrics)
+        tput = mixed["value"] / carry_off["value"] - 1
+        note = caveat(carry_off, mixed)
+        rate = mixed.get("mixed_chain_rate")
+        gap_on = mixed.get("mixed_host_gap_ms_mean")
+        gap_off = carry_off.get("mixed_host_gap_ms_mean")
+        gap_note = ""
+        if gap_on is not None and gap_off is not None:
+            gap_note = f", host gap {gap_off:.1f} -> {gap_on:.1f} ms/step"
+        if rate is not None and rate < 0.2:
+            recommendations.append(
+                f"mixed carry: chain rate collapsed ({rate:.1%}) — the "
+                f"two-step plan is constantly invalidated (throughput "
+                f"{tput:+.1%}{gap_note}); read "
+                "mixed_carry_invalidations_total by reason before "
+                "judging the carry" + note
+            )
+        elif tput > 0.03:
+            recommendations.append(
+                f"KEEP mixed-carry on (engine default): {tput:+.1%} "
+                f"tok/s over the carry-off control"
+                + (f", chain rate {rate:.1%}" if rate is not None else "")
+                + gap_note + note
+            )
+        else:
+            recommendations.append(
+                f"mixed carry is NOT paying ({tput:+.1%} vs off"
+                + (f", chain rate {rate:.1%}" if rate is not None else "")
+                + f"{gap_note}): on a local chip the host gap may "
+                "already be negligible — keep the default only if the "
+                "tunnel legs confirm it" + note
+            )
     chaos = records["bench_heal_chaos.json"]
     if usable(main_rec) and usable(chaos):
         # chaos-vs-clean pair: the delta prices one crash/rebuild/resume

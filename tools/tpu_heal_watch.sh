@@ -187,6 +187,22 @@ y.block_until_ready()" 2>/dev/null
             else
                 echo "$(date -u +%FT%TZ) mixed-prefill A/B failed (non-fatal)" >> "$LOG"
             fi
+            # 2b-carry) mixed-step carry A/B (ISSUE 14): the leg above
+            #    runs the engine default (carry ON — consecutive mixed
+            #    steps chained off device-resident outputs); this
+            #    control leg forces the per-step host round trip back
+            #    (BENCH_MIXED_CARRY=off). Same compiled graphs, so no
+            #    separate warm pass; bitwise-identical tokens, so the
+            #    pair is a pure step-time/host-gap verdict (ab_analyze
+            #    reads chain rate + mixed_host_gap_ms_mean).
+            if BENCH_KV_LAYOUT=paged BENCH_PREFILL_MODE=mixed \
+                BENCH_MIXED_CARRY=off \
+                BENCH_DEADLINE=3600 BENCH_INIT_TIMEOUT=600 \
+                python bench.py > "${OUT%.json}_mixed_carry.json" 2>> "$LOG"; then
+                echo "$(date -u +%FT%TZ) mixed-carry control done: $(cat "${OUT%.json}_mixed_carry.json")" >> "$LOG"
+            else
+                echo "$(date -u +%FT%TZ) mixed-carry control failed (non-fatal)" >> "$LOG"
+            fi
             # 2c) speculative-decoding A/B: self-drafting prompt-lookup
             #    (ngram) vs the oracle scan (the main run is the OFF
             #    leg — same traffic shape). Warm the spec jit graphs
