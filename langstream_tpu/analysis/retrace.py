@@ -64,6 +64,18 @@ def _builder_probes(engine) -> List[Tuple[str, Callable[[], Any]]]:
         )
     if getattr(engine, "paged", False):
         probes.append(("_get_block_copy()", engine._get_block_copy))
+        # KV-handoff gather/scatter (ISSUE 15): memoized per pow2-
+        # padded chain width — a broken memo would re-lower the import
+        # scatter on EVERY handoff admission
+        for width in (1, 4):
+            probes.append(
+                (f"_get_handoff_export({width})",
+                 lambda w=width: engine._get_handoff_export(w))
+            )
+            probes.append(
+                (f"_get_handoff_import({width})",
+                 lambda w=width: engine._get_handoff_import(w))
+            )
     elif engine.prefix_cache:
         bucket = min(engine.prefill_buckets)
         probes.append(

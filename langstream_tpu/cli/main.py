@@ -1001,6 +1001,34 @@ def build_parser() -> argparse.ArgumentParser:
              "shed with 503 + Retry-After instead of waiting in the "
              "queue forever (0 = off)",
     )
+    # fleet membership (langstream_tpu/fleet): role-aware heartbeat
+    # gossip over the topic fabric — the router's liveness/affinity/
+    # disaggregation view is built ENTIRELY from these beats
+    serve.add_argument(
+        "--fleet-role", default="unified",
+        choices=["unified", "prefill", "decode"],
+        help="disaggregation pool this replica serves (gossiped in "
+             "every heartbeat; the FleetRouter sends cold prompts to "
+             "the prefill pool and pinned handoff continuations to "
+             "the decode pool — docs/fleet.md)",
+    )
+    serve.add_argument(
+        "--fleet-gossip", default=None, metavar="JSON",
+        help="streaming-cluster config for the heartbeat fabric, e.g. "
+             '\'{"type":"kafka","configuration":{...}}\' — when set, '
+             "this replica publishes build_heartbeat on a period "
+             "(fleet/heartbeat.publish_loop) so routers see it without "
+             "scraping",
+    )
+    serve.add_argument(
+        "--fleet-replica-id", default=None,
+        help="stable pod identity stamped on heartbeats (default: "
+             "$HOSTNAME — the StatefulSet ordinal name on kube)",
+    )
+    serve.add_argument(
+        "--fleet-heartbeat-s", type=float, default=2.0,
+        help="heartbeat publish period in seconds",
+    )
     serve.add_argument("--embeddings-checkpoint", default=None)
     serve.add_argument("--host", default="0.0.0.0")
     serve.add_argument("--port", type=int, default=8000)

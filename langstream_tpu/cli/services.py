@@ -417,8 +417,96 @@ async def serve_main(args) -> None:
     )
     stop = asyncio.Event()
     _install_stop(asyncio.get_running_loop(), stop)
+    gossip_task, gossip_runtime = await _start_fleet_gossip(
+        args, completions, port, stop
+    )
     try:
         await stop.wait()
     finally:
+        if gossip_task is not None:
+            gossip_task.cancel()
+            try:
+                # wait the cancel out: a mid-write publish must not
+                # race the runtime close below
+                await gossip_task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+        if gossip_runtime is not None:
+            try:
+                await gossip_runtime.close()
+            except Exception:  # noqa: BLE001 — shutdown best-effort
+                pass
         await server.stop()
         await completions.close()
+
+
+async def _start_fleet_gossip(args, completions, port: int, stop):
+    """``serve --fleet-gossip``: publish role-aware heartbeats on the
+    topic fabric so fleet routers see this replica without scraping —
+    the runner-pod wiring of ``fleet/heartbeat.publish_loop`` (ROADMAP
+    item 4). Returns (task, topic_runtime), both None when gossip is
+    not configured. A bad fabric config logs and disables gossip; it
+    never takes the serving process down."""
+    gossip = getattr(args, "fleet_gossip", None)
+    if not gossip:
+        return None, None
+    import socket
+
+    from langstream_tpu.fleet.heartbeat import (
+        HEARTBEAT_TOPIC,
+        build_heartbeat,
+        publish_loop,
+    )
+    from langstream_tpu.topics import create_topic_runtime
+
+    role = getattr(args, "fleet_role", "unified") or "unified"
+    replica_id = (
+        getattr(args, "fleet_replica_id", None)
+        or os.environ.get("HOSTNAME")
+        or f"{socket.gethostname()}:{port}"
+    )
+    runtime = None
+    try:
+        runtime = create_topic_runtime(json.loads(gossip))
+        producer = runtime.create_producer(
+            f"fleet-gossip-{replica_id}", {"topic": HEARTBEAT_TOPIC}
+        )
+        await producer.start()
+    except Exception:  # noqa: BLE001 — gossip must not kill serving
+        logger.exception("fleet gossip disabled: bad --fleet-gossip")
+        if runtime is not None:
+            # the runtime came up before the producer failed: close it
+            # or its client connections/threads outlive the feature
+            try:
+                await runtime.close()
+            except Exception:  # noqa: BLE001
+                pass
+        return None, None
+    seq = {"n": 0}
+
+    def beat():
+        seq["n"] += 1
+        # the CURRENT engine: the supervisor swaps it on rebuild, and
+        # the degraded/rebuilding state rides the beat so routers
+        # drain this replica instead of 503-discovering it
+        return build_heartbeat(
+            replica_id,
+            seq["n"],
+            engine=completions.engine,
+            supervisor=getattr(completions, "_supervisor", None),
+            role=role,
+        )
+
+    task = asyncio.get_running_loop().create_task(
+        publish_loop(
+            producer, beat,
+            interval_s=getattr(args, "fleet_heartbeat_s", 2.0),
+            stop=stop,
+        )
+    )
+    print(
+        f"fleet gossip: {replica_id} role={role} -> "
+        f"{HEARTBEAT_TOPIC} every {getattr(args, 'fleet_heartbeat_s', 2.0)}s",
+        flush=True,
+    )
+    return task, runtime

@@ -29,6 +29,12 @@ from langstream_tpu.fleet.autoscaler import (  # noqa: F401
     AutoscalePolicy,
     SLOAutoscaler,
 )
+from langstream_tpu.fleet.handoff import (  # noqa: F401
+    HANDOFF_TOPIC,
+    HandoffAssembler,
+    handoff_records,
+    manifest_for_request,
+)
 from langstream_tpu.fleet.router import (  # noqa: F401
     REPLICA_HEADER,
     FleetRouter,
@@ -57,8 +63,11 @@ class FleetController:
         # a StatefulSet spec read); None = report the router's view
         self._replicas_current = replicas_current
 
-    def route(self, prompt_tokens=None, now=None) -> RouteDecision:
-        return self.router.route(prompt_tokens, now=now)
+    def route(self, prompt_tokens=None, now=None, **kwargs) -> RouteDecision:
+        """Routing pass-through; ``role=`` / ``session_replica=`` ride
+        the kwargs (prefill/decode pool selection + session
+        stickiness, :meth:`FleetRouter.route`)."""
+        return self.router.route(prompt_tokens, now=now, **kwargs)
 
     def gauges(self, now: Optional[float] = None) -> Dict[str, float]:
         out = self.router.gauges(now=now)

@@ -93,9 +93,22 @@ class SLOAutoscaler:
         policy: Optional[AutoscalePolicy] = None,
         *,
         scale: Optional[Callable[[int], None]] = None,
+        role: Optional[str] = None,
+        burn_keys: Optional[Sequence[str]] = None,
     ) -> None:
+        """``role`` scopes this instance to one disaggregation pool: it
+        evaluates (and drains) only replicas gossiping that role, so a
+        disaggregated fleet runs one autoscaler per pool, each on its
+        own StatefulSet and its own signals. ``burn_keys`` overrides
+        the burn-rate gauges that count as pressure — queue/TTFT burn
+        for a prefill pool (cold prompts stack up as admission
+        backlog), TPOT burn for a decode pool (its SLO is the
+        inter-token gap, and TTFT there is the prefill pool's problem).
+        Defaults preserve the unified behavior exactly."""
         self.policy = policy or AutoscalePolicy()
         self._scale = scale
+        self.role = role
+        self._burn_keys = tuple(burn_keys) if burn_keys else _BURN_KEYS
         self._last_up_at = float("-inf")
         self._last_down_at = float("-inf")
         self._calm_evals = 0
@@ -116,7 +129,7 @@ class SLOAutoscaler:
     def _pressure(self, replicas: Sequence[ReplicaState]) -> Dict[str, float]:
         max_burn, queue_sum, shed_delta = 0.0, 0.0, 0.0
         for state in replicas:
-            for key in _BURN_KEYS:
+            for key in self._burn_keys:
                 max_burn = max(max_burn, state.gauges.get(key, 0.0))
             queue_sum += state.queue_depth
             if _SHED_KEY in state.gauges:
@@ -219,6 +232,11 @@ class SLOAutoscaler:
         scale-up immediately; scale-down via drain-then-shrink."""
         now = time.monotonic() if now is None else now
         view = router.snapshot_states()
+        if self.role is not None:
+            # pool-scoped: this instance owns ONE role's StatefulSet —
+            # the other pool's replicas are neither pressure nor
+            # scale-down victims here
+            view = [s for s in view if s.role == self.role]
         fresh = [
             s for s in view if s.fresh(now, router.heartbeat_timeout_s)
         ]
@@ -307,15 +325,21 @@ class SLOAutoscaler:
     # metrics
     # ------------------------------------------------------------------ #
     def gauges(self) -> Dict[str, float]:
+        # role-scoped instances label their series so a disaggregated
+        # fleet's two autoscalers merge into one scrape without
+        # colliding; un-roled instances keep the PR 10 names exactly
+        suffix = f'{{role="{self.role}"}}' if self.role else ""
         out = {
-            "fleet_replicas_draining": float(len(self._draining)),
+            f"fleet_replicas_draining{suffix}": float(len(self._draining)),
         }
         if self.target > 0:
             # absent until the first evaluation: a scrape must read
             # "no target yet" (top renders n/a), not a target of 0
-            out["fleet_replicas_target"] = float(self.target)
+            out[f"fleet_replicas_target{suffix}"] = float(self.target)
         for direction, count in sorted(self.events.items()):
-            out[
-                f'fleet_autoscale_events_total{{direction="{direction}"}}'
-            ] = float(count)
+            label = (
+                f'{{direction="{direction}",role="{self.role}"}}'
+                if self.role else f'{{direction="{direction}"}}'
+            )
+            out[f"fleet_autoscale_events_total{label}"] = float(count)
         return out

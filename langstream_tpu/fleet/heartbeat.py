@@ -16,6 +16,11 @@ ignored so the schema can grow without a fleet-wide flag day):
                                           #   epoch = a restarted pod
                                           #   (fresh seq counter)
       "state":           "serving",       # serving|degraded|rebuilding|down
+      "role":            "unified",       # prefill|decode|unified —
+                                          #   disaggregation pool this
+                                          #   replica serves (absent =
+                                          #   unified, the pre-disagg
+                                          #   behavior)
       "queue_depth":     3,               # admission queue + pending
       "active_sessions": 5,               # sessions holding slots
       "block_size":      16,              # paged block size (0 = dense)
@@ -70,6 +75,7 @@ def build_heartbeat(
     supervisor: Optional[Any] = None,
     snapshot: Optional[Mapping[str, float]] = None,
     digest_limit: int = 4096,
+    role: str = "unified",
 ) -> Dict[str, Any]:
     """Assemble a heartbeat from a live engine (+ optional supervisor).
 
@@ -81,6 +87,7 @@ def build_heartbeat(
     """
     heartbeat: Dict[str, Any] = {
         "replica": replica_id, "seq": int(seq), "epoch": PROCESS_EPOCH,
+        "role": str(role or "unified"),
     }
     state = "serving"
     if supervisor is not None:

@@ -170,6 +170,11 @@ class ReplicaState:
     prior_epochs: Set[str] = dataclasses.field(default_factory=set)
     last_seen: float = float("-inf")
     state: str = "serving"  # serving|degraded|rebuilding|down
+    # disaggregation pool membership: prefill|decode|unified — set by
+    # the replica's own heartbeat (serve --fleet-role); a unified
+    # replica serves either leg, which is also every pre-disagg
+    # replica's implicit role
+    role: str = "unified"
     queue_depth: float = 0.0
     active_sessions: float = 0.0
     block_size: int = 0
@@ -227,8 +232,10 @@ class FleetRouter:
         self._rr = 0  # guarded-by: _lock
         self._routed: Dict[str, int] = {  # guarded-by: _lock
             "affinity": 0, "least_queue": 0, "round_robin": 0,
+            "sticky": 0,
         }
         self._matched_tokens = 0  # guarded-by: _lock
+        self._sticky_stale = 0  # guarded-by: _lock
 
     # ------------------------------------------------------------------ #
     # heartbeat view
@@ -279,6 +286,7 @@ class FleetRouter:
             state.seq = seq
             state.last_seen = now
             state.state = str(heartbeat.get("state", "serving"))
+            state.role = str(heartbeat.get("role", "") or "unified")
             state.queue_depth = float(heartbeat.get("queue_depth", 0) or 0)
             state.active_sessions = float(
                 heartbeat.get("active_sessions", 0) or 0
@@ -358,11 +366,43 @@ class FleetRouter:
         self,
         prompt_tokens: Optional[Sequence[int]] = None,
         now: Optional[float] = None,
+        *,
+        role: Optional[str] = None,
+        session_replica: Optional[str] = None,
     ) -> RouteDecision:
         """Pick a replica for a new session. Raises
         :class:`NoRoutableReplica` when the whole fleet is unroutable —
-        the caller's 503-with-Retry-After moment."""
+        the caller's 503-with-Retry-After moment.
+
+        ``role`` narrows to one disaggregation pool (``prefill`` /
+        ``decode``): candidates of that role are preferred, with
+        ``unified`` replicas as the fallback when the pool is empty or
+        wholly unroutable, and any routable replica as the last resort
+        — a fleet that never configured roles routes exactly as
+        before, and a role-aware caller never dead-ends on a role.
+
+        ``session_replica`` is the stickiness pin: the stamped
+        ``langstream-replica`` header from the reply that served this
+        session. A warm follow-up's KV lives on that replica NOW, but
+        its chain digests may not have gossiped yet (publish-at-finish
+        beats the next heartbeat by up to a full interval), so the pin
+        outranks digest scoring — with a staleness fallback: a pinned
+        replica that is condemned, draining, stale, or unknown drops
+        the pin and the follow-up re-enters normal scoring (a cache
+        miss at worst, never a dead-end)."""
         now = time.monotonic() if now is None else now
+        if session_replica is not None:
+            with self._lock:
+                pinned = self.replicas.get(session_replica)
+                if pinned is not None and pinned.routable(
+                    now, self.heartbeat_timeout_s
+                ):
+                    pinned.queue_depth += 1.0
+                    self._routed["sticky"] = (
+                        self._routed.get("sticky", 0) + 1
+                    )
+                    return RouteDecision(pinned.replica_id, "sticky")
+                self._sticky_stale += 1
         # hash OUTSIDE the lock: the digest chain is O(prompt) blake2b
         # work, and holding the router-wide lock for it would serialize
         # every concurrent route/observe/gauges behind one request.
@@ -385,6 +425,20 @@ class FleetRouter:
                 s for s in self.replicas.values()
                 if s.routable(now, self.heartbeat_timeout_s)
             ]
+            if role is not None:
+                pool = [s for s in candidates if s.role == role]
+                if not pool:
+                    # no live replica of this role: unified replicas
+                    # absorb the leg (and an un-roled fleet is ALL
+                    # unified, so disagg-aware callers degrade cleanly)
+                    pool = [s for s in candidates if s.role == "unified"]
+                # last resort — both the role pool and the unified tier
+                # are empty: route to ANYONE routable. Deliberate
+                # availability-over-purity: a cold prefill on a decode
+                # replica costs a TPOT excursion; an unplaceable
+                # session costs the client a 503 (test-pinned in
+                # test_router_routes_by_role_with_unified_fallback)
+                candidates = pool or candidates
             if not candidates:
                 raise NoRoutableReplica(
                     f"no routable replica among {sorted(self.replicas)}"
@@ -451,6 +505,10 @@ class FleetRouter:
                 out["fleet_prefix_match_tokens_total"] = float(
                     self._matched_tokens
                 )
+            # session stickiness: pins honored ride the policy="sticky"
+            # routed counter above; this is the fallback leg (pin was
+            # stale/condemned/unknown → digest scoring took over)
+            out["fleet_sticky_fallbacks_total"] = float(self._sticky_stale)
             routable = 0
             for state in sorted(
                 self.replicas.values(), key=lambda s: s.replica_id
@@ -459,6 +517,11 @@ class FleetRouter:
                 out[f"fleet_replica_queue_depth{label}"] = float(
                     state.queue_depth
                 )
+                if state.role != "unified":
+                    out[
+                        f'fleet_replica_role{{replica='
+                        f'"{state.replica_id}",role="{state.role}"}}'
+                    ] = 1.0
                 if state.routable(now, self.heartbeat_timeout_s):
                     display, routable = "serving", routable + 1
                 elif state.draining:

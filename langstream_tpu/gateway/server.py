@@ -352,13 +352,24 @@ class GatewayServer:
         trace_id = new_trace_id()
         return headers + ((TRACE_ID_HEADER, trace_id),), trace_id
 
-    def _fleet_headers(self, value: Any) -> Tuple[Tuple[str, str], ...]:
+    def _fleet_headers(
+        self,
+        value: Any,
+        user_headers: Tuple[Tuple[str, str], ...] = (),
+    ) -> Tuple[Tuple[str, str], ...]:
         """Prefix-affinity routing at the front door: when a fleet
         router is registered, pick the replica whose resident chain set
         best matches the session's token prefix (``tokens`` in a dict
         payload; token-less payloads fall back least-queue-depth) and
         stamp it as the ``langstream-replica`` header, so downstream
         consumers — and keyed partitioners — can honor the decision.
+
+        Session stickiness (ROADMAP item 4): a follow-up carrying the
+        stamped ``langstream-replica`` header from a prior reply PINS
+        its session's replica — the warm KV lives there NOW, before its
+        chain digests have gossiped — and a stale/condemned pin falls
+        back to digest scoring, re-stamping the new decision.
+
         Never fails the produce: an unroutable fleet degrades to the
         pre-fleet blind path."""
         if self._fleet is None:
@@ -375,11 +386,20 @@ class GatewayServer:
                 isinstance(t, int) for t in raw
             ):
                 tokens = raw
+        pin = next(
+            (
+                str(v) for k, v in user_headers
+                if k == REPLICA_HEADER and v
+            ),
+            None,
+        )
         try:
-            decision = self._fleet.route(tokens)
+            decision = self._fleet.route(tokens, session_replica=pin)
         except NoRoutableReplica:
             self.metrics.counter("fleet_unroutable").count()
             return ()
+        if decision.policy == "sticky":
+            self.metrics.counter("fleet_sticky").count()
         self.metrics.counter("fleet_routed").count()
         return ((REPLICA_HEADER, decision.replica_id),)
 
@@ -390,10 +410,23 @@ class GatewayServer:
         gateway_headers = self._resolve_headers(
             gateway.produce_options.get("headers"), parameters, principal
         )
+        fleet_headers = self._fleet_headers(value, tuple(user_headers))
+        if self._fleet is not None:
+            # the routing layer owns the replica header: drop any
+            # client-supplied pin (honored pins re-stamp the same
+            # value; stale pins must not ride beside the new decision
+            # — and when the whole fleet is unroutable, forwarding the
+            # client's echoed pin would steer the session to a replica
+            # the router just refused)
+            from langstream_tpu.fleet.router import REPLICA_HEADER
+
+            user_headers = [
+                h for h in user_headers if h[0] != REPLICA_HEADER
+            ]
         headers, trace_id = self._stamp_trace(
             tuple(user_headers)
             + tuple(gateway_headers)
-            + self._fleet_headers(value)
+            + fleet_headers
         )
         with self.tracer.span(
             "gateway.produce", trace_id=trace_id,

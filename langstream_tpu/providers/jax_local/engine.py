@@ -134,6 +134,8 @@ def engines_snapshot() -> Dict[str, float]:
     paged_engines = 0
     kv_blocks_in_use = kv_blocks_total = 0
     prefix_hit_tokens = prefix_evictions = 0
+    handoff_exported_bytes = handoff_imported_bytes = 0
+    handoff_exports = handoff_imports = handoff_imported_tokens = 0
     useful_tokens = 0
     wasted: Dict[str, int] = {
         reason: 0
@@ -151,6 +153,10 @@ def engines_snapshot() -> Dict[str, float]:
             # sampled for rows whose request had already stopped or
             # been cancelled by the time the step was host-processed
             "carry_invalidated",
+            # prefill/decode disaggregation: tokens whose KV handoff
+            # aborted (pool pressure / torn payload / layout mismatch)
+            # and had to be re-prefilled on the decode replica
+            "handoff_aborted",
         )
     }
     shed_engines = 0
@@ -225,6 +231,13 @@ def engines_snapshot() -> Dict[str, float]:
             kv_blocks_total += engine.num_blocks
             prefix_hit_tokens += engine.kv_manager.stats["hit_tokens"]
             prefix_evictions += engine.kv_manager.stats["evictions"]
+            handoff_exports += stats.get("handoff_exports", 0)
+            handoff_exported_bytes += stats.get("handoff_export_bytes", 0)
+            handoff_imports += stats.get("handoff_imports", 0)
+            handoff_imported_bytes += stats.get("handoff_import_bytes", 0)
+            handoff_imported_tokens += stats.get(
+                "handoff_import_tokens", 0
+            )
     if live_engines:
         # watchdog trips ride the engine exposition so every scrape
         # surface sees them (0 included — the series must exist BEFORE
@@ -249,6 +262,21 @@ def engines_snapshot() -> Dict[str, float]:
         out["kv_blocks_total"] = float(kv_blocks_total)
         out["prefix_cache_hit_tokens_total"] = float(prefix_hit_tokens)
         out["prefix_cache_evictions_total"] = float(prefix_evictions)
+        # paged-KV handoff (prefill/decode disaggregation): exposed
+        # from construction on every paged engine so the disagg A/B
+        # never scrapes no-data, and a decode replica importing nothing
+        # (routing misconfigured) is visible as a flat zero
+        out["kv_handoff_exports_total"] = float(handoff_exports)
+        out["kv_handoff_exported_bytes_total"] = float(
+            handoff_exported_bytes
+        )
+        out["kv_handoff_imports_total"] = float(handoff_imports)
+        out["kv_handoff_imported_bytes_total"] = float(
+            handoff_imported_bytes
+        )
+        out["kv_handoff_imported_tokens_total"] = float(
+            handoff_imported_tokens
+        )
     if spec_engines:
         # speculative decoding (spec-decode: ngram): drafted/accepted
         # counters + the acceptance rate — exposed from construction so
@@ -391,6 +419,14 @@ class GenerationRequest:
     replay_logprobs: Optional[List[float]] = None
     replay_tops: Optional[List[Tuple[List[int], List[float]]]] = None
     prompt_len: Optional[int] = None
+    # prefill/decode disaggregation (fleet/handoff.py): a prefill-leg
+    # request asks the engine to export the session's published KV
+    # chain at finish (rides GenerationResult.kv_handoff); a decode-leg
+    # replay request carries the assembled handoff payload, imported
+    # into the pool at admission so the replay prefill hits the prefix
+    # cache for the full prompt instead of recomputing it
+    export_handoff: bool = False
+    kv_import: Optional[Dict[str, Any]] = None
 
     def cancel(self) -> None:
         self.cancelled = True
@@ -414,6 +450,10 @@ class GenerationResult:
     # (token_ids, logprobs) pair per generated token, or None when the
     # engine runs with logprobs_topk=0
     top_logprobs: Optional[List[Tuple[List[int], List[float]]]] = None
+    # disaggregation prefill leg (request.export_handoff): the session's
+    # published KV chain serialized for the topic fabric — tokens +
+    # per-leaf pool rows (fleet/handoff.py chunks it into records)
+    kv_handoff: Optional[Dict[str, Any]] = None
 
 
 @dataclasses.dataclass
@@ -836,6 +876,10 @@ class DecodeEngine:
         self._mixed_fns: Dict[int, Any] = {}
         self._copy_fns: Dict[int, Any] = {}
         self._block_copy_fn: Optional[Any] = None
+        # KV-handoff gather/scatter jits, memoized per pow2-padded
+        # block-chain width (same retrace budget as every builder)
+        self._handoff_export_fns: Dict[int, Any] = {}
+        self._handoff_import_fns: Dict[int, Any] = {}
         # prefill dispatches whose first tokens are not yet harvested
         # (FIFO — the device executes dispatches in order)
         self._prefill_inflight: List[Dict[str, Any]] = []  # owned-by: _run_loop
@@ -939,6 +983,15 @@ class DecodeEngine:
             # summed device idle between consecutive mixed steps (the
             # per-step host tax; ~0 while chains hold)
             "mixed_gap_time": 0.0,
+            # paged-KV handoff (prefill/decode disaggregation): exports
+            # serialized off this engine's pool, imports written into
+            # it, and the device bytes each way — the transfer price
+            # the disagg A/B reads next to its tail win
+            "handoff_exports": 0,
+            "handoff_export_bytes": 0,
+            "handoff_imports": 0,
+            "handoff_import_bytes": 0,
+            "handoff_import_tokens": 0,
         }
 
     # lint: allow(owned-by-violation) -- bench/warmup contract: callers
@@ -1516,6 +1569,204 @@ class DecodeEngine:
         )
         self.kv_manager.stats["cow_copies"] += 1
 
+    # ------------------------------------------------------------------ #
+    # paged-KV handoff (prefill/decode disaggregation, fleet/handoff.py)
+    # ------------------------------------------------------------------ #
+    @staticmethod
+    def _handoff_pad(n: int) -> int:
+        """Pow2-padded block-chain width: bounds the export/import jits
+        to one lowering per width bucket instead of one per chain
+        length (the retrace-budget rule, analysis/retrace.py)."""
+        return 1 << max(0, int(n - 1).bit_length())
+
+    def _get_handoff_export(self, width: int):
+        """Jitted pool gather for a handoff export: every cache leaf's
+        rows for ``width`` table blocks, ``[layers, width, …]`` per
+        leaf. No donation — the pool stays live (the exported chain is
+        still published and serving). Dynamic block ids index a
+        replicated axis, so no sharding constraint is needed: each
+        kv-head shard gathers its own rows and the host concatenation
+        is the unsharded view."""
+        fn = self._handoff_export_fns.get(width)
+        if fn is None:
+
+            @jax.jit
+            def run(cache, blocks):
+                return jax.tree_util.tree_map(
+                    lambda c: jnp.take(c, blocks, axis=1), cache
+                )
+
+            fn = run
+            self._handoff_export_fns[width] = fn
+        return fn
+
+    def _get_handoff_import(self, width: int):
+        """Jitted pool scatter for a handoff import: write ``width``
+        blocks of per-leaf rows into their freshly reserved pool slots.
+        Donates the cache like every mutating dispatch; padded entries
+        target the null block (their zero rows are never read through a
+        live length mask). Outputs carry the pool's sharding constraint
+        for the same reason the block copy does — the scattered block
+        axis is replicated, and without the pin the partitioner may
+        materialize the kv-head-sharded pool whole under tp>1."""
+        fn = self._handoff_import_fns.get(width)
+        if fn is None:
+            sharding = self._cache_sharding
+
+            @functools.partial(jax.jit, donate_argnums=(1,))
+            def run(params, cache, blocks, data):
+                del params
+
+                def put(c, d, s):
+                    return jax.lax.with_sharding_constraint(
+                        c.at[:, blocks].set(d.astype(c.dtype)), s
+                    )
+
+                return (
+                    jax.tree_util.tree_map(put, cache, data, sharding),
+                )
+
+            fn = run
+            self._handoff_import_fns[width] = fn
+        return fn
+
+    def _export_handoff(self, slot: _Slot) -> Optional[Dict[str, Any]]:
+        """Serialize the finishing slot's published chain for the topic
+        fabric: full blocks of ``history[:length]`` (exactly what
+        :meth:`PagedKVManager.publish` made matchable — the final
+        sampled token is never in the cache, so it rides the manifest's
+        teacher-forced replay instead). Returns the payload
+        ``fleet.handoff.handoff_records`` chunks, or None when nothing
+        is exportable (no full block yet)."""
+        full = slot.length // self.block_size
+        if full <= 0 or not slot.blocks:
+            return None
+        tokens = slot.history[: full * self.block_size]
+        blocks = slot.blocks[:full]
+        width = self._handoff_pad(full)
+        padded = np.zeros((width,), dtype=np.int32)
+        padded[:full] = blocks
+        run = self._get_handoff_export(width)
+        gathered = run(self.cache, padded)
+        arrays = {
+            leaf: np.asarray(value)[:, :full]
+            for leaf, value in gathered.items()
+        }
+        # lazy: the canonical byte accounting lives with the wire
+        # schema (one definition for gauges, assembler, and sim)
+        from langstream_tpu.fleet.handoff import payload_nbytes
+
+        payload = {
+            "tokens": list(tokens),
+            "arrays": arrays,
+            "block_size": self.block_size,
+            "kv_quant": bool(self.kv_quant),
+        }
+        nbytes = payload_nbytes(payload)
+        self.stats["handoff_exports"] += 1
+        self.stats["handoff_export_bytes"] += nbytes
+        flight.record(
+            "kv_handoff_export",
+            tokens=len(tokens),
+            blocks=full,
+            nbytes=nbytes,
+        )
+        return payload
+
+    def _import_pending_handoffs(self) -> None:
+        """Import every pending request's handoff payload BEFORE the
+        admission scan, on the engine thread (the manager's owner): the
+        written chain publishes under the normal ``(parent_block,
+        chunk)`` keys, so the request's own admission — and any
+        concurrent same-prefix admission — then hits the prefix cache
+        instead of re-prefilling. A failed import (pool pressure, shape
+        mismatch, torn payload) bills ``handoff_aborted`` and degrades
+        to recompute — never a caller-visible error."""
+        if not self.paged or not self.prefix_cache:
+            return
+        for request in self._pending:
+            if request.kv_import is None:
+                continue
+            payload, request.kv_import = request.kv_import, None
+            self._import_handoff(payload)
+
+    def _import_handoff(self, payload: Dict[str, Any]) -> bool:
+        manager = self.kv_manager
+        tokens = list(payload.get("tokens") or [])
+        arrays = payload.get("arrays") or {}
+        size = int(payload.get("block_size", 0) or 0)
+        full = len(tokens) // size if size else 0
+
+        def aborted(reason: str) -> bool:
+            self._waste("handoff_aborted", len(tokens))
+            flight.record(
+                "kv_handoff_import_aborted",
+                reason=reason, tokens=len(tokens),
+            )
+            return False
+
+        if self.mirror is not None:
+            # followers replay dispatch records, and the import scatter
+            # carries host-built arrays no record schema ships yet —
+            # refuse rather than fork the mirrored pools
+            return aborted("mirror")
+        if (
+            full <= 0
+            or size != self.block_size
+            or bool(payload.get("kv_quant", False)) != bool(self.kv_quant)
+            or set(arrays) != set(self.cache)
+        ):
+            return aborted("layout_mismatch")
+        for leaf, expect in self.cache.items():
+            shape = tuple(np.asarray(arrays[leaf]).shape)
+            if shape != (expect.shape[0], full, *expect.shape[2:]):
+                return aborted("shape_mismatch")
+        reserved = manager.import_session(tokens)
+        if reserved is None:
+            return aborted("pool_exhausted")
+        chain, fresh = reserved
+        try:
+            if fresh:
+                # only the blocks the local cache does NOT already hold
+                # are written; a (partially) resident prefix keeps its
+                # local rows — they are bitwise the same content
+                start = len(chain)
+                width = self._handoff_pad(len(fresh))
+                padded = np.zeros((width,), dtype=np.int32)
+                padded[: len(fresh)] = fresh
+                data = {}
+                for leaf, array in arrays.items():
+                    piece = np.ascontiguousarray(
+                        np.asarray(array)[:, start:full]
+                    )
+                    if width > len(fresh):
+                        pad = [(0, 0)] * piece.ndim
+                        pad[1] = (0, width - len(fresh))
+                        piece = np.pad(piece, pad)
+                    data[leaf] = piece
+                run = self._get_handoff_import(width)
+                (self.cache,) = run(self.params, self.cache, padded, data)
+        except Exception:  # noqa: BLE001 — unwind before ids recycle
+            manager.abort_import(chain + fresh)
+            raise
+        manager.commit_import(tokens, chain + fresh)
+        nbytes = payload.get("nbytes")
+        if not isinstance(nbytes, (int, float)):
+            from langstream_tpu.fleet.handoff import payload_nbytes
+
+            nbytes = payload_nbytes(payload)
+        self.stats["handoff_imports"] += 1
+        self.stats["handoff_import_bytes"] += int(nbytes)
+        self.stats["handoff_import_tokens"] += len(tokens)
+        flight.record(
+            "kv_handoff_import",
+            tokens=len(tokens),
+            blocks_written=len(fresh),
+            blocks_local=len(chain),
+            nbytes=int(nbytes),
+        )
+        return True
+
     def _dispatch_prefix_copy(self, src: int, dst: int, length: int) -> None:
         """Copy cache rows [0:length) of ``src`` into ``dst`` in
         bucket-sized windows. Windows may overshoot the exact length:
@@ -1851,11 +2102,16 @@ class DecodeEngine:
         session_id: Optional[str] = None,
         handle: Optional[List[GenerationRequest]] = None,
         trace_id: Optional[str] = None,
+        request_fields: Optional[Dict[str, Any]] = None,
     ) -> GenerationResult:
         """Asyncio entry: submit and await the result. Pass ``handle``
         (an empty list) to receive the live request — its ``cancel()``
         ends generation at the next token boundary (used by the service
-        layer for stop-string matches and disconnected clients)."""
+        layer for stop-string matches and disconnected clients).
+        ``request_fields`` sets extra :class:`GenerationRequest` fields
+        before submit — the disaggregation seam (``export_handoff`` on
+        the prefill leg; ``kv_import``/``replay_tokens``/``prompt_len``
+        on the decode leg's warm admission)."""
         loop = asyncio.get_running_loop()
         future: "asyncio.Future[GenerationResult]" = loop.create_future()
         request = GenerationRequest(
@@ -1868,6 +2124,9 @@ class DecodeEngine:
             loop=loop,
             trace_id=trace_id,
         )
+        if request_fields:
+            for key, value in request_fields.items():
+                setattr(request, key, value)
         if handle is not None:
             handle.append(request)
         self.start()
@@ -2457,6 +2716,7 @@ class DecodeEngine:
         always reads rows whose writes are already dispatched."""
         self._shed_expired()
         self._drop_cancelled()
+        self._import_pending_handoffs()
         largest = self.prefill_buckets[-1]
         while self._pending:
             cold: List[Tuple[int, GenerationRequest]] = []
@@ -2583,6 +2843,7 @@ class DecodeEngine:
         like every partially-matched prompt."""
         self._shed_expired()
         self._drop_cancelled()
+        self._import_pending_handoffs()
         while self._pending:
             position, index, session_lcp = self._scan_admission()
             request = self._pending[position]
@@ -4362,6 +4623,11 @@ class DecodeEngine:
                 self.kv_manager.publish(
                     slot.history[: slot.length], slot.blocks
                 )
+                if request.export_handoff and reason != "cancelled":
+                    # disaggregation prefill leg: serialize the chain
+                    # just published, while the slot's refs still pin
+                    # it (no eviction race inside this finish)
+                    result.kv_handoff = self._export_handoff(slot)
             if request.session_id is not None:
                 slot.session_id = request.session_id
                 slot.last_used = time.monotonic()

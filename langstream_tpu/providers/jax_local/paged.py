@@ -225,6 +225,64 @@ class PagedKVManager:
                 out[b] = key
         return out
 
+    # ------------------------------------------------------------------ #
+    # KV handoff (prefill/decode disaggregation, fleet/handoff.py)
+    # ------------------------------------------------------------------ #
+    def export_session(self, tokens: Sequence[int]) -> Tuple[List[int], int]:
+        """The handoff export set: the longest published chain covering
+        full blocks of ``tokens``. Unlike :meth:`match`, the chain IS
+        refcounted — it must survive concurrent LRU eviction while the
+        engine serializes the pool data behind it — so the caller
+        :meth:`release`\\ s it once the chunks are on the wire."""
+        chain, matched = self.match(tokens)
+        self.ref(chain)
+        return chain, matched
+
+    def import_session(
+        self, tokens: Sequence[int]
+    ) -> Optional[Tuple[List[int], List[int]]]:
+        """Worst-case reservation at import-admission: returns
+        ``(local_chain, fresh_blocks)`` — the locally-published prefix
+        (refcounted, its rows need no write) plus freshly allocated
+        blocks for every remaining full block of ``tokens`` — or None
+        when the pool cannot cover the import even after eviction (the
+        caller aborts the handoff and falls back to recompute).
+
+        Fresh blocks stay UNPUBLISHED (refcount 1) until
+        :meth:`commit_import`: an aborted partial import releases them
+        straight back to the free list, so a handoff torn mid-transfer
+        can never leave half-written rows matchable under live chain
+        keys before the block ids recycle."""
+        size = self.block_size
+        full = len(tokens) // size
+        chain, matched = self.match(tokens)
+        self.ref(chain)
+        fresh = self.allocate(full - len(chain))
+        if fresh is None:
+            self.release(chain)
+            return None
+        return chain, fresh
+
+    def commit_import(
+        self, tokens: Sequence[int], blocks: Sequence[int]
+    ) -> None:
+        """Publish a completed import under the same collision-free
+        ``(parent_block, chunk)`` chain keys a locally-built prefix
+        gets — the imported chain gossips as affinity digests and
+        matches future admissions like any other — then drop the import
+        refs (cache-held, evictable under pressure like any published
+        chain)."""
+        size = self.block_size
+        self.publish(tokens[: (len(tokens) // size) * size], blocks)
+        self.release(blocks)
+
+    def abort_import(self, blocks: Sequence[int]) -> None:
+        """Unwind a torn import BEFORE any block id recycles: nothing
+        was published, so releasing the refs frees the fresh blocks
+        (and un-pins any locally-matched prefix) with no stale-chain
+        hazard."""
+        self.release(blocks)
+
     def _unpublish(self, block: int) -> None:
         key = self._key_of.pop(block)
         del self._map[key]

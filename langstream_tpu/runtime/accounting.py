@@ -237,6 +237,20 @@ class CostModel:
         )
         return per_step * steps
 
+    def kv_handoff_bytes(self, tokens: int) -> float:
+        """Bytes a paged-KV handoff (prefill/decode disaggregation)
+        moves for ``tokens`` rows of history: whole-model rows —
+        ``kv_row_bytes`` is per CHIP under tp, and an export
+        concatenates every shard's kv-heads — block-padded like any
+        pool access (the handoff ships whole blocks). This is the
+        transfer price the disagg A/B reads next to its tail win, and
+        what the engine's ``kv_handoff_*_bytes_total`` gauges should
+        roughly integrate to."""
+        return (
+            float(self.kv_row_bytes) * self.tp_shards
+            * self.kv_read_tokens(int(tokens))
+        )
+
     # ------------------------------------------------------------------ #
     # prefill
     # ------------------------------------------------------------------ #

@@ -100,6 +100,10 @@ SHARDS: Dict[str, List[str]] = {
     # shards' wall time flat as the fleet suite grows
     "fleet": [
         "test_fleet",
+        # prefill/decode disaggregation: the sim A/B + handoff
+        # machinery are pure-CPU; the real-engine bitwise-parity legs
+        # are JAX-heavy but belong with the fleet story they verify
+        "test_disagg",
     ],
     # static analysis (`langstream-tpu check`): lock-discipline +
     # jit-hazard AST fixtures, the HLO rule library, and the repo-wide
