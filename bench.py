@@ -106,6 +106,30 @@ KV_LAYOUT = (
 if KV_LAYOUT not in ("dense", "paged"):
     print(f"unknown --kv-layout {KV_LAYOUT!r} (dense|paged)", file=sys.stderr)
     sys.exit(2)
+# Paged pool size in blocks (0 = the dense-equivalent worst case,
+# slots x ceil(max_seq/block)). The tiered leg shrinks this to put the
+# pool under REAL eviction pressure — an unbounded pool never demotes,
+# and a pressure-free tier A/B proves nothing.
+KV_BLOCKS = int(
+    _cli_flag("kv-blocks")
+    or os.environ.get("BENCH_KV_BLOCKS", "")
+    or "0"
+)
+if KV_BLOCKS and KV_LAYOUT != "paged":
+    print("--kv-blocks requires --kv-layout paged", file=sys.stderr)
+    sys.exit(2)
+# Host-DRAM demotion tier (ISSUE 18): arena capacity in blocks, 0 = the
+# HBM-only pool. One flag for the tiered-vs-untiered A/B under pool
+# pressure (bench_heal_kv_tiers.json leg); also BENCH_KV_HOST_BLOCKS
+# for the heal watcher. Only meaningful with --kv-layout paged.
+KV_HOST_BLOCKS = int(
+    _cli_flag("kv-host-blocks")
+    or os.environ.get("BENCH_KV_HOST_BLOCKS", "")
+    or "0"
+)
+if KV_HOST_BLOCKS and KV_LAYOUT != "paged":
+    print("--kv-host-blocks requires --kv-layout paged", file=sys.stderr)
+    sys.exit(2)
 # Paged attention kernel: fused ragged Pallas launch over the block
 # tables (default) vs the gather/scatter reference oracle. Only
 # meaningful with --kv-layout paged; the fused-vs-reference pair is the
@@ -499,6 +523,7 @@ def emit_provisional(metric: str, tok_s: float, **extra) -> None:
         # last line is a provisional must stay attributable to its leg
         "decode_kernel": os.environ.get("LS_DECODE_FLASH", "") or "auto",
         "kv_layout": KV_LAYOUT,
+        "kv_host_blocks": KV_HOST_BLOCKS,
         "paged_kernel": PAGED_KERNEL,
         "spec_decode": SPEC_DECODE,
         "prefill_mode": PREFILL_MODE,
@@ -537,6 +562,24 @@ def mixed_carry_extras(stats: dict) -> dict:
             round(stats.get("mixed_gap_time", 0.0) / mixed_steps * 1e3, 3)
             if mixed_steps else 0.0
         ),
+    }
+
+
+def host_tier_extras(stats: dict) -> dict:
+    """Tiered-pool evidence columns (host arena enabled only): how much
+    the demotion tier absorbed (host hits vs the recompute an un-tiered
+    pool would burn) and the waste column the A/B is judged on.
+    ab_analyze's kv-tiers leg reads these next to tok/s."""
+    if not KV_HOST_BLOCKS:
+        return {}
+    wasted = dict(stats.get("tokens_wasted", {}))
+    return {
+        "kv_host_blocks": KV_HOST_BLOCKS,
+        "host_demotions": stats.get("host_demotions", 0),
+        "host_promotions": stats.get("host_promotions", 0),
+        "host_promote_aborts": stats.get("host_promote_aborts", 0),
+        "kv_host_hit_tokens": stats.get("kv_host_hit_tokens", 0),
+        "evicted_recompute_tokens": wasted.get("evicted_recompute", 0),
     }
 
 
@@ -712,6 +755,8 @@ def run_compile_only() -> int:
         quantize=QUANT,
         kv_quant=KV_QUANT,
         kv_layout=KV_LAYOUT,
+        kv_blocks=KV_BLOCKS or None,
+        kv_host_blocks=KV_HOST_BLOCKS,
         paged_kernel=PAGED_KERNEL,
         prefill_mode=PREFILL_MODE,
         prefill_chunk=PREFILL_CHUNK,
@@ -969,6 +1014,8 @@ async def run_bench():
         quantize=QUANT,
         kv_quant=KV_QUANT,
         kv_layout=KV_LAYOUT,
+        kv_blocks=KV_BLOCKS or None,
+        kv_host_blocks=KV_HOST_BLOCKS,
         paged_kernel=PAGED_KERNEL,
         spec_decode=SPEC_DECODE,
         spec_k=SPEC_K,
@@ -1020,6 +1067,7 @@ async def run_bench():
             "chaos": CHAOS,
             "decode_kernel": os.environ.get("LS_DECODE_FLASH", "") or "auto",
             **mixed_carry_extras(stats),
+            **host_tier_extras(stats),
         })
     finally:
         # release the engine thread + device buffers even on OOM so the
@@ -1105,6 +1153,7 @@ async def run_bench_e2e():
                 "precompile": True,
                 "kv-quant": KV_QUANT or "",
                 "kv-layout": KV_LAYOUT,
+                "kv-host-blocks": KV_HOST_BLOCKS or "",
                 "paged-kernel": PAGED_KERNEL,
                 "spec-decode": SPEC_DECODE,
                 "spec-k": SPEC_K,

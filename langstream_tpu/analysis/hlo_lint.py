@@ -92,6 +92,45 @@ def pool_gather_lines(text: str, dims: PoolDims) -> List[str]:
     ]
 
 
+def pool_shaped_return_lines(text: str, dims: PoolDims) -> List[str]:
+    """Lowered StableHLO ``return`` lines carrying a full-pool-shaped
+    tensor [.., N, Bs, KVH, D]. On the tier demote gather this is the
+    bug the bounded-tier-transfer rule exists for: the D2H payload the
+    host arena reads back would be the ENTIRE pool, not the evicted
+    block's rows."""
+    pool_type = (
+        f"{dims.num_blocks}x{dims.block_size}"
+        f"x{dims.kv_heads}x{dims.head_dim}x{dims.dtype}"
+    )
+    return [
+        line for line in text.splitlines()
+        if line.strip().startswith("return") and pool_type in line
+    ]
+
+
+def unaliased_pool_param_chunks(text: str, dims: PoolDims) -> List[str]:
+    """``@main`` parameters of a lowered dispatch that are pool-shaped
+    but NOT donation-aliased (no ``tf.aliasing_output`` attr). On the
+    tier promote scatter every pool-shaped input must be the donated
+    cache itself — an unaliased one is an H2D upload of a whole pool
+    per promotion. Returns a truncated chunk per offending param."""
+    pool_type = (
+        f"{dims.num_blocks}x{dims.block_size}"
+        f"x{dims.kv_heads}x{dims.head_dim}x{dims.dtype}"
+    )
+    start = text.find("@main(")
+    if start < 0:
+        return []
+    arrow = text.find("->", start)
+    end = arrow if arrow > 0 else text.find("{", start)
+    header = text[start:end] if end > 0 else text[start:]
+    return [
+        ("%arg" + chunk.strip().rstrip(", "))[:120]
+        for chunk in header.split("%arg")[1:]
+        if pool_type in chunk and "aliasing_output" not in chunk
+    ]
+
+
 _COLLECTIVE_RE = re.compile(
     r"=\s+\S*\s*(all-gather|all-reduce|reduce-scatter|"
     r"collective-permute|all-to-all)"
@@ -146,9 +185,36 @@ def compiled_text(engine, fn) -> str:
         return fn.lower(*avals).compile().as_text()
 
 
+def tier_transfer_avals(engine, width: int):
+    """(params, cache, blocks, payload) avals for the tier-transfer
+    jits at ``width`` — mirrors what ``_demote_block_data`` and
+    ``_promote_host_chain`` pass. These builders live outside
+    ``_variant_jobs`` (the export's arg order breaks its params/cache
+    contract), so the lint supplies their avals directly."""
+    import jax
+    import jax.numpy as jnp
+
+    def aval(x):
+        return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+
+    params_aval = jax.tree_util.tree_map(aval, engine.params)
+    cache_aval = jax.tree_util.tree_map(aval, engine.cache)
+    blocks_aval = jax.ShapeDtypeStruct((width,), jnp.int32)
+    payload_aval = jax.tree_util.tree_map(
+        lambda c: jax.ShapeDtypeStruct(
+            (c.shape[0], width) + c.shape[2:], c.dtype
+        ),
+        cache_aval,
+    )
+    return params_aval, cache_aval, blocks_aval, payload_aval
+
+
 def named_dispatches(engine) -> Dict[str, Any]:
     """The curated dispatch set every rule is evaluated on: the builders
-    an engine of this configuration actually serves traffic through."""
+    an engine of this configuration actually serves traffic through.
+    Values are either a jitted fn (avals resolved through the engine's
+    ``_variant_jobs`` contract) or an explicit ``(fn, avals)`` pair for
+    builders that live outside the serving job list."""
     out: Dict[str, Any] = {}
     if getattr(engine, "mixed", False):
         for width in engine._mixed_widths:
@@ -164,6 +230,22 @@ def named_dispatches(engine) -> Dict[str, Any]:
         )
     if getattr(engine, "paged", False):
         out["block_copy"] = engine._get_block_copy()
+        if getattr(engine, "kv_host_arena", None) is not None:
+            # tier data plane (host-DRAM demotion): the demote gather
+            # produces every D2H payload, the promote scatter consumes
+            # every H2D one. Width 1 is the shape single-block demotion
+            # always dispatches; wider promotions are the same program
+            # modulo the leading dim, so one width lints the family.
+            params_aval, cache_aval, blocks, payload = tier_transfer_avals(
+                engine, 1
+            )
+            out["host_demote_gather[1]"] = (
+                engine._get_handoff_export(1), (cache_aval, blocks)
+            )
+            out["host_promote_scatter[1]"] = (
+                engine._get_handoff_import(1),
+                (params_aval, cache_aval, blocks, payload),
+            )
     return out
 
 
@@ -199,7 +281,18 @@ def _rule_no_full_pool_all_gather(engine, dispatch: str, text: str):
     ]
 
 
+def _is_tier_transfer(dispatch: str) -> bool:
+    return (
+        "host_demote_gather" in dispatch
+        or "host_promote_scatter" in dispatch
+    )
+
+
 def _rule_no_pool_shaped_gather(engine, dispatch: str, text: str):
+    if _is_tier_transfer(dispatch):
+        # gathering/scattering pool rows IS these dispatches' job; the
+        # bounded-tier-transfer rule polices their payload shape instead
+        return []
     dims = pool_dims(engine)
     lines = pool_gather_lines(text, dims)
     if not lines:
@@ -214,6 +307,11 @@ def _rule_no_pool_shaped_gather(engine, dispatch: str, text: str):
 
 
 def _rule_donation_respected(engine, dispatch: str, text: str):
+    if "host_demote_gather" in dispatch:
+        # deliberately undonated: the demoted chain is still published
+        # and serving while its rows are read out, so the pool must
+        # survive the gather (the export builder's own contract)
+        return []
     if donation_alias_present(text):
         return []
     return [
@@ -238,6 +336,39 @@ def _rule_collective_census(engine, dispatch: str, text: str):
             "communicate with",
         )
     ]
+
+
+def _rule_bounded_tier_transfer(engine, dispatch: str, text: str):
+    dims = pool_dims(engine)
+    if "host_demote_gather" in dispatch:
+        lines = pool_shaped_return_lines(text, dims)
+        if not lines:
+            return []
+        return [
+            Finding(
+                "bounded-tier-transfer", f"<hlo:{dispatch}>", 0,
+                f"{dispatch} returns a full-pool-shaped payload — every "
+                "demotion on the steady-state decode path would ship "
+                f"the ENTIRE [{dims.num_blocks},{dims.block_size},"
+                f"{dims.kv_heads},{dims.head_dim}] pool over D2H "
+                "instead of the evicted block's rows:\n"
+                + "\n".join(lines[:4]),
+            )
+        ]
+    if "host_promote_scatter" in dispatch:
+        chunks = unaliased_pool_param_chunks(text, dims)
+        if not chunks:
+            return []
+        return [
+            Finding(
+                "bounded-tier-transfer", f"<hlo:{dispatch}>", 0,
+                f"{dispatch} takes a pool-shaped input WITHOUT a "
+                "donation alias — each promotion would upload a whole "
+                "pool over H2D instead of writing the chain's rows "
+                "into the donated cache:\n" + "\n".join(chunks[:4]),
+            )
+        ]
+    return []
 
 
 RULES: List[HloRule] = [
@@ -267,6 +398,17 @@ RULES: List[HloRule] = [
         applies=lambda e: True,
         check=_rule_collective_census,
     ),
+    HloRule(
+        "bounded-tier-transfer", "lowered",
+        "tier transfers move width-bounded rows, never a full pool "
+        "(demote gather returns no pool-shaped payload; promote "
+        "scatter's only pool-shaped input is the donated cache)",
+        applies=lambda e: (
+            getattr(e, "paged", False)
+            and getattr(e, "kv_host_arena", None) is not None
+        ),
+        check=_rule_bounded_tier_transfer,
+    ),
 ]
 
 
@@ -292,7 +434,10 @@ def check_engine(
         # the matrix's trace time)
         texts: Dict[str, str] = {}
         if active:
-            jit_fn, avals = variant_avals(engine, fn)
+            if isinstance(fn, tuple):  # (fn, avals) — outside _variant_jobs
+                jit_fn, avals = fn
+            else:
+                jit_fn, avals = variant_avals(engine, fn)
             with engine.mesh:
                 lowered = jit_fn.lower(*avals)
                 if any(r.needs == "lowered" for r in active):
@@ -325,6 +470,10 @@ def default_matrix(device_count: int) -> List[Tuple[str, Dict[str, Any]]]:
         ("paged-fused-mixed-tp1",
          dict(paged, paged_kernel="fused", prefill_mode="mixed",
               prefill_chunk=16)),
+        # host-DRAM demotion tier: adds the demote gather / promote
+        # scatter dispatches and arms the bounded-tier-transfer rule
+        ("paged-fused-tiered-tp1",
+         dict(paged, paged_kernel="fused", kv_host_blocks=16)),
     ]
     if device_count >= 2:
         matrix += [

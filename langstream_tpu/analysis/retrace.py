@@ -142,9 +142,15 @@ def default_matrix() -> List[Tuple[str, Dict[str, Any]]]:
     paged = dict(kv_layout="paged", kv_block_size=8)
     return [
         ("dense-tp1", {}),
+        # kv_host_blocks rides the paged leg: the tier's demote gather
+        # and promote scatter ARE the handoff export/import builders,
+        # so this sweeps their memos on the exact engine shape the
+        # tiered pool serves through (a broken memo would re-lower the
+        # H2D scatter on every promotion)
         ("paged-fused-mixed-spec-tp1",
          dict(paged, paged_kernel="fused", prefill_mode="mixed",
-              prefill_chunk=16, spec_decode="ngram", spec_k=2)),
+              prefill_chunk=16, spec_decode="ngram", spec_k=2,
+              kv_host_blocks=16)),
     ]
 
 

@@ -928,6 +928,13 @@ def build_parser() -> argparse.ArgumentParser:
              "equivalent worst case, slots x ceil(max_seq/block))",
     )
     serve.add_argument(
+        "--kv-host-blocks", type=int, default=0,
+        help="paged layout: host-DRAM demotion tier capacity in "
+             "blocks (0 = off). Evicted chains demote to pinned host "
+             "RAM and promote back on a prefix digest hit instead of "
+             "recomputing (docs/perf.md 'KV tiers')",
+    )
+    serve.add_argument(
         "--paged-kernel", default="fused", choices=["fused", "reference"],
         help="paged attention kernel: fused ragged Pallas launch over "
              "the block tables (default) or the gather/scatter "

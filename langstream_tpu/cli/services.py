@@ -284,6 +284,7 @@ async def serve_main(args) -> None:
             "kv-layout": getattr(args, "kv_layout", "dense"),
             "kv-block-size": getattr(args, "kv_block_size", 16),
             "kv-blocks": getattr(args, "kv_blocks", 0) or "",
+            "kv-host-blocks": getattr(args, "kv_host_blocks", 0) or "",
             "paged-kernel": getattr(args, "paged_kernel", "fused"),
             "spec-decode": getattr(args, "spec_decode", "off"),
             "spec-k": getattr(args, "spec_k", 4),

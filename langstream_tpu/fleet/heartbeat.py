@@ -26,6 +26,10 @@ ignored so the schema can grow without a fleet-wide flag day):
       "block_size":      16,              # paged block size (0 = dense)
       "chain_digests":   ["ab12…", …],    # resident prefix chains
                                           #   (router.digests_from_keys)
+      "host_chain_digests": ["cd34…", …], # chains demoted to the
+                                          #   host-DRAM tier, promotable
+                                          #   without recompute (absent =
+                                          #   un-tiered pool)
       "gauges":          {…}              # engines_snapshot subset:
                                           #   SLO burn rates, sheds,
                                           #   prefix hit tokens
@@ -131,6 +135,11 @@ def build_heartbeat(
                     break
                 except RuntimeError:  # dict resized under iteration
                     continue
+            arena = getattr(manager, "host", None)
+            if arena is not None:
+                # the arena is thread-safe (own lock), so no retry
+                # loop; digests() is a point-in-time snapshot set
+                heartbeat["host_chain_digests"] = sorted(arena.digests())
         else:
             heartbeat["block_size"] = 0
     if snapshot is None and engine is not None:

@@ -179,6 +179,10 @@ class ReplicaState:
     active_sessions: float = 0.0
     block_size: int = 0
     digests: Set[str] = dataclasses.field(default_factory=set)
+    # host-DRAM tier (ISSUE 18): chains demoted out of HBM but still
+    # promotable without recompute — worth routing to, at a discount
+    # (the H2D scatter is cheap next to a cold re-prefill)
+    host_digests: Set[str] = dataclasses.field(default_factory=set)
     gauges: Dict[str, float] = dataclasses.field(default_factory=dict)
     draining: bool = False
     condemned_at_seq: Optional[int] = None
@@ -202,6 +206,9 @@ class RouteDecision:
     policy: str            # affinity | least_queue | round_robin
     matched_blocks: int = 0
     matched_tokens: int = 0
+    # of matched_blocks, how many the chosen replica holds only in its
+    # host-DRAM tier (promotion, not a free HBM hit)
+    matched_host_blocks: int = 0
 
 
 class FleetRouter:
@@ -235,6 +242,7 @@ class FleetRouter:
             "sticky": 0,
         }
         self._matched_tokens = 0  # guarded-by: _lock
+        self._matched_host_tokens = 0  # guarded-by: _lock
         self._sticky_stale = 0  # guarded-by: _lock
 
     # ------------------------------------------------------------------ #
@@ -297,6 +305,11 @@ class FleetRouter:
                 # full replacement, not a merge: evicted chains age out
                 # of scoring with the next heartbeat
                 state.digests = {str(d) for d in digests}
+            host_digests = heartbeat.get("host_chain_digests")
+            if isinstance(host_digests, (list, set, tuple)):
+                # same replacement rule for the host tier; pre-tier
+                # senders simply never carry the field
+                state.host_digests = {str(d) for d in host_digests}
             gauges = heartbeat.get("gauges")
             if isinstance(gauges, Mapping):
                 state.gauges = {
@@ -413,7 +426,8 @@ class FleetRouter:
             with self._lock:
                 sizes = {
                     s.block_size for s in self.replicas.values()
-                    if s.block_size > 0 and s.digests
+                    if s.block_size > 0
+                    and (s.digests or s.host_digests)
                     and s.routable(now, self.heartbeat_timeout_s)
                 }
             for block_size in sizes:
@@ -449,30 +463,46 @@ class FleetRouter:
                 self._rr += 1
                 decision = RouteDecision(chosen.replica_id, "round_robin")
             else:
-                best, best_score = None, -1
+                best, best_score = None, -1.0
+                best_hbm, best_host = 0, 0
                 for state in candidates:
-                    score = 0
+                    score, hbm, host = 0.0, 0, 0
                     # a block size that appeared between the two lock
                     # sections simply scores 0 this decision
                     chain = chains.get(state.block_size)
-                    if chain and state.digests:
+                    if chain and (state.digests or state.host_digests):
+                        # tier pricing (ISSUE 18): an HBM-resident block
+                        # is a free hit, a host-tier block still pays
+                        # the H2D promote — hbm-hit > host-hit > cold,
+                        # so a full HBM chain beats the same chain
+                        # demoted, but a demoted chain still beats any
+                        # replica that would cold-prefill it
                         for digest in chain:
-                            if digest not in state.digests:
+                            if digest in state.digests:
+                                score += 1.0
+                                hbm += 1
+                            elif digest in state.host_digests:
+                                score += 0.5
+                                host += 1
+                            else:
                                 break
-                            score += 1
                     if score > best_score or (
                         score == best_score
                         and best is not None
                         and state.queue_depth < best.queue_depth
                     ):
                         best, best_score = state, score
+                        best_hbm, best_host = hbm, host
                 assert best is not None
                 chosen = best
                 if best_score > 0:
                     decision = RouteDecision(
                         chosen.replica_id, "affinity",
-                        matched_blocks=best_score,
-                        matched_tokens=best_score * chosen.block_size,
+                        matched_blocks=best_hbm + best_host,
+                        matched_tokens=(
+                            (best_hbm + best_host) * chosen.block_size
+                        ),
+                        matched_host_blocks=best_host,
                     )
                 else:
                     decision = RouteDecision(chosen.replica_id, "least_queue")
@@ -483,6 +513,9 @@ class FleetRouter:
                 self._routed.get(decision.policy, 0) + 1
             )
             self._matched_tokens += decision.matched_tokens
+            self._matched_host_tokens += (
+                decision.matched_host_blocks * chosen.block_size
+            )
             return decision
 
     # ------------------------------------------------------------------ #
@@ -504,6 +537,9 @@ class FleetRouter:
                 ) if routed else 0.0
                 out["fleet_prefix_match_tokens_total"] = float(
                     self._matched_tokens
+                )
+                out["fleet_host_match_tokens_total"] = float(
+                    self._matched_host_tokens
                 )
             # session stickiness: pins honored ride the policy="sticky"
             # routed counter above; this is the fallback leg (pin was

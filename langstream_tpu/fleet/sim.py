@@ -75,8 +75,12 @@ from langstream_tpu.fleet.router import (
     FleetRouter,
     NoRoutableReplica,
     digests_from_keys,
+    prompt_digests,
 )
-from langstream_tpu.providers.jax_local.paged import PagedKVManager
+from langstream_tpu.providers.jax_local.paged import (
+    HostKVArena,
+    PagedKVManager,
+)
 from langstream_tpu.topics.memory import (
     MemoryBroker,
     MemoryTopicProducer,
@@ -185,6 +189,7 @@ class SimReplica:
         ttft_target_s: float = 2.0,
         digest_limit: int = 4096,
         role: str = "unified",
+        kv_host_blocks: int = 0,
         prefill_blocking: bool = False,
         handoff_block_bytes: int = 2048,
         handoff_chunk_bytes: int = 8192,
@@ -219,6 +224,19 @@ class SimReplica:
             "exported": 0, "imported": 0, "aborted": 0, "bytes": 0,
         }
         self.kv = PagedKVManager(num_blocks, block_size)
+        # host-DRAM demotion tier (ISSUE 18): accounting-only arena —
+        # no rows to move in the sim, but matching, LRU, capacity
+        # backpressure, and gossip behave exactly like the engine's
+        self.kv_host_blocks = int(kv_host_blocks)
+        if self.kv_host_blocks > 0:
+            self.kv.attach_host(HostKVArena(self.kv_host_blocks))
+        self.host_hit_tokens = 0
+        # eviction-recompute ledger, the engine's
+        # tokens_wasted{evicted_recompute} analogue: full blocks this
+        # replica prefills AGAIN after having once published them
+        # (digest-keyed, so an id recycled by the pool still counts)
+        self._taught: set = set()
+        self.recompute_tokens = 0
         self.queue: Deque[Tuple[SimSession, float]] = deque()
         self.active: List[_Slot] = []
         self.state = "serving"
@@ -256,11 +274,33 @@ class SimReplica:
             self.kv.ref(chain)
             self.kv.stats["hit_tokens"] += matched
             table = chain + fresh
+            # host-tier promotion: digest-matched demoted blocks
+            # continue the HBM chain without recompute — prefill only
+            # pays for tokens NEITHER tier holds (the engine's H2D
+            # scatter costs bytes, not FLOPs; the step model prices
+            # FLOPs, so a promoted block is simply not re-prefilled)
+            promoted = 0
+            if self.kv.host is not None:
+                entries = self.kv.host_match(adm, len(chain))
+                if entries:
+                    promoted = len(entries) * self.block_size
+                    self.host_hit_tokens += promoted
+                    self.kv.host.note_promoted(len(entries))
+            # eviction-recompute: unmatched full blocks this replica
+            # once published are re-teach work an un-tiered pool burns
+            digests = prompt_digests(
+                adm, self.block_size, limit=len(adm) // self.block_size
+            )
+            start = len(chain) + (promoted // self.block_size)
+            self.recompute_tokens += self.block_size * sum(
+                1 for d in digests[start:] if d in self._taught
+            )
+            self._taught.update(digests)
             # publish-cold-at-admission: concurrent same-prefix
             # sessions hit these blocks before this one finishes
             self.kv.publish(adm, table)
             prefill_steps = math.ceil(
-                max(0, len(adm) - matched) / self.prefill_rate
+                max(0, len(adm) - matched - promoted) / self.prefill_rate
             )
             self.active.append(_Slot(session, table, prefill_steps, adm))
 
@@ -461,6 +501,12 @@ class SimReplica:
         identity, heartbeat seq continues so the router's condemnation
         clears on the next serving gossip."""
         self.kv = PagedKVManager(self.kv.num_blocks, self.block_size)
+        if self.kv_host_blocks > 0:
+            # pinned host memory dies with the process too
+            self.kv.attach_host(HostKVArena(self.kv_host_blocks))
+        # a crash-rebuild re-teach is crash recompute, not eviction
+        # recompute — reset the ledger so the tiered A/B stays honest
+        self._taught = set()
         self.state = "serving"
         self.boot += 1  # new process: new heartbeat epoch
 
@@ -493,7 +539,7 @@ class SimReplica:
         gauges["prefix_cache_hit_tokens_total"] = float(
             self.kv.stats["hit_tokens"]
         )
-        return {
+        heartbeat = {
             "replica": self.name,
             "seq": self.seq,
             "epoch": f"{self.name}/boot-{self.boot}",
@@ -510,6 +556,11 @@ class SimReplica:
             ),
             "gauges": gauges,
         }
+        if self.kv.host is not None:
+            heartbeat["host_chain_digests"] = sorted(
+                self.kv.host.digests()
+            )
+        return heartbeat
 
 
 class SimFleet:
@@ -899,6 +950,29 @@ class SimFleet:
     def fleet_shed_total(self) -> int:
         return self.fleet_sheds
 
+    def fleet_recompute_tokens(self) -> int:
+        """Eviction-recompute across live replicas — the waste column
+        the tiered A/B is judged on (retired replicas' counters are
+        crash recompute, a different bill)."""
+        return sum(
+            r.recompute_tokens for r in self.replicas.values()
+        )
+
+    def fleet_host_hit_tokens(self) -> int:
+        return sum(
+            r.host_hit_tokens for r in self.replicas.values()
+        )
+
+    def host_tier_totals(self) -> Dict[str, int]:
+        totals = {"demoted_blocks": 0, "promoted_blocks": 0, "evictions": 0}
+        for replica in self.replicas.values():
+            if replica.kv.host is None:
+                continue
+            stats = replica.kv.host.snapshot_stats()
+            for key in totals:
+                totals[key] += stats[key]
+        return totals
+
     def client_errors(self) -> int:
         return sum(len(s.errors) for s in self.sessions)
 
@@ -1044,6 +1118,14 @@ def _leg_record(
         record["handoffs_orphaned"] = fleet.assembler.stats[
             "handoffs_orphaned"
         ]
+    # tiered-pool columns (ISSUE 18): the A/B verdict fields — how much
+    # re-teach work eviction burned, and how much the host tier absorbed
+    record["evicted_recompute_tokens"] = fleet.fleet_recompute_tokens()
+    if any(r.kv_host_blocks > 0 for r in fleet.replicas.values()):
+        record["kv_host_hit_tokens"] = fleet.fleet_host_hit_tokens()
+        record.update(
+            {f"host_{k}": v for k, v in fleet.host_tier_totals().items()}
+        )
     return record
 
 
@@ -1134,6 +1216,70 @@ async def run_disagg_leg(
     return record
 
 
+# tiered-pool A/B traffic: MORE shared-prefix groups than one replica's
+# HBM pool can keep resident, re-arriving in shuffled waves — an
+# un-tiered pool evicts a group's prefix between its arrivals and
+# re-prefills it (evicted_recompute); the host tier absorbs the same
+# evictions as demotions and answers the re-arrival with a promotion
+TIERED_SPEC = TrafficSpec(
+    groups=8,
+    sessions_per_group=8,
+    prefix_blocks=8,
+    suffix_tokens=8,
+    max_new_tokens=8,
+    wave_size=8,
+    ticks_between_waves=2,
+)
+
+TIERED_REPLICA_KWARGS = dict(
+    block_size=8,
+    slots=4,
+    prefill_rate=32,
+    num_blocks=40,  # ~half of one replica's share of the prefix set
+)
+
+
+async def run_tiered_leg(
+    mode: str,
+    spec: TrafficSpec = TIERED_SPEC,
+    *,
+    replicas: int = 2,
+    kv_host_blocks: int = 256,
+    queue_timeout_s: Optional[float] = 16.0,
+    **fleet_kwargs: Any,
+) -> Dict[str, Any]:
+    """One leg of the tiered-vs-untiered pool A/B on identical
+    pool-pressure traffic: ``mode="tiered"`` gives every replica a
+    host-DRAM demotion arena (and gossips its digests, so the router
+    prices host hits); ``mode="untiered"`` is the same fleet with the
+    HBM-only pool. Judged on the evicted_recompute_tokens cut at
+    >=0.9x tok/s."""
+    if mode not in ("tiered", "untiered"):
+        raise ValueError(f"unknown tiered leg mode {mode!r}")
+    kwargs = dict(TIERED_REPLICA_KWARGS)
+    kwargs.update(fleet_kwargs.pop("replica_kwargs", {}))
+    kwargs["kv_host_blocks"] = kv_host_blocks if mode == "tiered" else 0
+    fleet = SimFleet(
+        replicas,
+        policy="affinity",
+        queue_timeout_s=queue_timeout_s,
+        **kwargs,
+        **fleet_kwargs,
+    )
+    await fleet._pump_heartbeats()
+    prompts = make_prompts(spec, kwargs["block_size"])
+    waves = [
+        prompts[i:i + spec.wave_size]
+        for i in range(0, len(prompts), spec.wave_size)
+    ]
+    for wave in waves:
+        for prompt in wave:
+            fleet.submit(prompt, max_new_tokens=spec.max_new_tokens)
+        await fleet.run(spec.ticks_between_waves)
+    await fleet.run_until_idle()
+    return _leg_record(fleet, mode, replicas)
+
+
 def main(argv: Optional[List[str]] = None) -> None:
     parser = argparse.ArgumentParser(
         description="routed-vs-round-robin fleet A/B on simulated traffic"
@@ -1151,12 +1297,47 @@ def main(argv: Optional[List[str]] = None) -> None:
              "and p95 TTFT at equal tok/s)",
     )
     parser.add_argument(
+        "--tiers", action="store_true",
+        help="run the tiered-vs-untiered KV pool A/B instead "
+             "(bench_fleet_tiered.json vs bench_fleet_untiered.json: "
+             "host-DRAM demotion arenas + tier-tagged gossip vs the "
+             "HBM-only pool on identical pool-pressure traffic, judged "
+             "on the evicted_recompute_tokens cut at equal tok/s)",
+    )
+    parser.add_argument(
+        "--kv-host-blocks", type=int, default=256,
+        help="--tiers: host arena capacity per replica, in blocks",
+    )
+    parser.add_argument(
         "--out", default="bench_artifacts",
         help="directory for bench_fleet_routed.json / bench_fleet_rr.json "
-             "(--disagg: bench_fleet_disagg.json / bench_fleet_unified.json)",
+             "(--disagg: bench_fleet_disagg.json / bench_fleet_unified.json; "
+             "--tiers: bench_fleet_tiered.json / bench_fleet_untiered.json)",
     )
     args = parser.parse_args(argv)
     os.makedirs(args.out, exist_ok=True)
+    if args.tiers:
+        spec = dataclasses.replace(
+            TIERED_SPEC,
+            groups=args.groups if args.groups != 4 else TIERED_SPEC.groups,
+            sessions_per_group=min(
+                args.sessions_per_group, TIERED_SPEC.sessions_per_group
+            ),
+            seed=args.seed,
+        )
+        legs = {
+            "bench_fleet_tiered.json": "tiered",
+            "bench_fleet_untiered.json": "untiered",
+        }
+        for filename, mode in legs.items():
+            record = asyncio.run(run_tiered_leg(
+                mode, spec, kv_host_blocks=args.kv_host_blocks,
+            ))
+            path = os.path.join(args.out, filename)
+            with open(path, "w") as handle:
+                handle.write(json.dumps(record) + "\n")
+            print(json.dumps(record))
+        return
     if args.disagg:
         spec = dataclasses.replace(
             DISAGG_SPEC,

@@ -136,6 +136,11 @@ def engines_snapshot() -> Dict[str, float]:
     prefix_hit_tokens = prefix_evictions = 0
     handoff_exported_bytes = handoff_imported_bytes = 0
     handoff_exports = handoff_imports = handoff_imported_tokens = 0
+    host_engines = 0
+    kv_host_blocks_in_use = kv_host_blocks_total = 0
+    host_demotions = host_promotions = host_evictions = 0
+    host_demote_bytes = host_promote_bytes = 0
+    kv_host_hit_tokens = host_promote_aborts = 0
     useful_tokens = 0
     wasted: Dict[str, int] = {
         reason: 0
@@ -238,6 +243,19 @@ def engines_snapshot() -> Dict[str, float]:
             handoff_imported_tokens += stats.get(
                 "handoff_import_tokens", 0
             )
+            arena = getattr(engine, "kv_host_arena", None)
+            if arena is not None:
+                host_engines += 1
+                arena_stats = arena.snapshot_stats()
+                kv_host_blocks_in_use += arena_stats["blocks_in_use"]
+                kv_host_blocks_total += arena.capacity_blocks
+                host_evictions += arena_stats["evictions"]
+                host_demotions += stats.get("host_demotions", 0)
+                host_demote_bytes += stats.get("host_demote_bytes", 0)
+                host_promotions += stats.get("host_promotions", 0)
+                host_promote_bytes += stats.get("host_promote_bytes", 0)
+                kv_host_hit_tokens += stats.get("kv_host_hit_tokens", 0)
+                host_promote_aborts += stats.get("host_promote_aborts", 0)
     if live_engines:
         # watchdog trips ride the engine exposition so every scrape
         # surface sees them (0 included — the series must exist BEFORE
@@ -277,6 +295,23 @@ def engines_snapshot() -> Dict[str, float]:
         out["kv_handoff_imported_tokens_total"] = float(
             handoff_imported_tokens
         )
+    if host_engines:
+        # tiered KV pool (kv-host-blocks > 0): host-arena capacity /
+        # pressure and the demote/promote traffic each way — gated on
+        # the tier being configured so an un-tiered deployment's
+        # exposition is byte-identical to pre-tier builds. Exposed from
+        # construction: a freshly sized host arena must scrape 0, not
+        # no-data, and kv_host_hit_tokens_total is the goodput-ledger
+        # companion (promotions that replaced eviction recompute)
+        out["kv_host_blocks_in_use"] = float(kv_host_blocks_in_use)
+        out["kv_host_blocks_total"] = float(kv_host_blocks_total)
+        out["kv_host_demotions_total"] = float(host_demotions)
+        out["kv_host_demoted_bytes_total"] = float(host_demote_bytes)
+        out["kv_host_promotions_total"] = float(host_promotions)
+        out["kv_host_promoted_bytes_total"] = float(host_promote_bytes)
+        out["kv_host_hit_tokens_total"] = float(kv_host_hit_tokens)
+        out["kv_host_promote_aborts_total"] = float(host_promote_aborts)
+        out["kv_host_evictions_total"] = float(host_evictions)
     if spec_engines:
         # speculative decoding (spec-decode: ngram): drafted/accepted
         # counters + the acceptance rate — exposed from construction so
@@ -546,6 +581,12 @@ class DecodeEngine:
         kv_block_size: int = 16,         # paged: tokens per pool block
         kv_blocks: Optional[int] = None,  # paged: pool size (None = the
                                           # dense-equivalent worst case)
+        kv_host_blocks: int = 0,          # paged: host-DRAM demotion
+                                          # tier capacity in blocks —
+                                          # evicted chains demote there
+                                          # and promote back on a
+                                          # digest match (0 = off, the
+                                          # single-tier behavior)
         paged_kernel: str = "fused",     # paged attention: "fused" (one
                                           # Pallas launch over the block
                                           # tables) | "reference" (the
@@ -739,6 +780,8 @@ class DecodeEngine:
         ):
             self.paged_kernel = "reference"
         self.kv_manager = None
+        self.kv_host_blocks = 0
+        self.kv_host_arena = None
         if self.paged:
             from langstream_tpu.providers.jax_local.paged import (
                 PagedKVManager,
@@ -761,6 +804,23 @@ class DecodeEngine:
                     f"{self.block_size})"
                 )
             self.kv_manager = PagedKVManager(self.num_blocks, self.block_size)
+            # two-tier pool (ISSUE 18): a bounded pinned host-RAM arena
+            # below the HBM pool — eviction demotes victim chains
+            # through the jitted handoff gather (D2H) and admission
+            # promotes digest matches back through the donated handoff
+            # scatter (H2D) before falling back to cold prefill
+            self.kv_host_blocks = max(0, int(kv_host_blocks or 0))
+            if self.kv_host_blocks:
+                from langstream_tpu.providers.jax_local.paged import (
+                    HostKVArena,
+                )
+
+                self.kv_host_arena = HostKVArena(self.kv_host_blocks)
+                self.kv_manager.attach_host(
+                    self.kv_host_arena, self._demote_block_data
+                )
+            else:
+                self.kv_host_arena = None
             # host-authoritative block tables [slots, max_blocks]; rows
             # are uploaded per dispatch (0 = the null block)
             self._block_tables = np.zeros(
@@ -925,6 +985,7 @@ class DecodeEngine:
             kv_quant=bool(self.kv_quant),
             kv_layout=self.kv_layout,
             kv_blocks=self.num_blocks if self.paged else 0,
+            kv_host_blocks=self.kv_host_blocks,
             paged_kernel=self.paged_kernel or "",
             paged_kernel_requested=self.paged_kernel_requested or "",
             spec_decode=self.spec_decode,
@@ -992,6 +1053,16 @@ class DecodeEngine:
             "handoff_imports": 0,
             "handoff_import_bytes": 0,
             "handoff_import_tokens": 0,
+            # tiered KV pool (host-DRAM demotion tier): blocks moved
+            # each way with their D2H/H2D bytes, prompt tokens served
+            # by promotions instead of recompute, and promotions that
+            # tore mid-scatter and fell back to cold prefill
+            "host_demotions": 0,
+            "host_demote_bytes": 0,
+            "host_promotions": 0,
+            "host_promote_bytes": 0,
+            "kv_host_hit_tokens": 0,
+            "host_promote_aborts": 0,
         }
 
     # lint: allow(owned-by-violation) -- bench/warmup contract: callers
@@ -1766,6 +1837,132 @@ class DecodeEngine:
             nbytes=int(nbytes),
         )
         return True
+
+    # ------------------------------------------------------------------ #
+    # tiered KV pool: host-DRAM demotion / promotion (ISSUE 18)
+    # ------------------------------------------------------------------ #
+    # lint: allow(owned-by-violation) -- engine-thread by contract: the
+    #   manager stores this as its demote hook (attach_host) and calls
+    #   it only inside the eviction pass of allocate(), which runs on
+    #   _run_loop()'s admission scan; the AST reachability pass cannot
+    #   follow the stored-callback indirection
+    def _demote_block_data(
+        self, block: int
+    ) -> Optional[Tuple[Dict[str, Any], int]]:
+        """Data-plane hook the manager calls while demoting one victim
+        block: gather the block's pool rows D2H through the memoized
+        handoff-export jit (width 1 — demotion happens block-by-block
+        inside the eviction pass, before the id returns to the free
+        list, so the gather dispatch always precedes any new owner's
+        write in stream order). Returns ``(leaf tree, nbytes)`` —
+        ``np.asarray`` preserves bf16 and int8+scales bitwise — or
+        None when demotion must be skipped (mirrored engines replay
+        dispatch records that carry no host-tier schema)."""
+        if self.mirror is not None:
+            return None
+        run = self._get_handoff_export(1)
+        gathered = run(self.cache, np.asarray([block], dtype=np.int32))
+        data = {
+            leaf: np.asarray(value)[:, 0]
+            for leaf, value in gathered.items()
+        }
+        nbytes = sum(a.nbytes for a in data.values())
+        self.stats["host_demotions"] += 1
+        self.stats["host_demote_bytes"] += nbytes
+        flight.record("kv_host_demote", block=block, nbytes=nbytes)
+        return data, nbytes
+
+    def _host_probe(
+        self, prompt: Sequence[int], match: Optional[Tuple[List[int], int]]
+    ) -> List[Any]:
+        """Host-tier continuation of the HBM prefix scan: the demoted
+        entries that extend ``match``'s chain, truncated at the first
+        entry without captured rows (an accounting-only entry cannot
+        be promoted)."""
+        if (
+            not self.paged
+            or not self.prefix_cache
+            or self.kv_manager.host is None
+            or self.mirror is not None
+        ):
+            return []
+        start = len(match[0]) if match is not None else 0
+        entries = self.kv_manager.host_match(prompt, start)
+        out: List[Any] = []
+        for entry in entries:
+            if entry.data is None:
+                break
+            out.append(entry)
+        return out
+
+    def _promote_host_chain(
+        self,
+        prompt: Sequence[int],
+        matched: List[int],
+        matched_tokens: int,
+        entries: List[Any],
+        fresh: List[int],
+    ) -> int:
+        """Scatter ``entries`` (host-tier continuation of the matched
+        HBM chain) into the first ``len(entries)`` freshly reserved
+        blocks through the donated, sharding-pinned handoff-import jit,
+        then publish the promoted chain — publish-at-commit: the rows'
+        writes are dispatched HERE, so any reader (same-round warm
+        suffix, later mixed window) is ordered after them on the
+        stream. Any failure aborts BEFORE anything publishes: the fresh
+        blocks stay private, the admission proceeds as a cold prefill,
+        and the caller never sees an error. Returns promoted blocks
+        (0 = aborted)."""
+        count = len(entries)
+        target = fresh[:count]
+        size = self.block_size
+        try:
+            if faults.fire("host_promote_torn") is not None:
+                raise RuntimeError("chaos: torn host promotion")
+            width = self._handoff_pad(count)
+            padded = np.zeros((width,), dtype=np.int32)
+            padded[:count] = target
+            data: Dict[str, Any] = {}
+            for leaf, expect in self.cache.items():
+                rows = np.stack(
+                    [np.asarray(entry.data[leaf]) for entry in entries],
+                    axis=1,
+                )
+                if rows.shape != (
+                    expect.shape[0], count, *expect.shape[2:]
+                ):
+                    raise ValueError(
+                        f"host entry shape {rows.shape} does not fit "
+                        f"pool leaf {leaf}"
+                    )
+                if width > count:
+                    pad = [(0, 0)] * rows.ndim
+                    pad[1] = (0, width - count)
+                    rows = np.pad(rows, pad)
+                data[leaf] = rows
+            run = self._get_handoff_import(width)
+            (self.cache,) = run(self.params, self.cache, padded, data)
+        except Exception:  # noqa: BLE001 — abort-before-recycle
+            self.stats["host_promote_aborts"] += 1
+            flight.record(
+                "kv_host_promote_aborted",
+                blocks=count, tokens=count * size,
+            )
+            return 0
+        end = matched_tokens + count * size
+        self.kv_manager.publish(list(prompt[:end]), matched + target)
+        nbytes = sum(entry.nbytes for entry in entries)
+        self.stats["host_promotions"] += count
+        self.stats["host_promote_bytes"] += nbytes
+        self.stats["kv_host_hit_tokens"] += count * size
+        arena = self.kv_manager.host
+        if arena is not None:
+            arena.note_promoted(count)
+        flight.record(
+            "kv_host_promote",
+            blocks=count, tokens=count * size, nbytes=nbytes,
+        )
+        return count
 
     def _dispatch_prefix_copy(self, src: int, dst: int, length: int) -> None:
         """Copy cache rows [0:length) of ``src`` into ``dst`` in
@@ -2738,14 +2935,25 @@ class DecodeEngine:
                 # O(prompt_len) chain walk runs once per admission
                 prompt_len = len(request.prompt_tokens)
                 probe_match = None
+                host_probe: List[Any] = []
                 if session_lcp is not None:
                     probe = session_lcp
                 elif self.prefix_cache:
                     probe_match = self.kv_manager.match(
                         request.prompt_tokens
                     )
-                    probe = probe_match[1]
+                    # host-tier continuation after the HBM prefix scan:
+                    # demoted chain entries extend the probe exactly as
+                    # resident blocks would (reserve promotes them)
+                    host_probe = self._host_probe(
+                        request.prompt_tokens, probe_match
+                    )
+                    probe = (
+                        probe_match[1] + len(host_probe) * self.block_size
+                    )
                     while probe >= prompt_len:
+                        if host_probe:
+                            host_probe.pop()
                         probe -= self.block_size
                 else:
                     probe = 0
@@ -2762,7 +2970,8 @@ class DecodeEngine:
                     elif bucket != cold_bucket:
                         break  # different bucket: next outer round
                 resume = self._paged_reserve(
-                    index, request, session_lcp, probe_match
+                    index, request, session_lcp, probe_match,
+                    host_entries=host_probe,
                 )
                 if resume is None:
                     # pool exhausted even after eviction: every block is
@@ -2775,7 +2984,14 @@ class DecodeEngine:
                 self.slots[index].request = request  # reserve the slot
                 if session_lcp is not None:
                     self.stats["session_hits"] += 1
-                if needs_long:
+                if resume < probe:
+                    # a torn promotion fell back toward cold: the
+                    # probe-based cold/warm grouping above no longer
+                    # holds, so route through the long path — it
+                    # handles ANY resume offset without disturbing the
+                    # round's cold-bucket invariant
+                    long_entries.append((index, request, resume))
+                elif needs_long:
                     long_entries.append((index, request, resume))
                 elif resume == 0:
                     cold.append((index, request))
@@ -2850,11 +3066,15 @@ class DecodeEngine:
             if index is None:
                 return
             probe_match = None
+            host_probe: List[Any] = []
             if session_lcp is None and self.prefix_cache:
                 probe_match = self.kv_manager.match(request.prompt_tokens)
+                host_probe = self._host_probe(
+                    request.prompt_tokens, probe_match
+                )
             resume = self._paged_reserve(
                 index, request, session_lcp, probe_match,
-                publish_cold=False,
+                publish_cold=False, host_entries=host_probe,
             )
             if resume is None:
                 # pool exhausted even after eviction: every block is
@@ -2890,11 +3110,19 @@ class DecodeEngine:
         session_lcp: Optional[int],
         match: Optional[Tuple[List[int], int]] = None,
         publish_cold: bool = True,
+        host_entries: Optional[List[Any]] = None,
     ) -> Optional[int]:
         """Commit pool blocks for a request before it is admitted.
         Returns the resume offset — tokens already resident for this
-        slot (session continuation or prefix-cache hit) — or None when
-        the pool cannot cover the reservation.
+        slot (session continuation, prefix-cache hit, or host-tier
+        promotion) — or None when the pool cannot cover the
+        reservation.
+
+        ``host_entries`` is the host-tier continuation of ``match``
+        (``_host_probe``): after the worst-case fresh allocation, those
+        entries are scattered H2D into the first fresh blocks and
+        published (publish-at-commit); a torn promotion aborts before
+        anything publishes and the admission degrades to cold prefill.
 
         Copy-on-write happens here: a session follow-up that diverges
         mid-block gets a private copy of the boundary block, and shared
@@ -2959,8 +3187,15 @@ class DecodeEngine:
                     (list(match[0]), match[1]) if match is not None
                     else manager.match(prompt)
                 )
+            promote = list(host_entries or [])
             # re-prefill at least the last prompt token so fresh logits
-            # exist for the first sample (same rule as the dense paths)
+            # exist for the first sample (same rule as the dense paths).
+            # Host-tier entries trim first: they continue the HBM chain,
+            # so they are the chain's tail
+            total = matched_tokens + size * len(promote)
+            while promote and total >= len(prompt):
+                promote.pop()
+                total -= size
             while matched and matched_tokens >= len(prompt):
                 matched.pop()
                 matched_tokens -= size
@@ -2969,12 +3204,27 @@ class DecodeEngine:
             if fresh is None:
                 manager.release(matched)
                 return None
+            promoted = 0
+            if promote:
+                # worst-case-reserved promotion: the fresh allocation
+                # above already covers every non-matched block, so the
+                # H2D scatter targets the first len(promote) of them —
+                # an abort leaves them private cold blocks (no client
+                # error, no publish, no id recycled mid-chain)
+                promoted = self._promote_host_chain(
+                    prompt, matched, matched_tokens, promote, fresh
+                )
             slot.blocks = matched + fresh
             if matched_tokens:
                 self.stats["prefix_hits"] += 1
                 self.stats["prefix_tokens_reused"] += matched_tokens
                 manager.stats["hit_tokens"] += matched_tokens
-            if self.prefix_cache and publish_cold and not matched_tokens:
+            if promoted:
+                self.stats["prefix_tokens_reused"] += promoted * size
+            if (
+                self.prefix_cache and publish_cold
+                and not matched_tokens and not promoted
+            ):
                 # publish a fully-cold prompt's blocks NOW so same-round
                 # duplicates share them — safe because the cold batch
                 # (which writes every one of these blocks) dispatches
@@ -2983,9 +3233,11 @@ class DecodeEngine:
                 # (their suffix prefill dispatches in the warm wave).
                 # Mixed admission passes publish_cold=False: its blocks
                 # fill across several dispatches, so early publication
-                # would let a duplicate read unwritten rows.
+                # would let a duplicate read unwritten rows. (A promoted
+                # admission already published its promoted chain —
+                # publishing the unwritten tail here would expose it.)
                 manager.publish(prompt, slot.blocks)
-            resume = matched_tokens
+            resume = matched_tokens + promoted * size
         table = self._block_tables[index]
         table[:] = 0
         table[: len(slot.blocks)] = slot.blocks

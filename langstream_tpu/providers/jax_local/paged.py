@@ -27,16 +27,186 @@ Copy-on-write is decided here (:meth:`is_shared`) and executed by the
 engine's jitted block-copy: writes into a block that the map or another
 slot still references first get a private copy (session follow-ups that
 diverge mid-block), so shared prefixes are immutable once published.
+
+**Two tiers** (ISSUE 18): when a :class:`HostKVArena` is attached,
+eviction *demotes* victim chains into bounded pinned host RAM instead
+of dropping them, and admission can *promote* them back (see
+:meth:`PagedKVManager.host_match` and the engine's promotion scatter).
+The host tier is keyed by the rolling chain digest (``fleet/router.py``)
+rather than ``(parent_block, chunk)``: pool block ids recycle the moment
+a chain is evicted, so a block-keyed host entry could resolve a recycled
+id to another chain's rows — the digest encodes the whole token prefix
+and never recycles.
 """
 
 from __future__ import annotations
 
+import threading
 from collections import deque
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 # the reserved null block: block tables point padding / masked writes
 # here; attention never reads it through a live length mask
 NULL_BLOCK = 0
+
+
+class HostKVEntry:
+    """One demoted block's worth of chain, keyed by the rolling chain
+    digest of the token prefix it completes. ``data`` is the per-leaf
+    host copy of the block's pool rows (``leaf -> [layers, block_size,
+    kv_heads, head_dim]``, int8 pools carry their scale leaves too) —
+    or None in accounting-only arenas (the fleet sim)."""
+
+    __slots__ = ("digest", "parent_digest", "chunk", "data", "nbytes")
+
+    def __init__(
+        self,
+        digest: str,
+        parent_digest: str,
+        chunk: Tuple[int, ...],
+        data: Optional[Dict[str, object]],
+        nbytes: int,
+    ) -> None:
+        self.digest = digest
+        self.parent_digest = parent_digest  # "" = chain root
+        self.chunk = chunk
+        self.data = data
+        self.nbytes = int(nbytes)
+
+
+class HostKVArena:
+    """Bounded pinned-host-RAM demotion tier below the HBM pool.
+
+    Same LRU discipline as the HBM prefix cache, leaf-first by design:
+    a parent entry is never evicted while a demoted child is resident,
+    so the host tier's digest set stays ancestry-complete *within the
+    tier* (an entry's missing ancestors are, by leaf-first HBM
+    demotion order, still published in HBM) — the invariant heartbeat
+    gossip relies on for leading-prefix scoring.
+
+    Unlike :class:`PagedKVManager` (engine-thread-owned), this class IS
+    thread-safe: the engine thread demotes/promotes while the gossip
+    task snapshots :meth:`digests` for heartbeats, so every access
+    holds ``_lock``.
+    """
+
+    def __init__(self, capacity_blocks: int) -> None:
+        if capacity_blocks < 1:
+            raise ValueError("host arena needs at least 1 block")
+        self.capacity_blocks = int(capacity_blocks)
+        self._lock = threading.Lock()
+        self._entries: Dict[str, HostKVEntry] = {}  # guarded-by: _lock
+        # digest -> count of RESIDENT children (incremented at child
+        # put, decremented at child removal — a digest forest always
+        # has a leaf, so eviction always progresses)
+        self._children: Dict[str, int] = {}  # guarded-by: _lock
+        self._lru: Dict[str, int] = {}  # guarded-by: _lock
+        self._tick = 0  # guarded-by: _lock
+        self.stats: Dict[str, int] = {  # guarded-by: _lock
+            "demoted_blocks": 0,   # entries accepted from the HBM tier
+            "promoted_blocks": 0,  # entries scattered back to HBM
+            "evictions": 0,        # entries dropped by host-tier LRU
+            "demoted_bytes": 0,    # host bytes written by demotions
+        }
+
+    # requires-lock: _lock
+    def _touch_locked(self, digest: str) -> None:
+        self._tick += 1
+        self._lru[digest] = self._tick
+
+    # requires-lock: _lock
+    def _remove_locked(self, digest: str) -> None:
+        entry = self._entries.pop(digest)
+        self._lru.pop(digest, None)
+        self._children.pop(digest, None)
+        parent = entry.parent_digest
+        if parent:
+            left = self._children.get(parent, 0) - 1
+            if left > 0:
+                self._children[parent] = left
+            else:
+                self._children.pop(parent, None)
+
+    # requires-lock: _lock
+    def _evict_locked(self) -> bool:
+        """Drop the least-recently-used LEAF entry (no resident
+        children). Leaf-first mirrors the HBM pool's discipline and
+        keeps resident chains ancestry-complete."""
+        for digest, _ in sorted(self._lru.items(), key=lambda kv: kv[1]):
+            if self._children.get(digest, 0) == 0:
+                self._remove_locked(digest)
+                self.stats["evictions"] += 1
+                return True
+        return False
+
+    @property
+    def blocks_in_use(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def has(self, digest: str) -> bool:
+        with self._lock:
+            return digest in self._entries
+
+    def touch(self, digest: str) -> None:
+        with self._lock:
+            if digest in self._entries:
+                self._touch_locked(digest)
+
+    def lookup(self, digest: str) -> Optional[HostKVEntry]:
+        """The resident entry for ``digest`` (LRU-touched), or None."""
+        with self._lock:
+            entry = self._entries.get(digest)
+            if entry is not None:
+                self._touch_locked(digest)
+            return entry
+
+    def put(
+        self,
+        digest: str,
+        parent_digest: str,
+        chunk: Sequence[int],
+        data: Optional[Dict[str, object]],
+        nbytes: int,
+    ) -> bool:
+        """Admit one demoted block; capacity pressure evicts LRU leaves
+        first. Idempotent per digest (a re-demotion of a promoted chain
+        only refreshes the LRU tick). False when the arena refused the
+        entry (already resident, or nothing evictable)."""
+        with self._lock:
+            if digest in self._entries:
+                self._touch_locked(digest)
+                return False
+            while len(self._entries) >= self.capacity_blocks:
+                if not self._evict_locked():
+                    return False
+            self._entries[digest] = HostKVEntry(
+                digest, parent_digest, tuple(chunk), data, nbytes
+            )
+            if parent_digest:
+                self._children[parent_digest] = (
+                    self._children.get(parent_digest, 0) + 1
+                )
+            self._touch_locked(digest)
+            self.stats["demoted_blocks"] += 1
+            self.stats["demoted_bytes"] += int(nbytes)
+            return True
+
+    def note_promoted(self, blocks: int) -> None:
+        with self._lock:
+            self.stats["promoted_blocks"] += int(blocks)
+
+    def digests(self) -> Set[str]:
+        """Snapshot of resident digests — heartbeat gossip's host-tier
+        tag (``host_chain_digests``); safe from any thread."""
+        with self._lock:
+            return set(self._entries)
+
+    def snapshot_stats(self) -> Dict[str, int]:
+        with self._lock:
+            out = dict(self.stats)
+            out["blocks_in_use"] = len(self._entries)
+            return out
 
 
 class PagedKVManager:
@@ -66,11 +236,21 @@ class PagedKVManager:
         # eviction on another thread can never serve a recycled id a
         # stale digest (the key mismatch forces a recompute)
         self.digest_memo: Dict[int, object] = {}
+        # host-DRAM demotion tier (ISSUE 18): when attached, _evict
+        # demotes victim chains into the arena instead of dropping
+        # them; _demote_data is the optional data-plane hook (the
+        # engine's D2H gather — None keeps the arena accounting-only,
+        # the fleet sim's mode)
+        self.host: Optional[HostKVArena] = None
+        self._demote_data: Optional[
+            Callable[[int], Optional[Tuple[Dict[str, object], int]]]
+        ] = None
         self.stats: Dict[str, int] = {
             "hit_tokens": 0,       # prompt tokens served from cached blocks
             "evictions": 0,        # cached blocks unpublished under pressure
             "cow_copies": 0,       # private copies made before a shared write
             "published_blocks": 0,
+            "demotions": 0,        # victim blocks demoted to the host tier
         }
 
     # ------------------------------------------------------------------ #
@@ -226,6 +406,121 @@ class PagedKVManager:
         return out
 
     # ------------------------------------------------------------------ #
+    # host-DRAM tier (ISSUE 18)
+    # ------------------------------------------------------------------ #
+    def attach_host(
+        self,
+        arena: HostKVArena,
+        demote_data: Optional[
+            Callable[[int], Optional[Tuple[Dict[str, object], int]]]
+        ] = None,
+    ) -> None:
+        """Attach the host-DRAM demotion tier. ``demote_data(block)``
+        is the data-plane hook — the engine's jitted D2H gather of one
+        block's pool rows, returning ``(leaf tree, nbytes)`` or None
+        when the rows cannot be captured (the chain then drops exactly
+        as an un-tiered eviction would). None keeps the arena
+        accounting-only: entries carry no rows but matching, LRU and
+        capacity backpressure behave identically (the fleet sim's
+        mode)."""
+        self.host = arena
+        self._demote_data = demote_data
+
+    def chain_digest(self, block: int) -> Optional[str]:
+        """Rolling chain digest (``fleet/router.py``) of the token
+        prefix ending at published ``block``, memoized into
+        ``digest_memo`` under the same ``(key, digest)`` format the
+        heartbeat digester writes — demotion-time digests and gossip
+        digests can never disagree. None when the block (or an
+        ancestor) is not published."""
+        from langstream_tpu.fleet.router import _chunk_digest
+
+        stack: List[Tuple[int, Tuple[int, Tuple[int, ...]]]] = []
+        digest = b""
+        walk = block
+        while walk >= 0:
+            key = self._key_of.get(walk)
+            if key is None:
+                return None
+            memo = self.digest_memo.get(walk)
+            if (
+                isinstance(memo, tuple) and len(memo) == 2
+                and memo[0] == key and isinstance(memo[1], bytes)
+                and memo[1]
+            ):
+                digest = memo[1]
+                break
+            stack.append((walk, key))
+            walk = key[0]
+        for b, key in reversed(stack):
+            digest = _chunk_digest(digest, key[1])
+            self.digest_memo[b] = (key, digest)
+        return digest.hex()
+
+    def _demote(self, block: int) -> None:
+        """Move a victim chain block into the host tier before it is
+        unpublished. Digest-keyed on purpose: the HBM block id recycles
+        the moment :meth:`_evict` frees it, so a host entry keyed by
+        ``(parent_block, chunk)`` could later resolve a recycled id to
+        another chain's rows — the digest encodes the whole token
+        prefix and never recycles. Leaf-first eviction order means the
+        victim's ancestors are still published here, so the digest walk
+        always completes."""
+        host = self.host
+        if host is None:
+            return
+        key = self._key_of.get(block)
+        if key is None:
+            return
+        digest = self.chain_digest(block)
+        if digest is None:
+            return
+        if host.has(digest):
+            # promoted-then-re-evicted chain: the host copy is bitwise
+            # identical (published blocks are immutable), so refresh
+            # the LRU tick and skip the D2H gather
+            host.touch(digest)
+            return
+        parent_digest = ""
+        if key[0] >= 0:
+            parent_digest = self.chain_digest(key[0]) or ""
+            if not parent_digest:
+                return
+        data: Optional[Dict[str, object]] = None
+        nbytes = 0
+        if self._demote_data is not None:
+            fetched = self._demote_data(block)
+            if fetched is None:
+                return  # data plane unavailable: drop like an eviction
+            data, nbytes = fetched
+        if host.put(digest, parent_digest, key[1], data, nbytes):
+            self.stats["demotions"] += 1
+
+    def host_match(self, tokens: Sequence[int], start_block: int) -> List[HostKVEntry]:
+        """Consecutive host-tier entries continuing the HBM chain from
+        full-block index ``start_block`` of ``tokens``. Digest-keyed, so
+        a match proves the ENTIRE token prefix across both tiers; the
+        caller promotes the returned entries (engine: H2D scatter +
+        publish-at-commit) or treats them as accounting hits (sim)."""
+        host = self.host
+        if host is None:
+            return []
+        size = self.block_size
+        full = len(tokens) // size
+        if start_block >= full:
+            return []
+        from langstream_tpu.fleet.router import prompt_digests
+
+        digests = prompt_digests(tokens, size, limit=full)
+        out: List[HostKVEntry] = []
+        for i in range(start_block, full):
+            entry = host.lookup(digests[i])
+            if entry is None:
+                break
+            out.append(entry)
+        return out
+
+    # ------------------------------------------------------------------ #
     # KV handoff (prefill/decode disaggregation, fleet/handoff.py)
     # ------------------------------------------------------------------ #
     def export_session(self, tokens: Sequence[int]) -> Tuple[List[int], int]:
@@ -310,6 +605,8 @@ class PagedKVManager:
                     self._refcount[block] == 0
                     and not self._children.get(block)
                 ):
+                    if self.host is not None:
+                        self._demote(block)
                     self._unpublish(block)
                     self._free.append(block)
                     self.stats["evictions"] += 1

@@ -170,6 +170,10 @@ class JaxCompletionsService(CompletionsService):
                 if engine_config.get("kv-blocks")
                 else None
             ),
+            # host-DRAM demotion tier capacity (0 = HBM-only pool):
+            # evicted chains demote to a pinned host arena and promote
+            # back on a digest hit instead of recomputing
+            kv_host_blocks=int(engine_config.get("kv-host-blocks") or 0),
             # paged attention kernel: fused ragged Pallas launch over
             # the block tables (default) vs the gather/scatter reference
             # oracle — the ROADMAP-item-1 A/B knob

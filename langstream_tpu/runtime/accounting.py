@@ -251,6 +251,24 @@ class CostModel:
             * self.kv_read_tokens(int(tokens))
         )
 
+    def kv_demote_bytes(self, tokens: int) -> float:
+        """D2H bytes to demote ``tokens`` rows of KV history into the
+        host-DRAM tier (ISSUE 18). Same whole-model, block-padded row
+        accounting as :meth:`kv_handoff_bytes` — the demote gather IS
+        the handoff export jit pointed at PCIe instead of the fabric —
+        so the on-chip handoff-bandwidth window doubles as this leg's
+        calibration. Integrates to ``kv_host_demoted_bytes_total``."""
+        return self.kv_handoff_bytes(tokens)
+
+    def kv_promote_bytes(self, tokens: int) -> float:
+        """H2D bytes to promote ``tokens`` rows back into the HBM pool
+        through the donated import scatter. Symmetric with
+        :meth:`kv_demote_bytes` (same rows, opposite direction); the
+        price a promotion pays instead of the recompute FLOPs a cold
+        re-teach would burn. Integrates to
+        ``kv_host_promoted_bytes_total``."""
+        return self.kv_handoff_bytes(tokens)
+
     # ------------------------------------------------------------------ #
     # prefill
     # ------------------------------------------------------------------ #

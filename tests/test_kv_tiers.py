@@ -1,0 +1,369 @@
+"""Tiered KV pool: host-DRAM demotion tier (ISSUE 18).
+
+Covers the host arena (leaf-first LRU, capacity backpressure,
+idempotent demotion), the digest-keyed correctness edges the tier
+hangs on (no stale digest through a recycled block id; host chains
+stay ancestry-complete), the engine acceptance scenario — a chain
+demoted under pool pressure and promoted back must continue BITWISE
+IDENTICAL to a never-evicted oracle (greedy + seeded, unquantized +
+int8) — the torn-promotion abort (cold-prefill fallback, no client
+error), the goodput attribution rule (promoted tokens are never
+billed as ``tokens_wasted{evicted_recompute}``), tier-tagged gossip
+and routing preference (hbm-hit > host-hit > cold), and the fleet-sim
+tiered A/B: a strict eviction-recompute cut at >=0.9x tok/s on
+identical pool-pressure traffic."""
+
+import asyncio
+
+import pytest
+
+from langstream_tpu.providers.jax_local.engine import (
+    DecodeEngine,
+    SamplingParams,
+    engines_snapshot,
+)
+from langstream_tpu.providers.jax_local.model import LlamaConfig, init_params
+from langstream_tpu.providers.jax_local.paged import (
+    HostKVArena,
+    PagedKVManager,
+)
+from langstream_tpu.runtime import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# ---------------------------------------------------------------------- #
+# HostKVArena (pure host-side accounting)
+# ---------------------------------------------------------------------- #
+def test_arena_capacity_backpressure_evicts_lru_leaves_first():
+    arena = HostKVArena(capacity_blocks=2)
+    # a parent/child chain plus an older unrelated leaf
+    assert arena.put("p", "", (1, 2), None, 0)
+    assert arena.put("c", "p", (3, 4), None, 0)
+    # full: admitting a third entry must evict exactly one LEAF — and
+    # the LRU-oldest leaf is the child "c", never the parent "p" (a
+    # parent with a resident child would break ancestry-completeness)
+    assert arena.put("x", "", (9, 9), None, 0)
+    assert arena.has("p") and arena.has("x") and not arena.has("c")
+    assert arena.snapshot_stats()["evictions"] == 1
+    # touching "p" then pressuring again: "x" (now LRU-oldest leaf) goes
+    arena.touch("p")
+    assert arena.put("y", "", (8, 8), None, 0)
+    assert arena.has("p") and arena.has("y") and not arena.has("x")
+    assert arena.blocks_in_use == 2
+
+
+def test_arena_put_is_idempotent_per_digest():
+    arena = HostKVArena(capacity_blocks=4)
+    assert arena.put("d", "", (1,), None, 16)
+    # re-demotion of a promoted-then-evicted chain: refresh, don't copy
+    assert not arena.put("d", "", (1,), None, 16)
+    stats = arena.snapshot_stats()
+    assert stats["demoted_blocks"] == 1 and stats["demoted_bytes"] == 16
+    assert arena.digests() == {"d"}
+
+
+# ---------------------------------------------------------------------- #
+# digest-keyed correctness across tiers (the two eviction edges)
+# ---------------------------------------------------------------------- #
+def _managed_pair(num_blocks=8, block_size=2, host_blocks=8):
+    manager = PagedKVManager(num_blocks=num_blocks, block_size=block_size)
+    arena = HostKVArena(host_blocks)
+    manager.attach_host(arena)  # accounting-only: matching semantics
+    return manager, arena
+
+
+def test_recycled_block_id_cannot_resurface_a_stale_digest():
+    """The reason the host tier is digest-keyed: after chain A's blocks
+    are evicted (demoted) and their ids recycled into chain B, nothing
+    in either tier may resolve A's identity to B's rows."""
+    manager, arena = _managed_pair(num_blocks=4, block_size=2)  # 3 usable
+    tokens_a = [1, 2, 3, 4, 5, 6]
+    blocks_a = manager.allocate(3)
+    manager.publish(tokens_a, blocks_a)
+    manager.release(blocks_a)
+    # pressure: chain A is evicted (demoted to host) and its ids recycle
+    blocks_b = manager.allocate(3)
+    assert blocks_b is not None and set(blocks_b) == set(blocks_a)
+    tokens_b = [7, 8, 9, 10, 11, 12]
+    manager.publish(tokens_b, blocks_b)
+    # HBM: the recycled ids answer for B only, never for A
+    assert manager.match(tokens_a) == ([], 0)
+    chain_b, matched_b = manager.match(tokens_b)
+    assert chain_b == blocks_b and matched_b == 6
+    # host: A's whole chain is matchable by digest, B's digests are NOT
+    # resident (B was never evicted) — no cross-talk in either direction
+    assert len(manager.host_match(tokens_a, 0)) == 3
+    assert manager.host_match(tokens_b, 0) == []
+    # and a recycled id's chain_digest is B's chain, not A's leftovers
+    digest_b = manager.chain_digest(blocks_b[0])
+    digests_a = {e.digest for e in manager.host_match(tokens_a, 0)}
+    assert digest_b is not None and digest_b not in digests_a
+
+
+def test_host_match_stops_at_the_first_missing_ancestor():
+    """host_match must return a CONSECUTIVE chain continuation: once an
+    ancestor digest is absent from the arena, everything behind it is
+    unreachable (promoting it would splice rows onto the wrong
+    prefix)."""
+    manager, arena = _managed_pair(num_blocks=4, block_size=2)  # 3 usable
+    tokens = [1, 2, 3, 4, 5, 6]
+    blocks = manager.allocate(3)
+    manager.publish(tokens, blocks)
+    manager.release(blocks)
+    # zero-slack pool: reallocating every block demotes the WHOLE chain
+    assert manager.allocate(3) is not None
+    assert len(manager.host_match(tokens, 0)) == 3
+    entries = manager.host_match(tokens, 0)
+    # punch out the MIDDLE entry: the tail must become unmatchable
+    with arena._lock:
+        arena._remove_locked(entries[1].digest)
+    truncated = manager.host_match(tokens, 0)
+    assert [e.digest for e in truncated] == [entries[0].digest]
+    # but a scan STARTING past the hole (i.e. the HBM chain already
+    # covers blocks 0..1) still matches the leaf: its digest proves the
+    # whole token prefix, wherever the ancestors live
+    past = manager.host_match(tokens, 2)
+    assert [e.digest for e in past] == [entries[2].digest]
+
+
+# ---------------------------------------------------------------------- #
+# engine: demote -> promote bitwise parity vs a never-evicted oracle
+# ---------------------------------------------------------------------- #
+def _tiny_engine(**kwargs):
+    config = LlamaConfig.tiny(max_seq_len=128)
+    params = init_params(config)
+    engine = DecodeEngine(
+        config, params,
+        max_slots=kwargs.pop("max_slots", 4),
+        max_seq_len=128,
+        prefill_buckets=kwargs.pop("prefill_buckets", [16, 32, 64]),
+        kv_layout="paged", kv_block_size=8,
+        **kwargs,
+    )
+    engine.start()
+    return engine
+
+
+# prompt1 publishes a 4-block chain; the thrash prompts overflow the
+# 19-usable-block pool so that chain is EVICTED (tiered: demoted);
+# prompt2 extends prompt1 — a strict-prefix continuation, so the tiered
+# engine must promote the demoted blocks back instead of re-prefilling
+_PROMPT1 = list(range(1, 33))
+_PROMPT2 = _PROMPT1 + list(range(101, 109))
+_THRASH = [[(i * 31 + j) % 240 + 2 for j in range(32)] for i in range(6)]
+
+
+async def _pressure_scenario(engine, sampling):
+    out = [(await engine.generate(_PROMPT1, sampling)).tokens]
+    for prompt in _THRASH:
+        await engine.generate(prompt, sampling)
+    out.append((await engine.generate(_PROMPT2, sampling)).tokens)
+    return out
+
+
+def _parity_case(sampling, **engine_kwargs):
+    """Run the pressure scenario on a demoting/promoting engine and on
+    an oracle with a default-sized pool that never evicts; return
+    (tiered tokens, oracle tokens, tiered engine stats).  ``sampling``
+    may be a single SamplingParams or a sequence — multiple modes run
+    back-to-back on ONE engine pair (the carried-over demoted/cached
+    state between modes is itself parity-contract exercise, and it
+    halves the engine builds on the tier-1 clock)."""
+    samplings = (
+        [sampling] if isinstance(sampling, SamplingParams) else list(sampling)
+    )
+    tiered = _tiny_engine(kv_blocks=20, kv_host_blocks=32, **engine_kwargs)
+    oracle = _tiny_engine(**engine_kwargs)
+    try:
+        got = [asyncio.run(_pressure_scenario(tiered, s)) for s in samplings]
+        want = [asyncio.run(_pressure_scenario(oracle, s)) for s in samplings]
+        stats = {
+            "demotions": tiered.kv_manager.stats["demotions"],
+            "host_promotions": tiered.stats["host_promotions"],
+            "kv_host_hit_tokens": tiered.stats["kv_host_hit_tokens"],
+            "host_promote_aborts": tiered.stats["host_promote_aborts"],
+            "arena": tiered.kv_manager.host.snapshot_stats(),
+        }
+        if isinstance(sampling, SamplingParams):
+            return got[0], want[0], stats
+        return got, want, stats
+    finally:
+        tiered.stop()
+        oracle.stop()
+
+
+_PARITY_SAMPLINGS = (
+    SamplingParams(max_new_tokens=8),
+    SamplingParams(max_new_tokens=8, temperature=0.8, seed=7),
+)
+
+
+def test_promoted_continuation_is_bitwise_identical_int8():
+    """int8 pools demote quantized rows AND their scale leaves; the
+    promoted continuation must reproduce the oracle exactly — greedy
+    and seeded sampling alike."""
+    got, want, stats = _parity_case(_PARITY_SAMPLINGS, kv_quant="int8")
+    assert got == want
+    assert stats["demotions"] > 0
+    assert stats["host_promotions"] > 0
+    assert stats["kv_host_hit_tokens"] >= 8
+    assert stats["host_promote_aborts"] == 0
+
+
+# slow tier: the unquantized pool shares every demote/promote code path
+# with the int8 leg above except the scale leaves — the int8 leg is the
+# superset, so this representative rides the slow tier (~25s saved)
+@pytest.mark.slow
+def test_promoted_continuation_is_bitwise_identical_unquantized():
+    got, want, stats = _parity_case(_PARITY_SAMPLINGS)
+    assert got == want
+    assert stats["host_promotions"] > 0
+
+
+def test_torn_promotion_aborts_to_cold_prefill():
+    """A promotion torn mid-transfer (fault point ``host_promote_torn``)
+    must abort BEFORE anything publishes: the admission proceeds as a
+    cold prefill, tokens still match the oracle, and the client never
+    sees an error."""
+    faults.configure("host_promote_torn@step=1")
+    sampling = SamplingParams(max_new_tokens=8)
+    got, want, stats = _parity_case(sampling)
+    assert got == want  # cold fallback is still bitwise-correct
+    assert stats["host_promote_aborts"] >= 1
+    assert stats["host_promotions"] == 0
+    assert stats["kv_host_hit_tokens"] == 0
+
+
+def test_promotion_is_not_billed_as_evicted_recompute():
+    """Goodput attribution: a session follow-up whose warm cache was
+    evicted re-enters through promotion — the promoted tokens were NOT
+    re-prefilled, so they must not land in
+    ``tokens_wasted{evicted_recompute}`` (only the genuinely recomputed
+    tail may). The host-hit gauge carries the recovered tokens."""
+    engine = _tiny_engine(
+        max_slots=2, kv_blocks=20, kv_host_blocks=32,
+    )
+    sampling = SamplingParams(max_new_tokens=8)
+
+    async def run():
+        first = await engine.generate(
+            _PROMPT1, sampling, session_id="attr"
+        )
+        history = _PROMPT1 + first.tokens
+        # more concurrent strangers than slots: the pinned session's
+        # slot is evicted (its 40 cached tokens noted), and the pool
+        # pressure demotes its published chain to the host tier
+        await asyncio.gather(*[
+            engine.generate(p, sampling) for p in _THRASH[:4]
+        ])
+        await engine.generate(history, sampling, session_id="attr")
+        return len(history)
+
+    try:
+        cached = asyncio.run(run())
+        wasted = engine.stats["tokens_wasted"]["evicted_recompute"]
+        promoted_tokens = engine.stats["kv_host_hit_tokens"]
+        assert engine.stats["host_promotions"] > 0
+        # full re-prefill would bill all `cached` tokens; promotion (+
+        # any residual HBM hit) must keep the bill to the cold tail
+        assert 0 <= wasted <= cached - promoted_tokens < cached
+        assert engine.stats["host_promote_aborts"] == 0
+        # gauge surface (process-global — lower-bound, not an absolute)
+        snapshot = engines_snapshot()
+        assert snapshot["kv_host_hit_tokens_total"] >= promoted_tokens
+    finally:
+        engine.stop()
+
+
+def test_tier_config_plumbing_and_heartbeat_tag():
+    """``engine: {kv-host-blocks}`` reaches the engine, the arena is
+    sized by it, and heartbeats grow the ``host_chain_digests`` tier
+    tag exactly when an arena is attached."""
+    from langstream_tpu.fleet.heartbeat import build_heartbeat
+
+    engine = _tiny_engine(kv_blocks=20, kv_host_blocks=32)
+    try:
+        assert engine.kv_host_blocks == 32
+        assert engine.kv_host_arena is engine.kv_manager.host
+        assert engine.kv_host_arena.capacity_blocks == 32
+        asyncio.run(_pressure_scenario(engine, SamplingParams(max_new_tokens=8)))
+        heartbeat = build_heartbeat("replica-0", 1, engine=engine)
+        assert heartbeat["host_chain_digests"] == sorted(
+            engine.kv_host_arena.digests()
+        )
+        assert heartbeat["host_chain_digests"]  # demotions happened
+    finally:
+        engine.stop()
+
+
+# ---------------------------------------------------------------------- #
+# fleet: tier-tagged gossip routing + the sim A/B acceptance
+# ---------------------------------------------------------------------- #
+def test_router_prices_hbm_over_host_over_cold():
+    from langstream_tpu.fleet.router import FleetRouter, prompt_digests
+
+    prompt = list(range(1, 65))
+    digests = prompt_digests(prompt, 16)
+    router = FleetRouter()
+    router.observe({
+        "replica": "hbm", "seq": 1, "block_size": 16,
+        "chain_digests": digests,
+    })
+    router.observe({
+        "replica": "host", "seq": 1, "block_size": 16,
+        "host_chain_digests": digests,
+    })
+    router.observe({
+        "replica": "cold", "seq": 1, "block_size": 16, "queue_depth": 0,
+    })
+    decision = router.route(prompt_tokens=prompt)
+    # the same chain resident in HBM outbids it demoted to host RAM
+    assert decision.replica_id == "hbm"
+    assert decision.matched_blocks == 4
+    assert decision.matched_host_blocks == 0
+
+    # ... and a host-tier hit outbids a cold replica
+    router = FleetRouter()
+    router.observe({
+        "replica": "host", "seq": 1, "block_size": 16,
+        "host_chain_digests": digests,
+    })
+    router.observe({
+        "replica": "cold", "seq": 1, "block_size": 16, "queue_depth": 0,
+    })
+    decision = router.route(prompt_tokens=prompt)
+    assert decision.replica_id == "host"
+    assert decision.matched_host_blocks == 4
+    assert router.gauges()["fleet_host_match_tokens_total"] == 64.0
+
+
+def test_sim_tiered_ab_cuts_eviction_recompute_at_equal_throughput():
+    """The acceptance A/B: on identical pool-pressure traffic the
+    tiered fleet strictly cuts ``evicted_recompute_tokens`` while
+    keeping >=0.9x tok/s — with every stream bitwise-exact and no
+    client errors in either leg."""
+    from langstream_tpu.fleet.sim import run_tiered_leg
+
+    tiered = asyncio.run(run_tiered_leg("tiered"))
+    untiered = asyncio.run(run_tiered_leg("untiered"))
+    for record in (tiered, untiered):
+        assert record["client_errors"] == 0
+        assert record["streams_exact"]
+    assert untiered["evicted_recompute_tokens"] > 0
+    assert (
+        tiered["evicted_recompute_tokens"]
+        < untiered["evicted_recompute_tokens"]
+    )
+    assert tiered["tok_s"] >= 0.9 * untiered["tok_s"]
+    assert tiered["kv_host_hit_tokens"] > 0
+    assert tiered["host_demoted_blocks"] > 0
+    assert tiered["host_promoted_blocks"] > 0
+    # the untiered leg carries no host columns — the A/B table stays
+    # honest about which leg had the knob on
+    assert "kv_host_hit_tokens" not in untiered

@@ -209,6 +209,12 @@ def test_session_reservation_trimmed_at_finish(paged_engine):
     assert len(slot.blocks) < -(-(6 + 48) // size)  # << the reservation
 
 
+# slow tier: the eviction-under-pressure parity story is subsumed by
+# tests/test_kv_tiers.py, whose tier-1 legs drive the same pool-pressure
+# eviction machinery (kv_blocks-starved pool, thrash prompts, parity vs
+# a never-evicting oracle) three times over — WITH the demotion hook the
+# eviction path now always traverses (tier-1 wall-clock headroom)
+@pytest.mark.slow
 def test_eviction_under_pool_pressure_keeps_parity(dense_engine):
     """A pool with zero slack (exactly the dense worst case) forces the
     prefix cache to evict published chains as fresh prompts arrive —

@@ -35,6 +35,8 @@ LEGS = {
         "paged KV, mixed prefill+decode dispatch (--prefill-mode mixed)",
     "bench_heal_mixed_carry.json":
         "mixed dispatch, device carry OFF control (--mixed-carry off)",
+    "bench_heal_kv_tiers.json":
+        "paged KV + host-DRAM demotion tier (--kv-host-blocks)",
     "bench_heal_paged_tp2.json": "paged KV, fused kernel, tp=2 mesh (--tp 2)",
     "bench_heal_paged_ref_tp2.json": "paged KV, gather reference, tp=2 mesh",
     "bench_heal_chaos.json":
@@ -52,6 +54,14 @@ LEGS = {
         "fleet: prefill/decode disaggregation + KV handoff (sim)",
     "bench_fleet_unified.json":
         "fleet: unified control for --disagg (sim)",
+    # tiered KV pool A/B (fleet/sim.py --tiers): host-DRAM demotion
+    # arenas + tier-tagged gossip vs the HBM-only pool on identical
+    # pool-pressure traffic — judged on the eviction-recompute cut at
+    # roughly equal tok/s
+    "bench_fleet_tiered.json":
+        "fleet: tiered KV pool, host-DRAM demotion arenas (sim)",
+    "bench_fleet_untiered.json":
+        "fleet: HBM-only control for --tiers (sim)",
 }
 
 
@@ -108,6 +118,19 @@ def describe(record: Dict[str, Any]) -> str:
                 f" (aborted {record.get('handoff_aborted', 0)},"
                 f" orphaned {record.get('handoffs_orphaned', 0)})"
             )
+        # tiered-pool columns (ISSUE 18): the --tiers pair's verdict —
+        # re-teach work eviction burned vs hits the host tier absorbed
+        if record.get("evicted_recompute_tokens") is not None:
+            bits.append(
+                f"evict recompute "
+                f"{record['evicted_recompute_tokens']} tok"
+            )
+        if record.get("kv_host_hit_tokens") is not None:
+            bits.append(f"host hits {record['kv_host_hit_tokens']} tok")
+            bits.append(
+                f"demoted/promoted {record.get('host_demoted_blocks', 0)}"
+                f"/{record.get('host_promoted_blocks', 0)} blocks"
+            )
         if record.get("streams_exact") is False:
             bits.append("STREAMS DIVERGED")
         return " ".join(bits)
@@ -149,6 +172,19 @@ def describe(record: Dict[str, Any]) -> str:
             bits.append(
                 f"host gap {record['mixed_host_gap_ms_mean']:.1f} ms/step"
             )
+    # tiered-pool columns (ISSUE 18): arena size, what the host tier
+    # absorbed (promoted hits) vs what eviction still re-taught — the
+    # pair's verdict is the recompute cut, read next to tok/s
+    if record.get("kv_host_blocks"):
+        bits.append(f"host-blocks={record['kv_host_blocks']}")
+        if record.get("kv_host_hit_tokens") is not None:
+            bits.append(f"host hits {record['kv_host_hit_tokens']} tok")
+        if record.get("host_promote_aborts"):
+            bits.append(f"promote aborts {record['host_promote_aborts']}")
+    if record.get("evicted_recompute_tokens") is not None:
+        bits.append(
+            f"evict recompute {record['evicted_recompute_tokens']} tok"
+        )
     # chaos column: which leg ran with the fault registry armed — a
     # recovery-under-load number must never read as a clean regression
     if record.get("chaos"):
@@ -849,6 +885,103 @@ def main() -> None:
                     "prefill work saved, and the pool split (prefill-"
                     "bound traffic wants a bigger prefill pool)"
                 )
+
+    kv_tiers = records["bench_heal_kv_tiers.json"]
+    if usable(paged) and usable(kv_tiers):
+        # tiered-vs-untiered pool at equal (paged) layout: the verdict
+        # is the eviction-recompute cut — tokens the HBM-only pool
+        # re-prefilled that the host tier answered with a promotion —
+        # at roughly equal tok/s (the H2D scatter must not eat the
+        # saved FLOPs). Read host hits next to the cut: hits without a
+        # cut mean the traffic was never pool-pressured and the pair
+        # proves nothing.
+        tput = kv_tiers["value"] / paged["value"] - 1
+        note = caveat(paged, kv_tiers)
+        rec_base = paged.get("evicted_recompute_tokens")
+        rec_tier = kv_tiers.get("evicted_recompute_tokens")
+        hits = kv_tiers.get("kv_host_hit_tokens", 0)
+        if rec_base is None or rec_tier is None:
+            recommendations.append(
+                "kv tiers: eviction-recompute columns missing on one "
+                f"leg (throughput {tput:+.1%}); re-run both legs with "
+                "a pool-pressure bench (small --kv-blocks) for the "
+                "verdict" + note
+            )
+        elif not rec_base and not hits:
+            recommendations.append(
+                f"kv tiers: no pool pressure on either leg (0 recompute, "
+                f"0 host hits, throughput {tput:+.1%}) — shrink "
+                "--kv-blocks or widen the prompt set before judging the "
+                "tier" + note
+            )
+        else:
+            cut = (
+                (rec_base - rec_tier) / rec_base if rec_base else 0.0
+            )
+            if cut > 0.3 and tput > -0.10:
+                recommendations.append(
+                    f"ENABLE the host KV tier: eviction recompute cut "
+                    f"{cut:.1%} ({rec_base} -> {rec_tier} tokens, "
+                    f"{hits} host-hit tokens) at {tput:+.1%} tok/s; "
+                    f"set serve --kv-host-blocks "
+                    f"{kv_tiers.get('kv_host_blocks', 0)} (docs/perf.md "
+                    "'KV tiers')" + note
+                )
+            else:
+                recommendations.append(
+                    f"keep the pool HBM-only (recompute cut {cut:.1%}, "
+                    f"{hits} host-hit tokens, tok/s {tput:+.1%}): the "
+                    "promote/demote traffic is not repaying the saved "
+                    "prefill — check host_promote_aborts and the D2H "
+                    "window in the flight digest" + note
+                )
+
+    tiered = records["bench_fleet_tiered.json"]
+    untiered = records["bench_fleet_untiered.json"]
+    if (
+        tiered and untiered
+        and tiered.get("metric") == "fleet_sim"
+        and untiered.get("metric") == "fleet_sim"
+        and tiered.get("sessions") == untiered.get("sessions")
+    ):
+        # fleet-level tiered pair on identical pool-pressure traffic:
+        # same verdict shape as the engine pair, plus the stream
+        # contract (a recompute cut bought with diverged streams is
+        # not a win)
+        rec_base = int(untiered.get("evicted_recompute_tokens", 0))
+        rec_tier = int(tiered.get("evicted_recompute_tokens", 0))
+        hits = int(tiered.get("kv_host_hit_tokens", 0))
+        tok_u = untiered.get("tok_s") or 0
+        tok_t = tiered.get("tok_s") or 0
+        tput = tok_t / tok_u - 1 if tok_u else 0.0
+        safe = (
+            tiered.get("client_errors", 0) == 0
+            and tiered.get("streams_exact", False)
+        )
+        cut = (rec_base - rec_tier) / rec_base if rec_base else 0.0
+        if not safe:
+            recommendations.append(
+                "kv tiers (fleet sim) BROKE the stream contract "
+                f"({tiered.get('client_errors', 0)} client errors, "
+                f"streams_exact={tiered.get('streams_exact')}) — fix "
+                "the promotion path before reading the recompute cut"
+            )
+        elif rec_base and cut > 0.3 and tput > -0.10:
+            recommendations.append(
+                f"ENABLE host KV tiers fleet-wide: eviction recompute "
+                f"cut {cut:.1%} ({rec_base} -> {rec_tier} tokens, "
+                f"{hits} host-hit tokens, "
+                f"{tiered.get('host_promoted_blocks', 0)} blocks "
+                f"promoted) at {tput:+.1%} tok/s with tier-tagged "
+                "routing — serve --kv-host-blocks on every replica"
+            )
+        else:
+            recommendations.append(
+                f"keep fleet pools HBM-only (recompute cut {cut:.1%}, "
+                f"{hits} host-hit tokens, tok/s {tput:+.1%}): traffic "
+                "has too little re-arrival under pressure for the tier "
+                "to pay"
+            )
 
     print("# Recommendations\n")
     if recommendations:

@@ -35,6 +35,10 @@ SHARDS: Dict[str, List[str]] = {
         "test_spec_decode",
         "test_paged_kernel",
         "test_paged_kv",
+        # tiered KV pool (host-DRAM demotion tier): demote/promote
+        # bitwise-parity A/Bs construct DecodeEngines — JAX-heavy; the
+        # pure-CPU arena/router/sim legs ride along with the story
+        "test_kv_tiers",
         # unified mixed prefill+decode dispatch (token-ragged kernel +
         # engine scheduler A/Bs) constructs DecodeEngines — JAX-heavy
         "test_mixed_dispatch",

@@ -203,6 +203,33 @@ y.block_until_ready()" 2>/dev/null
             else
                 echo "$(date -u +%FT%TZ) mixed-carry control failed (non-fatal)" >> "$LOG"
             fi
+            # 2b-tiers) host-DRAM KV tier A/B (ISSUE 18): the paged
+            #    pool shrunk enough to thrash (BENCH_KV_BLOCKS) with a
+            #    host demotion arena absorbing the evictions
+            #    (BENCH_KV_HOST_BLOCKS) — evicted chains promote back
+            #    through the H2D scatter instead of re-prefilling.
+            #    Judged against bench_heal_paged.json on the
+            #    evicted_recompute cut + kv_host_hit_tokens at roughly
+            #    equal tok/s (ab_analyze's kv-tiers pair). Same jit
+            #    graphs as the paged leg plus the handoff-width
+            #    export/import builders — warm first.
+            if BENCH_KV_LAYOUT=paged BENCH_KV_BLOCKS=96 \
+                BENCH_KV_HOST_BLOCKS=512 \
+                BENCH_COMPILE_ONLY=1 BENCH_DEADLINE=3000 \
+                BENCH_INIT_TIMEOUT=600 \
+                python bench.py > /dev/null 2>> "$LOG"; then
+                :
+            else
+                echo "$(date -u +%FT%TZ) kv-tiers warm interrupted (entries kept)" >> "$LOG"
+            fi
+            if BENCH_KV_LAYOUT=paged BENCH_KV_BLOCKS=96 \
+                BENCH_KV_HOST_BLOCKS=512 \
+                BENCH_DEADLINE=3600 BENCH_INIT_TIMEOUT=600 \
+                python bench.py > "${OUT%.json}_kv_tiers.json" 2>> "$LOG"; then
+                echo "$(date -u +%FT%TZ) kv-tiers A/B done: $(cat "${OUT%.json}_kv_tiers.json")" >> "$LOG"
+            else
+                echo "$(date -u +%FT%TZ) kv-tiers A/B failed (non-fatal)" >> "$LOG"
+            fi
             # 2c) speculative-decoding A/B: self-drafting prompt-lookup
             #    (ngram) vs the oracle scan (the main run is the OFF
             #    leg — same traffic shape). Warm the spec jit graphs
