@@ -364,6 +364,9 @@ def _flight(configure: bool = False):
                 ),
             )
             if directory:
+                # synthetic fleet identity: bench runs are single-
+                # replica, but journey joins still want a replica label
+                flight.set_identity(f"bench-{os.getpid()}", "bench")
                 flight.configure(
                     directory, run_id=f"bench-{MODE}-{MODEL_PRESET}"
                 )

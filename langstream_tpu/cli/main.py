@@ -369,6 +369,20 @@ def _trace_cmd(args) -> None:
         print(line)
 
 
+def _journey_cmd(args) -> None:
+    """Join fleet-wide flight-recorder artifacts by trace id into
+    per-request journey waterfalls, per-stage percentiles, and SLO
+    blame tables (docs/observability.md, "Request journeys")."""
+    from langstream_tpu.runtime.journey import run_journey
+
+    for line in run_journey(
+        args.paths, trace_id=args.trace_id,
+        slo_ttft_ms=args.slo_ttft_ms, slo_tpot_ms=args.slo_tpot_ms,
+        as_json=args.json, waterfalls=args.waterfalls,
+    ):
+        print(line)
+
+
 async def _profile_cmd(args) -> None:
     """Trigger an on-demand profiler capture on a serving process via
     its guarded ``/debug/profile`` endpoint (runner pod :8080, serve
@@ -542,6 +556,41 @@ async def _top_cmd(args) -> None:
                 if slo_rows:
                     print("  -- SLO --")
                     for row in slo_rows:
+                        print(row)
+                # journey stage panel: per-stage latency histograms
+                # from the request-journey ledger — rendered only for
+                # stages that have observed at least one sample
+                stage_rows = []
+                for stage in (
+                    "route", "queue", "admit", "prefill",
+                    "handoff_export", "handoff_transit",
+                    "handoff_import", "decode", "finish",
+                ):
+                    base = f"jax_engine_journey_{stage}_seconds"
+                    count_samples = metrics.get(f"{base}_count")
+                    if not count_samples or not count_samples[0][1]:
+                        continue
+                    count = count_samples[0][1]
+                    buckets = metrics.get(f"{base}_bucket", [])
+                    p50s = quantile_from_buckets(buckets, 0.5)
+                    p95s = quantile_from_buckets(buckets, 0.95)
+                    sum_samples = metrics.get(f"{base}_sum")
+                    total = sum_samples[0][1] if sum_samples else 0.0
+
+                    def ms(value: Optional[float]) -> str:
+                        return (
+                            "     n/a" if value is None
+                            else f"{value * 1e3:8.1f}"
+                        )
+
+                    stage_rows.append(
+                        f"    {stage:16s} n={count:6.0f}  "
+                        f"p50 {ms(p50s)} ms  p95 {ms(p95s)} ms  "
+                        f"total {total:8.2f} s"
+                    )
+                if stage_rows:
+                    print("  -- journey stages --")
+                    for row in stage_rows:
                         print(row)
                 # fleet panel: rendered when the target serves fleet
                 # gauges (a gateway with a registered FleetRouter /
@@ -781,6 +830,40 @@ def build_parser() -> argparse.ArgumentParser:
     trace.add_argument(
         "--list", action="store_true",
         help="list trace ids and the components each one crossed",
+    )
+
+    journey = sub.add_parser(
+        "journey",
+        help="join fleet-wide flight artifacts (LANGSTREAM_FLIGHT_DIR) "
+             "by trace id into per-request waterfalls, per-stage "
+             "p50/p95, and SLO blame",
+    )
+    journey.add_argument(
+        "paths", nargs="+",
+        help="flight_*.jsonl artifacts and/or directories of them "
+             "(pass every replica's artifact dir to join "
+             "cross-replica journeys)",
+    )
+    journey.add_argument(
+        "--trace-id", default=None,
+        help="render the full stage waterfall of one request",
+    )
+    journey.add_argument(
+        "--slo-ttft-ms", type=float, default=0.0,
+        help="TTFT SLO for blame attribution (0 = no TTFT blame)",
+    )
+    journey.add_argument(
+        "--slo-tpot-ms", type=float, default=0.0,
+        help="per-token TPOT SLO for blame attribution "
+             "(0 = no TPOT blame)",
+    )
+    journey.add_argument(
+        "--waterfalls", type=int, default=3,
+        help="how many slowest-request waterfalls to render",
+    )
+    journey.add_argument(
+        "--json", action="store_true",
+        help="emit the joined journeys as JSON instead of tables",
     )
 
     top = sub.add_parser(
@@ -1117,6 +1200,8 @@ def main(argv: Optional[List[str]] = None) -> None:
         _docs(args)
     elif args.command == "trace":
         _trace_cmd(args)
+    elif args.command == "journey":
+        _journey_cmd(args)
     elif args.command == "top":
         try:
             asyncio.run(_top_cmd(args))

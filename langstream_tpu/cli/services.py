@@ -252,6 +252,17 @@ async def serve_main(args) -> None:
         else os.path.join("bench_artifacts", "flight")
     )
     flight_dir = os.environ.get("LANGSTREAM_FLIGHT_DIR", default_dir)
+    # stamp fleet identity before configure so it rides the artifact's
+    # meta record — `langstream-tpu journey` joins per-replica artifacts
+    # by trace id and labels each stage with this replica id
+    import socket
+
+    flight.set_identity(
+        getattr(args, "fleet_replica_id", None)
+        or os.environ.get("HOSTNAME")
+        or socket.gethostname(),
+        getattr(args, "fleet_role", "unified") or "unified",
+    )
     if flight_dir:
         path = flight.configure(flight_dir, run_id=f"serve-{args.model}")
         print(f"flight recorder -> {path}", flush=True)

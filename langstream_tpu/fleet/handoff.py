@@ -311,6 +311,7 @@ def manifest_for_request(
     session_id: Optional[str] = None,
     trace_id: Optional[str] = None,
     replica: Optional[str] = None,
+    export_ts: Optional[float] = None,
 ) -> Dict[str, Any]:
     """The warm-admission envelope: everything the decode side needs to
     rebuild the PR 9 replay request — original prompt, every token the
@@ -319,7 +320,14 @@ def manifest_for_request(
     effective seed (an unseeded stochastic session must continue the
     prefill replica's stream, so the auto-seed crosses in the manifest;
     sampling keys derive from ``(seed, position)`` and positions are
-    absolute, so the continuation is bitwise wherever it lands)."""
+    absolute, so the continuation is bitwise wherever it lands).
+
+    ``export_ts`` (wall seconds; stamped now when omitted) rides the
+    chunk-0 manifest so the decode side can compute the journey
+    ledger's ``handoff_transit`` stage — import-side clock minus the
+    export stamp — without any side channel (ISSUE 20)."""
+    import time as _time
+
     return {
         "prompt_tokens": [int(t) for t in prompt_tokens],
         "generated": [int(t) for t in generated],
@@ -327,4 +335,7 @@ def manifest_for_request(
         "session_id": session_id,
         "trace_id": trace_id,
         "replica": replica,
+        "export_ts": (
+            float(export_ts) if export_ts is not None else _time.time()
+        ),
     }

@@ -81,6 +81,7 @@ from langstream_tpu.providers.jax_local.paged import (
     HostKVArena,
     PagedKVManager,
 )
+from langstream_tpu.runtime.journey import StageBuilder
 from langstream_tpu.topics.memory import (
     MemoryBroker,
     MemoryTopicProducer,
@@ -113,6 +114,15 @@ class SimSession:
     def __init__(self, prompt: Sequence[int], max_new_tokens: int = 8) -> None:
         SimSession._ids += 1
         self.id = f"sess-{SimSession._ids}"
+        # one trace id for the whole client stream, however many
+        # replicas (prefill leg, handoff, decode leg, crash re-routes)
+        # it crosses — the journey ledger's join key
+        self.trace_id = f"trace-{self.id}"
+        # journey cross-leg markers, stamped by the fleet at handoff
+        # commit (transit start = the chunk-0 manifest's export_ts) and
+        # consumed by the next leg's journey record
+        self._jt_transit_start: Optional[float] = None
+        self._jt_import: Optional[Tuple[float, float]] = None
         self.prompt = list(prompt)
         self.max_new_tokens = int(max_new_tokens)
         self.tokens: List[int] = []
@@ -160,13 +170,24 @@ class SimSession:
 
 
 class _Slot:
-    __slots__ = ("session", "table", "prefill_remaining", "adm_tokens")
+    __slots__ = (
+        "session", "table", "prefill_remaining", "adm_tokens",
+        # journey anchors for this leg (sim seconds)
+        "queued_at", "admitted_at", "admit_class", "first_token_at",
+    )
 
-    def __init__(self, session, table, prefill_steps, adm_tokens) -> None:
+    def __init__(
+        self, session, table, prefill_steps, adm_tokens,
+        queued_at=0.0, admitted_at=0.0, admit_class="cold",
+    ) -> None:
         self.session = session
         self.table = table
         self.prefill_remaining = prefill_steps
         self.adm_tokens = adm_tokens
+        self.queued_at = queued_at
+        self.admitted_at = admitted_at
+        self.admit_class = admit_class
+        self.first_token_at: Optional[float] = None
 
 
 class SimReplica:
@@ -239,6 +260,11 @@ class SimReplica:
         self.recompute_tokens = 0
         self.queue: Deque[Tuple[SimSession, float]] = deque()
         self.active: List[_Slot] = []
+        # journey ledger (ISSUE 20): one record per leg served here, the
+        # same schema the engine writes to its flight recorder —
+        # ``SimFleet.write_flight_artifacts`` lays them out on disk so
+        # ``langstream-tpu journey`` joins sim fleets unchanged
+        self.journeys: List[Dict[str, Any]] = []
         self.state = "serving"
         self.seq = 0
         self.boot = 0  # bumped per rebuild: the heartbeat epoch
@@ -302,7 +328,19 @@ class SimReplica:
             prefill_steps = math.ceil(
                 max(0, len(adm) - matched - promoted) / self.prefill_rate
             )
-            self.active.append(_Slot(session, table, prefill_steps, adm))
+            # journey admission class: a handoff-import leg's prefix
+            # hit was manufactured by the fabric, not earned by the pool
+            admit_class = (
+                "handoff-import" if session._jt_import is not None
+                else "host-promote" if promoted
+                else "hbm-hit" if matched
+                else "cold"
+            )
+            self.active.append(_Slot(
+                session, table, prefill_steps, adm,
+                queued_at=queued_at, admitted_at=now,
+                admit_class=admit_class,
+            ))
 
     def _shed_expired(self, now: float) -> List[SimSession]:
         if not self.queue_timeout_s:
@@ -313,6 +351,21 @@ class SimReplica:
             if now - queued_at >= self.queue_timeout_s:
                 self.shed_total += 1
                 shed.append(session)
+                # the shed wait is still attributable queue time: a
+                # partial journey record keeps the re-routed request's
+                # e2e wall tiled (the next leg starts its own queue)
+                self.journeys.append({
+                    "ts": now,
+                    "kind": "journey",
+                    "trace_id": session.trace_id,
+                    "session_id": session.id,
+                    "finish_reason": "shed",
+                    "tokens": len(session.tokens),
+                    "stages": [{
+                        "stage": "queue", "start": queued_at,
+                        "end": now, "shed": True,
+                    }],
+                })
             else:
                 keep.append((session, queued_at))
         self.queue = keep
@@ -362,6 +415,8 @@ class SimReplica:
             )
             session.token_times.append(now)
             session.token_replicas.append(self.name)
+            if slot.first_token_at is None:
+                slot.first_token_at = now  # this LEG's prefill→decode edge
             if session.first_token_at is None:
                 session.first_token_at = now
                 assert session.submitted_at is not None
@@ -381,18 +436,73 @@ class SimReplica:
                 self.kv.release(slot.table)
                 self.active.remove(slot)
                 finished.append(session)
+                self._emit_journey(slot, now)
             elif self.role == "prefill":
                 # disaggregation prefill leg: first token out, chain
                 # out — the decode pool owns the continuation
-                handoffs.append((self._export_handoff(slot), session))
+                handoffs.append((self._export_handoff(slot, now), session))
                 self.active.remove(slot)
+                self._emit_journey(slot, now, handoff=True)
         return {"finished": finished, "shed": shed,
                 "handoffs": handoffs, "records": records}
+
+    def _emit_journey(
+        self, slot: _Slot, now: float, *, handoff: bool = False
+    ) -> None:
+        """One finished (or handed-off) leg's ``journey`` record, on
+        the sim clock — the exact shape the engine's ``_emit_journey``
+        writes to the flight recorder, so ``runtime/journey.py`` joins
+        real and simulated fleets with the same code. StageBuilder
+        clamping makes the leg tile by construction; the fleet-stamped
+        cross-leg markers (transit start, import window) are consumed
+        here so a later leg cannot double-emit them."""
+        session = slot.session
+        builder = StageBuilder()
+        transit_start = session._jt_transit_start
+        import_window = session._jt_import
+        if transit_start is not None:
+            builder.add(
+                "handoff_transit",
+                transit_start,
+                import_window[0] if import_window else slot.queued_at,
+            )
+        if import_window is not None:
+            builder.add(
+                "handoff_import", import_window[0], import_window[1]
+            )
+        builder.add("queue", slot.queued_at, slot.admitted_at)
+        builder.add(
+            "admit", slot.admitted_at, slot.admitted_at,
+            admit_class=slot.admit_class,
+        )
+        first = (
+            slot.first_token_at if slot.first_token_at is not None
+            else now
+        )
+        builder.add("prefill", slot.admitted_at, first)
+        builder.add("decode", first, now)
+        if handoff:
+            builder.add("handoff_export", now, now)
+        else:
+            builder.add("finish", now, now)
+        session._jt_transit_start = None
+        session._jt_import = None
+        self.journeys.append({
+            "ts": now,
+            "kind": "journey",
+            "trace_id": session.trace_id,
+            "session_id": session.id,
+            "finish_reason": "handoff" if handoff else "stop",
+            "tokens": len(session.tokens),
+            "admit_class": slot.admit_class,
+            "first_token": session.first_token_at,
+            "stages": builder.stages,
+        })
 
     # -------------------------------------------------------------- #
     # KV handoff (disaggregation; fleet/handoff.py schema)
     # -------------------------------------------------------------- #
-    def _export_handoff(self, slot: _Slot) -> str:
+    def _export_handoff(self, slot: _Slot, now: float) -> str:
         """Serialize the finishing prefill leg's chain into bounded
         ``kv_handoff`` records on the outbox. The exported chain is the
         PUBLISHED full-block prefix (publish-at-admission already made
@@ -411,9 +521,13 @@ class SimReplica:
         }
         manifest = {
             "session_id": session.id,
+            "trace_id": session.trace_id,
             "prompt_len": len(session.prompt),
             "generated": list(session.tokens),
             "replica": self.name,
+            # transit anchor: the decode side's journey subtracts this
+            # from its import-start to price the fabric hop
+            "export_ts": now,
         }
         for record in handoff_records(
             payload, manifest,
@@ -583,6 +697,7 @@ class SimFleet:
         unrouted_patience_ticks: int = 200,
         roles: Optional[Dict[str, int]] = None,
         handoff_timeout_s: float = 10.0,
+        slow_handoff_s: float = 0.0,
         **replica_kwargs: Any,
     ) -> None:
         self.now = 0.0
@@ -616,6 +731,20 @@ class SimFleet:
         self._handoff_routes: Dict[str, str] = {}
         self._awaiting: Dict[str, SimSession] = {}
         self.handoff_timeout_s = float(handoff_timeout_s)
+        # fault injection (journey blame instrument): every handoff
+        # chunk sits on the simulated wire this long before the fleet
+        # sees it — the ledger must blame the tail on handoff_transit.
+        # Keep it under handoff_timeout_s or the orphan sweep wins.
+        self.slow_handoff_s = float(slow_handoff_s)
+        self._delayed_chunks: List[Tuple[float, Dict[str, Any]]] = []
+        # journey anchors the replicas can't see: per-handoff import
+        # start (first-chunk reservation) and the chunk-0 manifest's
+        # export stamp, consumed when the decode leg is pinned
+        self._import_started: Dict[str, float] = {}
+        self._handoff_export_ts: Dict[str, float] = {}
+        # route-stage journey records (the fleet router is the sim's
+        # "gateway"): written as their own flight artifact
+        self.route_journeys: List[Dict[str, Any]] = []
         # last chunk progress per awaited handoff: a prefill replica
         # killed BEFORE any chunk flushed leaves nothing in the
         # assembler to GC, so the fleet sweeps its own awaiting table
@@ -734,12 +863,51 @@ class SimFleet:
             try:
                 replica.submit(session, self.now)
                 session._unrouted_ticks = 0
+                self._record_route(
+                    session,
+                    replica=decision.replica_id,
+                    policy=getattr(decision, "policy", self.policy),
+                    matched_blocks=getattr(decision, "matched_blocks", 0),
+                    matched_host_blocks=getattr(
+                        decision, "matched_host_blocks", 0
+                    ),
+                )
                 return
             except ReplicaDown:
                 self.router.mark_unroutable(
                     decision.replica_id, reason="connection refused"
                 )
         self._unrouted.append(session)
+
+    def _record_route(
+        self,
+        session: SimSession,
+        *,
+        replica: str,
+        policy: str,
+        matched_blocks: int = 0,
+        matched_host_blocks: int = 0,
+    ) -> None:
+        """A zero-width ``route`` journey stage — the fleet router is
+        the sim's gateway, so its decisions land in their own flight
+        artifact keyed by the same trace id."""
+        prefix_class = (
+            "handoff" if policy == "pinned"
+            else "host" if matched_host_blocks
+            else "warm" if matched_blocks
+            else "cold"
+        )
+        self.route_journeys.append({
+            "ts": self.now,
+            "kind": "journey",
+            "trace_id": session.trace_id,
+            "session_id": session.id,
+            "stages": [{
+                "stage": "route", "start": self.now, "end": self.now,
+                "policy": policy, "replica": replica,
+                "prefix_class": prefix_class,
+            }],
+        })
 
     # -------------------------------------------------------------- #
     # the loop
@@ -770,7 +938,13 @@ class SimFleet:
                 replica.abort_import(handoff_id)
         session = self._awaiting.pop(handoff_id, None)
         self._awaiting_progress.pop(handoff_id, None)
+        self._import_started.pop(handoff_id, None)
+        export_ts = self._handoff_export_ts.pop(handoff_id, None)
         if session is not None and not session.done:
+            if export_ts is not None:
+                # the dead handoff's wire time is still transit the
+                # ledger should attribute to the cold re-routed leg
+                session._jt_transit_start = export_ts
             session.reroutes += 1
             self.reroutes += 1
             self._route_submit(session)
@@ -782,17 +956,43 @@ class SimFleet:
         final chunk commit the chain + submit the pinned decode leg.
         Then GC orphans (prefill replica died mid-handoff) back to cold
         re-routes."""
-        for record in await self._handoff_reader.read(
-            max_records=10_000, timeout=0.0
-        ):
-            value = record.value
-            if not isinstance(value, dict):
-                continue
+        incoming = [
+            record.value
+            for record in await self._handoff_reader.read(
+                max_records=10_000, timeout=0.0
+            )
+            if isinstance(record.value, dict)
+        ]
+        if self.slow_handoff_s > 0.0:
+            # injected fabric fault: park every fresh chunk until its
+            # simulated arrival time
+            self._delayed_chunks.extend(
+                (self.now + self.slow_handoff_s, value)
+                for value in incoming
+            )
+            incoming = []
+        if self._delayed_chunks:
+            due = [v for t, v in self._delayed_chunks if t <= self.now]
+            if due:
+                self._delayed_chunks = [
+                    (t, v) for t, v in self._delayed_chunks
+                    if t > self.now
+                ]
+                incoming = due + incoming
+        for value in incoming:
             handoff_id = value.get("handoff_id")
             session = self._awaiting.get(handoff_id)
             if session is None:
                 continue  # already aborted/completed; stale chunk
             self._awaiting_progress[handoff_id] = self.now
+            manifest = value.get("manifest")
+            if (
+                isinstance(manifest, dict)
+                and manifest.get("export_ts") is not None
+            ):
+                self._handoff_export_ts[handoff_id] = float(
+                    manifest["export_ts"]
+                )
             if handoff_id not in self._handoff_routes:
                 try:
                     decision = self.router.route(
@@ -812,6 +1012,7 @@ class SimFleet:
                     self._fallback_cold(handoff_id)
                     continue
                 self._handoff_routes[handoff_id] = decision.replica_id
+                self._import_started[handoff_id] = self.now
             replica = self.replicas.get(self._handoff_routes[handoff_id])
             if replica is not None:
                 replica.feed_import(
@@ -823,6 +1024,12 @@ class SimFleet:
             replica_name = self._handoff_routes.pop(handoff_id, None)
             session = self._awaiting.pop(handoff_id, None)
             self._awaiting_progress.pop(handoff_id, None)
+            import_start = self._import_started.pop(handoff_id, self.now)
+            export_ts = self._handoff_export_ts.pop(handoff_id, None)
+            if export_ts is None:
+                export_ts = (assembled.get("manifest") or {}).get(
+                    "export_ts"
+                )
             replica = (
                 self.replicas.get(replica_name) if replica_name else None
             )
@@ -832,11 +1039,20 @@ class SimFleet:
             if session is None:
                 continue
             if committed:
+                # journey cross-leg markers: the decode leg's record
+                # prices transit (manifest stamp → first-chunk
+                # reservation) and the import window itself
+                if export_ts is not None:
+                    session._jt_transit_start = float(export_ts)
+                session._jt_import = (import_start, self.now)
                 try:
                     # the routed `langstream-replica` pin: the decode
                     # leg goes to the replica holding the imported
                     # chain, not through scoring again
                     replica.submit(session, self.now)
+                    self._record_route(
+                        session, replica=replica_name, policy="pinned"
+                    )
                     continue
                 except ReplicaDown:
                     pass
@@ -987,6 +1203,34 @@ class SimFleet:
         return max(
             (s.max_tpot_excursion() for s in self.sessions), default=0.0
         )
+
+    def write_flight_artifacts(self, directory: str) -> List[str]:
+        """Lay the fleet's journey records out as per-replica
+        ``flight_*.jsonl`` artifacts (meta line first, carrying the
+        replica identity) plus one for the fleet router's route
+        decisions — the exact on-disk shape a real pod's flight
+        recorder leaves, so ``langstream-tpu journey`` joins simulated
+        fleets through the same code path as real ones."""
+        os.makedirs(directory, exist_ok=True)
+        paths: List[str] = []
+
+        def write(
+            name: str, role: str, records: List[Dict[str, Any]]
+        ) -> None:
+            path = os.path.join(directory, f"flight_sim-{name}.jsonl")
+            with open(path, "w", encoding="utf-8") as handle:
+                handle.write(json.dumps({
+                    "ts": 0.0, "kind": "meta",
+                    "replica": name, "fleet_role": role,
+                }) + "\n")
+                for record in records:
+                    handle.write(json.dumps(record) + "\n")
+            paths.append(path)
+
+        for name, replica in self.replicas.items():
+            write(name, replica.role, replica.journeys)
+        write("fleet-router", "router", self.route_journeys)
+        return paths
 
     def gauges(self) -> Dict[str, float]:
         out = self.router.gauges(now=self.now)
@@ -1163,6 +1407,7 @@ async def run_disagg_leg(
     pools: Optional[Tuple[int, int]] = None,
     queue_timeout_s: Optional[float] = 16.0,
     kill: Optional[Tuple[str, float]] = None,
+    journey_dir: Optional[str] = None,
     **fleet_kwargs: Any,
 ) -> Dict[str, Any]:
     """One leg of the disaggregated-vs-unified A/B on identical traffic
@@ -1213,6 +1458,10 @@ async def run_disagg_leg(
     record = _leg_record(fleet, mode, replicas)
     if kill:
         record["killed_replica"] = kill[0]
+    if journey_dir is not None:
+        record["journey_artifacts"] = fleet.write_flight_artifacts(
+            journey_dir
+        )
     return record
 
 
@@ -1352,9 +1601,13 @@ def main(argv: Optional[List[str]] = None) -> None:
             "bench_fleet_unified.json": "unified",
         }
         for filename, mode in legs.items():
-            record = asyncio.run(
-                run_disagg_leg(mode, spec, replicas=args.replicas)
-            )
+            record = asyncio.run(run_disagg_leg(
+                mode, spec, replicas=args.replicas,
+                # the disagg leg leaves journey flight artifacts next
+                # to the A/B record: `langstream-tpu journey <out>`
+                # renders its cross-replica waterfalls
+                journey_dir=args.out if mode == "disagg" else None,
+            ))
             path = os.path.join(args.out, filename)
             with open(path, "w") as handle:
                 handle.write(json.dumps(record) + "\n")

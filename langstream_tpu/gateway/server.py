@@ -153,6 +153,13 @@ class GatewayServer:
         if engine_module is not None:
             gauges.update(engine_module.engines_snapshot())
             histograms.update(engine_module.engines_histograms())
+        else:
+            # gateway-only process: the engine families are absent, but
+            # the journey ledger's route stage is sampled HERE — its
+            # per-stage histograms must still reach this surface
+            from langstream_tpu.runtime.journey import stage_histograms
+
+            histograms.update(stage_histograms())
         # fleet routing/autoscaling gauges (per-replica queue depth and
         # state, affinity hit rate, replica counts) — the `top` fleet
         # panel reads exactly these families
@@ -352,28 +359,14 @@ class GatewayServer:
         trace_id = new_trace_id()
         return headers + ((TRACE_ID_HEADER, trace_id),), trace_id
 
-    def _fleet_headers(
-        self,
-        value: Any,
-        user_headers: Tuple[Tuple[str, str], ...] = (),
-    ) -> Tuple[Tuple[str, str], ...]:
-        """Prefix-affinity routing at the front door: when a fleet
-        router is registered, pick the replica whose resident chain set
-        best matches the session's token prefix (``tokens`` in a dict
-        payload; token-less payloads fall back least-queue-depth) and
-        stamp it as the ``langstream-replica`` header, so downstream
-        consumers — and keyed partitioners — can honor the decision.
-
-        Session stickiness (ROADMAP item 4): a follow-up carrying the
-        stamped ``langstream-replica`` header from a prior reply PINS
-        its session's replica — the warm KV lives there NOW, before its
-        chain digests have gossiped — and a stale/condemned pin falls
-        back to digest scoring, re-stamping the new decision.
-
-        Never fails the produce: an unroutable fleet degrades to the
-        pre-fleet blind path."""
+    def _route_decision(self, value: Any, user_headers=()):
+        """The fleet router's verdict for one produce, or None (no
+        fleet attached / unroutable). Split out of
+        :meth:`_fleet_headers` so the journey ledger sees the decision
+        itself — policy and matched-prefix class — not just the stamped
+        header."""
         if self._fleet is None:
-            return ()
+            return None
         from langstream_tpu.fleet.router import (
             REPLICA_HEADER,
             NoRoutableReplica,
@@ -397,11 +390,81 @@ class GatewayServer:
             decision = self._fleet.route(tokens, session_replica=pin)
         except NoRoutableReplica:
             self.metrics.counter("fleet_unroutable").count()
-            return ()
+            return None
         if decision.policy == "sticky":
             self.metrics.counter("fleet_sticky").count()
         self.metrics.counter("fleet_routed").count()
+        return decision
+
+    def _fleet_headers(
+        self,
+        value: Any,
+        user_headers: Tuple[Tuple[str, str], ...] = (),
+    ) -> Tuple[Tuple[str, str], ...]:
+        """Prefix-affinity routing at the front door: when a fleet
+        router is registered, pick the replica whose resident chain set
+        best matches the session's token prefix (``tokens`` in a dict
+        payload; token-less payloads fall back least-queue-depth) and
+        stamp it as the ``langstream-replica`` header, so downstream
+        consumers — and keyed partitioners — can honor the decision.
+
+        Session stickiness (ROADMAP item 4): a follow-up carrying the
+        stamped ``langstream-replica`` header from a prior reply PINS
+        its session's replica — the warm KV lives there NOW, before its
+        chain digests have gossiped — and a stale/condemned pin falls
+        back to digest scoring, re-stamping the new decision.
+
+        Never fails the produce: an unroutable fleet degrades to the
+        pre-fleet blind path."""
+        decision = self._route_decision(value, user_headers)
+        if decision is None:
+            return ()
+        from langstream_tpu.fleet.router import REPLICA_HEADER
+
         return ((REPLICA_HEADER, decision.replica_id),)
+
+    def _record_route(
+        self, trace_id: str, decision, start_wall: float, dur_s: float
+    ) -> None:
+        """The journey ledger's ``route`` stage on the gateway: a
+        histogram sample for this /metrics surface, a ``gateway.route``
+        trace event, and a ``journey`` flight record when the recorder
+        is armed — so fleet-wide joins see who decided and why, not
+        just where the request landed."""
+        from langstream_tpu.runtime import flight
+        from langstream_tpu.runtime.journey import STAGE_SECONDS
+
+        STAGE_SECONDS["route"].observe(max(0.0, dur_s))
+        if decision is None:
+            return
+        attrs = {
+            "policy": decision.policy,
+            "replica": decision.replica_id,
+            "prefix_class": (
+                "host" if getattr(decision, "matched_host_blocks", 0)
+                else "warm" if getattr(decision, "matched_blocks", 0)
+                else "cold"
+            ),
+        }
+        if self.tracer.enabled:
+            self.tracer.event(
+                "gateway.route",
+                max(0.0, dur_s),
+                trace_id=trace_id,
+                start_wall=start_wall,
+                **attrs,
+            )
+        if flight.RECORDER.enabled:
+            flight.record(
+                "journey",
+                trace_id=trace_id,
+                stages=[{
+                    "stage": "route",
+                    "start": start_wall,
+                    "end": start_wall + max(0.0, dur_s),
+                    **attrs,
+                }],
+            )
 
     async def _do_produce(
         self, registered, gateway, parameters, principal, payload: str
@@ -410,7 +473,15 @@ class GatewayServer:
         gateway_headers = self._resolve_headers(
             gateway.produce_options.get("headers"), parameters, principal
         )
-        fleet_headers = self._fleet_headers(value, tuple(user_headers))
+        route_t0 = time.perf_counter()
+        route_wall = time.time()
+        decision = self._route_decision(value, tuple(user_headers))
+        route_dur = time.perf_counter() - route_t0
+        fleet_headers: Tuple[Tuple[str, str], ...] = ()
+        if decision is not None:
+            from langstream_tpu.fleet.router import REPLICA_HEADER
+
+            fleet_headers = ((REPLICA_HEADER, decision.replica_id),)
         if self._fleet is not None:
             # the routing layer owns the replica header: drop any
             # client-supplied pin (honored pins re-stamp the same
@@ -428,6 +499,8 @@ class GatewayServer:
             + tuple(gateway_headers)
             + fleet_headers
         )
+        if self._fleet is not None:
+            self._record_route(trace_id, decision, route_wall, route_dur)
         with self.tracer.span(
             "gateway.produce", trace_id=trace_id,
             gateway=gateway.id, topic=gateway.topic,

@@ -68,6 +68,7 @@ _LIVE_ENGINES: "weakref.WeakSet" = weakref.WeakSet()
 # a decode step lives in)
 from langstream_tpu.api.metrics import Histogram
 from langstream_tpu.runtime import accounting
+from langstream_tpu.runtime import journey as journey_ledger
 
 DECODE_STEP_SECONDS = Histogram(
     "jax_engine_decode_step_seconds",
@@ -113,8 +114,10 @@ def engines_histograms():
             REQUEST_SECONDS, MFU_PER_CHUNK, MBU_PER_CHUNK,
         )
     }
-    # recovery_seconds rides every surface the engine histograms reach
-    # (runner pods, the OpenAI server, the gateway)
+    # per-stage journey histograms (ISSUE 20) and recovery_seconds ride
+    # every surface the engine histograms reach (runner pods, the
+    # OpenAI server, the gateway)
+    out.update(journey_ledger.stage_histograms())
     supervisor_mod = _supervisor_module()
     if supervisor_mod is not None:
         out.update(supervisor_mod.supervisor_histograms())
@@ -462,6 +465,11 @@ class GenerationRequest:
     # cache for the full prompt instead of recomputing it
     export_handoff: bool = False
     kv_import: Optional[Dict[str, Any]] = None
+    # journey ledger (ISSUE 20): the prefill replica's manifest export
+    # stamp (wall seconds), threaded onto the decode-leg request so the
+    # engine can emit a ``handoff_transit`` stage — fabric time between
+    # the export and this replica's import — in its journey record
+    handoff_export_ts: Optional[float] = None
 
     def cancel(self) -> None:
         self.cancelled = True
@@ -1701,17 +1709,25 @@ class DecodeEngine:
             self._handoff_import_fns[width] = fn
         return fn
 
-    def _export_handoff(self, slot: _Slot) -> Optional[Dict[str, Any]]:
+    def _export_handoff(
+        self, slot: _Slot, request: Optional[GenerationRequest] = None
+    ) -> Optional[Dict[str, Any]]:
         """Serialize the finishing slot's published chain for the topic
         fabric: full blocks of ``history[:length]`` (exactly what
         :meth:`PagedKVManager.publish` made matchable — the final
         sampled token is never in the cache, so it rides the manifest's
         teacher-forced replay instead). Returns the payload
         ``fleet.handoff.handoff_records`` chunks, or None when nothing
-        is exportable (no full block yet)."""
+        is exportable (no full block yet). ``request`` (the finishing
+        request — ``slot.request`` is already cleared by ``_finish``)
+        labels the trace span; the payload's ``export_ts`` lets the
+        serving layer stamp the chunk-0 manifest so the decode side can
+        compute ``handoff_transit``."""
         full = slot.length // self.block_size
         if full <= 0 or not slot.blocks:
             return None
+        export_t0 = time.perf_counter()
+        export_wall = time.time()
         tokens = slot.history[: full * self.block_size]
         blocks = slot.blocks[:full]
         width = self._handoff_pad(full)
@@ -1732,6 +1748,11 @@ class DecodeEngine:
             "arrays": arrays,
             "block_size": self.block_size,
             "kv_quant": bool(self.kv_quant),
+            # the transit anchor: rides the chunk-0 manifest
+            # (manifest_for_request) so the decode leg can subtract.
+            # Stamped AFTER the arrays are materialized — transit
+            # measures the fabric, not this replica's serialization
+            "export_ts": time.time(),
         }
         nbytes = payload_nbytes(payload)
         self.stats["handoff_exports"] += 1
@@ -1742,6 +1763,18 @@ class DecodeEngine:
             blocks=full,
             nbytes=nbytes,
         )
+        if self.tracer.enabled:
+            self.tracer.event(
+                "engine.handoff_export",
+                time.perf_counter() - export_t0,
+                trace_id=(request.trace_id or "") if request else "",
+                start_wall=export_wall,
+                tokens=len(tokens),
+                blocks=full,
+                bytes=nbytes,
+                aborted=False,
+                replica=flight.get_identity().get("replica", ""),
+            )
         return payload
 
     def _import_pending_handoffs(self) -> None:
@@ -1759,14 +1792,31 @@ class DecodeEngine:
             if request.kv_import is None:
                 continue
             payload, request.kv_import = request.kv_import, None
-            self._import_handoff(payload)
+            import_start = time.time()
+            ok = self._import_handoff(
+                payload, trace_id=request.trace_id or ""
+            )
+            if ok:
+                # journey ledger: the decode leg's handoff_import stage
+                # window + admission class (the later prefix-cache hit
+                # this import manufactured must not book as "hbm-hit")
+                request._jt_import = (  # type: ignore[attr-defined]
+                    import_start, time.time()
+                )
+                request._jt_admit_class = (  # type: ignore[attr-defined]
+                    "handoff-import"
+                )
 
-    def _import_handoff(self, payload: Dict[str, Any]) -> bool:
+    def _import_handoff(
+        self, payload: Dict[str, Any], trace_id: str = ""
+    ) -> bool:
         manager = self.kv_manager
         tokens = list(payload.get("tokens") or [])
         arrays = payload.get("arrays") or {}
         size = int(payload.get("block_size", 0) or 0)
         full = len(tokens) // size if size else 0
+        import_t0 = time.perf_counter()
+        import_wall = time.time()
 
         def aborted(reason: str) -> bool:
             self._waste("handoff_aborted", len(tokens))
@@ -1774,6 +1824,16 @@ class DecodeEngine:
                 "kv_handoff_import_aborted",
                 reason=reason, tokens=len(tokens),
             )
+            if self.tracer.enabled:
+                self.tracer.event(
+                    "engine.handoff_import",
+                    time.perf_counter() - import_t0,
+                    trace_id=trace_id,
+                    start_wall=import_wall,
+                    tokens=len(tokens),
+                    aborted=True,
+                    reason=reason,
+                )
             return False
 
         if self.mirror is not None:
@@ -1836,6 +1896,18 @@ class DecodeEngine:
             blocks_local=len(chain),
             nbytes=int(nbytes),
         )
+        if self.tracer.enabled:
+            self.tracer.event(
+                "engine.handoff_import",
+                time.perf_counter() - import_t0,
+                trace_id=trace_id,
+                start_wall=import_wall,
+                tokens=len(tokens),
+                blocks=len(chain) + len(fresh),
+                bytes=int(nbytes),
+                aborted=False,
+                replica=flight.get_identity().get("replica", ""),
+            )
         return True
 
     # ------------------------------------------------------------------ #
@@ -3221,6 +3293,12 @@ class DecodeEngine:
                 manager.stats["hit_tokens"] += matched_tokens
             if promoted:
                 self.stats["prefix_tokens_reused"] += promoted * size
+                # journey admit class: the host tier (not cold prefill,
+                # not a pure HBM hit) is what served this admission
+                if getattr(request, "_jt_admit_class", None) is None:
+                    request._jt_admit_class = (  # type: ignore[attr-defined]
+                        "host-promote"
+                    )
             if (
                 self.prefix_cache and publish_cold
                 and not matched_tokens and not promoted
@@ -3283,6 +3361,15 @@ class DecodeEngine:
         """Reset a slot's bookkeeping for a newly admitted request.
         ``reused`` = cache tokens this admission did NOT re-prefill
         (session continuation / prefix copy / paged prefix hit)."""
+        # journey ledger anchors: the single admission point for every
+        # path (cold, mixed, session, handoff) stamps the queue→prefill
+        # boundary and the admission class (unless an earlier stage —
+        # handoff import, host promotion — already classified it)
+        request._admit_wall = time.time()  # type: ignore[attr-defined]
+        if getattr(request, "_jt_admit_class", None) is None:
+            request._jt_admit_class = (  # type: ignore[attr-defined]
+                "hbm-hit" if reused > 0 else "cold"
+            )
         slot = self.slots[index]
         if (
             slot.session_id is not None
@@ -4752,8 +4839,13 @@ class DecodeEngine:
         request = slot.request
         if not slot.generated:
             # first token: TTFT anchor for the request span / flight log
+            # (wall twin anchors the journey ledger's prefill→decode
+            # stage boundary on the cross-replica timeline)
             request._first_token_ts = (  # type: ignore[attr-defined]
                 time.perf_counter()
+            )
+            request._first_token_wall = (  # type: ignore[attr-defined]
+                time.time()
             )
         slot.generated.append(token)
         slot.logprobs.append(logprob)
@@ -4879,7 +4971,14 @@ class DecodeEngine:
                     # disaggregation prefill leg: serialize the chain
                     # just published, while the slot's refs still pin
                     # it (no eviction race inside this finish)
-                    result.kv_handoff = self._export_handoff(slot)
+                    export_start = time.time()
+                    result.kv_handoff = self._export_handoff(
+                        slot, request
+                    )
+                    if result.kv_handoff is not None:
+                        request._jt_export = (  # type: ignore[attr-defined]
+                            export_start, time.time()
+                        )
             if request.session_id is not None:
                 slot.session_id = request.session_id
                 slot.last_used = time.monotonic()
@@ -4919,8 +5018,123 @@ class DecodeEngine:
             slot.session_id = None
             slot.history = None
             slot.length = 0
+        self._emit_journey(
+            index, request, reason, len(generated), ttft_ms
+        )
         if request.future is not None:
             self._post_future(request, result)
+
+    def _emit_journey(
+        self,
+        index: int,
+        request: GenerationRequest,
+        reason: str,
+        tokens: int,
+        ttft_ms: float,
+    ) -> None:
+        """Assemble this leg's journey stages (wall clock, tiled by
+        StageBuilder construction), feed the per-stage histograms and
+        SLO blame — always — and emit the ``journey`` flight record +
+        per-stage trace events when those sinks are enabled."""
+        now_wall = time.time()
+        submit_wall = getattr(request, "_submit_wall", now_wall)
+        admit_wall = getattr(request, "_admit_wall", submit_wall)
+        first_wall = getattr(request, "_first_token_wall", None)
+        import_window = getattr(request, "_jt_import", None)
+        export_window = getattr(request, "_jt_export", None)
+        admit_class = getattr(request, "_jt_admit_class", None) or "cold"
+        builder = journey_ledger.StageBuilder()
+        if request.handoff_export_ts is not None:
+            # decode leg of a disaggregated request: the prefill
+            # replica's export stamp (off the chunk-0 manifest) anchors
+            # transit — fabric + assembly time until our submit
+            builder.add(
+                "handoff_transit", request.handoff_export_ts, submit_wall
+            )
+        builder.add(
+            "queue",
+            submit_wall,
+            import_window[0] if import_window else admit_wall,
+        )
+        if import_window:
+            builder.add(
+                "handoff_import", import_window[0], import_window[1]
+            )
+        builder.add(
+            "admit", admit_wall, admit_wall, admit_class=admit_class
+        )
+        builder.add(
+            "prefill",
+            admit_wall,
+            first_wall if first_wall is not None else admit_wall,
+        )
+        decode_end = export_window[0] if export_window else now_wall
+        builder.add(
+            "decode",
+            first_wall if first_wall is not None else admit_wall,
+            decode_end,
+        )
+        if export_window:
+            builder.add(
+                "handoff_export", export_window[0], export_window[1]
+            )
+        builder.add(
+            "finish",
+            export_window[1] if export_window else decode_end,
+            now_wall,
+            finish_reason=reason,
+        )
+        stages = builder.stages
+        journey_ledger.observe_stages(stages)
+        first_ref = first_wall
+        if self.slo is not None and self.slo.targets_s:
+            ttft_target = self.slo.targets_s.get("ttft")
+            if (
+                ttft_target is not None
+                and ttft_ms / 1e3 > ttft_target
+            ):
+                self.slo.attribute(
+                    "ttft",
+                    journey_ledger.blame_stage(stages, first_ref, "ttft"),
+                )
+            tpot_target = self.slo.targets_s.get("tpot")
+            if (
+                tpot_target is not None
+                and tokens > 1
+                and first_wall is not None
+                and (decode_end - first_wall) / (tokens - 1) > tpot_target
+            ):
+                self.slo.attribute(
+                    "tpot",
+                    journey_ledger.blame_stage(stages, first_ref, "tpot"),
+                )
+        if not (self.tracer.enabled or flight.RECORDER.enabled):
+            return
+        tid = request.trace_id or ""
+        flight.record(
+            "journey",
+            trace_id=tid,
+            session_id=request.session_id or "",
+            slot=index,
+            finish_reason=reason,
+            tokens=tokens,
+            admit_class=admit_class,
+            first_token=first_wall,
+            ttft_ms=ttft_ms,
+            e2e_ms=round((now_wall - stages[0]["start"]) * 1e3, 3),
+            stages=stages,
+        )
+        if self.tracer.enabled and tid:
+            replica = flight.get_identity().get("replica", "")
+            for stage in stages:
+                self.tracer.event(
+                    f"engine.journey.{stage['stage']}",
+                    stage["end"] - stage["start"],
+                    trace_id=tid,
+                    start_wall=stage["start"],
+                    slot=index,
+                    replica=replica,
+                )
 
     def _resolve_cancelled(self, request: GenerationRequest) -> None:
         """Resolve a request cancelled before it ever reached a slot."""

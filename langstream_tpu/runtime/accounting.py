@@ -427,6 +427,10 @@ class SLOTracker:
         self._ring: Deque[Tuple[float, Dict[str, Dict[str, float]]]] = (
             deque()
         )
+        # per-stage SLO blame (ISSUE 20): violating requests counted by
+        # the journey stage that dominated the violated window, keyed
+        # (kind, stage)  # guarded-by: _lock
+        self._blame: Dict[Tuple[str, str], int] = {}
         self._lock = threading.Lock()
 
     def tick(self, now: Optional[float] = None) -> None:
@@ -447,6 +451,18 @@ class SLOTracker:
             horizon = now - self.WINDOWS[-1][1] - self.snapshot_interval
             while len(self._ring) > 1 and self._ring[1][0] <= horizon:
                 self._ring.popleft()
+
+    def attribute(self, kind: str, stage: Optional[str]) -> None:
+        """Book one violating request against its dominant journey
+        stage (``runtime/journey.blame_stage``) — the per-stage blame
+        the burn rates alone cannot give: a burning TTFT budget with
+        blame on ``queue`` is a capacity problem, on ``handoff_transit``
+        a fabric problem, on ``prefill`` a scheduling one."""
+        if not stage or kind not in ("ttft", "tpot"):
+            return
+        with self._lock:
+            key = (kind, str(stage))
+            self._blame[key] = self._blame.get(key, 0) + 1
 
     def _snapshot_before(
         self, key: str, cutoff: float
@@ -479,4 +495,9 @@ class SLOTracker:
                         out[f"jax_engine_slo_{key}_burn_rate_{label}"] = (
                             round(fraction / budget, 4)
                         )
+            for (kind, stage), count in sorted(self._blame.items()):
+                out[
+                    "jax_engine_slo_blame_total"
+                    f'{{kind="{kind}",stage="{stage}"}}'
+                ] = float(count)
         return out

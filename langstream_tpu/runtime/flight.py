@@ -29,6 +29,17 @@ by the current emitters:
   admission deadline: waited_s, queue_depth, retry_after_s
 - ``watchdog_escalation`` — N watchdog trips in a window handed the
   engine to the supervisor
+- ``kv_handoff_export`` / ``kv_handoff_import`` — disaggregation KV
+  chain serialized to / imported from the topic fabric
+- ``journey``         — one finished (or handed-off) request leg's
+  stage events (``runtime/journey.py``): trace_id, admit_class, and
+  ``stages`` tiling the leg's wall clock — joined fleet-wide by
+  ``langstream-tpu journey``
+
+The ``meta`` record additionally carries ``replica`` + ``fleet_role``
+when :func:`set_identity` has stamped the process's fleet identity
+(serve threads ``--fleet-replica-id`` / ``--fleet-role``; bench stamps
+a synthetic id), so artifact consumers can label samples per pod.
 
 Disabled (the default) the recorder is a single ``if`` per call; enable
 with :func:`configure` or the ``LANGSTREAM_FLIGHT_DIR`` env var (every
@@ -60,6 +71,10 @@ class FlightRecorder:
         # racing enable loses at most the samples of that instant)
         self.path: Optional[str] = None  # guarded-by: _lock (writes)
         self.dropped = 0  # guarded-by: _lock
+        # fleet identity (replica id + role), stamped into the meta
+        # record so the journey ledger can tell pods apart when it
+        # joins fleet-wide artifacts by trace id
+        self.identity: Dict[str, str] = {}  # guarded-by: _lock
         self._pending: Deque[Dict[str, Any]] = deque(maxlen=capacity)  # guarded-by: _lock
         self._lock = threading.Lock()
         self._last_flush = 0.0  # guarded-by: _lock
@@ -85,14 +100,34 @@ class FlightRecorder:
             if not self._atexit_registered:
                 self._atexit_registered = True
                 atexit.register(self.flush)
+            identity = dict(self.identity)
         self.record(
             "meta",
             pid=os.getpid(),
             run_id=run_id or "",
             argv=" ".join(sys.argv[:4]),
+            **identity,
         )
         self.flush()
         return self.path
+
+    def set_identity(
+        self, replica: Optional[str], fleet_role: Optional[str] = None
+    ) -> None:
+        """Stamp the fleet identity of this process. Called before
+        :meth:`configure`, it rides the artifact's first ``meta`` line;
+        called after (serve learns its ``--fleet-replica-id`` past
+        backend init), a supplementary ``meta`` record carries it —
+        :func:`read_artifact` consumers take the last value seen."""
+        with self._lock:
+            if replica:
+                self.identity["replica"] = str(replica)
+            if fleet_role:
+                self.identity["fleet_role"] = str(fleet_role)
+            identity = dict(self.identity)
+            enabled = self.path is not None
+        if enabled and identity:
+            self.record("meta", pid=os.getpid(), **identity)
 
     def record(self, kind: str, **fields: Any) -> None:
         """Append one sample; cheap no-op when disabled. Auto-flushes
@@ -154,6 +189,17 @@ def configure_from_env() -> Optional[str]:
 
 def record(kind: str, **fields: Any) -> None:
     RECORDER.record(kind, **fields)
+
+
+def set_identity(
+    replica: Optional[str], fleet_role: Optional[str] = None
+) -> None:
+    RECORDER.set_identity(replica, fleet_role)
+
+
+def get_identity() -> Dict[str, str]:
+    with RECORDER._lock:
+        return dict(RECORDER.identity)
 
 
 def flush() -> None:

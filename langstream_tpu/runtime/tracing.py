@@ -360,6 +360,10 @@ def run_trace_merge(
         return [
             f"{tid}  components={','.join(entry['components'])}  "
             f"spans={entry['spans']}"
+            + (
+                f"  replicas={','.join(entry['replicas'])}"
+                if entry.get("replicas") else ""
+            )
             for tid, entry in sorted(summary.items())
         ]
     merged = merge_chrome_trace_files(files, trace_id=trace_id)
@@ -373,8 +377,11 @@ def run_trace_merge(
 
 def trace_summary(paths: Sequence[str]) -> Dict[str, Dict[str, Any]]:
     """Per-trace-id view over a set of dumps: which components a request
-    crossed and how many spans each contributed. The acceptance check for
-    end-to-end propagation (gateway + runner + engine under one id)."""
+    crossed, how many spans each contributed, and — for spans stamped
+    with a ``replica`` attr (gateway route decisions, engine handoff
+    spans on identity-stamped serve processes) — which REPLICAS the
+    request crossed, so a disaggregated prefill→decode path reads as
+    two replicas under one id from ``langstream-tpu trace --list``."""
     out: Dict[str, Dict[str, Any]] = {}
     for path in collect_trace_files(paths):
         with open(path) as handle:
@@ -382,14 +389,19 @@ def trace_summary(paths: Sequence[str]) -> Dict[str, Dict[str, Any]]:
         events = data.get("traceEvents", []) if isinstance(data, dict) else data
         for event in events:
             category = event.get("cat", "?")
+            replica = (event.get("args") or {}).get("replica")
             for tid in _event_trace_ids(event):
                 entry = out.setdefault(
-                    tid, {"components": set(), "spans": 0}
+                    tid,
+                    {"components": set(), "spans": 0, "replicas": set()},
                 )
                 entry["components"].add(category)
                 entry["spans"] += 1
+                if replica:
+                    entry["replicas"].add(str(replica))
     for entry in out.values():
         entry["components"] = sorted(entry["components"])
+        entry["replicas"] = sorted(entry["replicas"])
     return out
 
 

@@ -1,0 +1,512 @@
+"""Request journey ledger (ISSUE 20): cross-replica latency attribution.
+
+The invariants under test, smallest to largest:
+
+- ``StageBuilder`` emits monotonic stage chains — the tiling invariant
+  holds by construction, whatever clock skew the anchors carried.
+- ``blame_stage`` attributes a TTFT violation to the dominant stage
+  before the first token and a TPOT violation to the dominant stage
+  after it; ``finish`` is bookkeeping, never a verdict.
+- The fleet sim's journey records tile each request's end-to-end wall
+  (coverage >= 95%, zero overlapping or negative stages), a disagg
+  journey crosses two replicas under ONE trace id with the transit
+  stage computed from the chunk-0 manifest's export stamp, and an
+  injected slow handoff is blamed on ``handoff_transit`` by the
+  ``langstream-tpu journey`` CLI body.
+- A real ``DecodeEngine`` emits the same tiling journey records, and a
+  decode leg fed ``handoff_export_ts`` grows a transit stage.
+- Torn artifacts (replica died mid-request / mid-write) degrade to
+  partial journeys, never crashes.
+"""
+
+import asyncio
+import json
+import os
+
+import pytest
+
+from langstream_tpu.api.metrics import Histogram
+from langstream_tpu.runtime.journey import (
+    ADMIT_CLASSES,
+    CORE_STAGES,
+    EPS,
+    Journey,
+    JourneyLedger,
+    StageBuilder,
+    blame_stage,
+    run_journey,
+)
+
+
+def _journey_from(records):
+    journey = Journey(records[0]["trace_id"])
+    for record in records:
+        journey.add(record)
+    return journey
+
+
+# ---------------------------------------------------------------------- #
+# units: builder, blame, join
+# ---------------------------------------------------------------------- #
+def test_stage_builder_clamps_to_monotonic_tiling():
+    builder = StageBuilder()
+    builder.add("queue", 0.0, 1.0)
+    # raw anchors rewind the clock: both get clamped forward
+    builder.add("admit", 0.5, 0.5, admit_class="cold")
+    builder.add("prefill", 0.2, 0.8)
+    builder.add("decode", 1.0, 2.0)
+    builder.add("finish", 2.0, 2.0)
+    journey = _journey_from([{
+        "trace_id": "t", "kind": "journey", "stages": builder.stages,
+    }])
+    assert journey.negatives() == []
+    assert journey.overlaps() == []
+    assert journey.coverage() >= 0.999
+    by_name = {s["stage"]: s for s in builder.stages}
+    assert by_name["admit"]["start"] == by_name["admit"]["end"] == 1.0
+    assert by_name["prefill"]["start"] == by_name["prefill"]["end"] == 1.0
+    assert by_name["admit"]["admit_class"] == "cold"
+
+
+def test_blame_windows_split_at_first_token():
+    stages = (
+        StageBuilder()
+        .add("queue", 0.0, 2.0)
+        .add("admit", 2.0, 2.0)
+        .add("prefill", 2.0, 3.0)
+        .add("decode", 3.0, 10.0)
+        .add("finish", 10.0, 10.0)
+        .stages
+    )
+    # TTFT window ends at the first token: queue (2s) beats prefill (1s)
+    assert blame_stage(stages, 3.0, "ttft") == "queue"
+    # TPOT window starts there: decode dominates
+    assert blame_stage(stages, 3.0, "tpot") == "decode"
+    # no first token -> whole journey, largest stage wins
+    assert blame_stage(stages, None, "ttft") == "decode"
+    # finish is never a verdict, even when it is all there is
+    assert blame_stage([{"stage": "finish", "start": 0, "end": 5}],
+                       None, "ttft") is None
+    # ties break toward the canonical stage order
+    tied = [
+        {"stage": "decode", "start": 1.0, "end": 2.0},
+        {"stage": "queue", "start": 0.0, "end": 1.0},
+    ]
+    assert blame_stage(tied, None, "ttft") == "queue"
+
+
+def test_cross_replica_join_orders_replicas_and_blames_transit():
+    prefill_leg = {
+        "kind": "journey", "trace_id": "trace-1", "replica": "pf-0",
+        "tokens": 1, "first_token": 2.5, "admit_class": "cold",
+        "stages": (
+            StageBuilder()
+            .add("queue", 0.0, 1.0)
+            .add("admit", 1.0, 1.0, admit_class="cold")
+            .add("prefill", 1.0, 2.5)
+            .add("decode", 2.5, 3.0)
+            .add("handoff_export", 3.0, 3.0)
+            .stages
+        ),
+    }
+    decode_leg = {
+        "kind": "journey", "trace_id": "trace-1", "replica": "dec-0",
+        "tokens": 9, "finish_reason": "stop",
+        "admit_class": "handoff-import",
+        "stages": (
+            StageBuilder()
+            .add("handoff_transit", 3.0, 7.0)
+            .add("handoff_import", 7.0, 7.5)
+            .add("queue", 7.5, 7.5)
+            .add("admit", 7.5, 7.5, admit_class="handoff-import")
+            .add("prefill", 7.5, 7.5)
+            .add("decode", 7.5, 9.0)
+            .add("finish", 9.0, 9.0)
+            .stages
+        ),
+    }
+    journey = _journey_from([decode_leg, prefill_leg])
+    # merged view: time-sorted, replica-labeled, both legs under one id
+    assert journey.replicas == ["pf-0", "dec-0"]
+    assert journey.finished
+    assert journey.missing_stages() == []
+    assert journey.overlaps() == []
+    assert journey.negatives() == []
+    assert journey.coverage() >= 0.999
+    assert journey.admit_classes == ["handoff-import", "cold"]
+    assert journey.ttft_s() == pytest.approx(2.5)
+    # the 4s transit dominates the post-first-token window
+    assert journey.blame("tpot") == "handoff_transit"
+    assert journey.stage_totals()["handoff_transit"] == pytest.approx(4.0)
+
+
+def test_torn_journey_reports_missing_core_stages():
+    torn = _journey_from([{
+        "kind": "journey", "trace_id": "t-torn", "replica": "r0",
+        "stages": [{"stage": "queue", "start": 0.0, "end": 3.0,
+                    "shed": True}],
+    }])
+    assert not torn.finished
+    missing = torn.missing_stages()
+    assert set(missing) == set(CORE_STAGES) - {"queue"}
+    # partial stages still count toward stage totals / blame
+    assert torn.stage_totals()["queue"] == pytest.approx(3.0)
+
+
+def test_overlap_and_negative_detection():
+    journey = _journey_from([{
+        "kind": "journey", "trace_id": "t", "stages": [
+            {"stage": "queue", "start": 0.0, "end": 2.0},
+            {"stage": "prefill", "start": 1.0, "end": 3.0},
+            {"stage": "decode", "start": 5.0, "end": 4.0},
+        ],
+    }])
+    overlaps = journey.overlaps()
+    assert overlaps and overlaps[0][:2] == ("queue", "prefill")
+    assert overlaps[0][2] == pytest.approx(1.0)
+    assert journey.negatives() == ["decode"]
+    # sub-EPS jitter is a serialization artifact, not an overlap
+    clean = _journey_from([{
+        "kind": "journey", "trace_id": "t2", "stages": [
+            {"stage": "queue", "start": 0.0, "end": 1.0},
+            {"stage": "decode", "start": 1.0 - EPS / 2, "end": 2.0},
+        ],
+    }])
+    assert clean.overlaps() == []
+
+
+def test_ledger_joins_artifacts_with_identity_and_torn_tails(tmp_path):
+    a = tmp_path / "flight_pf.jsonl"
+    b = tmp_path / "flight_dec.jsonl"
+    a.write_text(
+        json.dumps({"ts": 0.0, "kind": "meta", "replica": "pf-0",
+                    "fleet_role": "prefill"}) + "\n"
+        + json.dumps({"ts": 1.0, "kind": "journey", "trace_id": "t-1",
+                      "stages": [{"stage": "queue", "start": 0.0,
+                                  "end": 1.0}]}) + "\n"
+        # journey records without a trace id cannot join: skipped
+        + json.dumps({"ts": 1.0, "kind": "journey", "trace_id": "",
+                      "stages": []}) + "\n"
+        + '{"ts": 2.0, "kind": "journey", "trace_id": "t-2", "sta'
+    )  # torn final line: the process died mid-write
+    b.write_text(
+        # no meta record (pre-identity artifact): filename fallback
+        json.dumps({"ts": 2.0, "kind": "journey", "trace_id": "t-1",
+                    "stages": [{"stage": "decode", "start": 1.0,
+                                "end": 2.0}]}) + "\n"
+    )
+    ledger = JourneyLedger()
+    assert ledger.add_artifact(str(a)) == 1
+    assert ledger.add_artifact(str(b)) == 1
+    assert ledger.replicas["pf-0"] == "prefill"
+    assert "flight_dec" in ledger.replicas
+    journey = ledger.get("t-1")
+    assert journey is not None
+    assert journey.replicas == ["pf-0", "flight_dec"]
+    stats = ledger.stage_stats()
+    assert stats["queue"]["count"] == 1.0
+    assert stats["decode"]["p50_s"] == pytest.approx(1.0)
+
+
+def test_slo_tracker_books_blame_as_labeled_gauges():
+    from langstream_tpu.runtime.accounting import SLOTracker
+
+    tracker = SLOTracker(
+        {"ttft_ms_p95": 100, "tpot_ms_p95": 20},
+        {"ttft": Histogram("t_ttft"), "tpot": Histogram("t_tpot")},
+    )
+    tracker.attribute("ttft", "queue")
+    tracker.attribute("ttft", "queue")
+    tracker.attribute("tpot", "handoff_transit")
+    tracker.attribute("ttft", None)      # unblamable: dropped
+    tracker.attribute("nope", "queue")   # unknown kind: dropped
+    gauges = tracker.gauges(now=0.0)
+    assert gauges[
+        'jax_engine_slo_blame_total{kind="ttft",stage="queue"}'
+    ] == 2.0
+    assert gauges[
+        'jax_engine_slo_blame_total{kind="tpot",stage="handoff_transit"}'
+    ] == 1.0
+
+
+def test_trace_list_shows_replicas_crossed(tmp_path):
+    from langstream_tpu.runtime.tracing import run_trace_merge
+
+    dump = tmp_path / "trace_gateway.json"
+    dump.write_text(json.dumps({"traceEvents": [
+        {"name": "gateway.route", "cat": "gateway", "ph": "X",
+         "ts": 0, "dur": 10,
+         "args": {"trace_id": "t-x", "replica": "pf-0"}},
+        {"name": "engine.handoff_import", "cat": "engine", "ph": "X",
+         "ts": 20, "dur": 10,
+         "args": {"trace_id": "t-x", "replica": "dec-0"}},
+    ]}))
+    lines = run_trace_merge([str(tmp_path)], list_ids=True)
+    assert len(lines) == 1
+    assert "t-x" in lines[0]
+    assert "replicas=dec-0,pf-0" in lines[0]
+
+
+# ---------------------------------------------------------------------- #
+# the sim fleet: tiling, two-replica joins, slow-handoff blame
+# ---------------------------------------------------------------------- #
+@pytest.fixture(scope="module")
+def disagg_artifacts(tmp_path_factory):
+    from langstream_tpu.fleet import sim
+
+    out = tmp_path_factory.mktemp("journey_disagg")
+    record = asyncio.run(
+        sim.run_disagg_leg("disagg", replicas=4, journey_dir=str(out))
+    )
+    assert record["client_errors"] == 0
+    assert record["streams_exact"] is True
+    return record, str(out)
+
+
+def _joined(directory):
+    ledger = JourneyLedger()
+    for name in sorted(os.listdir(directory)):
+        if name.startswith("flight_") and name.endswith(".jsonl"):
+            ledger.add_artifact(os.path.join(directory, name))
+    return ledger
+
+
+def test_sim_disagg_journeys_tile_the_request_wall(disagg_artifacts):
+    record, directory = disagg_artifacts
+    assert record["journey_artifacts"]  # per-replica files + the router
+    ledger = _joined(directory)
+    journeys = ledger.journeys()
+    assert len(journeys) == record["sessions"]
+    for journey in journeys:
+        # THE tiling invariant: stages cover >= 95% of the e2e wall
+        # with zero overlapping and zero negative stages
+        assert journey.coverage() >= 0.95, journey.trace_id
+        assert journey.overlaps() == [], journey.trace_id
+        assert journey.negatives() == [], journey.trace_id
+        assert journey.finished
+        assert journey.missing_stages() == []
+        for admit_class in journey.admit_classes:
+            assert admit_class in ADMIT_CLASSES
+
+
+def test_sim_disagg_journey_crosses_two_replicas_with_transit(
+    disagg_artifacts,
+):
+    _, directory = disagg_artifacts
+    ledger = _joined(directory)
+    crossed = [j for j in ledger.journeys() if len(j.replicas) > 1]
+    assert crossed  # the disagg path: prefill pool -> decode pool
+    for journey in crossed:
+        names = {s["stage"] for s in journey.stages}
+        # the hop is visible end to end: export on the prefill leg,
+        # transit computed from the chunk-0 manifest's export stamp,
+        # import on the decode leg
+        assert {"handoff_export", "handoff_transit",
+                "handoff_import"} <= names
+        assert "handoff-import" in journey.admit_classes
+        transit = journey.stage_totals()["handoff_transit"]
+        assert transit >= 0.0
+        # the route stages name the replicas the fleet router picked
+        routes = [s for s in journey.stages if s["stage"] == "route"]
+        assert routes and all(s.get("replica") for s in routes)
+    # per-replica artifacts carry the roles the ledger reports
+    assert "prefill" in ledger.replicas.values()
+    assert "decode" in ledger.replicas.values()
+    assert "router" in ledger.replicas.values()
+
+
+def test_sim_slow_handoff_blamed_on_transit_by_the_cli(tmp_path):
+    from langstream_tpu.fleet import sim
+
+    record = asyncio.run(sim.run_disagg_leg(
+        "disagg", replicas=4, journey_dir=str(tmp_path),
+        # parked below handoff_timeout_s (10s) so the orphan sweep
+        # does not fall the sessions back to a cold re-route
+        slow_handoff_s=5.0,
+    ))
+    assert record["client_errors"] == 0
+    ledger = _joined(str(tmp_path))
+    blame = ledger.blame_table(slo_tpot_s=0.5)
+    assert blame["tpot"]
+    assert max(blame["tpot"], key=blame["tpot"].get) == "handoff_transit"
+    # and through the CLI body itself (``langstream-tpu journey``)
+    lines = run_journey([str(tmp_path)], slo_tpot_ms=500.0)
+    blamed = [
+        line for line in lines
+        if "tpot" in line and "handoff_transit" in line
+    ]
+    assert blamed, lines
+    # a waterfall for one crossed journey renders both replicas
+    crossed = next(
+        j for j in ledger.journeys() if len(j.replicas) > 1
+    )
+    waterfall = run_journey(
+        [str(tmp_path)], trace_id=crossed.trace_id,
+    )
+    assert any("handoff_transit" in line for line in waterfall)
+    assert any("replicas=" in line and ">" in line for line in waterfall)
+
+
+def test_journey_cli_unknown_inputs_fail_loudly(tmp_path):
+    with pytest.raises(SystemExit):
+        run_journey([str(tmp_path)])  # no artifacts at all
+    artifact = tmp_path / "flight_x.jsonl"
+    artifact.write_text(json.dumps({
+        "ts": 0.0, "kind": "journey", "trace_id": "t-1",
+        "stages": [{"stage": "queue", "start": 0.0, "end": 1.0}],
+    }) + "\n")
+    with pytest.raises(SystemExit):
+        run_journey([str(tmp_path)], trace_id="no-such-trace")
+    # a torn journey (core stages missing) renders, never crashes
+    lines = run_journey([str(tmp_path)])
+    assert any("torn journey" in line for line in lines)
+    doc = json.loads(run_journey([str(tmp_path)], as_json=True)[0])
+    assert doc["journeys"][0]["missing_stages"]
+
+
+# ---------------------------------------------------------------------- #
+# the real engine: journey records tile, disagg legs grow transit
+# ---------------------------------------------------------------------- #
+@pytest.fixture(scope="module")
+def tiny():
+    from langstream_tpu.providers.jax_local.model import (
+        LlamaConfig,
+        init_params,
+    )
+
+    config = LlamaConfig.tiny(max_seq_len=512)
+    return config, init_params(config)
+
+
+def _engine(tiny, **overrides):
+    from langstream_tpu.providers.jax_local.engine import DecodeEngine
+
+    config, params = tiny
+    kwargs = dict(
+        max_slots=4, max_seq_len=512,
+        prefill_buckets=[16, 32, 64, 128, 256], decode_chunk=4,
+        seed=11, kv_layout="paged", kv_block_size=16,
+    )
+    kwargs.update(overrides)
+    return DecodeEngine(config, params, **kwargs)
+
+
+def _run(engine, prompt, sampling_kwargs, **kw):
+    from langstream_tpu.providers.jax_local.engine import SamplingParams
+
+    async def main():
+        return await engine.generate(
+            list(prompt), SamplingParams(**sampling_kwargs), **kw
+        )
+
+    return asyncio.run(main())
+
+
+PROMPT = [(i * 7) % 250 + 1 for i in range(260)]  # >=256-token prefix
+GREEDY = dict(max_new_tokens=8)
+
+
+def _journeys_on_disk(flight_dir):
+    from langstream_tpu.runtime import flight
+
+    flight.flush()
+    ledger = JourneyLedger()
+    for name in sorted(os.listdir(flight_dir)):
+        ledger.add_artifact(os.path.join(flight_dir, name))
+    return ledger
+
+
+def test_engine_emits_tiling_journeys_and_transit_on_import(
+    tiny, tmp_path,
+):
+    from langstream_tpu.fleet.handoff import (
+        HandoffAssembler,
+        handoff_records,
+        manifest_for_request,
+    )
+    from langstream_tpu.runtime import flight
+
+    flight_dir = str(tmp_path / "flight")
+    saved = (flight.RECORDER.path, dict(flight.RECORDER.identity))
+    flight.RECORDER.path = None
+    flight.RECORDER._pending.clear()
+    flight.set_identity("journey-engine-a", "unified")
+    flight.configure(flight_dir)
+    engine_a = _engine(tiny)
+    engine_b = _engine(tiny)
+    try:
+        # plain leg: one journey record whose stages tile the request
+        result = _run(engine_a, PROMPT, GREEDY, trace_id="jt-plain")
+        assert result.finish_reason in ("stop", "length")
+        ledger = _journeys_on_disk(flight_dir)
+        plain = ledger.get("jt-plain")
+        assert plain is not None
+        assert plain.replicas == ["journey-engine-a"]
+        assert plain.coverage() >= 0.95
+        assert plain.overlaps() == []
+        assert plain.negatives() == []
+        assert plain.missing_stages() == []
+        assert plain.admit_classes == ["cold"]
+        assert plain.tokens == len(result.tokens)
+        assert plain.ttft_s() is not None
+
+        # disagg pair under ONE trace id: export leg on engine A, the
+        # manifest's export stamp crosses, and engine B's decode-leg
+        # journey grows handoff_transit + handoff_import stages
+        leg = _run(
+            engine_a, PROMPT, dict(GREEDY, max_new_tokens=2),
+            trace_id="jt-disagg",
+            request_fields={"export_handoff": True},
+        )
+        assert leg.kv_handoff is not None
+        manifest = manifest_for_request(
+            PROMPT, leg.tokens, dict(GREEDY), trace_id="jt-disagg",
+            export_ts=leg.kv_handoff["export_ts"],
+        )
+        assembled = None
+        asm = HandoffAssembler()
+        for record in handoff_records(
+            leg.kv_handoff, manifest, max_chunk_bytes=16 * 1024
+        ):
+            assembled = asm.offer(record, now=0.0) or assembled
+        assert assembled is not None
+        replay = list(assembled["manifest"]["generated"])
+        result_b = _run(
+            engine_b, PROMPT + replay[:-1],
+            assembled["manifest"]["sampling"],
+            trace_id="jt-disagg",
+            request_fields={
+                "kv_import": assembled["payload"],
+                "replay_tokens": replay,
+                "prompt_len": len(PROMPT),
+                "handoff_export_ts": assembled["manifest"]["export_ts"],
+            },
+        )
+        assert result_b.tokens  # the stream continued on the decode leg
+        ledger = _journeys_on_disk(flight_dir)
+        disagg = ledger.get("jt-disagg")
+        assert disagg is not None
+        names = [s["stage"] for s in disagg.stages]
+        assert "handoff_export" in names
+        assert "handoff_transit" in names
+        assert "handoff_import" in names
+        assert "handoff-import" in disagg.admit_classes
+        assert disagg.coverage() >= 0.95
+        assert disagg.negatives() == []
+        # each leg tiles on its own (StageBuilder guarantees it); the
+        # cross-leg join may overlap by the exporter's post-export
+        # bookkeeping (its finish stage runs while the payload is in
+        # transit), which stays far below any stage worth blaming
+        for record in disagg.records:
+            assert _journey_from([record]).overlaps() == []
+        assert sum(a for _, _, a in disagg.overlaps()) < 0.1
+        # both legs ran in one process: same replica label, but the
+        # transit stage still spans export stamp -> decode submit
+        assert disagg.stage_totals()["handoff_transit"] >= 0.0
+    finally:
+        engine_a.stop()
+        engine_b.stop()
+        flight.RECORDER.flush()
+        flight.RECORDER.path = saved[0]
+        flight.RECORDER.identity.clear()
+        flight.RECORDER.identity.update(saved[1])

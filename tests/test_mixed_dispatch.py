@@ -112,7 +112,13 @@ def _flat(q):
     return q.reshape(batch * width, *q.shape[2:]), qoffs, width
 
 
-@pytest.mark.parametrize("heads,kv_heads", [(4, 4), (4, 2), (8, 2)])
+@pytest.mark.parametrize("heads,kv_heads", [
+    (4, 4), (4, 2),
+    # (8, 2) re-checks the same GQA group packing at a wider head
+    # count — redundant with (4, 2) on the fast tier (ISSUE 20 budget:
+    # the journey suite rides tier-1 in its place)
+    pytest.param(8, 2, marks=pytest.mark.slow),
+])
 @pytest.mark.parametrize("softcap", [None, 30.0])
 def test_ragged_q_matches_reference(heads, kv_heads, softcap):
     q, k_pool, v_pool, tables, starts, totals = _mixed_case(

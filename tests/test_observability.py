@@ -75,9 +75,32 @@ def _sample_exposition() -> str:
         # fleet layer (ISSUE 11): the admission backlog the router's
         # least-queue fallback and the autoscaler's pressure math read
         "jax_engine_queue_depth": 2.0,
+        # request-journey ledger (ISSUE 20): per-stage SLO blame —
+        # violating requests counted by their dominant journey stage
+        'jax_engine_slo_blame_total{kind="ttft",stage="queue"}': 2.0,
+        'jax_engine_slo_blame_total{kind="tpot",stage="handoff_transit"}':
+            1.0,
     }
+    # request-journey ledger (ISSUE 20): per-stage latency histogram
+    # families (jax_engine_journey_<stage>_seconds) — fresh Histograms
+    # with the ledger's buckets, NOT the process-global STAGE_SECONDS
+    # (other tests observe into those; the golden must be deterministic)
+    from langstream_tpu.runtime.journey import _STAGE_BUCKETS
+
+    histograms = reporter.histogram_snapshots()
+    for stage, values in (
+        ("queue", (0.004, 0.02, 0.02)),
+        ("handoff_transit", (0.3, 4.0)),
+    ):
+        stage_histogram = Histogram(
+            f"jax_engine_journey_{stage}_seconds",
+            buckets=_STAGE_BUCKETS,
+        )
+        for value in values:
+            stage_histogram.observe(value)
+        histograms[stage_histogram.name] = stage_histogram.snapshot()
     return prometheus_text(
-        reporter.snapshot(), gauges, reporter.histogram_snapshots(),
+        reporter.snapshot(), gauges, histograms,
         help_texts={
             "jax_engine_slot_occupancy":
                 "mean fraction of decode slots active",
@@ -127,6 +150,14 @@ def _sample_exposition() -> str:
             "jax_engine_queue_depth":
                 "requests waiting for a decode slot (submit queue +"
                 " admission pending); the fleet routing/scaling signal",
+            "jax_engine_slo_blame_total":
+                "SLO-violating requests by kind (ttft/tpot) and the"
+                " journey stage that dominated the violated window",
+            "jax_engine_journey_queue_seconds":
+                "request-journey stage latency: admission queue wait",
+            "jax_engine_journey_handoff_transit_seconds":
+                "request-journey stage latency: KV handoff fabric"
+                " transit (export stamp to decode-side arrival)",
         },
     )
 

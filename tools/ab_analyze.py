@@ -267,6 +267,21 @@ def flight_summary(art_dir: str) -> Optional[str]:
         if e.get("kind") in ("engine_crash", "bench_failure")
     ]
     lines = [f"# Flight recorder ({os.path.basename(path)})\n"]
+    # fleet identity rides the meta record(s) — supplementary meta
+    # (post-configure set_identity) comes later, so the last one wins
+    identity: Dict[str, Any] = {}
+    for entry in entries:
+        if entry.get("kind") == "meta":
+            identity.update({
+                key: entry[key]
+                for key in ("replica", "fleet_role")
+                if entry.get(key)
+            })
+    if identity:
+        lines.append(
+            f"  replica: {identity.get('replica', '?')} "
+            f"[{identity.get('fleet_role', 'unified')}]"
+        )
     if phases:
         lines.append(
             "  phases: " + " -> ".join(str(p.get("name")) for p in phases)
@@ -468,6 +483,28 @@ def flight_summary(art_dir: str) -> Optional[str]:
     return "\n".join(lines)
 
 
+def journey_summary(art_dir: str) -> Optional[str]:
+    """Per-stage journey digest over every flight artifact under
+    ``<art_dir>/flight`` — stage p50/p95, cross-replica journey count,
+    and the dominant stage (``langstream-tpu journey`` renders the full
+    waterfalls). None when no journey records exist (pre-ledger
+    artifacts) or the package is unimportable."""
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    try:
+        from langstream_tpu.runtime.journey import journey_digest
+    except Exception:  # noqa: BLE001 — analyzer must not need the package
+        return None
+    try:
+        lines = journey_digest(os.path.join(art_dir, "flight"))
+    except Exception:  # noqa: BLE001 — torn artifacts must not kill the report
+        return None
+    if not lines:
+        return None
+    return "\n".join(["# Request journeys\n"] + lines)
+
+
 def main() -> None:
     art_dir = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
@@ -492,6 +529,10 @@ def main() -> None:
     if flight_digest:
         print(flight_digest)
         print()
+        journey_digest_text = journey_summary(art_dir)
+        if journey_digest_text:
+            print(journey_digest_text)
+            print()
     else:
         # distinguish "legs ran without evidence" from a clean run: the
         # efficiency columns (MFU/MBU, goodput) come FROM the flight

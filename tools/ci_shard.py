@@ -108,6 +108,10 @@ SHARDS: Dict[str, List[str]] = {
         # machinery are pure-CPU; the real-engine bitwise-parity legs
         # are JAX-heavy but belong with the fleet story they verify
         "test_disagg",
+        # request-journey ledger: stage tiling, cross-replica joins,
+        # SLO blame — mostly pure-CPU sim legs plus one real-engine
+        # tiling leg, verifying fleet-wide observability
+        "test_journey",
     ],
     # static analysis (`langstream-tpu check`): lock-discipline +
     # jit-hazard AST fixtures, the HLO rule library, and the repo-wide
